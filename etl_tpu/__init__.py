@@ -1,0 +1,25 @@
+"""etl_tpu — TPU-native Postgres logical-replication ETL framework.
+
+A ground-up re-design of the capability surface of supabase/etl
+(/root/reference, Rust) for the TPU stack: the control plane and the
+Postgres protocol plane run on host (asyncio + a C hot path for framing),
+while the WAL-decode / CDC row-transform hot loop — pgoutput tuple decode,
+COPY text decode, type coercion, publication filtering, row→columnar
+transpose — runs on TPU via JAX/Pallas as fixed-shape, column-parallel
+programs over ragged byte batches.
+
+Layer map (mirrors reference SURVEY.md §1):
+  models/        data model: LSN, schema+masks, cells, events, errors
+  config/        typed config + YAML/env loader        (ref: etl-config)
+  postgres/      wire protocol, replication client, CPU codecs
+                                                       (ref: crates/etl/src/postgres)
+  ops/           TPU decode engine: staging + jitted/Pallas decode kernels
+  parallel/      device mesh + shard_map data/column-parallel decode
+  runtime/       pipeline, apply loop, table-sync workers, backpressure
+                                                       (ref: crates/etl/src/{replication,runtime})
+  store/         state/schema stores (memory, postgres) (ref: crates/etl/src/store)
+  destinations/  Destination implementations            (ref: crates/etl-destinations)
+  telemetry/     metrics + tracing                      (ref: crates/etl-telemetry)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,180 @@
+"""Cell value helpers: the Python value vocabulary for decoded columns.
+
+Design: values are plain Python objects (None, bool, int, float,
+decimal.Decimal, datetime.*, uuid.UUID, bytes, str, list) and the schema
+carries the type (see models/pgtypes.py). This file provides the few value
+types Python lacks natively, mirroring the reference's special codecs:
+
+  - PgNumeric  → decimal.Decimal subclass keeping Postgres NaN semantics
+                 (reference: crates/etl-postgres/src/numeric.rs, 967 LoC —
+                 Python's Decimal already implements exact arbitrary
+                 precision + NaN/±Infinity, so no hand-rolled codec needed)
+  - PgTimeTz   → time-of-day with fixed UTC offset
+                 (reference: crates/etl-postgres/src/time.rs)
+  - PgInterval → months/days/microseconds triple (Postgres' interval model)
+
+`py_value_kind` classifies a Python value back to a CellKind for schema
+inference in tests and destinations.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import decimal
+import uuid
+from dataclasses import dataclass
+
+from .pgtypes import CellKind
+
+Decimal = decimal.Decimal
+
+
+class PgNumeric(Decimal):
+    """Postgres NUMERIC. Subclass of Decimal; exists so destinations can
+    distinguish 'came from a numeric column' and so NaN formats as the
+    Postgres literal `NaN` rather than Python's `NaN` quirks."""
+
+    __slots__ = ()
+
+    def pg_text(self) -> str:
+        if self.is_nan():
+            return "NaN"
+        if self.is_infinite():
+            return "Infinity" if self > 0 else "-Infinity"
+        return format(self, "f")
+
+
+@dataclass(frozen=True, slots=True)
+class PgTimeTz:
+    """Time of day with a fixed UTC offset (reference PgTimeTz,
+    crates/etl-postgres/src/time.rs)."""
+
+    time: dt.time  # naive time-of-day
+    offset_seconds: int  # seconds east of UTC (pg: +HH:MM:SS)
+
+    def pg_text(self) -> str:
+        t = self.time.isoformat()
+        off = self.offset_seconds
+        sign = "+" if off >= 0 else "-"
+        off = abs(off)
+        h, rem = divmod(off, 3600)
+        m, s = divmod(rem, 60)
+        out = f"{t}{sign}{h:02d}"
+        if m or s:
+            out += f":{m:02d}"
+        if s:
+            out += f":{s:02d}"
+        return out
+
+
+@dataclass(frozen=True, slots=True)
+class PgInterval:
+    """Postgres interval: months / days / microseconds are separate units
+    (they do not normalize into each other)."""
+
+    months: int = 0
+    days: int = 0
+    microseconds: int = 0
+
+    def pg_text(self) -> str:
+        parts = []
+        if self.months:
+            y, m = divmod(abs(self.months), 12)
+            sign = "-" if self.months < 0 else ""
+            if y:
+                parts.append(f"{sign}{y} year" + ("s" if y != 1 else ""))
+            if m:
+                parts.append(f"{sign}{m} mon" + ("s" if m != 1 else ""))
+        if self.days:
+            parts.append(f"{self.days} day" + ("s" if abs(self.days) != 1 else ""))
+        us = self.microseconds
+        if us or not parts:
+            neg = us < 0
+            us = abs(us)
+            h, rem = divmod(us, 3_600_000_000)
+            mi, rem = divmod(rem, 60_000_000)
+            s, frac = divmod(rem, 1_000_000)
+            t = f"{'-' if neg else ''}{h:02d}:{mi:02d}:{s:02d}"
+            if frac:
+                t += f".{frac:06d}".rstrip("0")
+            parts.append(t)
+        return " ".join(parts)
+
+
+@dataclass(frozen=True, slots=True)
+class PgSpecialDate:
+    """A date outside Python's datetime range (BC dates; Python MINYEAR=1
+    while Postgres reaches 4713 BC). Carries the exact proleptic-Gregorian
+    day count since 1970-01-01 (negative) plus the source text, so dense
+    columnar staging and Arrow date32 output stay exact."""
+
+    days: int
+    text: str
+
+    def pg_text(self) -> str:
+        return self.text
+
+
+@dataclass(frozen=True, slots=True)
+class PgSpecialTimestamp:
+    """A timestamp outside Python's datetime range (BC timestamps). Exact
+    microseconds since the unix epoch (negative) plus source text."""
+
+    micros: int
+    text: str
+    tz_aware: bool = False
+
+    def pg_text(self) -> str:
+        return self.text
+
+
+_KIND_BY_PYTYPE = (
+    (bool, CellKind.BOOL),
+    (PgSpecialDate, CellKind.DATE),
+    (PgSpecialTimestamp, CellKind.TIMESTAMP),
+    (int, CellKind.I64),
+    (float, CellKind.F64),
+    (PgNumeric, CellKind.NUMERIC),
+    (Decimal, CellKind.NUMERIC),
+    (str, CellKind.STRING),
+    (bytes, CellKind.BYTES),
+    (dt.datetime, CellKind.TIMESTAMP),
+    (dt.date, CellKind.DATE),
+    (PgTimeTz, CellKind.TIMETZ),
+    (dt.time, CellKind.TIME),
+    (uuid.UUID, CellKind.UUID),
+    (PgInterval, CellKind.INTERVAL),
+    (list, CellKind.ARRAY),
+)
+
+
+def py_value_kind(value) -> CellKind:
+    """Classify a decoded Python value back to its CellKind."""
+    if value is None:
+        return CellKind.NULL
+    for pytype, kind in _KIND_BY_PYTYPE:
+        if isinstance(value, pytype):
+            if kind is CellKind.TIMESTAMP and value.tzinfo is not None:
+                return CellKind.TIMESTAMPTZ
+            return kind
+    if isinstance(value, dict):
+        return CellKind.JSON
+    return CellKind.STRING
+
+
+class ToastUnchanged:
+    """Sentinel for a TOASTed value pgoutput did not re-send ('u' tuple kind;
+    reference: codec/event.rs TOAST-unchanged handling). Singleton."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "TOAST_UNCHANGED"
+
+
+TOAST_UNCHANGED = ToastUnchanged()

@@ -1,0 +1,193 @@
+"""Error taxonomy and retry directives.
+
+Reference parity: ~60-variant `ErrorKind` (crates/etl/src/error.rs:85-210),
+multi-error aggregation, and `RetryDirective::{Timed, Manual, NoRetry}`
+produced by `build_error_handling_policy` (crates/etl/src/runtime/error_policy.rs)
+and shared by the apply worker and table-sync workers.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+
+class ErrorKind(enum.Enum):
+    # --- source / connection class ---
+    SOURCE_CONNECTION_FAILED = enum.auto()
+    SOURCE_IO = enum.auto()
+    SOURCE_QUERY_FAILED = enum.auto()
+    SOURCE_AUTH_FAILED = enum.auto()
+    SOURCE_TLS_FAILED = enum.auto()
+    SOURCE_PROTOCOL_VIOLATION = enum.auto()
+    SOURCE_UNSUPPORTED_VERSION = enum.auto()
+    SOURCE_SHUTTING_DOWN = enum.auto()
+
+    # --- replication class ---
+    SLOT_NOT_FOUND = enum.auto()
+    SLOT_ALREADY_EXISTS = enum.auto()
+    SLOT_INVALIDATED = enum.auto()
+    SLOT_IN_USE = enum.auto()
+    SLOT_NAME_TOO_LONG = enum.auto()
+    PUBLICATION_NOT_FOUND = enum.auto()
+    PUBLICATION_TABLE_MISSING = enum.auto()
+    REPLICATION_STREAM_FAILED = enum.auto()
+    REPLICATION_MESSAGE_INVALID = enum.auto()
+    SNAPSHOT_EXPORT_FAILED = enum.auto()
+    WAL_DECODE_FAILED = enum.auto()
+
+    # --- data / conversion class ---
+    ROW_CONVERSION_FAILED = enum.auto()
+    UNSUPPORTED_TYPE = enum.auto()
+    NULL_CONSTRAINT_VIOLATION = enum.auto()
+    INVALID_DATA = enum.auto()
+    COPY_FORMAT_INVALID = enum.auto()
+
+    # --- schema class ---
+    SCHEMA_NOT_FOUND = enum.auto()
+    SCHEMA_MISMATCH = enum.auto()
+    SCHEMA_CHANGE_UNSUPPORTED = enum.auto()
+    MISSING_PRIMARY_KEY = enum.auto()
+    SCHEMA_SNAPSHOT_INVALID = enum.auto()
+
+    # --- state / store class ---
+    STATE_STORE_FAILED = enum.auto()
+    STATE_ROLLBACK_FAILED = enum.auto()
+    INVALID_STATE_TRANSITION = enum.auto()
+    STORE_SERIALIZATION_FAILED = enum.auto()
+    PROGRESS_REGRESSION = enum.auto()
+
+    # --- destination class ---
+    DESTINATION_FAILED = enum.auto()
+    DESTINATION_CONNECTION_FAILED = enum.auto()
+    DESTINATION_AUTH_FAILED = enum.auto()
+    DESTINATION_SCHEMA_FAILED = enum.auto()
+    DESTINATION_THROTTLED = enum.auto()
+    DESTINATION_PAYLOAD_TOO_LARGE = enum.auto()
+
+    # --- runtime class ---
+    WORKER_PANICKED = enum.auto()
+    WORKER_CANCELLED = enum.auto()
+    SHUTDOWN_REQUESTED = enum.auto()
+    TIMEOUT = enum.auto()
+    MEMORY_PRESSURE_ABORT = enum.auto()
+    BATCH_OVERFLOW = enum.auto()
+
+    # --- device (TPU) class — no reference counterpart ---
+    DEVICE_DECODE_FAILED = enum.auto()
+    DEVICE_UNAVAILABLE = enum.auto()
+    DEVICE_STAGING_OVERFLOW = enum.auto()
+
+    # --- config class ---
+    CONFIG_INVALID = enum.auto()
+    CONFIG_MISSING = enum.auto()
+
+    # --- generic ---
+    UNKNOWN = enum.auto()
+
+
+class RetryKind(enum.Enum):
+    """How a failure should be retried (reference RetryDirective,
+    runtime/error_policy.rs)."""
+
+    TIMED = "timed"  # automatic retry with backoff
+    MANUAL = "manual"  # park as Errored until operator intervention
+    NO_RETRY = "no_retry"  # fatal: propagate and stop the worker
+
+
+@dataclass(frozen=True, slots=True)
+class RetryDirective:
+    kind: RetryKind
+    # for TIMED: delay schedule handled by RetryConfig; attempts escalate to
+    # MANUAL after max_attempts (reference table_sync/worker.rs:393-532)
+
+
+class EtlError(Exception):
+    """Framework error carrying one or more ErrorKinds (multi-error
+    aggregation parity with reference error.rs `EtlError::Many`)."""
+
+    def __init__(self, kind: ErrorKind, detail: str = "", *,
+                 causes: Sequence["EtlError"] | None = None):
+        self.kind = kind
+        self.detail = detail
+        self.causes: tuple[EtlError, ...] = tuple(causes or ())
+        super().__init__(f"{kind.name}: {detail}" if detail else kind.name)
+
+    def kinds(self) -> list[ErrorKind]:
+        out = [self.kind]
+        for c in self.causes:
+            out.extend(c.kinds())
+        return out
+
+    @classmethod
+    def many(cls, errors: Iterable["EtlError"]) -> "EtlError":
+        errs = list(errors)
+        if len(errs) == 1:
+            return errs[0]
+        return cls(ErrorKind.UNKNOWN, f"{len(errs)} errors: " +
+                   "; ".join(str(e) for e in errs), causes=errs)
+
+    def __repr__(self) -> str:
+        return f"EtlError({self.kind.name}, {self.detail!r})"
+
+
+def etl_error(kind: ErrorKind, detail: str = "") -> EtlError:
+    return EtlError(kind, detail)
+
+
+# kinds that indicate transient conditions worth automatic retry
+_TIMED_KINDS = frozenset({
+    ErrorKind.SOURCE_CONNECTION_FAILED,
+    ErrorKind.SOURCE_IO,
+    ErrorKind.SOURCE_QUERY_FAILED,
+    ErrorKind.REPLICATION_STREAM_FAILED,
+    ErrorKind.SNAPSHOT_EXPORT_FAILED,
+    ErrorKind.SLOT_IN_USE,
+    ErrorKind.STATE_STORE_FAILED,
+    ErrorKind.DESTINATION_FAILED,
+    ErrorKind.DESTINATION_CONNECTION_FAILED,
+    ErrorKind.DESTINATION_THROTTLED,
+    ErrorKind.TIMEOUT,
+    ErrorKind.WORKER_PANICKED,
+    ErrorKind.DEVICE_UNAVAILABLE,
+    ErrorKind.UNKNOWN,
+})
+
+# kinds that are permanent but operator-fixable: park the table, don't retry
+_MANUAL_KINDS = frozenset({
+    ErrorKind.SOURCE_AUTH_FAILED,
+    ErrorKind.SOURCE_TLS_FAILED,
+    ErrorKind.SOURCE_UNSUPPORTED_VERSION,
+    ErrorKind.SLOT_INVALIDATED,
+    ErrorKind.PUBLICATION_NOT_FOUND,
+    ErrorKind.PUBLICATION_TABLE_MISSING,
+    ErrorKind.MISSING_PRIMARY_KEY,
+    ErrorKind.SCHEMA_MISMATCH,
+    ErrorKind.SCHEMA_CHANGE_UNSUPPORTED,
+    ErrorKind.UNSUPPORTED_TYPE,
+    ErrorKind.ROW_CONVERSION_FAILED,
+    ErrorKind.INVALID_DATA,
+    ErrorKind.COPY_FORMAT_INVALID,
+    ErrorKind.DESTINATION_AUTH_FAILED,
+    ErrorKind.DESTINATION_SCHEMA_FAILED,
+    ErrorKind.DESTINATION_PAYLOAD_TOO_LARGE,
+    ErrorKind.CONFIG_INVALID,
+    ErrorKind.CONFIG_MISSING,
+    ErrorKind.DEVICE_DECODE_FAILED,
+})
+
+
+def retry_directive(error: EtlError) -> RetryDirective:
+    """Map an error to its retry directive (reference
+    build_error_handling_policy, runtime/error_policy.rs). Aggregated errors
+    take the most conservative directive of their parts
+    (NO_RETRY > MANUAL > TIMED)."""
+    kinds = set(error.kinds())
+    if ErrorKind.SHUTDOWN_REQUESTED in kinds or ErrorKind.WORKER_CANCELLED in kinds:
+        return RetryDirective(RetryKind.NO_RETRY)
+    if kinds & _MANUAL_KINDS:
+        return RetryDirective(RetryKind.MANUAL)
+    if kinds & _TIMED_KINDS:
+        return RetryDirective(RetryKind.TIMED)
+    return RetryDirective(RetryKind.TIMED)

@@ -1,0 +1,183 @@
+"""Replication events.
+
+Reference parity: `Event` enum Begin/Commit/Insert/Update/Delete/Truncate/
+Relation each carrying `start_lsn`, `commit_lsn`, `tx_ordinal` and its
+`ReplicatedTableSchema` (crates/etl/src/event.rs:21-320);
+`EventSequenceKey = commit_lsn/tx_ordinal` (event.rs:323).
+
+TPU-first addition: `DecodedBatchEvent` — a run of same-table row changes
+already decoded into a `ColumnarBatch` by the device engine, with per-row
+change types and ordinals. The CPU path emits per-row events; the TPU path
+emits batch events. Destinations accept both (destinations/base.py expands
+batches for row-oriented writers).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Sequence, Union
+
+import numpy as np
+
+from .lsn import Lsn
+from .schema import ReplicatedTableSchema, TableId
+from .table_row import ColumnarBatch, PartialTableRow, TableRow
+
+
+@dataclass(frozen=True, slots=True, order=True)
+class EventSequenceKey:
+    """Total order of row changes within the WAL stream: commit LSN of the
+    owning transaction, then statement ordinal within it (event.rs:323)."""
+
+    commit_lsn: Lsn
+    tx_ordinal: int
+
+    def with_ordinal(self, ordinal: int) -> str:
+        """Hex sequence string used by CDC destinations (reference BigQuery
+        `_CHANGE_SEQUENCE_NUMBER`, bigquery/core.rs:980-996)."""
+        return f"{int(self.commit_lsn):016x}/{self.tx_ordinal:016x}/{ordinal:016x}"
+
+    def __str__(self) -> str:
+        return f"{self.commit_lsn}/{self.tx_ordinal}"
+
+
+class ChangeType(enum.IntEnum):
+    INSERT = 0
+    UPDATE = 1
+    DELETE = 2
+
+
+@dataclass(slots=True)
+class BeginEvent:
+    start_lsn: Lsn
+    commit_lsn: Lsn  # final LSN announced by the BEGIN message
+    timestamp_us: int  # pg epoch-2000 micros converted to unix micros
+    xid: int
+
+
+@dataclass(slots=True)
+class CommitEvent:
+    start_lsn: Lsn
+    commit_lsn: Lsn
+    end_lsn: Lsn
+    timestamp_us: int
+    flags: int = 0
+
+
+@dataclass(slots=True)
+class RelationEvent:
+    start_lsn: Lsn
+    commit_lsn: Lsn
+    schema: ReplicatedTableSchema
+
+
+@dataclass(slots=True)
+class InsertEvent:
+    start_lsn: Lsn
+    commit_lsn: Lsn
+    tx_ordinal: int
+    schema: ReplicatedTableSchema
+    row: TableRow
+
+    @property
+    def sequence_key(self) -> EventSequenceKey:
+        return EventSequenceKey(self.commit_lsn, self.tx_ordinal)
+
+
+@dataclass(slots=True)
+class UpdateEvent:
+    start_lsn: Lsn
+    commit_lsn: Lsn
+    tx_ordinal: int
+    schema: ReplicatedTableSchema
+    row: TableRow
+    # old identity values when replica identity produced them ('K'/'O' tuples);
+    # merged-by-identity-mask semantics live in the codec (codec/event.rs:28-50)
+    old_row: PartialTableRow | TableRow | None = None
+
+    @property
+    def sequence_key(self) -> EventSequenceKey:
+        return EventSequenceKey(self.commit_lsn, self.tx_ordinal)
+
+
+@dataclass(slots=True)
+class DeleteEvent:
+    start_lsn: Lsn
+    commit_lsn: Lsn
+    tx_ordinal: int
+    schema: ReplicatedTableSchema
+    old_row: PartialTableRow | TableRow
+
+    @property
+    def sequence_key(self) -> EventSequenceKey:
+        return EventSequenceKey(self.commit_lsn, self.tx_ordinal)
+
+
+@dataclass(slots=True)
+class TruncateEvent:
+    start_lsn: Lsn
+    commit_lsn: Lsn
+    tx_ordinal: int
+    options: int  # bit 1: CASCADE, bit 2: RESTART IDENTITY
+    schemas: tuple[ReplicatedTableSchema, ...]
+
+    @property
+    def cascade(self) -> bool:
+        return bool(self.options & 1)
+
+    @property
+    def restart_identity(self) -> bool:
+        return bool(self.options & 2)
+
+
+@dataclass(slots=True)
+class SchemaChangeEvent:
+    """DDL logical message emitted by the source event trigger
+    (reference: apply.rs:2160-2277 + migrations/source/...schema_change_messages.up.sql)."""
+
+    start_lsn: Lsn
+    commit_lsn: Lsn
+    table_id: TableId
+    new_schema: ReplicatedTableSchema | None  # None = table dropped
+
+
+@dataclass(slots=True)
+class DecodedBatchEvent:
+    """TPU-path event: a contiguous same-table run of changes decoded on
+    device into columnar form. `change_types[i]` and `tx_ordinals[i]` /
+    `commit_lsns[i]` give each row its identity in the WAL order."""
+
+    start_lsn: Lsn
+    commit_lsn: Lsn
+    schema: ReplicatedTableSchema
+    batch: ColumnarBatch
+    change_types: np.ndarray  # uint8[n] of ChangeType
+    commit_lsns: np.ndarray  # uint64[n]
+    tx_ordinals: np.ndarray  # uint64[n]
+
+    def __len__(self) -> int:
+        return self.batch.num_rows
+
+
+Event = Union[
+    BeginEvent, CommitEvent, RelationEvent, InsertEvent, UpdateEvent,
+    DeleteEvent, TruncateEvent, SchemaChangeEvent, DecodedBatchEvent,
+]
+
+ROW_EVENT_TYPES = (InsertEvent, UpdateEvent, DeleteEvent)
+
+
+def event_size_hint(e: Event) -> int:
+    """Byte-size estimate for batch budgeting (reference: size hints consumed
+    by EventBatch, apply.rs:633)."""
+    if isinstance(e, (InsertEvent, UpdateEvent)):
+        base = 64 + e.row.size_hint()
+        if isinstance(e, UpdateEvent) and e.old_row is not None:
+            base += e.old_row.size_hint()
+        return base
+    if isinstance(e, DeleteEvent):
+        return 64 + e.old_row.size_hint()
+    if isinstance(e, DecodedBatchEvent):
+        return 64 + e.batch.size_hint() + e.change_types.nbytes + 16 * len(e)
+    return 64

@@ -1,0 +1,52 @@
+"""Postgres WAL log sequence numbers.
+
+Reference: the Rust build uses `tokio_postgres::types::PgLsn` (a u64 with
+an `X/Y` hex display form) throughout `crates/etl/src/replication/apply.rs`
+and the store progress rows. Here an LSN is a plain int subclass so it is
+hashable, ordered, JSON-serializable, and free to pass across the host/device
+boundary as a uint64.
+"""
+
+from __future__ import annotations
+
+
+class Lsn(int):
+    """A 64-bit WAL position. Displays as Postgres' `XXXXXXXX/XXXXXXXX`."""
+
+    __slots__ = ()
+
+    ZERO: "Lsn"
+    MAX: "Lsn"
+
+    def __new__(cls, value: "int | str" = 0) -> "Lsn":
+        if isinstance(value, str):
+            value = cls._parse(value)
+        if not 0 <= value <= 0xFFFF_FFFF_FFFF_FFFF:
+            raise ValueError(f"LSN out of range: {value}")
+        return super().__new__(cls, value)
+
+    @staticmethod
+    def _parse(text: str) -> int:
+        hi, sep, lo = text.partition("/")
+        if not sep:
+            raise ValueError(f"invalid LSN {text!r}: missing '/'")
+        try:
+            return (int(hi, 16) << 32) | int(lo, 16)
+        except ValueError as exc:
+            raise ValueError(f"invalid LSN {text!r}") from exc
+
+    def __str__(self) -> str:
+        return f"{int(self) >> 32:X}/{int(self) & 0xFFFF_FFFF:X}"
+
+    def __repr__(self) -> str:
+        return f"Lsn({str(self)!r})"
+
+    def __add__(self, other: int) -> "Lsn":
+        return Lsn(int(self) + int(other))
+
+    def __sub__(self, other: int) -> int:  # distance in bytes
+        return int(self) - int(other)
+
+
+Lsn.ZERO = Lsn(0)
+Lsn.MAX = Lsn(0xFFFF_FFFF_FFFF_FFFF)

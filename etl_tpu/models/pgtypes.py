@@ -1,0 +1,182 @@
+"""Postgres type registry: OIDs → logical cell kinds.
+
+TPU-first divergence from the reference: the reference tags every value with
+its type (`Cell` enum, crates/etl/src/data/cell.rs:19). Here values are plain
+Python objects / columnar buffers and the *schema* carries the type, so that
+batches can be staged to the device as homogeneous typed columns without
+per-cell dispatch. `CellKind` is the logical type vocabulary shared by the
+CPU codecs, the TPU decode kernels, and the destinations.
+"""
+
+from __future__ import annotations
+
+from enum import IntEnum
+
+
+class Oid:
+    """Well-known pg_type OIDs (stable across all supported PG versions)."""
+
+    BOOL = 16
+    BYTEA = 17
+    CHAR = 18
+    NAME = 19
+    INT8 = 20
+    INT2 = 21
+    INT4 = 23
+    TEXT = 25
+    OID = 26
+    JSON = 114
+    XML = 142
+    FLOAT4 = 700
+    FLOAT8 = 701
+    BPCHAR = 1042
+    VARCHAR = 1043
+    DATE = 1082
+    TIME = 1083
+    TIMESTAMP = 1114
+    TIMESTAMPTZ = 1184
+    INTERVAL = 1186
+    TIMETZ = 1266
+    NUMERIC = 1700
+    UUID = 2950
+    JSONB = 3802
+
+    # array element → array oid
+    BOOL_ARRAY = 1000
+    BYTEA_ARRAY = 1001
+    CHAR_ARRAY = 1002
+    NAME_ARRAY = 1003
+    INT2_ARRAY = 1005
+    INT4_ARRAY = 1007
+    TEXT_ARRAY = 1009
+    INT8_ARRAY = 1016
+    FLOAT4_ARRAY = 1021
+    FLOAT8_ARRAY = 1022
+    OID_ARRAY = 1028
+    BPCHAR_ARRAY = 1014
+    VARCHAR_ARRAY = 1015
+    DATE_ARRAY = 1182
+    TIME_ARRAY = 1183
+    TIMESTAMP_ARRAY = 1115
+    TIMESTAMPTZ_ARRAY = 1185
+    INTERVAL_ARRAY = 1187
+    TIMETZ_ARRAY = 1270
+    NUMERIC_ARRAY = 1231
+    UUID_ARRAY = 2951
+    JSON_ARRAY = 199
+    JSONB_ARRAY = 3807
+
+
+class CellKind(IntEnum):
+    """Logical value types, mirroring the reference's Cell variants
+    (crates/etl/src/data/cell.rs:19-58) minus the per-value tagging."""
+
+    NULL = 0
+    BOOL = 1
+    STRING = 2
+    I16 = 3
+    I32 = 4
+    U32 = 5
+    I64 = 6
+    F32 = 7
+    F64 = 8
+    NUMERIC = 9
+    DATE = 10
+    TIME = 11
+    TIMETZ = 12
+    TIMESTAMP = 13
+    TIMESTAMPTZ = 14
+    UUID = 15
+    JSON = 16
+    BYTES = 17
+    ARRAY = 18
+    INTERVAL = 19
+
+
+# element-kind for arrays, by array OID
+_ARRAY_ELEM: dict[int, tuple[int, CellKind]] = {
+    Oid.BOOL_ARRAY: (Oid.BOOL, CellKind.BOOL),
+    Oid.BYTEA_ARRAY: (Oid.BYTEA, CellKind.BYTES),
+    Oid.CHAR_ARRAY: (Oid.CHAR, CellKind.STRING),
+    Oid.NAME_ARRAY: (Oid.NAME, CellKind.STRING),
+    Oid.INT2_ARRAY: (Oid.INT2, CellKind.I16),
+    Oid.INT4_ARRAY: (Oid.INT4, CellKind.I32),
+    Oid.TEXT_ARRAY: (Oid.TEXT, CellKind.STRING),
+    Oid.INT8_ARRAY: (Oid.INT8, CellKind.I64),
+    Oid.FLOAT4_ARRAY: (Oid.FLOAT4, CellKind.F32),
+    Oid.FLOAT8_ARRAY: (Oid.FLOAT8, CellKind.F64),
+    Oid.OID_ARRAY: (Oid.OID, CellKind.U32),
+    Oid.BPCHAR_ARRAY: (Oid.BPCHAR, CellKind.STRING),
+    Oid.VARCHAR_ARRAY: (Oid.VARCHAR, CellKind.STRING),
+    Oid.DATE_ARRAY: (Oid.DATE, CellKind.DATE),
+    Oid.TIME_ARRAY: (Oid.TIME, CellKind.TIME),
+    Oid.TIMESTAMP_ARRAY: (Oid.TIMESTAMP, CellKind.TIMESTAMP),
+    Oid.TIMESTAMPTZ_ARRAY: (Oid.TIMESTAMPTZ, CellKind.TIMESTAMPTZ),
+    Oid.INTERVAL_ARRAY: (Oid.INTERVAL, CellKind.INTERVAL),
+    Oid.TIMETZ_ARRAY: (Oid.TIMETZ, CellKind.TIMETZ),
+    Oid.NUMERIC_ARRAY: (Oid.NUMERIC, CellKind.NUMERIC),
+    Oid.UUID_ARRAY: (Oid.UUID, CellKind.UUID),
+    Oid.JSON_ARRAY: (Oid.JSON, CellKind.JSON),
+    Oid.JSONB_ARRAY: (Oid.JSONB, CellKind.JSON),
+}
+
+_SCALAR_KIND: dict[int, CellKind] = {
+    Oid.BOOL: CellKind.BOOL,
+    Oid.BYTEA: CellKind.BYTES,
+    Oid.CHAR: CellKind.STRING,
+    Oid.NAME: CellKind.STRING,
+    Oid.INT8: CellKind.I64,
+    Oid.INT2: CellKind.I16,
+    Oid.INT4: CellKind.I32,
+    Oid.TEXT: CellKind.STRING,
+    Oid.OID: CellKind.U32,
+    Oid.JSON: CellKind.JSON,
+    Oid.XML: CellKind.STRING,
+    Oid.FLOAT4: CellKind.F32,
+    Oid.FLOAT8: CellKind.F64,
+    Oid.BPCHAR: CellKind.STRING,
+    Oid.VARCHAR: CellKind.STRING,
+    Oid.DATE: CellKind.DATE,
+    Oid.TIME: CellKind.TIME,
+    Oid.TIMESTAMP: CellKind.TIMESTAMP,
+    Oid.TIMESTAMPTZ: CellKind.TIMESTAMPTZ,
+    Oid.INTERVAL: CellKind.INTERVAL,
+    Oid.TIMETZ: CellKind.TIMETZ,
+    Oid.NUMERIC: CellKind.NUMERIC,
+    Oid.UUID: CellKind.UUID,
+    Oid.JSONB: CellKind.JSON,
+}
+
+_NAMES: dict[int, str] = {
+    Oid.BOOL: "bool", Oid.BYTEA: "bytea", Oid.CHAR: "char", Oid.NAME: "name",
+    Oid.INT8: "int8", Oid.INT2: "int2", Oid.INT4: "int4", Oid.TEXT: "text",
+    Oid.OID: "oid", Oid.JSON: "json", Oid.XML: "xml", Oid.FLOAT4: "float4",
+    Oid.FLOAT8: "float8", Oid.BPCHAR: "bpchar", Oid.VARCHAR: "varchar",
+    Oid.DATE: "date", Oid.TIME: "time", Oid.TIMESTAMP: "timestamp",
+    Oid.TIMESTAMPTZ: "timestamptz", Oid.INTERVAL: "interval",
+    Oid.TIMETZ: "timetz", Oid.NUMERIC: "numeric", Oid.UUID: "uuid",
+    Oid.JSONB: "jsonb",
+}
+
+
+def kind_for_oid(oid: int) -> CellKind:
+    """Logical kind for a pg_type OID; unknown OIDs decode as STRING, matching
+    the reference's fall-through to `Cell::String` for unsupported types."""
+    if oid in _ARRAY_ELEM:
+        return CellKind.ARRAY
+    return _SCALAR_KIND.get(oid, CellKind.STRING)
+
+
+def array_element(oid: int) -> tuple[int, CellKind] | None:
+    """(element oid, element kind) if `oid` is a supported array type."""
+    return _ARRAY_ELEM.get(oid)
+
+
+def is_array_oid(oid: int) -> bool:
+    return oid in _ARRAY_ELEM
+
+
+def type_name(oid: int) -> str:
+    if oid in _ARRAY_ELEM:
+        return "_" + _NAMES.get(_ARRAY_ELEM[oid][0], str(oid))
+    return _NAMES.get(oid, f"oid:{oid}")

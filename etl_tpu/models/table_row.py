@@ -1,0 +1,325 @@
+"""Rows and columnar batches.
+
+Reference parity: `TableRow`/`PartialTableRow`/`UpdatedTableRow`/`OldTableRow`
+(crates/etl/src/data/table_row.rs:15,68,145,193) and `SizeHint`
+(crates/etl/src/data/size.rs) used for batch byte budgeting.
+
+TPU-first addition: `ColumnarBatch` — the typed columnar form produced by the
+device decode path (and by CPU transpose), carried across the Destination
+boundary so Arrow-native writers never re-serialize row-by-row. It converts
+losslessly to a pyarrow RecordBatch.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Sequence
+
+import numpy as np
+
+from .cell import (TOAST_UNCHANGED, PgInterval, PgNumeric, PgSpecialDate,
+                   PgSpecialTimestamp, PgTimeTz)
+from .pgtypes import CellKind
+from .schema import ColumnSchema, ReplicatedTableSchema
+
+
+def value_size_hint(v: Any) -> int:
+    """Approximate in-memory size of a decoded value, for batch budgeting
+    (reference SizeHint, crates/etl/src/data/size.rs). Cheap, not exact."""
+    if v is None or v is TOAST_UNCHANGED:
+        return 8
+    if isinstance(v, bool):
+        return 8
+    if isinstance(v, int):
+        return 16
+    if isinstance(v, float):
+        return 16
+    if isinstance(v, str):
+        return 48 + len(v)
+    if isinstance(v, bytes):
+        return 32 + len(v)
+    if isinstance(v, (dt.datetime, dt.date, dt.time)):
+        return 48
+    if isinstance(v, PgNumeric):
+        return 64
+    if isinstance(v, (list, tuple)):
+        return 16 + sum(value_size_hint(x) for x in v)
+    if isinstance(v, dict):
+        return 64 + sum(value_size_hint(k) + value_size_hint(x) for k, x in v.items())
+    return 64
+
+
+class TableRow:
+    """One decoded row: positional values matching a ReplicatedTableSchema's
+    replicated columns (reference TableRow, data/table_row.rs:15)."""
+
+    __slots__ = ("values", "_size_hint")
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+        self._size_hint: int | None = None
+
+    def size_hint(self) -> int:
+        if self._size_hint is None:
+            self._size_hint = 16 + sum(value_size_hint(v) for v in self.values)
+        return self._size_hint
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def __getitem__(self, i: int) -> Any:
+        return self.values[i]
+
+    def __iter__(self) -> Iterator[Any]:
+        return iter(self.values)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, TableRow) and self.values == other.values
+
+    def __repr__(self) -> str:
+        return f"TableRow({self.values!r})"
+
+
+class PartialTableRow(TableRow):
+    """A row where only identity columns are populated (DELETE old tuples /
+    key-only old tuples; reference PartialTableRow, table_row.rs:68).
+    Non-identity positions hold None and `present` marks real values."""
+
+    __slots__ = ("present",)
+
+    def __init__(self, values: Sequence[Any], present: Sequence[bool]):
+        super().__init__(values)
+        self.present = list(present)
+
+    def __repr__(self) -> str:
+        return f"PartialTableRow({self.values!r}, present={self.present!r})"
+
+
+# dtypes for the dense device-decodable kinds
+_NUMPY_DTYPE: dict[CellKind, np.dtype] = {
+    CellKind.BOOL: np.dtype(np.bool_),
+    CellKind.I16: np.dtype(np.int16),
+    CellKind.I32: np.dtype(np.int32),
+    CellKind.U32: np.dtype(np.uint32),
+    CellKind.I64: np.dtype(np.int64),
+    CellKind.F32: np.dtype(np.float32),
+    CellKind.F64: np.dtype(np.float64),
+    CellKind.DATE: np.dtype(np.int32),      # days since 1970-01-01
+    CellKind.TIME: np.dtype(np.int64),      # microseconds since midnight
+    CellKind.TIMESTAMP: np.dtype(np.int64),  # microseconds since epoch (naive)
+    CellKind.TIMESTAMPTZ: np.dtype(np.int64),  # microseconds since epoch UTC
+}
+
+
+def dense_dtype(kind: CellKind) -> np.dtype | None:
+    """numpy dtype for kinds the device decodes densely; None for object kinds
+    (strings, bytes, json, numeric-exact, arrays) which stay host-side."""
+    return _NUMPY_DTYPE.get(kind)
+
+
+@dataclass
+class Column:
+    """One typed column of a batch: dense numpy data + validity, or a Python
+    object list for host-side kinds. `toast_unchanged[i]` marks cells whose
+    value pgoutput did not re-send (TOAST 'u' kind) — distinct from NULL so
+    CDC destinations can skip instead of overwrite (reference TOAST handling,
+    codec/event.rs)."""
+
+    schema: ColumnSchema
+    data: Any  # np.ndarray for dense kinds, list for object kinds
+    validity: np.ndarray  # bool[n], True = value present (not NULL/unchanged)
+    toast_unchanged: np.ndarray | None = None  # bool[n] or None if none set
+
+    def __len__(self) -> int:
+        return len(self.validity)
+
+    @property
+    def is_dense(self) -> bool:
+        return isinstance(self.data, np.ndarray)
+
+    def is_toast_unchanged(self, i: int) -> bool:
+        return self.toast_unchanged is not None and bool(self.toast_unchanged[i])
+
+
+class ColumnarBatch:
+    """Typed columnar rows for one table — the unit the TPU decode engine
+    emits and Arrow-native destinations consume."""
+
+    __slots__ = ("schema", "columns", "num_rows")
+
+    def __init__(self, schema: ReplicatedTableSchema, columns: list[Column]):
+        self.schema = schema
+        self.columns = columns
+        self.num_rows = len(columns[0]) if columns else 0
+        for c in columns:
+            if len(c) != self.num_rows:
+                raise ValueError("ragged columnar batch")
+
+    @classmethod
+    def from_rows(cls, schema: ReplicatedTableSchema, rows: Sequence[TableRow]) -> "ColumnarBatch":
+        """CPU transpose: list-of-rows → columns (the fallback for what the
+        device path produces directly)."""
+        cols_schema = schema.replicated_columns
+        n = len(rows)
+        columns: list[Column] = []
+        for j, cs in enumerate(cols_schema):
+            vals = [r.values[j] for r in rows]
+            toast = np.asarray([v is TOAST_UNCHANGED for v in vals], dtype=np.bool_)
+            validity = np.asarray(
+                [v is not None and v is not TOAST_UNCHANGED for v in vals],
+                dtype=np.bool_)
+            toast_arr = toast if toast.any() else None
+            dtype = dense_dtype(cs.kind)
+            if dtype is not None:
+                data = np.zeros(n, dtype=dtype)
+                for i, v in enumerate(vals):
+                    if validity[i]:
+                        data[i] = _to_dense(cs.kind, v)
+                columns.append(Column(cs, data, validity, toast_arr))
+            else:
+                columns.append(Column(
+                    cs, [v if validity[i] else None for i, v in enumerate(vals)],
+                    validity, toast_arr))
+        return cls(schema, columns)
+
+    def to_rows(self) -> list[TableRow]:
+        rows = []
+        for i in range(self.num_rows):
+            vals = []
+            for c in self.columns:
+                if c.is_toast_unchanged(i):
+                    vals.append(TOAST_UNCHANGED)
+                elif not c.validity[i]:
+                    vals.append(None)
+                elif c.is_dense:
+                    vals.append(_from_dense(c.schema.kind, c.data[i]))
+                else:
+                    vals.append(c.data[i])
+            rows.append(TableRow(vals))
+        return rows
+
+    def size_hint(self) -> int:
+        total = 0
+        for c in self.columns:
+            if c.is_dense:
+                total += c.data.nbytes + c.validity.nbytes
+            else:
+                total += sum(value_size_hint(v) for v in c.data)
+        return total
+
+    def to_arrow(self):
+        """Convert to a pyarrow RecordBatch (zero-copy for dense columns).
+
+        NUMERIC columns are emitted as Postgres text strings: exact at any
+        precision and able to carry NaN/±Infinity, which Arrow decimal128
+        cannot (same stance as the reference's BigQuery string encoding of
+        numerics, bigquery/encoding.rs). TOAST-unchanged cells surface as
+        nulls here; CDC writers that can skip columns should consult
+        `Column.toast_unchanged` instead of using the Arrow form."""
+        import pyarrow as pa
+
+        arrays, names = [], []
+        for c in self.columns:
+            names.append(c.schema.name)
+            mask = ~c.validity
+            if c.schema.kind is CellKind.NUMERIC:
+                vals = [c.data[i].pg_text() if c.validity[i] else None
+                        for i in range(self.num_rows)]
+                arrays.append(pa.array(vals, type=pa.string()))
+            elif c.is_dense:
+                kind = c.schema.kind
+                if kind is CellKind.DATE:
+                    arrays.append(pa.array(c.data, type=pa.date32(), mask=mask))
+                elif kind is CellKind.TIME:
+                    arrays.append(pa.array(c.data, type=pa.time64("us"), mask=mask))
+                elif kind is CellKind.TIMESTAMP:
+                    arrays.append(pa.array(c.data, type=pa.timestamp("us"), mask=mask))
+                elif kind is CellKind.TIMESTAMPTZ:
+                    arrays.append(pa.array(c.data, type=pa.timestamp("us", tz="UTC"), mask=mask))
+                else:
+                    arrays.append(pa.array(c.data, mask=mask))
+            else:
+                vals = [None if not c.validity[i] else _arrow_scalar(c.data[i])
+                        for i in range(self.num_rows)]
+                arrays.append(pa.array(vals))
+        return pa.RecordBatch.from_arrays(arrays, names=names)
+
+
+_EPOCH_DATE = dt.date(1970, 1, 1)
+_EPOCH_DT = dt.datetime(1970, 1, 1)
+_EPOCH_UTC = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
+
+
+_US = dt.timedelta(microseconds=1)
+
+# exact bounds of Python's datetime range in epoch microseconds / days
+_MIN_TS_US = -62_135_596_800_000_000  # 0001-01-01 00:00:00
+_MAX_TS_US = 253_402_300_799_999_999  # 9999-12-31 23:59:59.999999
+_MIN_DATE_DAYS = -719_162
+_MAX_DATE_DAYS = 2_932_896
+
+
+def _to_dense(kind: CellKind, v: Any):
+    # integer arithmetic throughout: float total_seconds() corrupts µs
+    # beyond 2^53 and overflows on the datetime.max infinity sentinel
+    if kind is CellKind.DATE:
+        if isinstance(v, PgSpecialDate):
+            return v.days
+        return (v - _EPOCH_DATE).days
+    if kind is CellKind.TIME:
+        return ((v.hour * 60 + v.minute) * 60 + v.second) * 1_000_000 + v.microsecond
+    if kind in (CellKind.TIMESTAMP, CellKind.TIMESTAMPTZ):
+        if isinstance(v, PgSpecialTimestamp):
+            return v.micros
+        if v.tzinfo is None:
+            return (v - _EPOCH_DT) // _US
+        return (v - _EPOCH_UTC) // _US
+    return v
+
+
+def _from_dense(kind: CellKind, v):
+    if kind is CellKind.DATE:
+        days = int(v)
+        if days < _MIN_DATE_DAYS:
+            return PgSpecialDate(days, f"<out-of-range date {days}d>")
+        return _EPOCH_DATE + dt.timedelta(days=days)
+    if kind is CellKind.TIME:
+        us = int(v)
+        s, us = divmod(us, 1_000_000)
+        h, rem = divmod(s, 3600)
+        m, s = divmod(rem, 60)
+        return dt.time(h, m, s, us)
+    if kind in (CellKind.TIMESTAMP, CellKind.TIMESTAMPTZ):
+        us = int(v)
+        tz_aware = kind is CellKind.TIMESTAMPTZ
+        if not _MIN_TS_US <= us <= _MAX_TS_US:
+            return PgSpecialTimestamp(us, f"<out-of-range timestamp {us}us>",
+                                      tz_aware=tz_aware)
+        if tz_aware:
+            return _EPOCH_UTC + dt.timedelta(microseconds=us)
+        return _EPOCH_DT + dt.timedelta(microseconds=us)
+    if kind is CellKind.BOOL:
+        return bool(v)
+    if kind in (CellKind.I16, CellKind.I32, CellKind.U32, CellKind.I64):
+        return int(v)
+    if kind in (CellKind.F32, CellKind.F64):
+        return float(v)
+    return v
+
+
+def _arrow_scalar(v: Any):
+    if isinstance(v, (PgSpecialDate, PgSpecialTimestamp)):
+        return v.pg_text()
+    if isinstance(v, PgTimeTz):
+        return v.pg_text()
+    if isinstance(v, PgInterval):
+        return v.pg_text()
+    if isinstance(v, dict) or isinstance(v, list):
+        import json
+
+        return json.dumps(v) if isinstance(v, dict) else v
+    if v is TOAST_UNCHANGED:
+        return None
+    return v

@@ -1,0 +1,19 @@
+"""CPU reference codecs: text values, COPY rows, pgoutput protocol, events.
+
+These are the correctness oracle for the TPU decode engine (etl_tpu/ops)
+and the fallback path for rows/types the device kernels don't handle.
+"""
+
+from .copy_text import (encode_copy_row, parse_copy_row, split_copy_line,
+                        unescape_copy_field)
+from .event import (DDL_MESSAGE_PREFIX, decode_begin, decode_commit,
+                    decode_delete, decode_insert, decode_schema_change,
+                    decode_truncate, decode_update, encode_schema_change,
+                    schema_from_relation_message)
+from .pgoutput import (decode_logical_message, decode_replication_frame,
+                       decode_standby_status_update, encode_begin,
+                       encode_commit, encode_delete, encode_insert,
+                       encode_logical_message, encode_primary_keepalive,
+                       encode_relation, encode_standby_status_update,
+                       encode_truncate, encode_update, encode_xlog_data)
+from .text import parse_cell_text

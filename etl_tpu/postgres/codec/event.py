@@ -1,0 +1,180 @@
+"""pgoutput message + schema → typed Event decode (the CPU hot loop).
+
+Reference parity: `parse_event_from_{begin,commit,insert,update,delete,
+truncate}_message` (crates/etl/src/postgres/codec/event.rs, 1696 LoC):
+old/new tuple merge by identity mask, TOAST-unchanged handling, DDL
+`SchemaChangeMessage` JSON parse.
+
+The TPU path replaces `decode_insert/update/delete` per-row text parsing
+with batched device decode (etl_tpu/ops) — this module remains the oracle
+and the fallback for rows the kernels cannot handle.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from ...models.cell import TOAST_UNCHANGED
+from ...models.errors import ErrorKind, EtlError
+from ...models.event import (BeginEvent, CommitEvent, DeleteEvent,
+                             InsertEvent, RelationEvent, SchemaChangeEvent,
+                             TruncateEvent, UpdateEvent)
+from ...models.lsn import Lsn
+from ...models.schema import (ColumnMask, ColumnSchema, ReplicatedTableSchema,
+                              TableName, TableSchema)
+from ...models.table_row import PartialTableRow, TableRow
+from .pgoutput import (TUPLE_BINARY, TUPLE_NULL, TUPLE_TEXT,
+                       TUPLE_UNCHANGED_TOAST, BeginMessage, CommitMessage,
+                       DeleteMessage, InsertMessage, LogicalMessage,
+                       RelationMessage, TruncateMessage, TupleData,
+                       UpdateMessage)
+from .text import parse_cell_text
+
+# prefix used by the source DDL event trigger (reference:
+# migrations/source/20260415100000_schema_change_messages.up.sql)
+DDL_MESSAGE_PREFIX = "supabase_etl_ddl"
+
+
+def schema_from_relation_message(msg: RelationMessage) -> ReplicatedTableSchema:
+    """Build the positional decode view from a RELATION message. pgoutput
+    lists only replicated columns, in table order (ordering rationale:
+    reference apply.rs:2386-2394), so the decode schema has exactly those
+    columns and a full-set replication mask; identity bits come from the
+    per-column key flag."""
+    columns = tuple(
+        ColumnSchema(
+            name=c.name,
+            type_oid=c.type_oid,
+            modifier=c.modifier,
+            nullable=not c.is_key,
+            primary_key_ordinal=(i + 1) if c.is_key else None,
+        )
+        for i, c in enumerate(msg.columns)
+    )
+    schema = TableSchema(
+        id=msg.relation_id,
+        name=TableName(msg.namespace, msg.relation_name),
+        columns=columns,
+    )
+    n = len(columns)
+    identity = ColumnMask(c.is_key for c in msg.columns)
+    if identity.count() == 0 and msg.replica_identity == ord("f"):
+        identity = ColumnMask.all_set(n)
+    return ReplicatedTableSchema(schema, ColumnMask.all_set(n), identity)
+
+
+def _decode_tuple_values(tup: TupleData,
+                         schema: ReplicatedTableSchema) -> list[Any]:
+    cols = schema.replicated_columns
+    if len(tup) != len(cols):
+        raise EtlError(
+            ErrorKind.SCHEMA_MISMATCH,
+            f"tuple has {len(tup)} columns, schema {schema.name} expects {len(cols)}")
+    values: list[Any] = []
+    for kind, raw, col in zip(tup.kinds, tup.values, cols):
+        if kind == TUPLE_NULL:
+            values.append(None)
+        elif kind == TUPLE_UNCHANGED_TOAST:
+            values.append(TOAST_UNCHANGED)
+        elif kind == TUPLE_TEXT:
+            assert raw is not None
+            values.append(parse_cell_text(raw.decode("utf-8"), col.type_oid))
+        elif kind == TUPLE_BINARY:
+            raise EtlError(ErrorKind.UNSUPPORTED_TYPE,
+                           "binary tuple format not enabled in START_REPLICATION")
+        else:  # unreachable: read_tuple_data validates kinds
+            raise EtlError(ErrorKind.REPLICATION_MESSAGE_INVALID,
+                           f"tuple kind {kind}")
+    return values
+
+
+def decode_begin(msg: BeginMessage, start_lsn: Lsn) -> BeginEvent:
+    return BeginEvent(start_lsn=start_lsn, commit_lsn=msg.final_lsn,
+                      timestamp_us=msg.timestamp_us, xid=msg.xid)
+
+
+def decode_commit(msg: CommitMessage, start_lsn: Lsn) -> CommitEvent:
+    return CommitEvent(start_lsn=start_lsn, commit_lsn=msg.commit_lsn,
+                       end_lsn=msg.end_lsn, timestamp_us=msg.timestamp_us,
+                       flags=msg.flags)
+
+
+def decode_insert(msg: InsertMessage, schema: ReplicatedTableSchema,
+                  start_lsn: Lsn, commit_lsn: Lsn, tx_ordinal: int) -> InsertEvent:
+    row = TableRow(_decode_tuple_values(msg.new_tuple, schema))
+    return InsertEvent(start_lsn, commit_lsn, tx_ordinal, schema, row)
+
+
+def _old_row(tup: TupleData | None, key: TupleData | None,
+             schema: ReplicatedTableSchema) -> PartialTableRow | TableRow | None:
+    if tup is not None:  # 'O': full old tuple (replica identity full)
+        return TableRow(_decode_tuple_values(tup, schema))
+    if key is not None:  # 'K': identity columns populated, rest null
+        values = _decode_tuple_values(key, schema)
+        identity = schema.identity_mask
+        idx = schema.replicated_indices
+        present = [identity[idx[i]] for i in range(len(values))]
+        return PartialTableRow(values, present)
+    return None
+
+
+def decode_update(msg: UpdateMessage, schema: ReplicatedTableSchema,
+                  start_lsn: Lsn, commit_lsn: Lsn, tx_ordinal: int) -> UpdateEvent:
+    new_values = _decode_tuple_values(msg.new_tuple, schema)
+    old = _old_row(msg.old_tuple, msg.key_tuple, schema)
+    # TOAST-unchanged merge: fill unchanged columns from the full old tuple
+    # when the server sent one (reference codec/event.rs merge semantics)
+    if isinstance(old, TableRow) and not isinstance(old, PartialTableRow):
+        for i, v in enumerate(new_values):
+            if v is TOAST_UNCHANGED:
+                new_values[i] = old.values[i]
+    return UpdateEvent(start_lsn, commit_lsn, tx_ordinal, schema,
+                       TableRow(new_values), old)
+
+
+def decode_delete(msg: DeleteMessage, schema: ReplicatedTableSchema,
+                  start_lsn: Lsn, commit_lsn: Lsn, tx_ordinal: int) -> DeleteEvent:
+    old = _old_row(msg.old_tuple, msg.key_tuple, schema)
+    if old is None:
+        raise EtlError(ErrorKind.REPLICATION_MESSAGE_INVALID,
+                       "DELETE without old or key tuple")
+    return DeleteEvent(start_lsn, commit_lsn, tx_ordinal, schema, old)
+
+
+def decode_truncate(msg: TruncateMessage,
+                    schemas: list[ReplicatedTableSchema], start_lsn: Lsn,
+                    commit_lsn: Lsn, tx_ordinal: int) -> TruncateEvent:
+    return TruncateEvent(start_lsn, commit_lsn, tx_ordinal, msg.options,
+                         tuple(schemas))
+
+
+def decode_schema_change(msg: LogicalMessage, start_lsn: Lsn,
+                         commit_lsn: Lsn) -> SchemaChangeEvent:
+    """Parse the DDL trigger's JSON payload (reference apply.rs:2160-2277).
+
+    Payload shape: {"table_id": oid, "dropped": bool, "schema": {...}} where
+    schema is the TableSchema JSON emitted by etl.describe_table_schema."""
+    if msg.prefix != DDL_MESSAGE_PREFIX:
+        raise EtlError(ErrorKind.REPLICATION_MESSAGE_INVALID,
+                       f"unexpected logical message prefix {msg.prefix!r}")
+    try:
+        doc = json.loads(msg.content.decode("utf-8"))
+        table_id = doc["table_id"]
+        if doc.get("dropped"):
+            return SchemaChangeEvent(start_lsn, commit_lsn, table_id, None)
+        schema = TableSchema.from_json(doc["schema"])
+    except (KeyError, ValueError, json.JSONDecodeError) as e:
+        raise EtlError(ErrorKind.SCHEMA_SNAPSHOT_INVALID,
+                       f"malformed DDL message: {e}")
+    return SchemaChangeEvent(start_lsn, commit_lsn, table_id,
+                             ReplicatedTableSchema.with_all_columns(schema))
+
+
+def encode_schema_change(table_id: int, schema: TableSchema | None) -> bytes:
+    """Test/fixture helper: the JSON the source event trigger would emit."""
+    if schema is None:
+        doc: dict[str, Any] = {"table_id": table_id, "dropped": True}
+    else:
+        doc = {"table_id": table_id, "dropped": False, "schema": schema.to_json()}
+    return json.dumps(doc).encode("utf-8")

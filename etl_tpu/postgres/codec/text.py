@@ -1,0 +1,374 @@
+"""Text-format value parsing: Postgres text output → typed Python values.
+
+This is the CPU reference decoder and correctness oracle for the TPU decode
+kernels. Reference parity: `parse_cell_from_postgres_text`
+(crates/etl/src/postgres/codec/text.rs, 1004 LoC), numeric codec
+(crates/etl-postgres/src/numeric.rs), time codecs
+(crates/etl-postgres/src/time.rs), bytea hex (codec/hex.rs), bool
+(codec/bool.rs), array literals (text.rs array parsing).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import uuid as uuid_mod
+from typing import Any, Callable
+
+from ...models.cell import (PgInterval, PgNumeric, PgSpecialDate,
+                            PgSpecialTimestamp, PgTimeTz)
+from ...models.errors import ErrorKind, EtlError
+from ...models.pgtypes import CellKind, Oid, array_element, kind_for_oid
+
+# Postgres renders infinity dates/timestamps as literals; map them to the
+# extreme representable Python values (reference maps to chrono MIN/MAX).
+DATE_POS_INFINITY = dt.date.max
+DATE_NEG_INFINITY = dt.date.min
+TS_POS_INFINITY = dt.datetime.max
+TS_NEG_INFINITY = dt.datetime.min
+TSTZ_POS_INFINITY = dt.datetime.max.replace(tzinfo=dt.timezone.utc)
+TSTZ_NEG_INFINITY = dt.datetime.min.replace(tzinfo=dt.timezone.utc)
+
+
+def _invalid(kind: str, text: str, exc: Exception | None = None) -> EtlError:
+    return EtlError(ErrorKind.INVALID_DATA, f"invalid {kind} literal: {text!r}"
+                    + (f" ({exc})" if exc else ""))
+
+
+def parse_bool(text: str) -> bool:
+    if text == "t":
+        return True
+    if text == "f":
+        return False
+    raise _invalid("bool", text)
+
+
+def parse_int(text: str) -> int:
+    try:
+        return int(text)
+    except ValueError as e:
+        raise _invalid("integer", text, e)
+
+
+def parse_float(text: str) -> float:
+    if text == "NaN":
+        return float("nan")
+    if text == "Infinity":
+        return float("inf")
+    if text == "-Infinity":
+        return float("-inf")
+    try:
+        return float(text)
+    except ValueError as e:
+        raise _invalid("float", text, e)
+
+
+def parse_numeric(text: str) -> PgNumeric:
+    t = text
+    if t == "NaN":
+        return PgNumeric("NaN")
+    if t in ("Infinity", "inf"):
+        return PgNumeric("Infinity")
+    if t in ("-Infinity", "-inf"):
+        return PgNumeric("-Infinity")
+    try:
+        return PgNumeric(t)
+    except Exception as e:
+        raise _invalid("numeric", text, e)
+
+
+def parse_bytea(text: str) -> bytes:
+    if text.startswith("\\x"):
+        try:
+            return bytes.fromhex(text[2:])
+        except ValueError as e:
+            raise _invalid("bytea", text, e)
+    # legacy escape format
+    out = bytearray()
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c != "\\":
+            out.append(ord(c))
+            i += 1
+        elif i + 1 < n and text[i + 1] == "\\":
+            out.append(0x5C)
+            i += 2
+        elif i + 3 < n and text[i + 1 : i + 4].isdigit():
+            out.append(int(text[i + 1 : i + 4], 8))
+            i += 4
+        else:
+            raise _invalid("bytea", text)
+    return bytes(out)
+
+
+def days_from_civil(y: int, m: int, d: int) -> int:
+    """Proleptic-Gregorian days since 1970-01-01 for any year (Howard
+    Hinnant's civil algorithm; handles year <= 0 exactly)."""
+    y -= m <= 2
+    era = (y if y >= 0 else y - 399) // 400
+    yoe = y - era * 400
+    doy = (153 * (m + (-3 if m > 2 else 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def parse_date(text: str) -> "dt.date | PgSpecialDate":
+    if text == "infinity":
+        return DATE_POS_INFINITY
+    if text == "-infinity":
+        return DATE_NEG_INFINITY
+    t, bc = (text[:-3], True) if text.endswith(" BC") else (text, False)
+    try:
+        y, m, d = t.split("-")
+        year, month, day = int(y), int(m), int(d)
+        if bc:
+            # Postgres year 1 BC = proleptic year 0 — below Python's MINYEAR,
+            # so carry the exact day count instead of collapsing the value
+            year = 1 - year
+            return PgSpecialDate(days_from_civil(year, month, day), text)
+        return dt.date(year, month, day)
+    except (ValueError, AttributeError) as e:
+        raise _invalid("date", text, e)
+
+
+def _parse_hms(text: str) -> tuple[int, int, int, int]:
+    hh, mm, rest = text.split(":")
+    if "." in rest:
+        ss, frac = rest.split(".")
+        us = int(frac.ljust(6, "0")[:6])
+    else:
+        ss, us = rest, 0
+    return int(hh), int(mm), int(ss), us
+
+
+def parse_time(text: str) -> dt.time:
+    try:
+        h, m, s, us = _parse_hms(text)
+        if h == 24 and m == 0 and s == 0 and us == 0:
+            # Postgres allows 24:00:00; clamp to max representable
+            return dt.time(23, 59, 59, 999999)
+        return dt.time(h, m, s, us)
+    except ValueError as e:
+        raise _invalid("time", text, e)
+
+
+def _split_tz(text: str) -> tuple[str, int]:
+    """Split trailing ±HH[:MM[:SS]] offset; returns (body, offset_seconds)."""
+    for i in range(len(text) - 1, max(len(text) - 10, 0), -1):
+        c = text[i]
+        if c in "+-":
+            body, off = text[:i], text[i:]
+            sign = 1 if off[0] == "+" else -1
+            parts = off[1:].split(":")
+            secs = 0
+            for p, mult in zip(parts, (3600, 60, 1)):
+                secs += int(p) * mult
+            return body, sign * secs
+        if c == ":" or c.isdigit() or c == ".":
+            continue
+        break
+    raise _invalid("tz offset", text)
+
+
+def parse_timetz(text: str) -> PgTimeTz:
+    try:
+        body, off = _split_tz(text)
+        return PgTimeTz(parse_time(body), off)
+    except (ValueError, EtlError) as e:
+        if isinstance(e, EtlError):
+            raise
+        raise _invalid("timetz", text, e)
+
+
+def parse_timestamp(text: str) -> "dt.datetime | PgSpecialTimestamp":
+    if text == "infinity":
+        return TS_POS_INFINITY
+    if text == "-infinity":
+        return TS_NEG_INFINITY
+    t, bc = (text[:-3], True) if text.endswith(" BC") else (text, False)
+    try:
+        date_part, _, time_part = t.partition(" ")
+        d = parse_date(date_part + (" BC" if bc else ""))
+        tm = parse_time(time_part) if time_part else dt.time()
+        if isinstance(d, PgSpecialDate):
+            tod = ((tm.hour * 60 + tm.minute) * 60 + tm.second) * 1_000_000 \
+                + tm.microsecond
+            return PgSpecialTimestamp(d.days * 86_400_000_000 + tod, text)
+        return dt.datetime.combine(d, tm)
+    except (ValueError, EtlError) as e:
+        if isinstance(e, EtlError) and "date" not in str(e) and "time" not in str(e):
+            raise
+        raise _invalid("timestamp", text, e)
+
+
+def parse_timestamptz(text: str) -> "dt.datetime | PgSpecialTimestamp":
+    if text == "infinity":
+        return TSTZ_POS_INFINITY
+    if text == "-infinity":
+        return TSTZ_NEG_INFINITY
+    t, bc = (text[:-3], True) if text.endswith(" BC") else (text, False)
+    try:
+        body, off = _split_tz(t)
+        naive = parse_timestamp(body + (" BC" if bc else ""))
+        if naive in (TS_POS_INFINITY, TS_NEG_INFINITY):
+            return naive.replace(tzinfo=dt.timezone.utc)
+        if isinstance(naive, PgSpecialTimestamp):
+            return PgSpecialTimestamp(naive.micros - off * 1_000_000, text,
+                                      tz_aware=True)
+        aware = naive.replace(tzinfo=dt.timezone(dt.timedelta(seconds=off)))
+        return aware.astimezone(dt.timezone.utc)
+    except (ValueError, OverflowError) as e:
+        raise _invalid("timestamptz", text, e)
+
+
+def parse_uuid(text: str) -> uuid_mod.UUID:
+    try:
+        return uuid_mod.UUID(text)
+    except ValueError as e:
+        raise _invalid("uuid", text, e)
+
+
+def parse_json(text: str) -> Any:
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError as e:
+        raise _invalid("json", text, e)
+
+
+_INTERVAL_UNITS = {
+    "year": 12, "years": 12, "mon": 1, "mons": 1, "month": 1, "months": 1,
+}
+
+
+def parse_interval(text: str) -> PgInterval:
+    """Parse Postgres' default interval output ('X years Y mons Z days
+    [-]HH:MM:SS[.ffffff]')."""
+    months = days = micros = 0
+    tokens = text.split()
+    i = 0
+    try:
+        while i < len(tokens):
+            tok = tokens[i]
+            if ":" in tok:
+                neg = tok.startswith("-")
+                h, m, s, us = _parse_hms(tok.lstrip("+-"))
+                micros = ((h * 60 + m) * 60 + s) * 1_000_000 + us
+                if neg:
+                    micros = -micros
+                i += 1
+            else:
+                qty = int(tok)
+                unit = tokens[i + 1]
+                if unit in _INTERVAL_UNITS:
+                    months += qty * _INTERVAL_UNITS[unit]
+                elif unit.startswith("day"):
+                    days += qty
+                elif unit.startswith("week"):
+                    days += qty * 7
+                else:
+                    raise ValueError(f"unknown unit {unit}")
+                i += 2
+        return PgInterval(months, days, micros)
+    except (ValueError, IndexError) as e:
+        raise _invalid("interval", text, e)
+
+
+def parse_array(text: str, elem_oid: int) -> list:
+    """Parse a Postgres array literal: `{a,b,NULL,"c,d"}` with optional
+    explicit bounds prefix `[l:u]=`. Nested arrays flatten is NOT done —
+    nested braces produce nested lists."""
+    if "=" in text and text.startswith("["):
+        text = text.split("=", 1)[1]
+    if not (text.startswith("{") and text.endswith("}")):
+        raise _invalid("array", text)
+
+    elem_parser = _parser_for_oid(elem_oid)
+    pos = [0]
+    s = text
+
+    def parse_items(depth: int) -> list:
+        assert s[pos[0]] == "{"
+        pos[0] += 1
+        items: list = []
+        if s[pos[0]] == "}":
+            pos[0] += 1
+            return items
+        while True:
+            c = s[pos[0]]
+            if c == "{":
+                items.append(parse_items(depth + 1))
+            elif c == '"':
+                pos[0] += 1
+                buf = []
+                while s[pos[0]] != '"':
+                    if s[pos[0]] == "\\":
+                        pos[0] += 1
+                    buf.append(s[pos[0]])
+                    pos[0] += 1
+                pos[0] += 1
+                items.append(elem_parser("".join(buf)))
+            else:
+                start = pos[0]
+                while s[pos[0]] not in ",}":
+                    pos[0] += 1
+                raw = s[start : pos[0]]
+                items.append(None if raw == "NULL" else elem_parser(raw))
+            c = s[pos[0]]
+            pos[0] += 1
+            if c == "}":
+                return items
+            if c != ",":
+                raise _invalid("array", text)
+
+    try:
+        result = parse_items(0)
+    except (IndexError, ValueError) as e:
+        raise _invalid("array", text, e)
+    if pos[0] != len(s):
+        raise _invalid("array", text)
+    return result
+
+
+def _identity(text: str) -> str:
+    return text
+
+
+_PARSERS: dict[CellKind, Callable[[str], Any]] = {
+    CellKind.BOOL: parse_bool,
+    CellKind.STRING: _identity,
+    CellKind.I16: parse_int,
+    CellKind.I32: parse_int,
+    CellKind.U32: parse_int,
+    CellKind.I64: parse_int,
+    CellKind.F32: parse_float,
+    CellKind.F64: parse_float,
+    CellKind.NUMERIC: parse_numeric,
+    CellKind.DATE: parse_date,
+    CellKind.TIME: parse_time,
+    CellKind.TIMETZ: parse_timetz,
+    CellKind.TIMESTAMP: parse_timestamp,
+    CellKind.TIMESTAMPTZ: parse_timestamptz,
+    CellKind.UUID: parse_uuid,
+    CellKind.JSON: parse_json,
+    CellKind.BYTES: parse_bytea,
+    CellKind.INTERVAL: parse_interval,
+}
+
+
+def _parser_for_oid(oid: int) -> Callable[[str], Any]:
+    kind = kind_for_oid(oid)
+    if kind is CellKind.ARRAY:
+        elem = array_element(oid)
+        assert elem is not None
+        elem_oid = elem[0]
+        return lambda t: parse_array(t, elem_oid)
+    return _PARSERS[kind]
+
+
+def parse_cell_text(text: str | None, type_oid: int) -> Any:
+    """Parse one text-format value for a column of `type_oid`. None stays
+    None (NULL). Reference: parse_cell_from_postgres_text (codec/text.rs)."""
+    if text is None:
+        return None
+    return _parser_for_oid(type_oid)(text)

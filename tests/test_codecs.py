@@ -1,0 +1,364 @@
+"""Codec tests: text values, COPY rows, pgoutput roundtrips, event decode.
+
+Strategy mirrors the reference: exhaustive per-type unit tests + encode→decode
+differential roundtrips (SURVEY §4.4 — here the encoder plays the Postgres
+oracle at the protocol layer)."""
+
+import datetime as dt
+import math
+import uuid
+
+import pytest
+
+from etl_tpu.models import (TOAST_UNCHANGED, CellKind, Lsn, Oid, PgInterval,
+                            PgNumeric, PgTimeTz, TableName, TableSchema,
+                            ColumnSchema, ReplicatedTableSchema)
+from etl_tpu.models.errors import EtlError
+from etl_tpu.models.table_row import PartialTableRow
+from etl_tpu.postgres.codec import (pgoutput, split_copy_line,
+                                    parse_copy_row, encode_copy_row,
+                                    parse_cell_text, unescape_copy_field,
+                                    schema_from_relation_message,
+                                    decode_logical_message, decode_insert,
+                                    decode_update, decode_delete,
+                                    decode_begin, decode_commit,
+                                    decode_schema_change, encode_schema_change,
+                                    decode_replication_frame,
+                                    decode_standby_status_update)
+from etl_tpu.postgres.codec.text import (DATE_NEG_INFINITY, DATE_POS_INFINITY,
+                                         TS_POS_INFINITY)
+
+UTC = dt.timezone.utc
+
+
+class TestTextParsing:
+    def test_bool(self):
+        assert parse_cell_text("t", Oid.BOOL) is True
+        assert parse_cell_text("f", Oid.BOOL) is False
+        with pytest.raises(EtlError):
+            parse_cell_text("true", Oid.BOOL)
+
+    def test_ints(self):
+        assert parse_cell_text("-32768", Oid.INT2) == -32768
+        assert parse_cell_text("2147483647", Oid.INT4) == 2147483647
+        assert parse_cell_text("-9223372036854775808", Oid.INT8) == -(2**63)
+
+    def test_floats(self):
+        assert parse_cell_text("1.5", Oid.FLOAT8) == 1.5
+        assert parse_cell_text("-0.25", Oid.FLOAT4) == -0.25
+        assert math.isnan(parse_cell_text("NaN", Oid.FLOAT8))
+        assert parse_cell_text("Infinity", Oid.FLOAT8) == float("inf")
+        assert parse_cell_text("-Infinity", Oid.FLOAT4) == float("-inf")
+        assert parse_cell_text("1e300", Oid.FLOAT8) == 1e300
+
+    def test_numeric(self):
+        v = parse_cell_text("12345.678900", Oid.NUMERIC)
+        assert isinstance(v, PgNumeric)
+        assert v.pg_text() == "12345.678900"  # scale preserved
+        assert parse_cell_text("NaN", Oid.NUMERIC).is_nan()
+        assert parse_cell_text("-Infinity", Oid.NUMERIC).is_infinite()
+
+    def test_bytea(self):
+        assert parse_cell_text("\\xdeadBEEF", Oid.BYTEA) == b"\xde\xad\xbe\xef"
+        assert parse_cell_text("\\x", Oid.BYTEA) == b""
+
+    def test_date(self):
+        assert parse_cell_text("2024-02-29", Oid.DATE) == dt.date(2024, 2, 29)
+        assert parse_cell_text("infinity", Oid.DATE) == DATE_POS_INFINITY
+        assert parse_cell_text("-infinity", Oid.DATE) == DATE_NEG_INFINITY
+        assert parse_cell_text("0001-01-01", Oid.DATE) == dt.date(1, 1, 1)
+
+    def test_bc_dates_exact(self):
+        from etl_tpu.models import PgSpecialDate
+        from etl_tpu.postgres.codec.text import days_from_civil
+        # civil day algorithm agrees with Python where ranges overlap
+        assert days_from_civil(1970, 1, 1) == 0
+        assert days_from_civil(2024, 2, 29) == (dt.date(2024, 2, 29) - dt.date(1970, 1, 1)).days
+        assert days_from_civil(1, 1, 1) == (dt.date(1, 1, 1) - dt.date(1970, 1, 1)).days
+        v1 = parse_cell_text("0001-01-01 BC", Oid.DATE)  # proleptic year 0
+        v2 = parse_cell_text("4713-01-01 BC", Oid.DATE)
+        assert isinstance(v1, PgSpecialDate) and isinstance(v2, PgSpecialDate)
+        assert v1 != v2 and v2.days < v1.days  # distinct, ordered, exact
+        assert v1.days == days_from_civil(0, 1, 1)
+        assert v1.pg_text() == "0001-01-01 BC"
+
+    def test_bc_timestamp(self):
+        from etl_tpu.models import PgSpecialTimestamp
+        v = parse_cell_text("0001-12-25 01:02:03 BC", Oid.TIMESTAMP)
+        assert isinstance(v, PgSpecialTimestamp)
+        vtz = parse_cell_text("0001-12-25 01:02:03+02 BC", Oid.TIMESTAMPTZ)
+        assert isinstance(vtz, PgSpecialTimestamp) and vtz.tz_aware
+        assert vtz.micros == v.micros - 2 * 3600 * 1_000_000
+
+    def test_time(self):
+        assert parse_cell_text("13:30:05", Oid.TIME) == dt.time(13, 30, 5)
+        assert parse_cell_text("13:30:05.123456", Oid.TIME) == \
+            dt.time(13, 30, 5, 123456)
+        assert parse_cell_text("13:30:05.5", Oid.TIME) == dt.time(13, 30, 5, 500000)
+
+    def test_timetz(self):
+        v = parse_cell_text("13:30:05+02", Oid.TIMETZ)
+        assert v == PgTimeTz(dt.time(13, 30, 5), 7200)
+        v = parse_cell_text("01:00:00.25-05:30", Oid.TIMETZ)
+        assert v == PgTimeTz(dt.time(1, 0, 0, 250000), -19800)
+
+    def test_timestamp(self):
+        assert parse_cell_text("2024-05-01 12:34:56.789", Oid.TIMESTAMP) == \
+            dt.datetime(2024, 5, 1, 12, 34, 56, 789000)
+        assert parse_cell_text("infinity", Oid.TIMESTAMP) == TS_POS_INFINITY
+
+    def test_timestamptz(self):
+        v = parse_cell_text("2024-05-01 12:00:00+02", Oid.TIMESTAMPTZ)
+        assert v == dt.datetime(2024, 5, 1, 10, 0, 0, tzinfo=UTC)
+        v = parse_cell_text("2024-01-01 00:00:00.000001-08", Oid.TIMESTAMPTZ)
+        assert v == dt.datetime(2024, 1, 1, 8, 0, 0, 1, tzinfo=UTC)
+
+    def test_uuid(self):
+        u = "a0eebc99-9c0b-4ef8-bb6d-6bb9bd380a11"
+        assert parse_cell_text(u, Oid.UUID) == uuid.UUID(u)
+
+    def test_json(self):
+        assert parse_cell_text('{"a": [1, 2]}', Oid.JSONB) == {"a": [1, 2]}
+        assert parse_cell_text("3", Oid.JSON) == 3
+
+    def test_interval(self):
+        v = parse_cell_text("1 year 2 mons 3 days 04:05:06.789", Oid.INTERVAL)
+        assert v == PgInterval(14, 3, ((4 * 60 + 5) * 60 + 6) * 1_000_000 + 789000)
+        assert parse_cell_text("-00:00:01", Oid.INTERVAL) == PgInterval(0, 0, -1_000_000)
+        assert parse_cell_text("5 days", Oid.INTERVAL) == PgInterval(0, 5, 0)
+
+    def test_unknown_oid_passthrough(self):
+        assert parse_cell_text("anything", 99999) == "anything"
+
+    def test_null(self):
+        assert parse_cell_text(None, Oid.INT4) is None
+
+
+class TestArrayParsing:
+    def test_int_array(self):
+        assert parse_cell_text("{1,2,NULL,4}", Oid.INT4_ARRAY) == [1, 2, None, 4]
+
+    def test_empty(self):
+        assert parse_cell_text("{}", Oid.TEXT_ARRAY) == []
+
+    def test_quoted_strings(self):
+        assert parse_cell_text('{a,"b,c","d\\"e","NULL",NULL}', Oid.TEXT_ARRAY) == \
+            ["a", "b,c", 'd"e', "NULL", None]
+
+    def test_nested(self):
+        assert parse_cell_text("{{1,2},{3,4}}", Oid.INT4_ARRAY) == [[1, 2], [3, 4]]
+
+    def test_bounds_prefix(self):
+        assert parse_cell_text("[0:2]={10,20,30}", Oid.INT4_ARRAY) == [10, 20, 30]
+
+    def test_numeric_array(self):
+        v = parse_cell_text("{1.5,NULL}", Oid.NUMERIC_ARRAY)
+        assert v == [PgNumeric("1.5"), None]
+
+
+class TestCopyText:
+    def test_simple_split(self):
+        assert split_copy_line(b"1\talice\t3.5") == [b"1", b"alice", b"3.5"]
+
+    def test_null_and_escapes(self):
+        fields = split_copy_line(b"1\t\\N\ta\\tb\\nc\\\\d")
+        assert fields == [b"1", None, b"a\tb\nc\\d"]
+
+    def test_octal_hex_escapes(self):
+        assert unescape_copy_field(b"\\101\\x41\\x4a") == b"AAJ"
+        assert unescape_copy_field(b"\\8") == b"8"  # non-octal passthrough
+
+    def test_empty_fields(self):
+        assert split_copy_line(b"\t\t") == [b"", b"", b""]
+
+    def test_parse_row_typed(self):
+        row = parse_copy_row(b"42\thello\t\\N\tt",
+                             [Oid.INT4, Oid.TEXT, Oid.NUMERIC, Oid.BOOL])
+        assert row.values == [42, "hello", None, True]
+
+    def test_field_count_mismatch(self):
+        with pytest.raises(EtlError):
+            parse_copy_row(b"1\t2", [Oid.INT4])
+
+    def test_encode_roundtrip(self):
+        texts = ["a\tb", None, "line\nbreak", "back\\slash", ""]
+        line = encode_copy_row(texts)
+        fields = split_copy_line(line)
+        expected = [t.encode() if t is not None else None for t in texts]
+        assert fields == expected
+
+
+def make_relation_msg():
+    return pgoutput.RelationMessage(
+        relation_id=16384, namespace="public", relation_name="accounts",
+        replica_identity=ord("d"),
+        columns=[
+            pgoutput.RelationColumn(1, "aid", Oid.INT4, -1),
+            pgoutput.RelationColumn(0, "bid", Oid.INT4, -1),
+            pgoutput.RelationColumn(0, "abalance", Oid.INT4, -1),
+            pgoutput.RelationColumn(0, "filler", Oid.BPCHAR, 88),
+        ])
+
+
+class TestPgOutputRoundtrip:
+    def test_begin_commit(self):
+        ts = 1_700_000_000_000_000
+        b = decode_logical_message(pgoutput.encode_begin(0x100, ts, 777))
+        assert b == pgoutput.BeginMessage(Lsn(0x100), ts, 777)
+        c = decode_logical_message(pgoutput.encode_commit(0x100, 0x108, ts))
+        assert c == pgoutput.CommitMessage(0, Lsn(0x100), Lsn(0x108), ts)
+
+    def test_relation(self):
+        msg = make_relation_msg()
+        enc = pgoutput.encode_relation(
+            msg.relation_id, msg.namespace, msg.relation_name,
+            [(c.flags, c.name, c.type_oid, c.modifier) for c in msg.columns])
+        assert decode_logical_message(enc) == msg
+
+    def test_insert(self):
+        enc = pgoutput.encode_insert(16384, [b"1", b"2", None, b"x"])
+        msg = decode_logical_message(enc)
+        assert isinstance(msg, pgoutput.InsertMessage)
+        assert msg.new_tuple.values == [b"1", b"2", None, b"x"]
+        assert msg.new_tuple.kinds[2] == pgoutput.TUPLE_NULL
+
+    def test_update_variants(self):
+        # no old tuple
+        m = decode_logical_message(pgoutput.encode_update(1, [b"a"]))
+        assert m.old_tuple is None and m.key_tuple is None
+        # key tuple
+        m = decode_logical_message(
+            pgoutput.encode_update(1, [b"a"], key_values=[b"k"]))
+        assert m.key_tuple.values == [b"k"]
+        # full old tuple
+        m = decode_logical_message(
+            pgoutput.encode_update(1, [b"a"], old_values=[b"o"]))
+        assert m.old_tuple.values == [b"o"]
+
+    def test_delete_truncate_message(self):
+        m = decode_logical_message(pgoutput.encode_delete(5, [b"k", None]))
+        assert m.key_tuple.values == [b"k", None]
+        m = decode_logical_message(pgoutput.encode_truncate([1, 2, 3], options=1))
+        assert m.relation_ids == [1, 2, 3] and m.options == 1
+        m = decode_logical_message(
+            pgoutput.encode_logical_message("pfx", b"payload", lsn=9))
+        assert (m.prefix, m.content, m.lsn) == ("pfx", b"payload", Lsn(9))
+
+    def test_toast_unchanged_kind(self):
+        enc = pgoutput.encode_update(
+            1, [b"1", None], new_kinds=[pgoutput.TUPLE_TEXT,
+                                        pgoutput.TUPLE_UNCHANGED_TOAST])
+        m = decode_logical_message(enc)
+        assert m.new_tuple.kinds[1] == pgoutput.TUPLE_UNCHANGED_TOAST
+
+    def test_frame_roundtrip(self):
+        clock = 1_700_000_000_000_000
+        f = decode_replication_frame(
+            pgoutput.encode_xlog_data(0x10, 0x20, clock, b"PAYLOAD"))
+        assert (f.start_lsn, f.end_lsn, f.clock_us, f.payload) == \
+            (Lsn(0x10), Lsn(0x20), clock, b"PAYLOAD")
+        k = decode_replication_frame(
+            pgoutput.encode_primary_keepalive(0x30, clock, True))
+        assert (k.end_lsn, k.reply_requested) == (Lsn(0x30), True)
+        s = decode_standby_status_update(
+            pgoutput.encode_standby_status_update(1, 2, 3, clock, False))
+        assert (s.written, s.flushed, s.applied) == (Lsn(1), Lsn(2), Lsn(3))
+
+    def test_truncated_message_raises(self):
+        enc = pgoutput.encode_insert(16384, [b"1"])
+        with pytest.raises(EtlError):
+            decode_logical_message(enc[:-2])
+
+
+class TestEventDecode:
+    def setup_method(self):
+        self.schema = schema_from_relation_message(make_relation_msg())
+        self.start, self.commit = Lsn(0x1000), Lsn(0x2000)
+
+    def test_schema_from_relation(self):
+        s = self.schema
+        assert s.id == 16384
+        assert s.name == TableName("public", "accounts")
+        assert [c.name for c in s.replicated_columns] == \
+            ["aid", "bid", "abalance", "filler"]
+        assert [c.name for c in s.identity_columns()] == ["aid"]
+
+    def test_replica_identity_full(self):
+        msg = make_relation_msg()
+        msg.replica_identity = ord("f")
+        for c in msg.columns:
+            c.flags = 0
+        s = schema_from_relation_message(msg)
+        assert s.identity_mask.count() == 4
+
+    def test_insert(self):
+        m = decode_logical_message(
+            pgoutput.encode_insert(16384, [b"7", b"1", b"-50", b"pad"]))
+        ev = decode_insert(m, self.schema, self.start, self.commit, 3)
+        assert ev.row.values == [7, 1, -50, "pad"]
+        assert ev.tx_ordinal == 3
+        assert ev.sequence_key.commit_lsn == self.commit
+
+    def test_update_with_key(self):
+        m = decode_logical_message(pgoutput.encode_update(
+            16384, [b"7", b"1", b"99", b"pad"],
+            key_values=[b"7", None, None, None]))
+        ev = decode_update(m, self.schema, self.start, self.commit, 0)
+        assert ev.row.values == [7, 1, 99, "pad"]
+        assert isinstance(ev.old_row, PartialTableRow)
+        assert ev.old_row.values[0] == 7
+        assert ev.old_row.present == [True, False, False, False]
+
+    def test_update_toast_merge_from_old(self):
+        m = decode_logical_message(pgoutput.encode_update(
+            16384,
+            [b"7", b"1", None, b"new"],
+            old_values=[b"7", b"1", b"42", b"old"],
+            new_kinds=[pgoutput.TUPLE_TEXT, pgoutput.TUPLE_TEXT,
+                       pgoutput.TUPLE_UNCHANGED_TOAST, pgoutput.TUPLE_TEXT]))
+        ev = decode_update(m, self.schema, self.start, self.commit, 0)
+        assert ev.row.values == [7, 1, 42, "new"]  # merged from old
+
+    def test_update_toast_without_old_keeps_sentinel(self):
+        m = decode_logical_message(pgoutput.encode_update(
+            16384, [b"7", b"1", None, b"new"],
+            new_kinds=[pgoutput.TUPLE_TEXT, pgoutput.TUPLE_TEXT,
+                       pgoutput.TUPLE_UNCHANGED_TOAST, pgoutput.TUPLE_TEXT]))
+        ev = decode_update(m, self.schema, self.start, self.commit, 0)
+        assert ev.row.values[2] is TOAST_UNCHANGED
+
+    def test_delete(self):
+        m = decode_logical_message(
+            pgoutput.encode_delete(16384, [b"7", None, None, None]))
+        ev = decode_delete(m, self.schema, self.start, self.commit, 1)
+        assert ev.old_row.values[0] == 7
+
+    def test_schema_mismatch(self):
+        m = decode_logical_message(pgoutput.encode_insert(16384, [b"1"]))
+        with pytest.raises(EtlError):
+            decode_insert(m, self.schema, self.start, self.commit, 0)
+
+    def test_ddl_message_roundtrip(self):
+        ts = TableSchema(
+            16384, TableName("public", "accounts"),
+            (ColumnSchema("aid", Oid.INT4, primary_key_ordinal=1, nullable=False),
+             ColumnSchema("note", Oid.TEXT)))
+        payload = encode_schema_change(16384, ts)
+        m = decode_logical_message(pgoutput.encode_logical_message(
+            "supabase_etl_ddl", payload))
+        ev = decode_schema_change(m, self.start, self.commit)
+        assert ev.table_id == 16384
+        assert ev.new_schema.table_schema == ts
+        # dropped table
+        m2 = decode_logical_message(pgoutput.encode_logical_message(
+            "supabase_etl_ddl", encode_schema_change(16384, None)))
+        assert decode_schema_change(m2, self.start, self.commit).new_schema is None
+
+    def test_begin_commit_events(self):
+        ts = 1_700_000_000_000_000
+        b = decode_begin(decode_logical_message(
+            pgoutput.encode_begin(0x2000, ts, 55)), self.start)
+        assert (b.commit_lsn, b.xid) == (Lsn(0x2000), 55)
+        c = decode_commit(decode_logical_message(
+            pgoutput.encode_commit(0x2000, 0x2008, ts)), self.start)
+        assert (c.commit_lsn, c.end_lsn) == (Lsn(0x2000), Lsn(0x2008))

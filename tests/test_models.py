@@ -1,0 +1,229 @@
+"""Data-model unit tests (reference strategy: in-module unit tests, SURVEY §4.1)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from etl_tpu.models import (
+    TOAST_UNCHANGED, CellKind, ColumnMask, ColumnSchema, ColumnarBatch,
+    DeleteEvent, ErrorKind, EtlError, EventSequenceKey, InsertEvent, Lsn, Oid,
+    PartialTableRow, PgInterval, PgNumeric, PgTimeTz, ReplicatedTableSchema,
+    RetryKind, SchemaDiff, TableName, TableRow, TableSchema, UpdateEvent,
+    event_size_hint, kind_for_oid, retry_directive,
+)
+
+
+def make_schema(**kw):
+    cols = (
+        ColumnSchema("id", Oid.INT4, nullable=False, primary_key_ordinal=1),
+        ColumnSchema("name", Oid.TEXT),
+        ColumnSchema("balance", Oid.NUMERIC),
+        ColumnSchema("created", Oid.TIMESTAMPTZ),
+    )
+    return TableSchema(id=16384, name=TableName("public", "users"), columns=cols)
+
+
+class TestLsn:
+    def test_parse_format_roundtrip(self):
+        for text in ["0/0", "1/0", "0/16B3748", "FFFFFFFF/FFFFFFFF", "16/B374D848"]:
+            assert str(Lsn(text)) == text.upper().replace("0X", "")
+
+    def test_ordering_and_arithmetic(self):
+        a, b = Lsn("0/100"), Lsn("0/200")
+        assert a < b
+        assert b - a == 0x100
+        assert a + 0x100 == b
+        assert isinstance(a + 1, Lsn)
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            Lsn("123")
+        with pytest.raises(ValueError):
+            Lsn("x/y")
+        with pytest.raises(ValueError):
+            Lsn(-1)
+
+    def test_int_behavior(self):
+        assert Lsn("0/10") == 16
+        assert {Lsn(5): "a"}[Lsn(5)] == "a"
+
+
+class TestTypes:
+    def test_kind_mapping(self):
+        assert kind_for_oid(Oid.INT4) is CellKind.I32
+        assert kind_for_oid(Oid.NUMERIC) is CellKind.NUMERIC
+        assert kind_for_oid(Oid.INT4_ARRAY) is CellKind.ARRAY
+        assert kind_for_oid(999999) is CellKind.STRING  # unknown → string
+
+    def test_pg_numeric_text(self):
+        assert PgNumeric("12.340").pg_text() == "12.340"
+        assert PgNumeric("NaN").pg_text() == "NaN"
+        assert PgNumeric("Infinity").pg_text() == "Infinity"
+        assert PgNumeric("-Infinity").pg_text() == "-Infinity"
+
+    def test_timetz_text(self):
+        t = PgTimeTz(dt.time(13, 30, 5), 3600)
+        assert t.pg_text() == "13:30:05+01"
+        t2 = PgTimeTz(dt.time(1, 2, 3), -(5 * 3600 + 30 * 60))
+        assert t2.pg_text() == "01:02:03-05:30"
+
+    def test_interval_text(self):
+        assert PgInterval(14, 3, 3_600_000_000).pg_text() == \
+            "1 year 2 mons 3 days 01:00:00"
+        assert PgInterval().pg_text() == "00:00:00"
+
+
+class TestMasks:
+    def test_roundtrip_bytes(self):
+        m = ColumnMask([True, False, True, True, False, False, True, False, True])
+        assert ColumnMask.from_bytes(m.to_bytes(), len(m)) == m
+        assert m.count() == 5
+        assert m.indices() == [0, 2, 3, 6, 8]
+
+    def test_from_names(self):
+        s = make_schema()
+        m = ColumnMask.from_column_names(s, ["id", "balance"])
+        assert list(m) == [True, False, True, False]
+        assert m.as_bool_array().dtype == np.bool_
+
+    def test_replicated_schema(self):
+        s = make_schema()
+        r = ReplicatedTableSchema.with_all_columns(s)
+        assert r.replicated_column_count() == 4
+        assert [c.name for c in r.identity_columns()] == ["id"]
+        # partial replication
+        mask = ColumnMask.from_column_names(s, ["id", "name"])
+        r2 = ReplicatedTableSchema(s, mask, ColumnMask.from_column_names(s, ["id"]))
+        assert [c.name for c in r2.replicated_columns] == ["id", "name"]
+        assert r2.replicated_indices == [0, 1]
+
+    def test_mask_length_validation(self):
+        s = make_schema()
+        with pytest.raises(ValueError):
+            ReplicatedTableSchema(s, ColumnMask([True]), ColumnMask([True]))
+
+
+class TestSchema:
+    def test_json_roundtrip(self):
+        s = make_schema()
+        assert TableSchema.from_json(s.to_json()) == s
+
+    def test_pk(self):
+        s = make_schema()
+        assert s.has_primary_key()
+        assert [c.name for c in s.primary_key_columns()] == ["id"]
+
+    def test_diff(self):
+        old = make_schema()
+        new_cols = list(old.columns)
+        new_cols[1] = ColumnSchema("name", Oid.VARCHAR)  # type change
+        new_cols.append(ColumnSchema("extra", Oid.BOOL))
+        del new_cols[2]  # drop balance
+        new = TableSchema(old.id, old.name, tuple(new_cols))
+        d = SchemaDiff.between(old, new)
+        assert [c.name for c in d.added] == ["extra"]
+        assert [c.name for c in d.dropped] == ["balance"]
+        assert [m.name for m in d.modified] == ["name"]
+        assert d.modified[0].type_changed
+        assert SchemaDiff.between(old, old).is_empty()
+
+
+class TestRowsAndBatches:
+    def test_size_hint(self):
+        r = TableRow([1, "hello", None, PgNumeric("3.14")])
+        assert r.size_hint() > 0
+        assert r.size_hint() == r.size_hint()  # cached
+
+    def test_columnar_roundtrip(self):
+        s = ReplicatedTableSchema.with_all_columns(make_schema())
+        ts = dt.datetime(2024, 5, 1, 12, 0, 0, tzinfo=dt.timezone.utc)
+        rows = [
+            TableRow([1, "alice", PgNumeric("10.50"), ts]),
+            TableRow([2, None, PgNumeric("-3"), None]),
+            TableRow([3, "bob", None, ts + dt.timedelta(seconds=1, microseconds=5)]),
+        ]
+        batch = ColumnarBatch.from_rows(s, rows)
+        assert batch.num_rows == 3
+        id_col = batch.columns[0]
+        assert id_col.is_dense and id_col.data.dtype == np.int32
+        assert list(id_col.data) == [1, 2, 3]
+        ts_col = batch.columns[3]
+        assert ts_col.is_dense and not ts_col.validity[1]
+        back = batch.to_rows()
+        assert back == rows
+
+    def test_to_arrow(self):
+        s = ReplicatedTableSchema.with_all_columns(make_schema())
+        rows = [TableRow([7, "x", PgNumeric("1.25"), None])]
+        rb = ColumnarBatch.from_rows(s, rows).to_arrow()
+        assert rb.num_rows == 1
+        assert rb.column(0).to_pylist() == [7]
+        assert rb.column(3).to_pylist() == [None]
+
+    def test_toast_sentinel_carried_through(self):
+        s = ReplicatedTableSchema.with_all_columns(make_schema())
+        batch = ColumnarBatch.from_rows(s, [TableRow([1, TOAST_UNCHANGED, None, None])])
+        assert not batch.columns[1].validity[0]
+        assert batch.columns[1].is_toast_unchanged(0)
+        assert not batch.columns[2].is_toast_unchanged(0)  # real NULL ≠ TOAST
+        # roundtrip preserves the sentinel instead of nulling it
+        back = batch.to_rows()[0]
+        assert back.values[1] is TOAST_UNCHANGED
+        assert back.values[2] is None
+
+    def test_extreme_timestamps_roundtrip(self):
+        import datetime as dt
+        s = ReplicatedTableSchema.with_all_columns(TableSchema(
+            1, TableName("p", "t"),
+            (ColumnSchema("ts", Oid.TIMESTAMP), ColumnSchema("d", Oid.DATE))))
+        vals = [
+            TableRow([dt.datetime.max, dt.date.max]),  # infinity sentinels
+            TableRow([dt.datetime.min, dt.date.min]),
+            TableRow([dt.datetime(2300, 1, 1, 0, 0, 0, 1), dt.date(2300, 1, 1)]),
+        ]
+        batch = ColumnarBatch.from_rows(s, vals)
+        assert batch.to_rows() == vals  # exact µs past 2^53 float range
+
+    def test_numeric_to_arrow_exact(self):
+        s = ReplicatedTableSchema.with_all_columns(make_schema())
+        rows = [TableRow([1, None, PgNumeric("NaN"), None]),
+                TableRow([2, None, PgNumeric("123456789012345678901234567890.5"), None])]
+        rb = ColumnarBatch.from_rows(s, rows).to_arrow()
+        assert rb.column(2).to_pylist() == \
+            ["NaN", "123456789012345678901234567890.5"]
+
+
+class TestEvents:
+    def test_sequence_key(self):
+        k = EventSequenceKey(Lsn(0x10), 2)
+        assert k < EventSequenceKey(Lsn(0x10), 3) < EventSequenceKey(Lsn(0x11), 0)
+        assert k.with_ordinal(5) == f"{0x10:016x}/{2:016x}/{5:016x}"
+
+    def test_event_size_hints(self):
+        s = ReplicatedTableSchema.with_all_columns(make_schema())
+        row = TableRow([1, "x", None, None])
+        ins = InsertEvent(Lsn(1), Lsn(2), 0, s, row)
+        upd = UpdateEvent(Lsn(1), Lsn(2), 1, s, row,
+                          PartialTableRow([1, None, None, None], [True, False, False, False]))
+        dele = DeleteEvent(Lsn(1), Lsn(2), 2, s, row)
+        assert event_size_hint(upd) > event_size_hint(ins) > 0
+        assert event_size_hint(dele) > 0
+        assert ins.sequence_key == EventSequenceKey(Lsn(2), 0)
+
+
+class TestErrors:
+    def test_retry_mapping(self):
+        assert retry_directive(EtlError(ErrorKind.SOURCE_IO)).kind is RetryKind.TIMED
+        assert retry_directive(EtlError(ErrorKind.MISSING_PRIMARY_KEY)).kind is RetryKind.MANUAL
+        assert retry_directive(EtlError(ErrorKind.SHUTDOWN_REQUESTED)).kind is RetryKind.NO_RETRY
+
+    def test_aggregation_most_conservative(self):
+        e = EtlError.many([EtlError(ErrorKind.SOURCE_IO),
+                           EtlError(ErrorKind.SCHEMA_MISMATCH)])
+        assert retry_directive(e).kind is RetryKind.MANUAL
+        assert set(e.kinds()) >= {ErrorKind.SOURCE_IO, ErrorKind.SCHEMA_MISMATCH}
+
+    def test_single_passthrough(self):
+        single = EtlError(ErrorKind.TIMEOUT)
+        assert EtlError.many([single]) is single
