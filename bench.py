@@ -1,0 +1,139 @@
+"""Benchmark: WAL records/sec decoded on the pgbench CDC workload.
+
+Measures the full TPU decode pipeline (native framing → staging → device
+parse → exact host combine → Arrow columnar output) against the CPU
+pgoutput decoder (the reference-architecture per-tuple path:
+decode_logical_message + decode_insert, mirroring
+crates/etl/src/postgres/codec/event.rs).
+
+Prints ONE JSON line:
+  {"metric": "wal_records_per_sec_decoded", "value": N, "unit": "records/s",
+   "vs_baseline": tpu_over_cpu_ratio, ...}
+
+Run on the real TPU chip (no JAX_PLATFORMS override). BASELINE.json target:
+vs_baseline ≥ 10.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = 65_536
+N_ITERS = 5
+CPU_SAMPLE_ROWS = 16_384  # CPU path timed on a sample, scaled (it's O(n))
+
+
+def build_workload(n_rows: int):
+    """pgbench_accounts insert stream: begin + n inserts + commit."""
+    import random
+
+    from etl_tpu.postgres.codec import pgoutput
+
+    rng = random.Random(7)
+    ts = 1_700_000_000_000_000
+    payloads = [pgoutput.encode_begin(0x5000, ts, 99)]
+    for i in range(n_rows):
+        payloads.append(pgoutput.encode_insert(
+            16384,
+            [str(i + 1).encode(), str(rng.randrange(1, 11)).encode(),
+             str(rng.randrange(-10**9, 10**9)).encode(), b" " * 84]))
+    payloads.append(pgoutput.encode_commit(0x5000, 0x5008, ts))
+    return payloads
+
+
+def make_schema():
+    from etl_tpu.models import (ColumnSchema, Oid, ReplicatedTableSchema,
+                                TableName, TableSchema)
+
+    return ReplicatedTableSchema.with_all_columns(TableSchema(
+        16384, TableName("public", "pgbench_accounts"),
+        (ColumnSchema("aid", Oid.INT4, nullable=False, primary_key_ordinal=1),
+         ColumnSchema("bid", Oid.INT4),
+         ColumnSchema("abalance", Oid.INT4),
+         ColumnSchema("filler", Oid.BPCHAR, modifier=88))))
+
+
+def bench_cpu(payloads, schema, n_rows):
+    """Reference-architecture CPU path: per-message decode into events."""
+    from etl_tpu.models.lsn import Lsn
+    from etl_tpu.postgres.codec import (decode_insert, decode_logical_message)
+    from etl_tpu.postgres.codec.pgoutput import InsertMessage
+
+    sample = payloads[1 : 1 + CPU_SAMPLE_ROWS]
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ordinal = 0
+        for p in sample:
+            msg = decode_logical_message(p)
+            if isinstance(msg, InsertMessage):
+                decode_insert(msg, schema, Lsn(1), Lsn(2), ordinal)
+                ordinal += 1
+        times.append(time.perf_counter() - t0)
+    per_row = statistics.median(times) / len(sample)
+    return 1.0 / per_row  # records/sec
+
+
+def bench_tpu(payloads, schema, n_rows):
+    """Sustained pipelined throughput: stage batch N+1 and complete batch
+    N-1 while batch N is in flight on the device — the same software
+    pipelining the apply loop uses (one in-flight write, apply.rs:1956)."""
+    from etl_tpu.ops import DeviceDecoder
+    from etl_tpu.ops.wal import concat_payloads, stage_wal_batch
+
+    buf, offs, lens = concat_payloads(payloads)
+    decoder = DeviceDecoder(schema)
+
+    def stage():
+        return stage_wal_batch(buf, offs, lens, 4)
+
+    # warmup: jit compile + transfer paths
+    decoder.decode(stage().staged)
+
+    n_batches = 8
+    times = []
+    for _ in range(N_ITERS):
+        t0 = time.perf_counter()
+        pending = []
+        done = 0
+        for _ in range(n_batches):
+            wal = stage()
+            pending.append(decoder.decode_async(wal.staged))
+            if len(pending) >= 3:  # keep ≤2 in flight ahead of completion
+                batch = pending.pop(0).result()
+                assert batch.num_rows == n_rows
+                done += 1
+        for p in pending:
+            assert p.result().num_rows == n_rows
+            done += 1
+        dt = time.perf_counter() - t0
+        times.append(dt / n_batches)
+    return n_rows / statistics.median(times)
+
+
+def main():
+    import jax
+
+    payloads = build_workload(N_ROWS)
+    schema = make_schema()
+    cpu_rps = bench_cpu(payloads, schema, N_ROWS)
+    tpu_rps = bench_tpu(payloads, schema, N_ROWS)
+    result = {
+        "metric": "wal_records_per_sec_decoded",
+        "value": round(tpu_rps),
+        "unit": "records/s",
+        "vs_baseline": round(tpu_rps / cpu_rps, 2),
+        "cpu_baseline_records_per_sec": round(cpu_rps),
+        "backend": jax.default_backend(),
+        "workload": f"pgbench insert CDC, {N_ROWS} rows/batch",
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

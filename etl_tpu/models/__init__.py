@@ -1,6 +1,6 @@
 """Core data model: LSNs, schemas+masks, cells, rows, events, errors."""
 
-from .cell import (TOAST_UNCHANGED, PgInterval, PgNumeric, PgSpecialDate,
+from .cell import (JSON_NULL, TOAST_UNCHANGED, PgInterval, PgNumeric, PgSpecialDate,
                    PgSpecialTimestamp, PgTimeTz, py_value_kind)
 from .errors import (EtlError, ErrorKind, RetryDirective, RetryKind,
                      etl_error, retry_directive)

@@ -178,3 +178,24 @@ class ToastUnchanged:
 
 
 TOAST_UNCHANGED = ToastUnchanged()
+
+
+class JsonNull:
+    """The JSON value `null` — a real value, distinct from SQL NULL
+    (reference: Cell::Json(Value::Null) vs Cell::Null). Singleton."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "JSON_NULL"
+
+    def __bool__(self) -> bool:
+        return False
+
+
+JSON_NULL = JsonNull()

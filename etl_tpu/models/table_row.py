@@ -128,7 +128,7 @@ class Column:
     codec/event.rs)."""
 
     schema: ColumnSchema
-    data: Any  # np.ndarray for dense kinds, list for object kinds
+    data: Any  # np.ndarray (dense) | pyarrow.Array (string) | list (object)
     validity: np.ndarray  # bool[n], True = value present (not NULL/unchanged)
     toast_unchanged: np.ndarray | None = None  # bool[n] or None if none set
 
@@ -138,6 +138,24 @@ class Column:
     @property
     def is_dense(self) -> bool:
         return isinstance(self.data, np.ndarray)
+
+    @property
+    def is_arrow(self) -> bool:
+        import pyarrow as pa
+
+        return isinstance(self.data, pa.Array)
+
+    def value(self, i: int) -> Any:
+        """Python value at row i regardless of storage form."""
+        if self.is_toast_unchanged(i):
+            return TOAST_UNCHANGED
+        if not self.validity[i]:
+            return None
+        if self.is_dense:
+            return _from_dense(self.schema.kind, self.data[i])
+        if self.is_arrow:
+            return self.data[i].as_py()
+        return self.data[i]
 
     def is_toast_unchanged(self, i: int) -> bool:
         return self.toast_unchanged is not None and bool(self.toast_unchanged[i])
@@ -185,20 +203,8 @@ class ColumnarBatch:
         return cls(schema, columns)
 
     def to_rows(self) -> list[TableRow]:
-        rows = []
-        for i in range(self.num_rows):
-            vals = []
-            for c in self.columns:
-                if c.is_toast_unchanged(i):
-                    vals.append(TOAST_UNCHANGED)
-                elif not c.validity[i]:
-                    vals.append(None)
-                elif c.is_dense:
-                    vals.append(_from_dense(c.schema.kind, c.data[i]))
-                else:
-                    vals.append(c.data[i])
-            rows.append(TableRow(vals))
-        return rows
+        return [TableRow([c.value(i) for c in self.columns])
+                for i in range(self.num_rows)]
 
     def size_hint(self) -> int:
         total = 0
@@ -224,8 +230,16 @@ class ColumnarBatch:
         for c in self.columns:
             names.append(c.schema.name)
             mask = ~c.validity
-            if c.schema.kind is CellKind.NUMERIC:
+            if c.is_arrow:
+                arrays.append(c.data)
+            elif c.schema.kind is CellKind.NUMERIC and not c.is_dense:
+                # exact text form (numeric_mode="f64" stores dense floats
+                # instead and takes the plain dense branch below)
                 vals = [c.data[i].pg_text() if c.validity[i] else None
+                        for i in range(self.num_rows)]
+                arrays.append(pa.array(vals, type=pa.string()))
+            elif c.schema.kind is CellKind.JSON:
+                vals = [_json_text(c.data[i]) if c.validity[i] else None
                         for i in range(self.num_rows)]
                 arrays.append(pa.array(vals, type=pa.string()))
             elif c.is_dense:
@@ -304,9 +318,21 @@ def _from_dense(kind: CellKind, v):
         return bool(v)
     if kind in (CellKind.I16, CellKind.I32, CellKind.U32, CellKind.I64):
         return int(v)
-    if kind in (CellKind.F32, CellKind.F64):
-        return float(v)
-    return v
+    # remaining dense kinds (floats; NUMERIC under numeric_mode="f64")
+    return float(v)
+
+
+def _json_text(v: Any) -> str:
+    """Serialize a decoded JSON column value back to JSON text (Arrow/
+    destination form). JSON_NULL is the literal `null`, distinct from SQL
+    NULL which is an absent (masked) value."""
+    import json
+
+    from .cell import JSON_NULL
+
+    if v is JSON_NULL:
+        return "null"
+    return json.dumps(v)
 
 
 def _arrow_scalar(v: Any):
@@ -316,10 +342,12 @@ def _arrow_scalar(v: Any):
         return v.pg_text()
     if isinstance(v, PgInterval):
         return v.pg_text()
-    if isinstance(v, dict) or isinstance(v, list):
-        import json
-
-        return json.dumps(v) if isinstance(v, dict) else v
+    if isinstance(v, dict):
+        return _json_text(v)
     if v is TOAST_UNCHANGED:
         return None
+    from .cell import JSON_NULL
+
+    if v is JSON_NULL:
+        return "null"
     return v

@@ -1,0 +1,197 @@
+"""Native host components: the pgoutput framer (C, via ctypes).
+
+Builds `framer.c` with the system compiler on first import (cached as
+`_framer-<hash>.so`); falls back to a pure-Python walker with identical
+outputs when no compiler is available. `frame_pgoutput` is the entry point;
+see ops/wal.py for the staging layer that consumes it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).resolve().parent
+
+FLAG_VALUE, FLAG_NULL, FLAG_TOAST, FLAG_BINARY = 0, 1, 2, 3
+
+_lib = None
+_build_error: str | None = None
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    src = _DIR / "framer.c"
+    tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    so = _DIR / f"_framer-{tag}.so"
+    try:
+        if not so.exists():
+            cc = os.environ.get("CC", "cc")
+            subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", str(src), "-o", str(so)],
+                check=True, capture_output=True, timeout=120)
+        lib = ctypes.CDLL(str(so))
+        lib.etl_frame_pgoutput.restype = ctypes.c_int64
+        lib.etl_frame_pgoutput.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,  # buf, buf_len
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,  # msg_off/len/n
+            ctypes.c_int32,  # n_cols
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,  # kind/relid/oldkind
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,  # new off/len/flag
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,  # old off/len/flag
+        ]
+        _lib = lib
+    except Exception as e:  # pragma: no cover - depends on toolchain
+        _build_error = f"{type(e).__name__}: {e}"
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class FramedBatch:
+    """Output of the framer over n messages (see framer.c doc comment)."""
+
+    __slots__ = ("buf", "kind", "relid", "old_kind", "new_off", "new_len",
+                 "new_flag", "old_off", "old_len", "old_flag", "n_msgs")
+
+    def __init__(self, buf: np.ndarray, n_msgs: int, n_cols: int):
+        self.buf = buf
+        self.n_msgs = n_msgs
+        self.kind = np.zeros(n_msgs, dtype=np.uint8)
+        self.relid = np.zeros(n_msgs, dtype=np.int32)
+        self.old_kind = np.zeros(n_msgs, dtype=np.uint8)
+        shape = (n_msgs, n_cols)
+        self.new_off = np.zeros(shape, dtype=np.int32)
+        self.new_len = np.zeros(shape, dtype=np.int32)
+        self.new_flag = np.full(shape, FLAG_NULL, dtype=np.uint8)
+        self.old_off = np.zeros(shape, dtype=np.int32)
+        self.old_len = np.zeros(shape, dtype=np.int32)
+        self.old_flag = np.full(shape, FLAG_NULL, dtype=np.uint8)
+
+
+def frame_pgoutput(buf: bytes | np.ndarray, msg_off: np.ndarray,
+                   msg_len: np.ndarray, n_cols: int) -> tuple[FramedBatch, int]:
+    """Frame `len(msg_off)` pgoutput messages inside `buf`.
+
+    Returns (framed, first_bad_index) — first_bad_index is -1 when every
+    message framed cleanly; otherwise framing stopped there and the caller
+    falls back to the CPU decoder for the remainder.
+    """
+    data = np.frombuffer(buf, dtype=np.uint8) if isinstance(buf, (bytes, bytearray)) \
+        else np.ascontiguousarray(buf, dtype=np.uint8)
+    msg_off = np.ascontiguousarray(msg_off, dtype=np.int64)
+    msg_len = np.ascontiguousarray(msg_len, dtype=np.int32)
+    n = len(msg_off)
+    out = FramedBatch(data, n, n_cols)
+    lib = _load()
+    if lib is not None:
+        def p(a):
+            return a.ctypes.data_as(ctypes.c_void_p)
+
+        bad = lib.etl_frame_pgoutput(
+            p(data), len(data), p(msg_off), p(msg_len), n, n_cols,
+            p(out.kind), p(out.relid), p(out.old_kind),
+            p(out.new_off), p(out.new_len), p(out.new_flag),
+            p(out.old_off), p(out.old_len), p(out.old_flag))
+        return out, int(bad)
+    return _frame_py(data, msg_off, msg_len, n_cols, out)
+
+
+def _frame_py(data: np.ndarray, msg_off: np.ndarray, msg_len: np.ndarray,
+              n_cols: int, out: FramedBatch) -> tuple[FramedBatch, int]:
+    """Pure-Python fallback with identical semantics to framer.c."""
+    import struct
+
+    buf = data.tobytes()
+
+    def walk(pos: int, end: int, row: int, off, ln, fl) -> int:
+        if pos + 2 > end:
+            return -1
+        ncols = struct.unpack_from(">h", buf, pos)[0]
+        pos += 2
+        if ncols != n_cols:
+            return -1
+        for c in range(ncols):
+            if pos + 1 > end:
+                return -1
+            k = buf[pos]
+            pos += 1
+            if k == ord("n"):
+                fl[row, c] = FLAG_NULL
+            elif k == ord("u"):
+                fl[row, c] = FLAG_TOAST
+            elif k in (ord("t"), ord("b")):
+                if pos + 4 > end:
+                    return -1
+                vlen = struct.unpack_from(">i", buf, pos)[0]
+                pos += 4
+                if vlen < 0 or pos + vlen > end:
+                    return -1
+                off[row, c] = pos
+                ln[row, c] = vlen
+                fl[row, c] = FLAG_VALUE if k == ord("t") else FLAG_BINARY
+                pos += vlen
+            else:
+                return -1
+        return pos
+
+    for i in range(len(msg_off)):
+        pos = int(msg_off[i])
+        end = pos + int(msg_len[i])
+        if end > len(buf) or msg_len[i] < 1:
+            return out, i
+        tag = buf[pos]
+        out.kind[i] = tag
+        if tag == ord("I"):
+            if pos + 6 > end or buf[pos + 5] != ord("N"):
+                out.kind[i] = 0
+                return out, i
+            out.relid[i] = struct.unpack_from(">I", buf, pos + 1)[0]
+            if walk(pos + 6, end, i, out.new_off, out.new_len,
+                    out.new_flag) < 0:
+                out.kind[i] = 0
+                return out, i
+        elif tag == ord("U"):
+            if pos + 6 > end:
+                out.kind[i] = 0
+                return out, i
+            out.relid[i] = struct.unpack_from(">I", buf, pos + 1)[0]
+            pos += 5
+            marker = buf[pos]
+            if marker in (ord("O"), ord("K")):
+                out.old_kind[i] = marker
+                pos = walk(pos + 1, end, i, out.old_off, out.old_len,
+                           out.old_flag)
+                if pos < 0 or pos + 1 > end:
+                    out.kind[i] = 0
+                    return out, i
+                marker = buf[pos]
+            if marker != ord("N"):
+                out.kind[i] = 0
+                return out, i
+            if walk(pos + 1, end, i, out.new_off, out.new_len,
+                    out.new_flag) < 0:
+                out.kind[i] = 0
+                return out, i
+        elif tag == ord("D"):
+            if pos + 6 > end or buf[pos + 5] not in (ord("O"), ord("K")):
+                out.kind[i] = 0
+                return out, i
+            out.relid[i] = struct.unpack_from(">I", buf, pos + 1)[0]
+            out.old_kind[i] = buf[pos + 5]
+            if walk(pos + 6, end, i, out.old_off, out.old_len,
+                    out.old_flag) < 0:
+                out.kind[i] = 0
+                return out, i
+    return out, -1

@@ -1,0 +1,167 @@
+/* pgoutput message framer — the native host hot path.
+ *
+ * Walks a batch of logical-replication message payloads (concatenated in one
+ * buffer) and emits, for every Insert/Update/Delete, the absolute
+ * offset/length/flag of each tuple field — zero-copy: field bytes are never
+ * moved, the offsets point straight into the WAL payload buffer that is then
+ * uploaded to the device whole.
+ *
+ * This replaces the per-tuple decode loop of the reference
+ * (crates/etl/src/postgres/codec/event.rs) with an index-building pass;
+ * the actual parsing happens on the TPU (etl_tpu/ops). Python fallback:
+ * etl_tpu/native/__init__.py.
+ *
+ * Build: cc -O3 -shared -fPIC framer.c -o _framer.so  (see native/__init__.py)
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+#define FLAG_VALUE 0
+#define FLAG_NULL 1
+#define FLAG_TOAST 2
+#define FLAG_BINARY 3
+
+static inline uint32_t be32(const uint8_t *p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+static inline uint16_t be16(const uint8_t *p) {
+    return ((uint16_t)p[0] << 8) | (uint16_t)p[1];
+}
+
+/* Walk one TupleData at buf[pos..end); fill n_cols entries of off/len/flag.
+ * Returns new pos, or -1 on malformed input. */
+static int64_t walk_tuple(const uint8_t *buf, int64_t pos, int64_t end,
+                          int32_t n_cols, int64_t base,
+                          int32_t *off, int32_t *len, uint8_t *flag) {
+    if (pos + 2 > end) return -1;
+    int32_t ncols = (int32_t)be16(buf + pos);
+    pos += 2;
+    if (ncols != n_cols) return -1;
+    for (int32_t c = 0; c < ncols; c++) {
+        if (pos + 1 > end) return -1;
+        uint8_t kind = buf[pos++];
+        switch (kind) {
+        case 'n':
+            off[c] = 0; len[c] = 0; flag[c] = FLAG_NULL;
+            break;
+        case 'u':
+            off[c] = 0; len[c] = 0; flag[c] = FLAG_TOAST;
+            break;
+        case 't':
+        case 'b': {
+            if (pos + 4 > end) return -1;
+            int32_t vlen = (int32_t)be32(buf + pos);
+            pos += 4;
+            if (vlen < 0 || pos + vlen > end) return -1;
+            off[c] = (int32_t)(pos - base);
+            len[c] = vlen;
+            flag[c] = kind == 't' ? FLAG_VALUE : FLAG_BINARY;
+            pos += vlen;
+            break;
+        }
+        default:
+            return -1;
+        }
+    }
+    return pos;
+}
+
+/* Frame a batch of pgoutput messages.
+ *
+ * Outputs (per message i):
+ *   kind_out[i]   message tag byte ('I','U','D','B','C','R','T','M','O','Y'),
+ *                 0 if malformed
+ *   relid_out[i]  relation oid for I/U/D, else 0
+ *   old_kind[i]   0 none, 'K' key tuple, 'O' full old tuple (U/D)
+ *   new_/old_ arrays: [i*n_cols + c] field offset (relative to buf start),
+ *                 length, flag. For D the old tuple fills the old_ arrays.
+ *
+ * Returns -1 if every message framed cleanly, else the index of the first
+ * malformed message (framing stops there).
+ */
+int64_t etl_frame_pgoutput(const uint8_t *buf, int64_t buf_len,
+                           const int64_t *msg_off, const int32_t *msg_len,
+                           int64_t n_msgs, int32_t n_cols,
+                           uint8_t *kind_out, int32_t *relid_out,
+                           uint8_t *old_kind,
+                           int32_t *new_off, int32_t *new_len,
+                           uint8_t *new_flag, int32_t *old_off,
+                           int32_t *old_len, uint8_t *old_flag) {
+    for (int64_t i = 0; i < n_msgs; i++) {
+        int64_t pos = msg_off[i];
+        int64_t end = pos + msg_len[i];
+        if (end > buf_len || msg_len[i] < 1) return i;
+        uint8_t tag = buf[pos];
+        kind_out[i] = tag;
+        relid_out[i] = 0;
+        old_kind[i] = 0;
+        int32_t *noff = new_off + i * n_cols;
+        int32_t *nlen = new_len + i * n_cols;
+        uint8_t *nflag = new_flag + i * n_cols;
+        int32_t *ooff = old_off + i * n_cols;
+        int32_t *olen = old_len + i * n_cols;
+        uint8_t *oflag = old_flag + i * n_cols;
+        for (int32_t c = 0; c < n_cols; c++) {
+            nflag[c] = FLAG_NULL; noff[c] = 0; nlen[c] = 0;
+            oflag[c] = FLAG_NULL; ooff[c] = 0; olen[c] = 0;
+        }
+        switch (tag) {
+        case 'I': {
+            if (pos + 6 > end) { kind_out[i] = 0; return i; }
+            relid_out[i] = (int32_t)be32(buf + pos + 1);
+            if (buf[pos + 5] != 'N') { kind_out[i] = 0; return i; }
+            pos = walk_tuple(buf, pos + 6, end, n_cols, 0, noff, nlen, nflag);
+            if (pos < 0) { kind_out[i] = 0; return i; }
+            break;
+        }
+        case 'U': {
+            if (pos + 6 > end) { kind_out[i] = 0; return i; }
+            relid_out[i] = (int32_t)be32(buf + pos + 1);
+            pos += 5;
+            uint8_t marker = buf[pos];
+            if (marker == 'O' || marker == 'K') {
+                old_kind[i] = marker;
+                pos = walk_tuple(buf, pos + 1, end, n_cols, 0, ooff, olen,
+                                 oflag);
+                if (pos < 0 || pos + 1 > end) { kind_out[i] = 0; return i; }
+                marker = buf[pos];
+            }
+            if (marker != 'N') { kind_out[i] = 0; return i; }
+            pos = walk_tuple(buf, pos + 1, end, n_cols, 0, noff, nlen, nflag);
+            if (pos < 0) { kind_out[i] = 0; return i; }
+            break;
+        }
+        case 'D': {
+            if (pos + 6 > end) { kind_out[i] = 0; return i; }
+            relid_out[i] = (int32_t)be32(buf + pos + 1);
+            uint8_t marker = buf[pos + 5];
+            if (marker != 'O' && marker != 'K') { kind_out[i] = 0; return i; }
+            old_kind[i] = marker;
+            pos = walk_tuple(buf, pos + 6, end, n_cols, 0, ooff, olen, oflag);
+            if (pos < 0) { kind_out[i] = 0; return i; }
+            break;
+        }
+        default:
+            /* non-row message: host decodes it (rare) */
+            break;
+        }
+    }
+    return -1;
+}
+
+/* COPY text scan: find tab/newline delimiter positions.
+ * Kept for parity with the numpy scan; the numpy version is already
+ * vectorized, so this exists for callers that want a single pass without
+ * numpy temporaries. Returns number of delimiters written (capped at cap). */
+int64_t etl_scan_copy_delims(const uint8_t *buf, int64_t n, int64_t *out,
+                             int64_t cap) {
+    int64_t k = 0;
+    for (int64_t i = 0; i < n && k < cap; i++) {
+        uint8_t b = buf[i];
+        if (b == '\t' || b == '\n') out[k++] = i;
+    }
+    return k;
+}

@@ -1,0 +1,5 @@
+"""TPU decode engine: staging, device parsers, the batch decoder."""
+
+from .engine import DEVICE_KINDS, DeviceDecoder
+from .staging import (StagedBatch, bucket_pow2, bucket_rows,
+                      stage_copy_chunk, stage_tuples)

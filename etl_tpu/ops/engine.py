@@ -1,0 +1,379 @@
+"""DeviceDecoder: the TPU decode engine (`batch_engine=tpu`).
+
+Pipeline per batch (north star in BASELINE.json):
+
+  StagedBatch (host, ops/staging.py)
+    → host pack: vectorized numpy gather of all dense-column field bytes
+      into ONE [R, ΣW] byte matrix (minimizes host↔device transfer: only
+      bytes the device parses are uploaded, in one array)
+    → device: one jitted program per (row-bucket, width-signature) parsing
+      every dense column (ops/parsers.py) and emitting ONE packed int32
+      [K, R] result matrix + a per-row ok-bitfield row (single fetch —
+      the tunnel/PCIe round trip is latency-bound, so transfer count
+      matters more than bytes)
+    → host: exact numpy combines into int64/f64 columns
+    → CPU-oracle fallback decode for flagged rows (escapes, BC dates,
+      17-digit floats, oversized fields) — mixed batches partition,
+      they never fail
+    → ColumnarBatch (typed columnar + validity + TOAST masks)
+
+`decode_async` dispatches without blocking so the host stages batch N+1
+while the device works on batch N (the software-pipelining analogue of the
+reference's one-in-flight flush, apply.rs:1956-2023).
+
+Object-typed columns (text, uuid, json, bytea, numeric-as-text, arrays,
+intervals) are materialized host-side — strings via a vectorized Arrow
+gather, no per-row Python objects.
+
+Reference parity: replaces the per-tuple `parse_cell_from_postgres_text`
+hot loop (crates/etl/src/postgres/codec/text.rs) behind the same batching
+boundary the reference flushes at (apply.rs:1910-1948).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.pgtypes import CellKind
+from ..models.schema import ReplicatedTableSchema
+from ..models.table_row import Column, ColumnarBatch, dense_dtype
+from ..postgres.codec.text import parse_cell_text
+from . import parsers
+from .staging import StagedBatch, bucket_pow2, bucket_width
+
+# kinds parsed on device; everything else is host-object
+DEVICE_KINDS = frozenset({
+    CellKind.BOOL, CellKind.I16, CellKind.I32, CellKind.U32, CellKind.I64,
+    CellKind.F32, CellKind.F64, CellKind.DATE, CellKind.TIME,
+    CellKind.TIMESTAMP, CellKind.TIMESTAMPTZ,
+})
+
+_MIN_WIDTH = {
+    CellKind.DATE: 16,
+    CellKind.TIME: 16,
+    CellKind.TIMESTAMP: 32,
+    CellKind.TIMESTAMPTZ: 64,
+    CellKind.F32: 16,
+    CellKind.F64: 32,
+}
+MAX_FIELD_WIDTH = 2048  # beyond this a field goes to CPU fallback
+
+# packed output rows per kind = its component count (parsers.COLUMN_COMPONENTS)
+_PACK_ROWS = {k: len(v) for k, v in parsers.COLUMN_COMPONENTS.items()}
+
+
+@dataclasses.dataclass(frozen=True)
+class _ColSpec:
+    index: int  # position among replicated columns
+    kind: CellKind
+
+
+def build_device_program(specs: tuple[tuple[int, CellKind, int], ...]):
+    """The (unjitted) single-chip forward step for one width-signature.
+
+    Inputs:  bmat u8[R, ΣW] packed field bytes, lengths i32[R, n_dense]
+    Output:  packed i32[K, R]: row 0 is the ok-bitfield (bit j = dense col j
+             parsed clean), then each column's value rows (_PACK_ROWS).
+    """
+
+    def fn(bmat, lengths):
+        lengths = lengths.astype(jnp.int32)
+        R = bmat.shape[0]
+        rows = []
+        okbits = jnp.zeros(R, dtype=jnp.int32)
+        w_off = 0
+        for j, (col_idx, kind, width) in enumerate(specs):
+            b = bmat[:, w_off : w_off + width].astype(jnp.int32)
+            w_off += width
+            comp, ok = parsers.parse_column(kind, b, lengths[:, j])
+            rows += [comp[k] for k in parsers.COLUMN_COMPONENTS[kind]]
+            okbits = okbits | (ok.astype(jnp.int32) << j)
+        return jnp.stack([okbits] + rows, axis=0)
+
+    return fn
+
+
+def _build_device_fn(specs):
+    return jax.jit(build_device_program(specs))
+
+
+def _combine(kind: CellKind, rows: np.ndarray) -> np.ndarray:
+    """Exact host-side combine of packed device rows (ordered per
+    parsers.COLUMN_COMPONENTS) into the column dtype."""
+    if kind is CellKind.BOOL:
+        return rows[0].astype(np.bool_)
+    if kind in (CellKind.I16, CellKind.I32, CellKind.U32):
+        return rows[0].astype(dense_dtype(kind))
+    if kind is CellKind.I64:
+        neg, l0, l1, l2 = rows
+        v = (l2.astype(np.int64) * 10**18 + l1.astype(np.int64) * 10**9
+             + l0.astype(np.int64))
+        return np.where(neg != 0, -v, v)
+    if kind in (CellKind.F32, CellKind.F64):
+        neg, l0, l1, ea, sp = rows
+        m = (l1.astype(np.int64) * 10**9 + l0.astype(np.int64)) \
+            .astype(np.float64)
+        ea = ea.astype(np.int64)
+        v = np.where(ea >= 0, m * np.power(10.0, np.clip(ea, 0, 22)),
+                     m / np.power(10.0, np.clip(-ea, 0, 22)))
+        v = np.where(neg != 0, -v, v)
+        v = np.where(sp == 1, np.nan, v)
+        v = np.where(sp == 2, np.inf, v)
+        v = np.where(sp == 3, -np.inf, v)
+        return v.astype(dense_dtype(kind))
+    if kind is CellKind.DATE:
+        return rows[0].astype(np.int32)
+    if kind is CellKind.TIME:
+        return rows[0].astype(np.int64) * 1000 + rows[1].astype(np.int64)
+    if kind in (CellKind.TIMESTAMP, CellKind.TIMESTAMPTZ):
+        days, ms, us = rows
+        return (days.astype(np.int64) * 86_400_000_000
+                + ms.astype(np.int64) * 1000 + us.astype(np.int64))
+    raise AssertionError(kind)
+
+
+class _PendingDecode:
+    """Handle for an in-flight device decode; `result()` completes it."""
+
+    __slots__ = ("_decoder", "_staged", "_widths", "_packed", "_done")
+
+    def __init__(self, decoder: "DeviceDecoder", staged: StagedBatch,
+                 widths: tuple[int, ...], packed):
+        self._decoder = decoder
+        self._staged = staged
+        self._widths = widths
+        self._packed = packed
+        self._done: ColumnarBatch | None = None
+
+    def result(self) -> ColumnarBatch:
+        if self._done is None:
+            self._done = self._decoder._complete(self._staged, self._widths,
+                                                 self._packed)
+        return self._done
+
+
+class DeviceDecoder:
+    """Schema-bound batch decoder. jit caches are per-instance, keyed by
+    (row_capacity, width-signature)."""
+
+    def __init__(self, schema: ReplicatedTableSchema, *,
+                 numeric_mode: str = "text"):
+        self.schema = schema
+        cols = schema.replicated_columns
+        self._numeric_mode = numeric_mode
+        self._dense: list[_ColSpec] = []
+        self._object: list[_ColSpec] = []
+        for i, c in enumerate(cols):
+            kind = c.kind
+            if kind is CellKind.NUMERIC and numeric_mode == "f64":
+                kind = CellKind.F64
+            if kind in DEVICE_KINDS:
+                self._dense.append(_ColSpec(i, kind))
+            else:
+                self._object.append(_ColSpec(i, kind))
+        if len(self._dense) > 31:
+            # ok-bitfield packs into one int32 row; extraordinarily wide
+            # tables spill the tail columns to the host-object path
+            for spec in self._dense[31:]:
+                self._object.append(spec)
+            self._dense = self._dense[:31]
+        self._fn_cache: dict[tuple, Callable] = {}
+
+    # -- internals ----------------------------------------------------------
+
+    def _widths(self, staged: StagedBatch) -> tuple[int, ...]:
+        out = []
+        for spec in self._dense:
+            need = max(staged.max_field_len(spec.index),
+                       _MIN_WIDTH.get(spec.kind, 4))
+            out.append(bucket_width(need, hi=MAX_FIELD_WIDTH))
+        return tuple(out)
+
+    def _pack_host(self, staged: StagedBatch, widths: tuple[int, ...]):
+        """Vectorized gather of all dense fields into one byte matrix."""
+        R = staged.row_capacity
+        total_w = sum(widths)
+        ldtype = np.uint8 if max(widths, default=0) <= 255 else np.int32
+        bmat = np.zeros((R, total_w), dtype=np.uint8)
+        lengths = np.zeros((R, len(self._dense)), dtype=ldtype)
+        data = staged.data
+        n = len(data)
+        w_off = 0
+        for j, (spec, w) in enumerate(zip(self._dense, widths)):
+            offs = staged.offsets[:, spec.index].astype(np.int64)
+            lens = np.minimum(staged.lengths[:, spec.index], w)
+            lengths[:, j] = lens
+            idx = offs[:, None] + np.arange(w, dtype=np.int64)[None, :]
+            np.clip(idx, 0, max(n - 1, 0), out=idx)
+            if n:
+                g = data[idx]
+                mask = np.arange(w, dtype=np.int32)[None, :] < lens[:, None]
+                bmat[:, w_off : w_off + w] = np.where(mask, g, 0)
+            w_off += w
+        return bmat, lengths
+
+    def _device_call(self, staged: StagedBatch, widths: tuple[int, ...]):
+        key = (staged.row_capacity, widths)
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            specs = tuple((s.index, s.kind, w)
+                          for s, w in zip(self._dense, widths))
+            fn = _build_device_fn(specs)
+            self._fn_cache[key] = fn
+        bmat, lengths = self._pack_host(staged, widths)
+        return fn(bmat, lengths)  # async dispatch
+
+    def _gather_string_arrow(self, staged: StagedBatch, spec: _ColSpec,
+                             valid: np.ndarray):
+        """Vectorized scatter-gather of a string column into an Arrow array:
+        no per-row Python objects — the columnar-native fast path."""
+        import pyarrow as pa
+
+        n = staged.n_rows
+        offs = staged.offsets[:n, spec.index].astype(np.int32)
+        lens = np.where(valid[:n], staged.lengths[:n, spec.index], 0) \
+            .astype(np.int32)
+        total = int(lens.sum())
+        arrow_offsets = np.zeros(n + 1, dtype=np.int32)
+        np.cumsum(lens, out=arrow_offsets[1:])
+        if total:
+            starts_rep = np.repeat(offs, lens)
+            prefix_rep = np.repeat(arrow_offsets[:-1], lens)
+            idx = np.arange(total, dtype=np.int32)
+            idx -= prefix_rep
+            idx += starts_rep
+            values = staged.data[idx]
+        else:
+            values = np.zeros(0, dtype=np.uint8)
+        validity = pa.array(valid[:n]).buffers()[1]
+        # py_buffer over the ndarrays directly — no tobytes() copies
+        return pa.StringArray.from_buffers(
+            n, pa.py_buffer(arrow_offsets), pa.py_buffer(values), validity)
+
+    def _decode_object_column(self, staged: StagedBatch, spec: _ColSpec,
+                              valid: np.ndarray) -> Any:
+        col = self.schema.replicated_columns[spec.index]
+        n = staged.n_rows
+        if spec.kind is CellKind.STRING and not staged.copy_escapes:
+            return self._gather_string_arrow(staged, spec, valid)
+        out: list[Any] = [None] * n
+        offs = staged.offsets[:, spec.index]
+        lens = staged.lengths[:, spec.index]
+        data = staged.data
+        if spec.kind is CellKind.STRING:
+            # COPY path may carry escapes → per-row decode (escaped rows are
+            # already routed to cpu_fallback_rows and fixed up afterwards)
+            for i in np.flatnonzero(valid[:n]):
+                out[i] = data[offs[i] : offs[i] + lens[i]].tobytes().decode("utf-8")
+        else:
+            oid = col.type_oid
+            for i in np.flatnonzero(valid[:n]):
+                text = data[offs[i] : offs[i] + lens[i]].tobytes().decode("utf-8")
+                out[i] = parse_cell_text(text, oid)
+        return out
+
+    def _cpu_fixup(self, staged: StagedBatch, rows: np.ndarray,
+                   columns: list[Column]) -> None:
+        """Re-decode flagged rows with the CPU oracle and patch columns."""
+        from ..models.table_row import _to_dense  # late: avoid cycle
+        from ..postgres.codec.copy_text import unescape_copy_field
+
+        cols = self.schema.replicated_columns
+        for c in columns:
+            if c.is_arrow and rows.size:
+                c.data = c.data.to_pylist()  # rare: fixup needs mutability
+        for i in rows:
+            for j, col in enumerate(cols):
+                c = columns[j]
+                raw = staged.field_bytes(int(i), j)
+                if raw is None:
+                    continue
+                if staged.copy_escapes:
+                    raw = unescape_copy_field(raw)
+                value = parse_cell_text(raw.decode("utf-8"), col.type_oid)
+                if c.is_dense:
+                    try:
+                        c.data[i] = _to_dense(c.schema.kind, value) \
+                            if value is not None else 0
+                    except (OverflowError, ValueError) as e:
+                        # value doesn't fit the column's declared type —
+                        # corrupt data, same as a Rust i32 parse failure
+                        from ..models.errors import ErrorKind, EtlError
+
+                        raise EtlError(
+                            ErrorKind.ROW_CONVERSION_FAILED,
+                            f"row {i} col {col.name}: value out of range "
+                            f"for {col.type_name}: {value!r}") from e
+                else:
+                    c.data[i] = value
+                c.validity[i] = value is not None
+
+    def _complete(self, staged: StagedBatch, widths: tuple[int, ...],
+                  packed) -> ColumnarBatch:
+        n = staged.n_rows
+        cols = self.schema.replicated_columns
+        valid_full = ~staged.nulls & ~staged.toast
+        packed_np = np.asarray(packed) if packed is not None else None
+
+        columns: list[Column] = [None] * len(cols)  # type: ignore[list-item]
+        fallback = set(int(r) for r in staged.cpu_fallback_rows)
+        for spec, w in zip(self._dense, widths):
+            if staged.max_field_len(spec.index) > w:
+                too_big = staged.lengths[:n, spec.index] > w
+                fallback.update(np.flatnonzero(too_big).tolist())
+
+        row_off = 1  # row 0 = ok bitfield
+        okbits = packed_np[0] if packed_np is not None else None
+        for j, spec in enumerate(self._dense):
+            k = _PACK_ROWS[spec.kind]
+            rows = packed_np[row_off : row_off + k]
+            row_off += k
+            valid = valid_full[:n, spec.index].copy()
+            ok = (okbits >> j) & 1
+            bad = (ok[:n] == 0) & valid
+            if bad.any():
+                fallback.update(np.flatnonzero(bad).tolist())
+            data = _combine(spec.kind, rows[:, :n]).copy()
+            toast_col = staged.toast[:n, spec.index]
+            columns[spec.index] = Column(
+                cols[spec.index], data, valid,
+                toast_col if toast_col.any() else None)
+
+        for spec in self._object:
+            valid = valid_full[:, spec.index]
+            toast_col = staged.toast[:n, spec.index]
+            data_list = self._decode_object_column(
+                staged, spec,
+                valid & ~np.isin(np.arange(staged.row_capacity),
+                                 list(fallback)) if fallback else valid)
+            columns[spec.index] = Column(
+                cols[spec.index], data_list, valid[:n].copy(),
+                toast_col if toast_col.any() else None)
+
+        if fallback:
+            rows_arr = np.asarray(sorted(r for r in fallback if r < n),
+                                  dtype=np.int64)
+            self._cpu_fixup(staged, rows_arr, columns)
+        return ColumnarBatch(self.schema, columns)
+
+    # -- public -------------------------------------------------------------
+
+    def decode_async(self, staged: StagedBatch) -> _PendingDecode:
+        """Dispatch the device work and return immediately; stage the next
+        batch while this one is in flight."""
+        cols = self.schema.replicated_columns
+        if len(cols) != staged.n_cols:
+            raise ValueError(
+                f"staged batch has {staged.n_cols} cols, schema expects "
+                f"{len(cols)}")
+        widths = self._widths(staged)
+        packed = self._device_call(staged, widths) if self._dense else None
+        return _PendingDecode(self, staged, widths, packed)
+
+    def decode(self, staged: StagedBatch) -> ColumnarBatch:
+        return self.decode_async(staged).result()

@@ -1,0 +1,380 @@
+"""Device-side field parsers: fixed-shape jax programs over gathered bytes.
+
+Each parser consumes a byte matrix `[R, L]` (one field per row, left-aligned,
+zero-padded — produced by `gather_fields`) plus per-row lengths, and emits
+int32 component arrays + an `ok` mask. All arithmetic is int32: TPU int64 and
+float64 are emulated, so multi-word values (int8, timestamps, float mantissas)
+leave the device as 9-digit base-10^9 limbs that the host combines exactly
+with vectorized numpy (see ops/engine.py). Rows with `ok == False` are
+re-decoded by the CPU oracle (mixed batches partition, they never fail —
+SURVEY §7 build plan item 5).
+
+Float fast-path note: a field is device-decodable iff its mantissa has ≤ 15
+significant digits and the decimal-point adjustment |e| ≤ 22 — then
+`m * 10^e` / `m / 10^-e` is a single correctly-rounded f64 operation on
+host, bit-identical to strtod (classic exact fast path). Everything else
+(17-digit shortest-roundtrip doubles, huge exponents) falls back to CPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+D0 = ord("0")
+MINUS = ord("-")
+PLUS = ord("+")
+DOT = ord(".")
+COLON = ord(":")
+DASH = ord("-")
+SPACE = ord(" ")
+
+# 10^k for k in 0..8 (int32-safe)
+POW10 = np.array([10**k for k in range(9)], dtype=np.int32)
+
+
+def gather_fields(data: jax.Array, offsets: jax.Array, lengths: jax.Array,
+                  width: int) -> jax.Array:
+    """Gather each row's field bytes into an int32 `[R, width]` matrix,
+    left-aligned, zero beyond the field length. `data` is uint8[cap]."""
+    idx = offsets[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+    raw = jnp.take(data, jnp.clip(idx, 0, data.shape[0] - 1), axis=0,
+                   mode="clip").astype(jnp.int32)
+    mask = jnp.arange(width, dtype=jnp.int32)[None, :] < lengths[:, None]
+    return jnp.where(mask, raw, 0)
+
+
+def _digit_limbs(bmat: jax.Array, lengths: jax.Array, start: jax.Array,
+                 n_limbs: int = 3):
+    """Base-10^9 limb accumulation of digits in positions [start, length).
+
+    Returns (limbs: list of int32[R] little-endian by 10^9 word, all_digits:
+    bool[R] — every position in range held an ASCII digit)."""
+    R, L = bmat.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_range = (pos >= start[:, None]) & (pos < lengths[:, None])
+    d = bmat - D0
+    is_digit = (d >= 0) & (d <= 9)
+    all_digits = jnp.where(in_range, is_digit, True).all(axis=1)
+    r = lengths[:, None] - 1 - pos  # digit position from the right
+    weight = jnp.take(POW10, jnp.clip(r % 9, 0, 8))
+    dd = jnp.where(in_range & is_digit, d, 0)
+    limbs = []
+    for k in range(n_limbs):
+        sel = in_range & (r // 9 == k)
+        limbs.append(jnp.where(sel, dd * weight, 0).sum(axis=1,
+                                                        dtype=jnp.int32))
+    return limbs, all_digits
+
+
+def parse_int(bmat: jax.Array, lengths: jax.Array):
+    """Signed decimal integer → (neg, limb0, limb1, limb2, ndigits, ok).
+    Handles up to 27 digits; int8's 19 fits with headroom."""
+    neg = bmat[:, 0] == MINUS
+    plus = bmat[:, 0] == PLUS
+    start = (neg | plus).astype(jnp.int32)
+    limbs, all_digits = _digit_limbs(bmat, lengths, start)
+    ndigits = lengths - start
+    ok = all_digits & (ndigits >= 1) & (ndigits <= 27) \
+        & (lengths <= bmat.shape[1])
+    return neg, limbs[0], limbs[1], limbs[2], ndigits, ok
+
+
+def parse_bool(bmat: jax.Array, lengths: jax.Array):
+    t = bmat[:, 0] == ord("t")
+    f = bmat[:, 0] == ord("f")
+    ok = (lengths == 1) & (t | f)
+    return t, ok
+
+
+def _fixed2(bmat: jax.Array, p: int) -> jax.Array:
+    return (bmat[:, p] - D0) * 10 + (bmat[:, p + 1] - D0)
+
+
+def _days_from_civil_dev(y: jax.Array, m: jax.Array, d: jax.Array) -> jax.Array:
+    """Device version of codec.text.days_from_civil (y >= 0 after shift)."""
+    y = y - (m <= 2)
+    era = y // 400
+    yoe = y - era * 400
+    doy = (153 * (m + jnp.where(m > 2, -3, 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def parse_date(bmat: jax.Array, lengths: jax.Array):
+    """'YYYY-MM-DD' → (days_since_epoch, ok). BC dates (trailing ' BC') and
+    5+ digit years fall back to CPU."""
+    d = bmat - D0
+    y = d[:, 0] * 1000 + d[:, 1] * 100 + d[:, 2] * 10 + d[:, 3]
+    m = _fixed2(bmat, 5)
+    dd = _fixed2(bmat, 8)
+    digits_ok = ((d[:, [0, 1, 2, 3, 5, 6, 8, 9]] >= 0)
+                 & (d[:, [0, 1, 2, 3, 5, 6, 8, 9]] <= 9)).all(axis=1)
+    ok = (lengths == 10) & digits_ok \
+        & (bmat[:, 4] == DASH) & (bmat[:, 7] == DASH) \
+        & (m >= 1) & (m <= 12) & (dd >= 1) & (dd <= 31) & (y >= 1)
+    days = _days_from_civil_dev(y, m, dd)
+    return jnp.where(ok, days, 0), ok
+
+
+def _parse_hms_at(bmat: jax.Array, lengths: jax.Array, base: int):
+    """HH:MM:SS[.ffffff] starting at column `base`. Returns
+    (sec_of_day, us, end_pos, ok)."""
+    R, L = bmat.shape
+    d = bmat - D0
+    hh = _fixed2(bmat, base)
+    mm = _fixed2(bmat, base + 3)
+    ss = _fixed2(bmat, base + 6)
+    sep_ok = (bmat[:, base + 2] == COLON) & (bmat[:, base + 5] == COLON)
+    base_digits = jnp.stack([d[:, base], d[:, base + 1], d[:, base + 3],
+                             d[:, base + 4], d[:, base + 6], d[:, base + 7]],
+                            axis=1)
+    digits_ok = ((base_digits >= 0) & (base_digits <= 9)).all(axis=1)
+    has_dot = (lengths > base + 8) & (bmat[:, base + 8] == DOT) \
+        if base + 8 < L else jnp.zeros(R, dtype=bool)
+
+    # fractional digits: contiguous run starting at base+9, max 6
+    frac_start = base + 9
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    is_digit = (d >= 0) & (d <= 9)
+    in_frac_window = (pos >= frac_start) & (pos < frac_start + 6) \
+        & (pos < lengths[:, None])
+    frac_digit = in_frac_window & is_digit
+    # run length = index of first non-digit within the window
+    run = jnp.where(
+        has_dot,
+        jnp.sum(jnp.cumprod(jnp.where(in_frac_window, frac_digit, 1),
+                            axis=1) * in_frac_window, axis=1),
+        0).astype(jnp.int32)
+    k = pos - frac_start  # 0-based frac index
+    scale = jnp.take(POW10, jnp.clip(5 - k, 0, 8))
+    us = jnp.where(frac_digit & (k < run[:, None]), d * scale, 0) \
+        .sum(axis=1, dtype=jnp.int32)
+    frac_ok = jnp.where(has_dot, run >= 1, True)
+    end = base + 8 + jnp.where(has_dot, 1 + run, 0)
+    sec = (hh * 60 + mm) * 60 + ss
+    # hh == 24 ("24:00:00") exists in PG but needs the CPU clamp path
+    ok = sep_ok & digits_ok & frac_ok & (hh <= 23) & (mm <= 59) & (ss <= 59)
+    return sec, us, end, ok
+
+
+def parse_time(bmat: jax.Array, lengths: jax.Array):
+    """'HH:MM:SS[.ffffff]' → (ms_of_day, us_rem, ok)."""
+    sec, us, end, ok = _parse_hms_at(bmat, lengths, 0)
+    ok = ok & (end == lengths)
+    ms = sec * 1000 + us // 1000
+    return ms, us % 1000, ok
+
+
+def _parse_tz_at(bmat: jax.Array, lengths: jax.Array, p: jax.Array):
+    """±HH[:MM[:SS]] at per-row position p. Returns (offset_sec, end, ok)."""
+    R, L = bmat.shape
+
+    def at(q):
+        return jnp.take_along_axis(bmat, jnp.clip(q, 0, L - 1)[:, None],
+                                   axis=1)[:, 0]
+
+    sign_b = at(p)
+    neg = sign_b == MINUS
+    sign_ok = neg | (sign_b == PLUS)
+    d1, d2 = at(p + 1) - D0, at(p + 2) - D0
+    hh = d1 * 10 + d2
+    hh_ok = (d1 >= 0) & (d1 <= 9) & (d2 >= 0) & (d2 <= 9)
+    has_min = (lengths > p + 3) & (at(p + 3) == COLON)
+    m1, m2 = at(p + 4) - D0, at(p + 5) - D0
+    mm = jnp.where(has_min, m1 * 10 + m2, 0)
+    mm_ok = jnp.where(has_min, (m1 >= 0) & (m1 <= 9) & (m2 >= 0) & (m2 <= 9),
+                      True)
+    has_sec = has_min & (lengths > p + 6) & (at(p + 6) == COLON)
+    s1, s2 = at(p + 7) - D0, at(p + 8) - D0
+    ss = jnp.where(has_sec, s1 * 10 + s2, 0)
+    ss_ok = jnp.where(has_sec, (s1 >= 0) & (s1 <= 9) & (s2 >= 0) & (s2 <= 9),
+                      True)
+    end = p + 3 + jnp.where(has_min, 3, 0) + jnp.where(has_sec, 3, 0)
+    off = hh * 3600 + mm * 60 + ss
+    off = jnp.where(neg, -off, off)
+    return off, end, sign_ok & hh_ok & mm_ok & ss_ok
+
+
+def parse_timestamp(bmat: jax.Array, lengths: jax.Array, with_tz: bool):
+    """'YYYY-MM-DD HH:MM:SS[.ffffff][±TZ]' →
+    (days, ms_of_day, us_rem, tz_sec, ok)."""
+    days, date_ok = parse_date(bmat, jnp.full_like(lengths, 10))
+    space_ok = bmat[:, 10] == SPACE
+    sec, us, end, hms_ok = _parse_hms_at(bmat, lengths, 11)
+    if with_tz:
+        tz, tz_end, tz_ok = _parse_tz_at(bmat, lengths, end)
+        ok = date_ok & space_ok & hms_ok & tz_ok & (tz_end == lengths)
+    else:
+        tz = jnp.zeros_like(sec)
+        ok = date_ok & space_ok & hms_ok & (end == lengths)
+    ok = ok & (lengths >= 19)
+    ms = sec * 1000 + us // 1000
+    return days, ms, us % 1000, tz, ok
+
+
+def parse_float(bmat: jax.Array, lengths: jax.Array):
+    """Decimal float text → (neg, limb0, limb1, exp_adj, special, ok).
+
+    `special`: 0 normal, 1 NaN, 2 +Inf, 3 -Inf. Device-ok only on the exact
+    fast path (≤15 sig digits, |exp_adj| ≤ 22, optional e-exponent) — host
+    computes sign * (limb1*1e9 + limb0) * 10^exp_adj with one rounding."""
+    R, L = bmat.shape
+    d = bmat - D0
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_len = pos < lengths[:, None]
+
+    # specials: NaN / Infinity / -Infinity
+    def match(lit: bytes):
+        arr = np.zeros(L, dtype=np.int32)
+        arr[: len(lit)] = np.frombuffer(lit, dtype=np.uint8)
+        return (lengths == len(lit)) & (bmat == jnp.asarray(arr)).all(axis=1)
+
+    is_nan = match(b"NaN")
+    is_pinf = match(b"Infinity")
+    is_ninf = match(b"-Infinity")
+    special = (is_nan * 1 + is_pinf * 2 + is_ninf * 3).astype(jnp.int32)
+
+    neg = bmat[:, 0] == MINUS
+    start = (neg | (bmat[:, 0] == PLUS)).astype(jnp.int32)
+
+    is_e = ((bmat == ord("e")) | (bmat == ord("E"))) & in_len
+    has_e = is_e.any(axis=1)
+    e_pos = jnp.where(has_e, jnp.argmax(is_e, axis=1),
+                      lengths).astype(jnp.int32)
+    is_dot = (bmat == DOT) & in_len & (pos < e_pos[:, None])
+    has_dot = is_dot.any(axis=1)
+    dot_pos = jnp.where(has_dot, jnp.argmax(is_dot, axis=1),
+                        e_pos).astype(jnp.int32)
+    n_dots = is_dot.sum(axis=1)
+
+    # mantissa digits: [start, e_pos) excluding the dot
+    is_digit = (d >= 0) & (d <= 9)
+    mant_sel = (pos >= start[:, None]) & (pos < e_pos[:, None]) \
+        & ~is_dot
+    mant_valid = jnp.where(mant_sel, is_digit, True).all(axis=1)
+    n_mant = mant_sel.sum(axis=1).astype(jnp.int32)
+    # digit position from the right within the mantissa (dot removed):
+    # digits after the dot keep index; digits before shift by frac count
+    frac_count = jnp.where(has_dot, e_pos - dot_pos - 1, 0).astype(jnp.int32)
+    before_dot = pos < dot_pos[:, None]
+    # index from right among mantissa digits
+    r = jnp.where(before_dot,
+                  (dot_pos[:, None] - 1 - pos) + frac_count[:, None],
+                  e_pos[:, None] - 1 - pos)
+    weight = jnp.take(POW10, jnp.clip(r % 9, 0, 8))
+    dd = jnp.where(mant_sel & is_digit, d, 0)
+    limb0 = jnp.where(mant_sel & (r // 9 == 0), dd * weight, 0) \
+        .sum(axis=1, dtype=jnp.int32)
+    limb1 = jnp.where(mant_sel & (r // 9 == 1), dd * weight, 0) \
+        .sum(axis=1, dtype=jnp.int32)
+
+    # explicit exponent after 'e'
+    exp_start = e_pos + 1
+    def at(q):
+        return jnp.take_along_axis(bmat, jnp.clip(q, 0, L - 1)[:, None],
+                                   axis=1)[:, 0]
+    exp_neg = has_e & (at(exp_start) == MINUS)
+    exp_sign = has_e & (exp_neg | (at(exp_start) == PLUS))
+    exp_d_start = exp_start + exp_sign.astype(jnp.int32)
+    exp_sel = (pos >= exp_d_start[:, None]) & in_len
+    exp_valid = jnp.where(exp_sel, is_digit, True).all(axis=1) \
+        & jnp.where(has_e, lengths > exp_d_start, True)
+    re = lengths[:, None] - 1 - pos
+    eweight = jnp.take(POW10, jnp.clip(re % 9, 0, 8))
+    exp_val = jnp.where(exp_sel & is_digit & (re // 9 == 0), d * eweight, 0) \
+        .sum(axis=1, dtype=jnp.int32)
+    exp_val = jnp.where(exp_neg, -exp_val, exp_val)
+    exp_val = jnp.where(has_e, exp_val, 0)
+
+    # significant digits (ignore leading zeros)
+    lead_zero_run = jnp.sum(
+        jnp.cumprod(jnp.where(mant_sel, (d == 0) & mant_sel, 1), axis=1)
+        * mant_sel, axis=1).astype(jnp.int32)
+    sig = n_mant - lead_zero_run
+    exp_adj = exp_val - frac_count
+
+    fast = (sig <= 15) & (jnp.abs(exp_adj) <= 22) & (n_mant >= 1) \
+        & (n_dots <= 1) & mant_valid & exp_valid
+    ok = fast | (special > 0)
+    return neg, limb0, limb1, exp_adj, special, ok
+
+
+# ---------------------------------------------------------------------------
+# Shared per-kind column dispatch — single source of truth for the engine
+# (single-chip packed program) and parallel/mesh.py (sharded step).
+# ---------------------------------------------------------------------------
+
+from ..models.pgtypes import CellKind  # noqa: E402  (bottom import by design)
+
+# packed int32 component names per kind, in emit order
+COLUMN_COMPONENTS: dict = {
+    CellKind.BOOL: ("v",),
+    CellKind.I16: ("v",), CellKind.I32: ("v",), CellKind.U32: ("v",),
+    CellKind.I64: ("neg", "l0", "l1", "l2"),
+    CellKind.F32: ("neg", "l0", "l1", "ea", "sp"),
+    CellKind.F64: ("neg", "l0", "l1", "ea", "sp"),
+    CellKind.DATE: ("days",),
+    CellKind.TIME: ("ms", "us"),
+    CellKind.TIMESTAMP: ("days", "ms", "us"),  # tz folded into ms
+    CellKind.TIMESTAMPTZ: ("days", "ms", "us"),
+}
+
+
+def _int_range_ok(kind, neg, l0, l1, l2, ndigits):
+    """Exact range check on base-10^9 limbs (values may wrap int32/int64
+    after combine, so bounds must be checked limb-wise on device)."""
+    if kind is CellKind.I16:
+        ok = (ndigits <= 5) & (l1 == 0) & (l2 == 0)
+        v = l0  # ≤ 99999, no wrap
+        return ok & jnp.where(neg, v <= 32768, v <= 32767)
+    if kind is CellKind.I32:
+        ok = (ndigits <= 10) & (l2 == 0)
+        in_range = (l1 < 2) | ((l1 == 2)
+                               & jnp.where(neg, l0 <= 147_483_648,
+                                           l0 <= 147_483_647))
+        return ok & in_range
+    if kind is CellKind.U32:
+        ok = (ndigits <= 10) & (l2 == 0) & ~neg
+        return ok & ((l1 < 4) | ((l1 == 4) & (l0 <= 294_967_295)))
+    if kind is CellKind.I64:
+        ok = ndigits <= 19
+        hi = jnp.where(neg, 1, 0)  # |min| = 9223372036854775808
+        at_cap = (l2 == 9) & ((l1 > 223_372_036)
+                              | ((l1 == 223_372_036)
+                                 & (l0 > 854_775_807 + hi)))
+        return ok & ~((l2 > 9) | at_cap)
+    raise AssertionError(kind)
+
+
+def parse_column(kind, bmat: jax.Array, lengths: jax.Array):
+    """Parse one column's gathered bytes → ({component: int32[R]}, ok[R]).
+    Component names follow COLUMN_COMPONENTS[kind]."""
+    if kind is CellKind.BOOL:
+        t, ok = parse_bool(bmat, lengths)
+        return {"v": t.astype(jnp.int32)}, ok
+    if kind in (CellKind.I16, CellKind.I32, CellKind.U32):
+        neg, l0, l1, l2, nd, ok = parse_int(bmat, lengths)
+        ok = ok & _int_range_ok(kind, neg, l0, l1, l2, nd)
+        v = l1 * jnp.int32(1_000_000_000) + l0  # wrap impossible once ok
+        return {"v": jnp.where(neg, -v, v)}, ok
+    if kind is CellKind.I64:
+        neg, l0, l1, l2, nd, ok = parse_int(bmat, lengths)
+        ok = ok & _int_range_ok(kind, neg, l0, l1, l2, nd)
+        return {"neg": neg.astype(jnp.int32), "l0": l0, "l1": l1, "l2": l2}, ok
+    if kind in (CellKind.F32, CellKind.F64):
+        neg, l0, l1, ea, sp, ok = parse_float(bmat, lengths)
+        return {"neg": neg.astype(jnp.int32), "l0": l0, "l1": l1, "ea": ea,
+                "sp": sp}, ok
+    if kind is CellKind.DATE:
+        days, ok = parse_date(bmat, lengths)
+        return {"days": days}, ok
+    if kind is CellKind.TIME:
+        ms, us, ok = parse_time(bmat, lengths)
+        return {"ms": ms, "us": us}, ok
+    if kind in (CellKind.TIMESTAMP, CellKind.TIMESTAMPTZ):
+        days, ms, us, tz, ok = parse_timestamp(
+            bmat, lengths, with_tz=kind is CellKind.TIMESTAMPTZ)
+        return {"days": days, "ms": ms - tz * 1000, "us": us}, ok
+    raise AssertionError(kind)

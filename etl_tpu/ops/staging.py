@@ -1,0 +1,192 @@
+"""Host↔HBM staging: ragged WAL/COPY field bytes → fixed-shape device arrays.
+
+This is the host half of the TPU decode engine. It converts ragged inputs —
+pgoutput TupleData values or raw COPY text chunks — into the dense layout the
+device kernels consume:
+
+    data     uint8[capacity]      concatenated field bytes (zero-padded)
+    offsets  int32[R, C]          start of each field in `data`
+    lengths  int32[R, C]          field byte length
+    nulls    bool[R, C]           SQL NULL ('n' tuple kind / COPY \\N)
+    toast    bool[R, C]           TOAST-unchanged ('u' tuple kind)
+
+Row counts are bucketed to powers of two so jit caches stay small; column
+count C is static per schema. The COPY path is fully vectorized numpy
+(the memchr/SIMD analogue of reference codec/table_row.rs:13-53); rows
+containing escape sequences are flagged for the CPU fallback decoder.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from ..models.errors import ErrorKind, EtlError
+from ..postgres.codec.pgoutput import (TUPLE_NULL, TUPLE_TEXT,
+                                       TUPLE_UNCHANGED_TOAST, TupleData)
+
+ROW_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144)
+
+
+def bucket_rows(n: int) -> int:
+    for b in ROW_BUCKETS:
+        if n <= b:
+            return b
+    return ((n + ROW_BUCKETS[-1] - 1) // ROW_BUCKETS[-1]) * ROW_BUCKETS[-1]
+
+
+def bucket_pow2(n: int, lo: int = 8, hi: int = 2048) -> int:
+    b = lo
+    while b < n and b < hi:
+        b *= 2
+    return b
+
+
+def bucket_width(n: int, hi: int = 2048) -> int:
+    """Field-width bucket: multiples of 4 up to 32 (tight — upload bytes are
+    precious over the device link), then powers of two."""
+    if n <= 32:
+        return max(4, (n + 3) & ~3)
+    return bucket_pow2(n, lo=64, hi=hi)
+
+
+@dataclass
+class StagedBatch:
+    """Fixed-shape staging of `n_rows` ragged rows × C fields."""
+
+    data: np.ndarray  # uint8[cap]
+    offsets: np.ndarray  # int32[R, C]
+    lengths: np.ndarray  # int32[R, C]
+    nulls: np.ndarray  # bool[R, C]
+    toast: np.ndarray  # bool[R, C]
+    n_rows: int  # valid rows (R may be larger: bucketed)
+    cpu_fallback_rows: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=np.int64))
+    # rows needing the exact CPU decoder (escapes, oversized fields)
+    copy_escapes: bool = False  # True: field bytes may carry COPY escapes
+
+    @property
+    def row_capacity(self) -> int:
+        return self.offsets.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.offsets.shape[1]
+
+    def field_bytes(self, row: int, col: int) -> bytes | None:
+        """Raw bytes of one field (CPU fallback path)."""
+        if self.nulls[row, col] or self.toast[row, col]:
+            return None
+        off, ln = int(self.offsets[row, col]), int(self.lengths[row, col])
+        return self.data[off : off + ln].tobytes()
+
+    def max_field_len(self, col: int) -> int:
+        if self.n_rows == 0:
+            return 0
+        return int(self.lengths[: self.n_rows, col].max())
+
+
+def stage_tuples(tuples: Sequence[TupleData], n_cols: int) -> StagedBatch:
+    """Stage decoded pgoutput tuples. (The zero-copy path that never builds
+    TupleData lives in the native framer; this is the portable version.)"""
+    n = len(tuples)
+    cap_rows = bucket_rows(n)
+    offsets = np.zeros((cap_rows, n_cols), dtype=np.int32)
+    lengths = np.zeros((cap_rows, n_cols), dtype=np.int32)
+    nulls = np.zeros((cap_rows, n_cols), dtype=np.bool_)
+    toast = np.zeros((cap_rows, n_cols), dtype=np.bool_)
+    nulls[n:, :] = True  # padding rows are all-NULL
+
+    chunks: list[bytes] = []
+    pos = 0
+    for i, tup in enumerate(tuples):
+        if len(tup) != n_cols:
+            raise EtlError(ErrorKind.SCHEMA_MISMATCH,
+                           f"tuple {i} has {len(tup)} cols, expected {n_cols}")
+        for j, (kind, val) in enumerate(zip(tup.kinds, tup.values)):
+            if kind == TUPLE_NULL:
+                nulls[i, j] = True
+            elif kind == TUPLE_UNCHANGED_TOAST:
+                toast[i, j] = True
+            elif kind != TUPLE_TEXT:
+                # binary tuple format is never requested in START_REPLICATION;
+                # staging it as text would silently corrupt values
+                raise EtlError(ErrorKind.UNSUPPORTED_TYPE,
+                               f"tuple {i} col {j}: binary format not enabled")
+            else:
+                assert val is not None
+                offsets[i, j] = pos
+                lengths[i, j] = len(val)
+                chunks.append(val)
+                pos += len(val)
+    data = np.frombuffer(b"".join(chunks), dtype=np.uint8) if chunks else \
+        np.zeros(0, dtype=np.uint8)
+    return StagedBatch(data, offsets, lengths, nulls, toast, n)
+
+
+_NULL_FIELD_BYTES = (92, 78)  # "\\N"
+
+
+def stage_copy_chunk(chunk: bytes, n_cols: int) -> StagedBatch:
+    """Stage a chunk of COPY text rows (newline-terminated) with a fully
+    vectorized delimiter scan. Rows whose fields contain backslash escapes
+    (other than a bare \\N null) are routed to `cpu_fallback_rows`."""
+    if not chunk:
+        return StagedBatch(np.zeros(0, np.uint8), np.zeros((0, n_cols), np.int32),
+                           np.zeros((0, n_cols), np.int32),
+                           np.zeros((0, n_cols), np.bool_),
+                           np.zeros((0, n_cols), np.bool_), 0)
+    if not chunk.endswith(b"\n"):
+        chunk += b"\n"
+    data = np.frombuffer(chunk, dtype=np.uint8)
+    is_tab = data == 9
+    is_nl = data == 10
+    delim_pos = np.flatnonzero(is_tab | is_nl)
+    nl_pos = np.flatnonzero(is_nl)
+    n_rows = len(nl_pos)
+    # each row must contribute exactly n_cols delimiters (C-1 tabs + 1 nl)
+    if len(delim_pos) != n_rows * n_cols:
+        raise EtlError(
+            ErrorKind.COPY_FORMAT_INVALID,
+            f"COPY chunk: {len(delim_pos)} delimiters for {n_rows} rows × "
+            f"{n_cols} cols")
+    ends = delim_pos.reshape(n_rows, n_cols)
+    if not np.array_equal(ends[:, -1], nl_pos):
+        raise EtlError(ErrorKind.COPY_FORMAT_INVALID,
+                       "COPY chunk: ragged rows (tab/newline mismatch)")
+    starts = np.empty_like(ends)
+    starts[:, 0] = np.concatenate(([0], nl_pos[:-1] + 1))
+    starts[:, 1:] = ends[:, :-1] + 1
+    lengths = (ends - starts).astype(np.int32)
+    offsets = starts.astype(np.int32)
+
+    # NULL detection: field == b"\\N"
+    first = data[np.minimum(starts, len(data) - 1)]
+    second = data[np.minimum(starts + 1, len(data) - 1)]
+    nulls = (lengths == 2) & (first == _NULL_FIELD_BYTES[0]) \
+        & (second == _NULL_FIELD_BYTES[1])
+
+    # escape detection per row: any backslash in the row span that is not a \N
+    bs_cum = np.concatenate(([0], np.cumsum(data == 92)))
+    row_start = starts[:, 0]
+    row_end = ends[:, -1]
+    bs_in_row = bs_cum[row_end] - bs_cum[row_start]
+    nulls_in_row = nulls.sum(axis=1)
+    fallback = np.flatnonzero(bs_in_row != nulls_in_row)
+
+    cap_rows = bucket_rows(n_rows)
+    if cap_rows != n_rows:
+        pad = cap_rows - n_rows
+
+        def padrc(a, fill=0):
+            return np.concatenate([a, np.full((pad, n_cols), fill, a.dtype)])
+
+        offsets = padrc(offsets)
+        lengths = padrc(lengths)
+        nulls = padrc(nulls, True)
+    toast = np.zeros((cap_rows, n_cols), dtype=np.bool_)
+    lengths = np.where(nulls, 0, lengths)
+    return StagedBatch(data, offsets, lengths, nulls, toast, n_rows,
+                       cpu_fallback_rows=fallback, copy_escapes=True)

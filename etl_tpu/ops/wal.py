@@ -1,0 +1,124 @@
+"""WAL batch staging: framed pgoutput messages → device-ready StagedBatch.
+
+The zero-copy pipeline: XLogData payloads are concatenated once into a
+single buffer; the native framer (etl_tpu/native) emits absolute field
+offsets into that buffer; this module groups rows numpy-vectorized and the
+whole buffer ships to the device for decode. Non-row messages
+(Begin/Commit/Relation/Truncate/Message) are returned by index for the
+host apply loop to decode with the CPU codec (they are rare and carry
+control semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..models.errors import ErrorKind, EtlError
+from ..models.event import ChangeType
+from ..native import (FLAG_BINARY, FLAG_NULL, FLAG_TOAST, FramedBatch,
+                      frame_pgoutput)
+from .staging import StagedBatch, bucket_rows
+
+
+@dataclass
+class WalBatch:
+    """One framed batch of row changes for a single table."""
+
+    staged: StagedBatch  # per row: new tuple (I/U) or old/key tuple (D)
+    change_types: np.ndarray  # uint8[n] of ChangeType
+    msg_index: np.ndarray  # int64[n] — original message index of each row
+    old_staged: StagedBatch | None  # old/key tuples for U rows that sent one
+    old_rows: np.ndarray  # int64[k] — row indices old_staged corresponds to
+    old_is_key: np.ndarray  # bool[k] — True: 'K' key tuple, False: 'O' full
+    non_row_indices: np.ndarray  # int64[] messages for host decode
+    relids: np.ndarray  # int32[n] per-row relation oid
+    bad_from: int  # -1, or first malformed message index (rest unframed)
+
+
+def _staged_from(framed: FramedBatch, rows: np.ndarray, off: np.ndarray,
+                 ln: np.ndarray, flag: np.ndarray) -> StagedBatch:
+    n = len(rows)
+    cap = bucket_rows(n) if n else 0
+    n_cols = off.shape[1]
+    offsets = np.zeros((cap, n_cols), dtype=np.int32)
+    lengths = np.zeros((cap, n_cols), dtype=np.int32)
+    nulls = np.ones((cap, n_cols), dtype=np.bool_)
+    toast = np.zeros((cap, n_cols), dtype=np.bool_)
+    if n:
+        offsets[:n] = off[rows]
+        lengths[:n] = ln[rows]
+        f = flag[rows]
+        nulls[:n] = f == FLAG_NULL
+        toast[:n] = f == FLAG_TOAST
+    if n and (flag[rows] == FLAG_BINARY).any():
+        # binary tuple format is never requested; decoding it as text (in
+        # either the device or the CPU-fixup path) would corrupt values
+        raise EtlError(ErrorKind.UNSUPPORTED_TYPE,
+                       "binary tuple format not enabled in START_REPLICATION")
+    return StagedBatch(framed.buf, offsets, lengths, nulls, toast, n)
+
+
+def stage_wal_batch(buf: bytes | np.ndarray, msg_off: np.ndarray,
+                    msg_len: np.ndarray, n_cols: int) -> WalBatch:
+    """Frame and stage one batch of pgoutput messages (single-table run —
+    the apply loop splits runs at relation boundaries, mirroring the
+    reference's per-table batching between barriers,
+    bigquery/core.rs:956-978)."""
+    framed, bad = frame_pgoutput(buf, msg_off, msg_len, n_cols)
+    n_msgs = framed.n_msgs
+    upto = n_msgs if bad < 0 else bad
+    kind = framed.kind[:upto]
+    is_i = kind == ord("I")
+    is_u = kind == ord("U")
+    is_d = kind == ord("D")
+    is_row = is_i | is_u | is_d
+    row_idx = np.flatnonzero(is_row)
+    non_row = np.flatnonzero(~is_row & (kind != 0))
+
+    change = np.empty(len(row_idx), dtype=np.uint8)
+    change[is_i[row_idx]] = ChangeType.INSERT
+    change[is_u[row_idx]] = ChangeType.UPDATE
+    change[is_d[row_idx]] = ChangeType.DELETE
+
+    # main tuple: new for I/U, old for D
+    off = framed.new_off.copy()
+    ln = framed.new_len.copy()
+    fl = framed.new_flag.copy()
+    d_rows = np.flatnonzero(is_d)
+    off[d_rows] = framed.old_off[d_rows]
+    ln[d_rows] = framed.old_len[d_rows]
+    fl[d_rows] = framed.old_flag[d_rows]
+    staged = _staged_from(framed, row_idx, off, ln, fl)
+
+    # old tuples for updates that sent one
+    u_with_old = np.flatnonzero(is_u & (framed.old_kind[:upto] != 0))
+    if len(u_with_old):
+        old_staged = _staged_from(framed, u_with_old, framed.old_off,
+                                  framed.old_len, framed.old_flag)
+        # map message index → row position
+        msg_to_row = np.full(upto, -1, dtype=np.int64)
+        msg_to_row[row_idx] = np.arange(len(row_idx))
+        old_rows = msg_to_row[u_with_old]
+        old_is_key = framed.old_kind[u_with_old] == ord("K")
+    else:
+        old_staged = None
+        old_rows = np.zeros(0, dtype=np.int64)
+        old_is_key = np.zeros(0, dtype=np.bool_)
+
+    return WalBatch(
+        staged=staged, change_types=change,
+        msg_index=row_idx.astype(np.int64), old_staged=old_staged,
+        old_rows=old_rows, old_is_key=old_is_key,
+        non_row_indices=non_row.astype(np.int64),
+        relids=framed.relid[row_idx], bad_from=bad)
+
+
+def concat_payloads(payloads: list[bytes]) -> tuple[bytes, np.ndarray, np.ndarray]:
+    """Concatenate message payloads, returning (buf, msg_off, msg_len)."""
+    lens = np.fromiter((len(p) for p in payloads), dtype=np.int32,
+                       count=len(payloads))
+    offs = np.zeros(len(payloads), dtype=np.int64)
+    np.cumsum(lens[:-1], out=offs[1:])
+    return b"".join(payloads), offs, lens

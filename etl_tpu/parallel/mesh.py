@@ -1,0 +1,120 @@
+"""Device-mesh parallelism for the decode engine.
+
+The reference's parallelism inventory (SURVEY §2.5) maps onto the TPU as:
+
+  - inter-table / copy-partition parallelism → `dp` mesh axis: independent
+    staged batches (one per table-sync copy partition or CDC flush) decode
+    on disjoint device groups;
+  - huge-batch scaling (the "sequence parallel" analogue — WAL bursts and
+    CTID partitions of arbitrary size) → `sp` mesh axis: rows of one batch
+    sharded across devices, with XLA collectives (psum/pmax over ICI) for
+    the batch-level reductions the apply loop needs (decode-error counts,
+    per-batch max LSN for durability accounting).
+
+The decode itself is embarrassingly parallel over rows, so collectives ride
+only the cheap reduction path — the design scales to multi-host DCN without
+change (jax.sharding.Mesh spanning hosts).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.pgtypes import CellKind
+from ..ops import parsers
+
+
+def make_mesh(devices: Sequence[jax.Device] | None = None,
+              dp: int | None = None) -> Mesh:
+    """Build a 2D ('dp', 'sp') mesh over the given devices. `dp` defaults to
+    the largest power-of-two split ≤ √n so both axes are populated."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp is None:
+        dp = 1
+        while dp * 2 <= max(1, int(n**0.5)) and n % (dp * 2) == 0:
+            dp *= 2
+        if n % dp:
+            dp = 1
+    sp = n // dp
+    arr = np.asarray(devices[: dp * sp]).reshape(dp, sp)
+    return Mesh(arr, axis_names=("dp", "sp"))
+
+
+def _parse_columns(data, offsets, lengths, specs):
+    """Shared per-shard decode body: offsets/lengths are [B, R, C] local
+    shards; returns per-column component dict (parsers.parse_column order)
+    + ok matrix [B, R, n_dense]."""
+    B, R, C = offsets.shape
+    out = {}
+    oks = []
+    for col_idx, kind, width in specs:
+        off = offsets[:, :, col_idx].reshape(B * R)
+        ln = lengths[:, :, col_idx].reshape(B * R)
+        b = parsers.gather_fields(data, off, ln, width)
+        comp, ok = parsers.parse_column(kind, b, ln)
+        out[col_idx] = {k: v.reshape(B, R) for k, v in comp.items()}
+        oks.append(ok.reshape(B, R))
+    ok_mat = jnp.stack(oks, axis=-1) if oks else \
+        jnp.ones((B, R, 0), dtype=bool)
+    return out, ok_mat
+
+
+def build_sharded_decode_step(mesh: Mesh,
+                              specs: tuple[tuple[int, CellKind, int], ...]):
+    """The multi-chip decode step: batches sharded over 'dp', rows over 'sp'.
+
+    Inputs (global shapes):
+      data      uint8[cap]      replicated byte buffer
+      offsets   int32[B, R, C]  sharded P('dp', 'sp')
+      lengths   int32[B, R, C]  sharded P('dp', 'sp')
+      valid     bool[B, R, C]   sharded P('dp', 'sp')
+      lsns      uint32[B, R]    per-row start-LSN low word, P('dp', 'sp')
+
+    Outputs:
+      components  per-column dicts, each [B, R] sharded P('dp', 'sp')
+      n_bad       int32[B]   rows needing CPU fallback, psum over 'sp'
+      max_lsn     uint32[B]  durability watermark per batch, pmax over 'sp'
+    """
+
+    dense_idx = np.asarray([i for i, _, _ in specs], dtype=np.int32)
+
+    def step(data, offsets, lengths, valid, lsns):
+        comps, ok_mat = _parse_columns(data, offsets, lengths, specs)
+        valid_dense = valid[:, :, dense_idx]  # align with ok_mat columns
+        row_bad = (~ok_mat & valid_dense).any(axis=-1)  # [B, R] local
+        n_bad = jax.lax.psum(row_bad.sum(axis=1, dtype=jnp.int32), "sp")
+        max_lsn = jax.lax.pmax(lsns.max(axis=1), "sp")
+        return comps, n_bad, max_lsn
+
+    kwargs = dict(
+        mesh=mesh,
+        in_specs=(P(), P("dp", "sp", None), P("dp", "sp", None),
+                  P("dp", "sp", None), P("dp", "sp")),
+        out_specs=({i: {k: P("dp", "sp") for k in parsers.COLUMN_COMPONENTS[kind]}
+                    for i, kind, _ in specs},
+                   P("dp"), P("dp")))
+    try:
+        from jax import shard_map  # jax >= 0.7: replication-check kwarg
+        sharded = shard_map(step, check_vma=False, **kwargs)
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+        sharded = shard_map(step, check_rep=False, **kwargs)
+    return jax.jit(sharded)
+
+
+def shard_staged_inputs(mesh: Mesh, data, offsets, lengths, valid, lsns):
+    """Place host arrays onto the mesh with the step's shardings."""
+    rep = NamedSharding(mesh, P())
+    rc = NamedSharding(mesh, P("dp", "sp", None))
+    rl = NamedSharding(mesh, P("dp", "sp"))
+    return (jax.device_put(data, rep), jax.device_put(offsets, rc),
+            jax.device_put(lengths, rc), jax.device_put(valid, rc),
+            jax.device_put(lsns, rl))

@@ -15,8 +15,8 @@ import json
 import uuid as uuid_mod
 from typing import Any, Callable
 
-from ...models.cell import (PgInterval, PgNumeric, PgSpecialDate,
-                            PgSpecialTimestamp, PgTimeTz)
+from ...models.cell import (JSON_NULL, PgInterval, PgNumeric,
+                            PgSpecialDate, PgSpecialTimestamp, PgTimeTz)
 from ...models.errors import ErrorKind, EtlError
 from ...models.pgtypes import CellKind, Oid, array_element, kind_for_oid
 
@@ -231,9 +231,10 @@ def parse_uuid(text: str) -> uuid_mod.UUID:
 
 def parse_json(text: str) -> Any:
     try:
-        return json.loads(text)
+        v = json.loads(text)
     except json.JSONDecodeError as e:
         raise _invalid("json", text, e)
+    return JSON_NULL if v is None else v
 
 
 _INTERVAL_UNITS = {

@@ -6,12 +6,18 @@ import functools
 import inspect
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU plugin overrides JAX_PLATFORMS at import time; the config
+# knob wins over it (verified: env alone still selects the TPU backend).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

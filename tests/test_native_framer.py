@@ -1,0 +1,119 @@
+"""Native framer tests: C framer vs Python fallback vs codec oracle."""
+
+import numpy as np
+import pytest
+
+from etl_tpu.models import ChangeType, Oid
+from etl_tpu.models.cell import TOAST_UNCHANGED
+from etl_tpu.models.schema import (ColumnSchema, ReplicatedTableSchema,
+                                   TableName, TableSchema)
+from etl_tpu.native import (FLAG_NULL, FLAG_TOAST, FramedBatch, _frame_py,
+                            frame_pgoutput, native_available)
+from etl_tpu.ops import DeviceDecoder
+from etl_tpu.ops.wal import concat_payloads, stage_wal_batch
+from etl_tpu.postgres.codec import pgoutput
+
+
+def sample_messages():
+    ts = 1_700_000_000_000_000
+    msgs = [
+        pgoutput.encode_begin(0x500, ts, 9),
+        pgoutput.encode_insert(42, [b"1", b"alice", b"10.5"]),
+        pgoutput.encode_insert(42, [b"2", None, b"-3"]),
+        pgoutput.encode_update(42, [b"1", b"bob", None],
+                               key_values=[b"1", None, None],
+                               new_kinds=[pgoutput.TUPLE_TEXT,
+                                          pgoutput.TUPLE_TEXT,
+                                          pgoutput.TUPLE_UNCHANGED_TOAST]),
+        pgoutput.encode_delete(42, [b"2", None, None]),
+        pgoutput.encode_commit(0x500, 0x508, ts),
+    ]
+    return msgs
+
+
+class TestFramer:
+    def test_native_built(self):
+        assert native_available(), "C framer failed to build"
+
+    def test_frame_against_python_fallback(self):
+        buf, offs, lens = concat_payloads(sample_messages())
+        framed_c, bad_c = frame_pgoutput(buf, offs, lens, 3)
+        out = FramedBatch(np.frombuffer(buf, np.uint8), len(offs), 3)
+        framed_py, bad_py = _frame_py(np.frombuffer(buf, np.uint8), offs,
+                                      lens.astype(np.int32), 3, out)
+        assert bad_c == bad_py == -1
+        for attr in ("kind", "relid", "old_kind", "new_off", "new_len",
+                     "new_flag", "old_off", "old_len", "old_flag"):
+            np.testing.assert_array_equal(
+                getattr(framed_c, attr), getattr(framed_py, attr), attr)
+
+    def test_field_bytes_zero_copy(self):
+        buf, offs, lens = concat_payloads(sample_messages())
+        framed, bad = frame_pgoutput(buf, offs, lens, 3)
+        assert bad == -1
+        raw = np.frombuffer(buf, np.uint8)
+        o, l = framed.new_off[1, 1], framed.new_len[1, 1]
+        assert raw[o : o + l].tobytes() == b"alice"
+        assert framed.new_flag[2, 1] == FLAG_NULL
+        assert framed.new_flag[3, 2] == FLAG_TOAST
+        assert framed.old_kind[3] == ord("K")
+        assert framed.old_kind[4] == ord("K")
+
+    def test_malformed_stops_at_index(self):
+        msgs = sample_messages()
+        msgs[3] = msgs[3][:-2]  # truncate the update
+        buf, offs, lens = concat_payloads(msgs)
+        framed, bad = frame_pgoutput(buf, offs, lens, 3)
+        assert bad == 3
+        assert framed.kind[1] == ord("I")  # earlier messages framed fine
+
+    def test_wrong_ncols_is_malformed(self):
+        buf, offs, lens = concat_payloads(sample_messages())
+        _, bad = frame_pgoutput(buf, offs, lens, 4)
+        assert bad == 1  # first row message fails the column check
+
+
+class TestWalStaging:
+    def make_schema(self):
+        return ReplicatedTableSchema.with_all_columns(TableSchema(
+            42, TableName("public", "t"),
+            (ColumnSchema("id", Oid.INT4, primary_key_ordinal=1, nullable=False),
+             ColumnSchema("name", Oid.TEXT),
+             ColumnSchema("val", Oid.NUMERIC))))
+
+    def test_stage_and_decode(self):
+        buf, offs, lens = concat_payloads(sample_messages())
+        wal = stage_wal_batch(buf, offs, lens, 3)
+        assert wal.bad_from == -1
+        assert list(wal.change_types) == [ChangeType.INSERT, ChangeType.INSERT,
+                                          ChangeType.UPDATE, ChangeType.DELETE]
+        assert list(wal.msg_index) == [1, 2, 3, 4]
+        assert list(wal.non_row_indices) == [0, 5]  # begin, commit
+        assert (wal.relids == 42).all()
+
+        batch = DeviceDecoder(self.make_schema()).decode(wal.staged)
+        assert batch.num_rows == 4
+        np.testing.assert_array_equal(batch.columns[0].data, [1, 2, 1, 2])
+        assert batch.columns[1].value(0) == "alice"
+        assert not batch.columns[1].validity[1]
+        assert batch.columns[2].is_toast_unchanged(2)
+        # delete row: main tuple is the key tuple
+        assert batch.columns[0].data[3] == 2
+        assert not batch.columns[1].validity[3]
+
+    def test_old_tuple_staging(self):
+        buf, offs, lens = concat_payloads(sample_messages())
+        wal = stage_wal_batch(buf, offs, lens, 3)
+        assert wal.old_staged is not None
+        assert list(wal.old_rows) == [2]  # the update row
+        assert list(wal.old_is_key) == [True]
+        old = DeviceDecoder(self.make_schema()).decode(wal.old_staged)
+        assert old.columns[0].data[0] == 1
+
+    def test_malformed_batch_reports_bad_from(self):
+        msgs = sample_messages()
+        msgs.append(pgoutput.encode_insert(42, [b"9", b"z", b"1"])[:-1])
+        buf, offs, lens = concat_payloads(msgs)
+        wal = stage_wal_batch(buf, offs, lens, 3)
+        assert wal.bad_from == 6
+        assert len(wal.change_types) == 4  # clean prefix still staged

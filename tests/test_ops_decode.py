@@ -1,0 +1,346 @@
+"""TPU decode engine tests: differential against the CPU oracle.
+
+Strategy (SURVEY §4.4 adapted): generate random typed values, render them to
+Postgres text with the test renderers, decode via DeviceDecoder, compare
+bit-for-bit with the CPU codec path. Runs on the CPU backend (conftest
+forces JAX_PLATFORMS=cpu); the same jitted code runs on TPU unchanged.
+"""
+
+import datetime as dt
+import math
+import random
+import string
+
+import numpy as np
+import pytest
+
+from etl_tpu.models import (ColumnSchema, ColumnarBatch, Oid, PgNumeric,
+                            ReplicatedTableSchema, TableName, TableRow,
+                            TableSchema)
+from etl_tpu.ops import (DeviceDecoder, stage_copy_chunk, stage_tuples)
+from etl_tpu.postgres.codec import encode_copy_row, parse_copy_row
+from etl_tpu.postgres.codec.pgoutput import (TUPLE_NULL, TUPLE_TEXT,
+                                             TUPLE_UNCHANGED_TOAST, TupleData)
+
+rng = random.Random(42)
+
+
+def make_schema(cols):
+    return ReplicatedTableSchema.with_all_columns(TableSchema(
+        1, TableName("public", "t"),
+        tuple(ColumnSchema(f"c{i}", oid) for i, oid in enumerate(cols))))
+
+
+def tuples_from_texts(rows):
+    out = []
+    for r in rows:
+        kinds = [TUPLE_NULL if v is None else TUPLE_TEXT for v in r]
+        vals = [None if v is None else v.encode() for v in r]
+        out.append(TupleData(kinds, vals))
+    return out
+
+
+def decode_both(col_oids, text_rows):
+    """Decode text rows via device engine and CPU oracle; return both."""
+    schema = make_schema(col_oids)
+    staged = stage_tuples(tuples_from_texts(text_rows), len(col_oids))
+    dev_batch = DeviceDecoder(schema).decode(staged)
+    cpu_rows = [
+        TableRow([None if v is None else
+                  __import__("etl_tpu.postgres.codec.text",
+                             fromlist=["parse_cell_text"]).parse_cell_text(v, oid)
+                  for v, oid in zip(r, col_oids)])
+        for r in text_rows
+    ]
+    cpu_batch = ColumnarBatch.from_rows(schema, cpu_rows)
+    return dev_batch, cpu_batch
+
+
+def assert_batches_equal(dev: ColumnarBatch, cpu: ColumnarBatch):
+    assert dev.num_rows == cpu.num_rows
+    for dcol, ccol in zip(dev.columns, cpu.columns):
+        np.testing.assert_array_equal(dcol.validity, ccol.validity,
+                                      err_msg=f"validity {dcol.schema.name}")
+        if dcol.is_dense:
+            d = np.where(dcol.validity, dcol.data, 0)
+            c = np.where(ccol.validity, ccol.data, 0)
+            if np.issubdtype(d.dtype, np.floating):
+                np.testing.assert_array_equal(
+                    d.view(np.uint32 if d.dtype == np.float32 else np.uint64),
+                    c.view(np.uint32 if c.dtype == np.float32 else np.uint64),
+                    err_msg=f"float bits {dcol.schema.name}")
+            else:
+                np.testing.assert_array_equal(d, c,
+                                              err_msg=f"col {dcol.schema.name}")
+        else:
+            for i in range(dev.num_rows):
+                if dcol.validity[i]:
+                    dv, cv = dcol.value(i), ccol.value(i)
+                    if (isinstance(dv, PgNumeric) and dv.is_nan()
+                            and isinstance(cv, PgNumeric) and cv.is_nan()):
+                        continue
+                    assert dv == cv, \
+                        f"{dcol.schema.name}[{i}]: {dv!r} != {cv!r}"
+
+
+class TestIntDecode:
+    def test_pgbench_like(self):
+        rows = [[str(i + 1), str(rng.randrange(1, 11)),
+                 str(rng.randrange(-10**9, 10**9)), "padding" * 3]
+                for i in range(100)]
+        dev, cpu = decode_both([Oid.INT4, Oid.INT4, Oid.INT4, Oid.TEXT], rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_int_extremes(self):
+        rows = [["-32768", "-2147483648", "-9223372036854775808"],
+                ["32767", "2147483647", "9223372036854775807"],
+                ["0", "-0", "+5"],
+                [None, "1", None]]
+        dev, cpu = decode_both([Oid.INT2, Oid.INT4, Oid.INT8], rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_random_int8(self):
+        rows = [[str(rng.randrange(-2**63, 2**63))] for _ in range(500)]
+        dev, cpu = decode_both([Oid.INT8], rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_garbage_falls_back(self):
+        # invalid int text: CPU oracle raises, device flags; engine fixup
+        # re-raises through the oracle — so feed values that *parse* under
+        # the oracle but not on device: none exist for ints; instead check
+        # ok-flag fallback via a float in an int column raising cleanly
+        from etl_tpu.models.errors import EtlError
+        with pytest.raises(EtlError):
+            decode_both([Oid.INT4], [["12.5"]])
+
+
+class TestBoolDecode:
+    def test_bools(self):
+        rows = [["t"], ["f"], [None], ["t"]]
+        dev, cpu = decode_both([Oid.BOOL], rows)
+        assert_batches_equal(dev, cpu)
+
+
+class TestFloatDecode:
+    def test_simple(self):
+        rows = [["1.5", "-0.25"], ["100", "2.5e10"], ["-1e-5", "0"],
+                ["NaN", "Infinity"], [None, "-Infinity"]]
+        dev, cpu = decode_both([Oid.FLOAT8, Oid.FLOAT4], rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_random_fixed_precision(self):
+        # ≤15 sig digits: device fast path, bit-identical to strtod
+        rows = [[f"{rng.uniform(-1e6, 1e6):.6f}"] for _ in range(300)]
+        dev, cpu = decode_both([Oid.FLOAT8], rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_17_digit_shortest_roundtrip_falls_back(self):
+        # full-precision doubles exceed the 15-digit fast path → CPU fixup,
+        # still bit-exact
+        rows = [[repr(rng.uniform(-1, 1))] for _ in range(50)]
+        rows += [["1.7976931348623157e308"], ["5e-324"], ["2.2250738585072014e-308"]]
+        dev, cpu = decode_both([Oid.FLOAT8], rows)
+        assert_batches_equal(dev, cpu)
+
+
+class TestDateTimeDecode:
+    def test_dates(self):
+        rows = [["2024-02-29"], ["1970-01-01"], ["0001-01-01"],
+                ["9999-12-31"], [None], ["2000-03-01"]]
+        dev, cpu = decode_both([Oid.DATE], rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_random_dates(self):
+        rows = [[(dt.date(1900, 1, 1)
+                  + dt.timedelta(days=rng.randrange(0, 80000))).isoformat()]
+                for _ in range(300)]
+        dev, cpu = decode_both([Oid.DATE], rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_bc_date_falls_back(self):
+        rows = [["0044-03-15 BC"], ["2024-01-01"]]
+        dev, cpu = decode_both([Oid.DATE], rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_times(self):
+        rows = [["00:00:00"], ["23:59:59.999999"], ["12:30:15.5"],
+                ["01:02:03.123"], [None]]
+        dev, cpu = decode_both([Oid.TIME], rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_timestamps(self):
+        rows = [["2024-05-01 12:34:56"], ["2024-05-01 12:34:56.789123"],
+                ["1970-01-01 00:00:00"], ["2262-04-11 23:47:16.854775"],
+                [None], ["1900-01-01 06:00:00.1"]]
+        dev, cpu = decode_both([Oid.TIMESTAMP], rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_timestamptz(self):
+        rows = [["2024-05-01 12:34:56+02"], ["2024-05-01 12:34:56.789-05:30"],
+                ["2024-01-01 00:00:00+00"], ["1995-06-15 10:00:00.25+09:30:30"],
+                [None]]
+        dev, cpu = decode_both([Oid.TIMESTAMPTZ], rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_random_timestamps(self):
+        rows = []
+        for _ in range(200):
+            base = dt.datetime(1950, 1, 1) + dt.timedelta(
+                seconds=rng.randrange(0, 4 * 10**9),
+                microseconds=rng.randrange(0, 10**6))
+            rows.append([base.isoformat(sep=" ")])
+        dev, cpu = decode_both([Oid.TIMESTAMP], rows)
+        assert_batches_equal(dev, cpu)
+
+
+class TestObjectColumns:
+    def test_text_numeric_uuid_json(self):
+        rows = [
+            ["hello", "12.340", "a0eebc99-9c0b-4ef8-bb6d-6bb9bd380a11",
+             '{"k": 1}'],
+            [None, "NaN", None, "[1,2]"],
+            ["unicode-é", "-99999999999999999999.5", None, "null"],
+        ]
+        dev, cpu = decode_both([Oid.TEXT, Oid.NUMERIC, Oid.UUID, Oid.JSONB],
+                               rows)
+        assert_batches_equal(dev, cpu)
+        assert isinstance(dev.columns[1].value(0), PgNumeric)
+
+    def test_numeric_f64_mode(self):
+        schema = make_schema([Oid.NUMERIC])
+        staged = stage_tuples(tuples_from_texts([["12.5"], ["-3"]]), 1)
+        batch = DeviceDecoder(schema, numeric_mode="f64").decode(staged)
+        assert batch.columns[0].is_dense
+        np.testing.assert_array_equal(batch.columns[0].data, [12.5, -3.0])
+
+
+class TestToastAndNulls:
+    def test_toast_passthrough(self):
+        schema = make_schema([Oid.INT4, Oid.TEXT])
+        tup = TupleData([TUPLE_TEXT, TUPLE_UNCHANGED_TOAST], [b"5", None])
+        batch = DeviceDecoder(schema).decode(stage_tuples([tup], 2))
+        assert batch.columns[0].data[0] == 5
+        assert not batch.columns[1].validity[0]
+        assert batch.columns[1].is_toast_unchanged(0)
+
+    def test_all_null_row(self):
+        dev, cpu = decode_both([Oid.INT4, Oid.DATE], [[None, None], ["1", "2020-01-01"]])
+        assert_batches_equal(dev, cpu)
+
+
+class TestCopyStaging:
+    def test_copy_chunk_roundtrip(self):
+        lines = []
+        expected = []
+        for i in range(50):
+            texts = [str(i), f"name-{i}" if i % 3 else None, f"{i}.25"]
+            lines.append(encode_copy_row(texts))
+            expected.append(texts)
+        chunk = b"\n".join(lines) + b"\n"
+        staged = stage_copy_chunk(chunk, 3)
+        assert staged.n_rows == 50
+        assert len(staged.cpu_fallback_rows) == 0
+        schema = make_schema([Oid.INT4, Oid.TEXT, Oid.FLOAT8])
+        batch = DeviceDecoder(schema).decode(staged)
+        for i, texts in enumerate(expected):
+            assert batch.columns[0].data[i] == i
+            if texts[1] is None:
+                assert not batch.columns[1].validity[i]
+            else:
+                assert batch.columns[1].value(i) == texts[1]
+
+    def test_copy_chunk_with_escapes(self):
+        lines = [encode_copy_row(["1", "plain"]),
+                 encode_copy_row(["2", "tab\there"]),
+                 encode_copy_row(["3", None])]
+        staged = stage_copy_chunk(b"\n".join(lines) + b"\n", 2)
+        assert list(staged.cpu_fallback_rows) == [1]
+        schema = make_schema([Oid.INT4, Oid.TEXT])
+        batch = DeviceDecoder(schema).decode(staged)
+        assert batch.columns[1].value(1) == "tab\there"
+        assert not batch.columns[1].validity[2]
+
+    def test_copy_chunk_ragged_raises(self):
+        from etl_tpu.models.errors import EtlError
+        with pytest.raises(EtlError):
+            stage_copy_chunk(b"1\t2\n3\n", 2)
+
+    def test_against_cpu_copy_parser(self):
+        oids = [Oid.INT8, Oid.TEXT, Oid.NUMERIC, Oid.DATE]
+        lines, cpu_rows = [], []
+        for i in range(64):
+            texts = [str(rng.randrange(-10**12, 10**12)),
+                     "".join(rng.choice(string.printable[:60]) for _ in range(10)),
+                     f"{rng.randrange(0, 10**6)}.{rng.randrange(0, 100):02d}",
+                     (dt.date(2000, 1, 1) + dt.timedelta(days=i)).isoformat()]
+            line = encode_copy_row(texts)
+            lines.append(line)
+            cpu_rows.append(parse_copy_row(line, oids))
+        staged = stage_copy_chunk(b"\n".join(lines) + b"\n", 4)
+        schema = make_schema(oids)
+        dev = DeviceDecoder(schema).decode(staged)
+        cpu = ColumnarBatch.from_rows(schema, cpu_rows)
+        assert_batches_equal(dev, cpu)
+
+
+class TestBuckets:
+    def test_jit_cache_reuse_across_sizes(self):
+        schema = make_schema([Oid.INT4])
+        dec = DeviceDecoder(schema)
+        for n in (3, 100, 250):  # all inside the 256 bucket
+            staged = stage_tuples(tuples_from_texts([[str(i)] for i in range(n)]), 1)
+            batch = dec.decode(staged)
+            assert list(batch.columns[0].data) == list(range(n))
+        assert len(dec._fn_cache) == 1
+
+    def test_oversized_field_falls_back(self):
+        schema = make_schema([Oid.TEXT, Oid.INT4])
+        big = "x" * 5000
+        staged = stage_tuples(tuples_from_texts([[big, "7"]]), 2)
+        batch = DeviceDecoder(schema).decode(staged)
+        assert batch.columns[0].value(0) == big
+        assert batch.columns[1].data[0] == 7
+
+
+class TestReviewRegressions:
+    def test_int_overflow_errors_not_wraps(self):
+        # out-of-range values for the declared type are corrupt data: the
+        # device flags them and the CPU fixup raises a typed error instead
+        # of silently shipping a wrapped/truncated integer
+        from etl_tpu.models.errors import ErrorKind, EtlError
+        for oid, text in [(Oid.INT4, "99999999999"), (Oid.INT2, "70000"),
+                          (Oid.INT8, "9223372036854775808")]:
+            with pytest.raises(EtlError) as ei:
+                decode_both([oid], [[text], ["5"]])
+            assert ei.value.kind is ErrorKind.ROW_CONVERSION_FAILED
+
+    def test_int_boundaries_exact(self):
+        dev, cpu = decode_both(
+            [Oid.INT2, Oid.INT4, Oid.INT8],
+            [["-32768", "-2147483648", "-9223372036854775808"],
+             ["32767", "2147483647", "9223372036854775807"]])
+        assert_batches_equal(dev, cpu)
+
+    def test_numeric_f64_to_arrow(self):
+        schema = make_schema([Oid.NUMERIC])
+        staged = stage_tuples(tuples_from_texts([["12.5"], [None]]), 1)
+        batch = DeviceDecoder(schema, numeric_mode="f64").decode(staged)
+        rb = batch.to_arrow()
+        assert rb.column(0).to_pylist() == [12.5, None]
+        assert batch.to_rows()[0].values[0] == 12.5
+
+    def test_json_null_to_arrow(self):
+        schema = make_schema([Oid.JSONB])
+        staged = stage_tuples(tuples_from_texts(
+            [["null"], [None], ['{"a": 1}']]), 1)
+        batch = DeviceDecoder(schema).decode(staged)
+        rb = batch.to_arrow()
+        assert rb.column(0).to_pylist() == ["null", None, '{"a": 1}']
+
+    def test_binary_tuple_rejected(self):
+        from etl_tpu.models.errors import EtlError, ErrorKind
+        from etl_tpu.postgres.codec.pgoutput import TUPLE_BINARY
+        tup = TupleData([TUPLE_BINARY], [b"\x00\x00\x00\x05"])
+        with pytest.raises(EtlError) as ei:
+            stage_tuples([tup], 1)
+        assert ei.value.kind is ErrorKind.UNSUPPORTED_TYPE
