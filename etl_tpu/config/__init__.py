@@ -1,0 +1,6 @@
+"""Typed configuration."""
+
+from .pipeline import (BatchConfig, BatchEngine, InvalidatedSlotBehavior,
+                       MemoryBackpressureConfig, PgConnectionConfig,
+                       PipelineConfig, RetryConfig, TableSyncCopyConfig,
+                       TlsConfig)

@@ -1,0 +1,148 @@
+"""Typed pipeline configuration.
+
+Reference parity: `PipelineConfig`, `BatchConfig`, `MemoryBackpressureConfig`,
+`PgConnectionConfig`, `TableSyncCopyConfig`, retry configs
+(crates/etl-config/src/shared/pipeline.rs:11,185,239; connection.rs).
+Defaults mirror the reference's tuning constants (BASELINE.md):
+batch 8 MiB / 10 s fill / memory ratio 0.2; backpressure 0.85/0.75;
+copy 4 partitions-per-connection / 250k rows / ≤1024 partitions.
+
+TPU-first addition: `batch_engine` selects the decode path ("cpu" oracle or
+"tpu" device engine) at the BatchConfig boundary, per the north star.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from ..models.errors import ErrorKind, EtlError
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        raise EtlError(ErrorKind.CONFIG_INVALID, what)
+
+
+class InvalidatedSlotBehavior(enum.Enum):
+    """What to do when the replication slot was invalidated by the source
+    (reference apply/worker.rs:476-527)."""
+
+    ERROR = "error"
+    RECREATE_AND_RESYNC = "recreate_and_resync"
+
+
+class BatchEngine(enum.Enum):
+    CPU = "cpu"
+    TPU = "tpu"
+
+
+@dataclass(frozen=True)
+class TlsConfig:
+    enabled: bool = False
+    trusted_root_certs: str = ""
+
+
+@dataclass(frozen=True)
+class PgConnectionConfig:
+    host: str = "localhost"
+    port: int = 5432
+    name: str = "postgres"  # database name
+    username: str = "postgres"
+    password: str | None = None
+    tls: TlsConfig = field(default_factory=TlsConfig)
+    keepalive_idle_s: int = 60
+    connect_timeout_s: int = 30
+
+    def validate(self) -> None:
+        _require(1 <= self.port <= 65535, f"port out of range: {self.port}")
+        _require(bool(self.host), "host must be non-empty")
+
+
+@dataclass(frozen=True)
+class BatchConfig:
+    """Flush sizing (reference pipeline.rs:52-68)."""
+
+    max_size_bytes: int = 8 * 1024 * 1024
+    max_fill_ms: int = 10_000
+    batch_engine: BatchEngine = BatchEngine.TPU
+
+    def validate(self) -> None:
+        _require(self.max_size_bytes > 0, "max_size_bytes must be > 0")
+        _require(self.max_fill_ms > 0, "max_fill_ms must be > 0")
+
+
+@dataclass(frozen=True)
+class MemoryBackpressureConfig:
+    """RSS hysteresis thresholds (reference pipeline.rs:199-201)."""
+
+    activate_ratio: float = 0.85
+    resume_ratio: float = 0.75
+    refresh_interval_ms: int = 100
+    memory_ratio: float = 0.2  # share of memory for batch budgets
+
+    def validate(self) -> None:
+        _require(0 < self.resume_ratio < self.activate_ratio <= 1.0,
+                 "need 0 < resume < activate <= 1")
+        _require(self.refresh_interval_ms > 0, "refresh interval must be > 0")
+
+
+@dataclass(frozen=True)
+class TableSyncCopyConfig:
+    """CTID-partitioned parallel copy planning (reference copy.rs:54-58)."""
+
+    max_connections: int = 4
+    partitions_per_connection: int = 4
+    rows_per_partition_target: int = 250_000
+    max_partitions: int = 1024
+
+    def validate(self) -> None:
+        _require(self.max_connections >= 1, "need >= 1 copy connection")
+        _require(self.max_partitions >= 1, "need >= 1 partition")
+
+
+@dataclass(frozen=True)
+class RetryConfig:
+    max_attempts: int = 5
+    initial_delay_ms: int = 1_000
+    max_delay_ms: int = 60_000
+    backoff_factor: float = 2.0
+
+    def delay_ms(self, attempt: int) -> int:
+        d = self.initial_delay_ms * (self.backoff_factor ** attempt)
+        return int(min(d, self.max_delay_ms))
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    pipeline_id: int
+    publication_name: str
+    pg_connection: PgConnectionConfig = field(default_factory=PgConnectionConfig)
+    batch: BatchConfig = field(default_factory=BatchConfig)
+    backpressure: MemoryBackpressureConfig = field(
+        default_factory=MemoryBackpressureConfig)
+    table_sync_copy: TableSyncCopyConfig = field(
+        default_factory=TableSyncCopyConfig)
+    apply_retry: RetryConfig = field(default_factory=RetryConfig)
+    table_retry: RetryConfig = field(default_factory=RetryConfig)
+    max_table_sync_workers: int = 4
+    invalidated_slot_behavior: InvalidatedSlotBehavior = \
+        InvalidatedSlotBehavior.ERROR
+    run_source_migrations: bool = True
+    wal_sender_timeout_ms: int = 60_000
+
+    def validate(self) -> None:
+        _require(self.pipeline_id >= 0, "pipeline_id must be >= 0")
+        _require(bool(self.publication_name), "publication_name required")
+        _require(self.max_table_sync_workers >= 1,
+                 "need >= 1 table sync worker")
+        self.pg_connection.validate()
+        self.batch.validate()
+        self.backpressure.validate()
+        self.table_sync_copy.validate()
+
+    @property
+    def keepalive_deadline_ms(self) -> int:
+        """60% of wal_sender_timeout, floored at 100ms (reference
+        apply.rs:94-116)."""
+        return max(100, int(self.wal_sender_timeout_ms * 0.6))

@@ -1,0 +1,5 @@
+"""Destination implementations."""
+
+from .base import Destination, WriteAck, expand_batch_events
+from .memory import (FaultAction, FaultInjectingDestination, FaultKind,
+                     MemoryDestination)

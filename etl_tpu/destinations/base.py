@@ -1,0 +1,119 @@
+"""Destination trait and write-acknowledgement semantics.
+
+Reference parity: `Destination` trait (crates/etl/src/destination/base.rs:27)
+and `AsyncResult` Accepted/Durable (destination/async_result.rs:22-66):
+`write_*` may return a *durable* ack (data is crash-safe at the destination)
+or an *accepted* ack (handed off; durability signalled later through the
+attached future). The apply loop advances durable progress — and therefore
+the replication slot — only on durable acks at commit boundaries.
+
+TPU-first: `write_table_rows` and `write_events` accept ColumnarBatch /
+DecodedBatchEvent payloads straight from the device engine; the
+`expand_batch_events` helper converts batch events to per-row events for
+row-oriented writers.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+from dataclasses import dataclass, field
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from ..models.event import (ChangeType, DecodedBatchEvent, DeleteEvent, Event,
+                            InsertEvent, UpdateEvent)
+from ..models.lsn import Lsn
+from ..models.schema import ReplicatedTableSchema, TableId
+from ..models.table_row import ColumnarBatch, TableRow
+
+
+class WriteAck:
+    """Acknowledgement of a write. `durable` may be True immediately;
+    otherwise await `wait_durable()` (resolves when the destination reports
+    crash-safety, or raises if the write ultimately failed)."""
+
+    __slots__ = ("_fut",)
+
+    def __init__(self, fut: "asyncio.Future[None]"):
+        self._fut = fut
+
+    @classmethod
+    def durable(cls) -> "WriteAck":
+        fut = asyncio.get_event_loop().create_future()
+        fut.set_result(None)
+        return cls(fut)
+
+    @classmethod
+    def accepted(cls) -> "tuple[WriteAck, asyncio.Future[None]]":
+        fut = asyncio.get_event_loop().create_future()
+        return cls(fut), fut
+
+    @property
+    def is_durable(self) -> bool:
+        return self._fut.done() and self._fut.exception() is None
+
+    async def wait_durable(self) -> None:
+        await asyncio.shield(self._fut)
+
+
+class Destination(abc.ABC):
+    """Where decoded rows and CDC events land. Implementations must be
+    idempotent under at-least-once delivery (SURVEY §5 checkpoint/resume)."""
+
+    @abc.abstractmethod
+    async def startup(self) -> None: ...
+
+    @abc.abstractmethod
+    async def write_table_rows(self, schema: ReplicatedTableSchema,
+                               batch: ColumnarBatch) -> WriteAck:
+        """Initial-copy path: append-only rows for one table."""
+
+    @abc.abstractmethod
+    async def write_events(self, events: Sequence[Event]) -> WriteAck:
+        """CDC path: ordered events (possibly spanning tables)."""
+
+    @abc.abstractmethod
+    async def drop_table(self, table_id: TableId) -> None:
+        """Drop destination table before a (re)copy
+        (reference table_sync/mod.rs:184-220 crash-consistency)."""
+
+    @abc.abstractmethod
+    async def truncate_table(self, table_id: TableId) -> None: ...
+
+    async def shutdown(self) -> None:  # optional
+        return None
+
+
+@dataclass(slots=True)
+class _RowChange:
+    change: ChangeType
+    key: tuple
+    row: TableRow | None
+
+
+def expand_batch_events(events: Iterable[Event]) -> list[Event]:
+    """Expand DecodedBatchEvents into per-row Insert/Update/Delete events
+    (helper for row-oriented destinations; columnar-native ones consume the
+    batch directly)."""
+    out: list[Event] = []
+    for e in events:
+        if not isinstance(e, DecodedBatchEvent):
+            out.append(e)
+            continue
+        rows = e.batch.to_rows()
+        for i, row in enumerate(rows):
+            ct = ChangeType(int(e.change_types[i]))
+            commit = Lsn(int(e.commit_lsns[i]))
+            ordinal = int(e.tx_ordinals[i])
+            if ct is ChangeType.INSERT:
+                out.append(InsertEvent(e.start_lsn, commit, ordinal,
+                                       e.schema, row))
+            elif ct is ChangeType.UPDATE:
+                out.append(UpdateEvent(e.start_lsn, commit, ordinal,
+                                       e.schema, row))
+            else:
+                out.append(DeleteEvent(e.start_lsn, commit, ordinal,
+                                       e.schema, row))
+    return out

@@ -1,0 +1,144 @@
+"""In-memory destination + the fault-scripting test wrapper.
+
+Reference parity: `MemoryDestination` (crates/etl/src/test_utils) and
+`TestDestinationWrapper` with a scripted FIFO fault queue per operation
+(test_utils/faults.rs:29-70): Reject / fail-after-apply ("lost-response
+ambiguity") / hold / delay — the machinery behind the faulty-destination
+integration suite (SURVEY §4.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from ..models.errors import ErrorKind, EtlError
+from ..models.event import Event
+from ..models.schema import ReplicatedTableSchema, TableId
+from ..models.table_row import ColumnarBatch, TableRow
+from .base import Destination, WriteAck, expand_batch_events
+
+
+class MemoryDestination(Destination):
+    """Durable-by-definition in-memory destination: rows and events are
+    captured in plain lists for assertions."""
+
+    def __init__(self) -> None:
+        self.table_rows: dict[TableId, list[TableRow]] = defaultdict(list)
+        self.events: list[Event] = []
+        self.dropped_tables: list[TableId] = []
+        self.truncated_tables: list[TableId] = []
+        self.started = False
+
+    async def startup(self) -> None:
+        self.started = True
+
+    async def write_table_rows(self, schema: ReplicatedTableSchema,
+                               batch: ColumnarBatch) -> WriteAck:
+        self.table_rows[schema.id].extend(batch.to_rows())
+        return WriteAck.durable()
+
+    async def write_events(self, events: Sequence[Event]) -> WriteAck:
+        self.events.extend(expand_batch_events(events))
+        return WriteAck.durable()
+
+    async def drop_table(self, table_id: TableId) -> None:
+        self.table_rows.pop(table_id, None)
+        self.dropped_tables.append(table_id)
+
+    async def truncate_table(self, table_id: TableId) -> None:
+        self.table_rows[table_id] = []
+        self.truncated_tables.append(table_id)
+
+
+class FaultKind(enum.Enum):
+    REJECT = "reject"  # fail before applying
+    FAIL_AFTER_APPLY = "fail_after_apply"  # apply, then report failure
+    HOLD = "hold"  # apply, ack Accepted, durable only on release()
+    DELAY = "delay"  # apply after a delay, then durable
+
+
+@dataclass
+class FaultAction:
+    kind: FaultKind
+    delay_s: float = 0.0
+    release_event: asyncio.Event | None = None
+
+
+class FaultInjectingDestination(Destination):
+    """Wraps a destination with per-operation FIFO fault scripts
+    (reference TestDestinationWrapper)."""
+
+    def __init__(self, inner: Destination):
+        self.inner = inner
+        self._faults: dict[str, deque[FaultAction]] = defaultdict(deque)
+        self.write_events_calls = 0
+        self.write_rows_calls = 0
+
+    def script(self, op: str, action: FaultAction) -> None:
+        """op: one of write_table_rows / write_events / drop_table /
+        truncate_table."""
+        self._faults[op].append(action)
+
+    def _next_fault(self, op: str) -> FaultAction | None:
+        q = self._faults.get(op)
+        return q.popleft() if q else None
+
+    async def _apply_fault(self, op: str, run) -> WriteAck:
+        fault = self._next_fault(op)
+        if fault is None:
+            return await run()
+        if fault.kind is FaultKind.REJECT:
+            raise EtlError(ErrorKind.DESTINATION_FAILED,
+                           f"scripted reject on {op}")
+        if fault.kind is FaultKind.FAIL_AFTER_APPLY:
+            await run()
+            raise EtlError(ErrorKind.DESTINATION_FAILED,
+                           f"scripted fail-after-apply on {op}")
+        if fault.kind is FaultKind.DELAY:
+            await asyncio.sleep(fault.delay_s)
+            return await run()
+        # HOLD: apply now, durable on release
+        await run()
+        ack, fut = WriteAck.accepted()
+        release = fault.release_event or asyncio.Event()
+
+        async def _release() -> None:
+            await release.wait()
+            if not fut.done():
+                fut.set_result(None)
+
+        asyncio.ensure_future(_release())
+        return ack
+
+    async def startup(self) -> None:
+        await self.inner.startup()
+
+    async def write_table_rows(self, schema: ReplicatedTableSchema,
+                               batch: ColumnarBatch) -> WriteAck:
+        self.write_rows_calls += 1
+        return await self._apply_fault(
+            "write_table_rows",
+            lambda: self.inner.write_table_rows(schema, batch))
+
+    async def write_events(self, events: Sequence[Event]) -> WriteAck:
+        self.write_events_calls += 1
+        return await self._apply_fault(
+            "write_events", lambda: self.inner.write_events(events))
+
+    async def drop_table(self, table_id: TableId) -> None:
+        async def run():
+            await self.inner.drop_table(table_id)
+            return WriteAck.durable()
+
+        await self._apply_fault("drop_table", run)
+
+    async def truncate_table(self, table_id: TableId) -> None:
+        async def run():
+            await self.inner.truncate_table(table_id)
+            return WriteAck.durable()
+
+        await self._apply_fault("truncate_table", run)

@@ -1,0 +1,74 @@
+"""Replication slot naming.
+
+Reference parity: crates/etl-postgres/src/slots.rs:16-18,49-120 —
+`supabase_etl_apply_{pipeline}` and
+`supabase_etl_table_sync_{pipeline}_{table}`, bounded by Postgres' 63-byte
+identifier limit, with parsing helpers for cleanup sweeps.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..models.errors import ErrorKind, EtlError
+from ..models.schema import TableId
+
+SLOT_PREFIX = "supabase_etl"
+MAX_SLOT_LEN = 63
+
+
+def apply_slot_name(pipeline_id: int) -> str:
+    name = f"{SLOT_PREFIX}_apply_{pipeline_id}"
+    _check(name)
+    return name
+
+
+def table_sync_slot_name(pipeline_id: int, table_id: TableId) -> str:
+    name = f"{SLOT_PREFIX}_table_sync_{pipeline_id}_{table_id}"
+    _check(name)
+    return name
+
+
+def _check(name: str) -> None:
+    if len(name.encode()) > MAX_SLOT_LEN:
+        raise EtlError(ErrorKind.SLOT_NAME_TOO_LONG, name)
+
+
+@dataclass(frozen=True)
+class ParsedSlot:
+    pipeline_id: int
+    table_id: TableId | None  # None = apply slot
+
+    @property
+    def is_apply(self) -> bool:
+        return self.table_id is None
+
+
+def parse_slot_name(name: str) -> ParsedSlot | None:
+    """Parse a framework slot name; None if it isn't ours."""
+    if name.startswith(f"{SLOT_PREFIX}_apply_"):
+        rest = name[len(f"{SLOT_PREFIX}_apply_"):]
+        try:
+            return ParsedSlot(int(rest), None)
+        except ValueError:
+            return None
+    if name.startswith(f"{SLOT_PREFIX}_table_sync_"):
+        rest = name[len(f"{SLOT_PREFIX}_table_sync_"):]
+        parts = rest.split("_")
+        if len(parts) != 2:
+            return None
+        try:
+            return ParsedSlot(int(parts[0]), int(parts[1]))
+        except ValueError:
+            return None
+    return None
+
+
+def slots_for_pipeline(names: list[str], pipeline_id: int) -> list[str]:
+    """Cleanup helper: all of a pipeline's slots among `names`."""
+    out = []
+    for n in names:
+        p = parse_slot_name(n)
+        if p is not None and p.pipeline_id == pipeline_id:
+            out.append(n)
+    return out

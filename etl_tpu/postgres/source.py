@@ -1,0 +1,124 @@
+"""ReplicationSource: the seam between the runtime and Postgres.
+
+The apply loop, table-sync workers and pipeline consume this interface; the
+wire-protocol client (postgres/client.py) implements it against a real
+server, and FakeSource (postgres/fake.py) implements it in-memory with the
+same semantics (slots with consistent points, MVCC snapshots at slot
+creation, publication filtering) — the substitute for the reference's
+real-Postgres integration harness (SURVEY §4.2) in an environment without a
+Postgres server.
+
+Reference parity: `PgReplicationClient` surface (crates/etl/src/postgres/
+client/raw.rs:212 — slot CRUD with snapshot transactions, publication
+queries, START_REPLICATION) and `PgReplicationTransaction` (transaction.rs:
+727 — schema introspection, COPY streams, snapshot forking).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import AsyncIterator
+
+from ..models.lsn import Lsn
+from ..models.schema import ReplicatedTableSchema, TableId
+from .codec.pgoutput import ReplicationFrame
+
+
+@dataclass(frozen=True)
+class SlotInfo:
+    name: str
+    confirmed_flush_lsn: Lsn
+    active: bool = False
+    invalidated: bool = False  # wal_status = lost
+
+
+@dataclass(frozen=True)
+class CreatedSlot:
+    name: str
+    consistent_point: Lsn  # WAL position at slot creation
+    snapshot_id: str  # exported snapshot (fake: internal snapshot key)
+
+
+class ReplicationStream(abc.ABC):
+    """The START_REPLICATION copy-both stream: frames down, status up."""
+
+    @abc.abstractmethod
+    def __aiter__(self) -> AsyncIterator[ReplicationFrame]: ...
+
+    @abc.abstractmethod
+    async def send_status_update(self, written: Lsn, flushed: Lsn,
+                                 applied: Lsn,
+                                 reply_requested: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+
+class CopyStream(abc.ABC):
+    """COPY TO STDOUT: yields raw text-format chunks (newline-complete)."""
+
+    @abc.abstractmethod
+    def __aiter__(self) -> AsyncIterator[bytes]: ...
+
+
+class ReplicationSource(abc.ABC):
+    """One logical connection to the source database."""
+
+    @abc.abstractmethod
+    async def connect(self) -> None: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+    # -- catalog -------------------------------------------------------------
+
+    @abc.abstractmethod
+    async def publication_exists(self, publication: str) -> bool: ...
+
+    @abc.abstractmethod
+    async def get_publication_table_ids(self,
+                                        publication: str) -> list[TableId]: ...
+
+    @abc.abstractmethod
+    async def get_table_schema(
+        self, table_id: TableId, publication: str,
+        snapshot_id: str | None = None) -> ReplicatedTableSchema:
+        """Schema + replica identity + publication column filters, read in
+        the slot snapshot when given (reference transaction.rs:750-768)."""
+
+    @abc.abstractmethod
+    async def get_current_wal_lsn(self) -> Lsn: ...
+
+    # -- slots ---------------------------------------------------------------
+
+    @abc.abstractmethod
+    async def get_slot(self, name: str) -> SlotInfo | None: ...
+
+    @abc.abstractmethod
+    async def create_slot(self, name: str) -> CreatedSlot:
+        """CREATE_REPLICATION_SLOT ... USE_SNAPSHOT inside a transaction —
+        the returned snapshot_id fences table copies against the slot's
+        consistent point (reference raw.rs:419-529)."""
+
+    @abc.abstractmethod
+    async def delete_slot(self, name: str) -> None:
+        """Drop if exists; no error when absent."""
+
+    # -- data ----------------------------------------------------------------
+
+    @abc.abstractmethod
+    async def copy_table_stream(self, table_id: TableId, publication: str,
+                                snapshot_id: str,
+                                ctid_range: "tuple[int, int] | None" = None
+                                ) -> CopyStream:
+        """COPY text stream of the table as of the snapshot; optional CTID
+        page range for partitioned parallel copy (transaction.rs:780,868)."""
+
+    @abc.abstractmethod
+    async def estimate_table_stats(self, table_id: TableId) -> tuple[int, int]:
+        """(estimated_rows, heap_pages) from pg_class for copy planning."""
+
+    @abc.abstractmethod
+    async def start_replication(self, slot_name: str, publication: str,
+                                start_lsn: Lsn) -> ReplicationStream: ...

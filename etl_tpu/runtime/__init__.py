@@ -1,0 +1,6 @@
+"""Replication runtime: pipeline, apply loop, workers, state machine."""
+
+from .apply_loop import ApplyContext, ApplyLoop, ExitIntent, TableSyncContext
+from .pipeline import Pipeline
+from .shutdown import ShutdownRequested, ShutdownSignal, or_shutdown
+from .state import TableState, TableStateType

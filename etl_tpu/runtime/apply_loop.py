@@ -1,0 +1,437 @@
+"""The apply loop — heart of the replication runtime.
+
+One loop type shared by the apply worker and table-sync workers via a
+worker-context object (reference `ApplyLoop` + `WorkerContext`,
+crates/etl/src/replication/apply.rs:215,1048). Responsibilities:
+
+  - event-driven select with explicit priorities (apply.rs:1280-1336):
+    shutdown > in-flight flush result > batch deadline > new WAL message
+    > proactive keepalive;
+  - decode pgoutput messages into typed events (via EventAssembler — CPU
+    per-tuple or TPU batched decode);
+  - batch events by size-hint bytes + fill deadline; dispatch at most ONE
+    in-flight `write_events` (apply.rs:1956-2023);
+  - advance durable progress only on durable acks at commit boundaries
+    (apply.rs:2665-2719) and send standby status updates with the effective
+    flush LSN (the ack/flow-control channel, apply.rs:1575);
+  - drive the table-sync handoff state machine at commit/flush/idle points
+    (apply.rs:2874-3441) — the restart-window reasoning from
+    apply.rs:2907-2929 applies: Catchup is set only in memory, so a crash
+    between SyncWait and SyncDone re-runs the wait, which is safe;
+  - handle DDL logical messages → versioned schema store (apply.rs:2160).
+
+Exit intents (apply.rs:139): PAUSE (shutdown; resumable) or COMPLETE
+(table-sync context reached its catchup target).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Protocol
+
+from ..config.pipeline import BatchEngine, PipelineConfig
+from ..models.errors import ErrorKind, EtlError
+from ..models.event import (BeginEvent, CommitEvent, RelationEvent,
+                            SchemaChangeEvent, TruncateEvent)
+from ..models.lsn import Lsn
+from ..models.schema import TableId
+from ..postgres.codec import event as event_codec
+from ..postgres.codec import pgoutput
+from ..postgres.source import ReplicationStream
+from ..store.base import PipelineStore
+from ..destinations.base import Destination
+from .assembler import EventAssembler
+from .shutdown import ShutdownSignal
+from .state import TableState, TableStateType
+from .table_cache import SharedTableCache
+
+
+class ExitIntent(enum.Enum):
+    PAUSE = "pause"  # shutdown requested; resumable from durable progress
+    COMPLETE = "complete"  # table-sync caught up to its target
+
+
+class SyncCoordination(Protocol):
+    """What the apply-context loop needs from the table-sync worker pool."""
+
+    def table_state(self, table_id: TableId) -> TableState | None:
+        """Merged store+memory view of one table's state (synchronous — the
+        pool keeps its cache current across worker transitions)."""
+
+    def syncing_table_states(self) -> dict[TableId, TableState]:
+        """Merged store+memory view of tables NOT owned by the apply worker
+        (everything except Ready)."""
+
+    async def set_catchup(self, table_id: TableId, target: Lsn) -> None: ...
+
+    async def wait_for_sync_done_or_errored(
+        self, table_id: TableId) -> TableState: ...
+
+    async def mark_ready(self, table_id: TableId) -> None: ...
+
+    async def ensure_worker(self, table_id: TableId) -> None: ...
+
+
+@dataclass
+class ApplyContext:
+    """Apply worker: owns the main slot and all Ready tables."""
+
+    progress_key: str  # the apply slot name
+    coordination: SyncCoordination
+
+
+@dataclass
+class TableSyncContext:
+    """Table-sync worker: owns exactly one table; streams from its snapshot
+    until the catchup target, then completes."""
+
+    table_id: TableId
+    progress_key: str  # the table-sync slot name
+    catchup_target: "asyncio.Future[Lsn]"  # resolved when apply sets Catchup
+
+
+@dataclass
+class _InFlight:
+    task: asyncio.Task
+    commit_end_lsn: Lsn | None  # durable watermark if batch ends past a commit
+    n_events: int
+
+
+@dataclass
+class _LoopState:
+    last_commit_end_lsn: Lsn | None = None  # end of last fully-seen commit
+    current_commit_lsn: Lsn = Lsn.ZERO  # from BEGIN
+    tx_ordinal: int = 0
+    durable_lsn: Lsn = Lsn.ZERO
+    received_lsn: Lsn = Lsn.ZERO
+    batch_commit_end: Lsn | None = None  # last commit boundary inside batch
+
+
+class ApplyLoop:
+    def __init__(self, *, ctx: "ApplyContext | TableSyncContext",
+                 stream: ReplicationStream, store: PipelineStore,
+                 destination: Destination, table_cache: SharedTableCache,
+                 config: PipelineConfig, shutdown: ShutdownSignal,
+                 start_lsn: Lsn):
+        self.ctx = ctx
+        self.stream = stream
+        self.store = store
+        self.destination = destination
+        self.cache = table_cache
+        self.config = config
+        self.shutdown = shutdown
+        self.assembler = EventAssembler(config.batch.batch_engine)
+        self.state = _LoopState(durable_lsn=start_lsn, received_lsn=start_lsn)
+        self._in_flight: _InFlight | None = None
+        self._batch_deadline: float | None = None
+        self._ready_states: dict[TableId, bool] = {}
+
+    # -- ownership filter -----------------------------------------------------
+
+    async def _table_owned(self, table_id: TableId) -> bool:
+        """Does THIS worker apply events for the table right now?
+
+        Apply context: Ready tables, plus the SYNC_DONE window — a
+        transaction whose commit LSN is ≥ the table's sync-done LSN was NOT
+        delivered by the (already exited) sync worker, so the apply worker
+        must deliver it even though the Ready transition hasn't happened
+        yet (same rule as Postgres tablesync: apply when lsn > syncdone
+        lsn). Proof of exactness: a sync-delivered transaction has commit
+        END ≤ done_lsn, hence commit LSN < done_lsn — no overlap, no loss.
+        """
+        if isinstance(self.ctx, TableSyncContext):
+            return table_id == self.ctx.table_id
+        if self._ready_states.get(table_id):
+            return True
+        st = self.ctx.coordination.table_state(table_id)
+        if st is None:
+            return False
+        if st.type is TableStateType.READY:
+            self._ready_states[table_id] = True
+            return True
+        if st.type is TableStateType.SYNC_DONE:
+            return self.state.current_commit_lsn >= (st.lsn or Lsn.ZERO)
+        return False
+
+    def _invalidate_ownership(self, table_id: TableId | None = None) -> None:
+        if table_id is None:
+            self._ready_states.clear()
+        else:
+            self._ready_states.pop(table_id, None)
+
+    # -- main loop ------------------------------------------------------------
+
+    async def run(self) -> ExitIntent:
+        keepalive_s = self.config.keepalive_deadline_ms / 1000
+        stream_iter = self.stream.__aiter__()
+        msg_task: asyncio.Task | None = None
+        shutdown_task = asyncio.ensure_future(self.shutdown.wait())
+        try:
+            while True:
+                if msg_task is None:
+                    msg_task = asyncio.ensure_future(stream_iter.__anext__())
+                waits = {shutdown_task, msg_task}
+                if self._in_flight is not None:
+                    waits.add(self._in_flight.task)
+                now = time.monotonic()
+                # the batch deadline only matters when a flush could actually
+                # dispatch — honoring it while a write is in flight would
+                # busy-spin with a zero timeout until the write completes
+                if self._batch_deadline is not None and self._in_flight is None:
+                    timeout = min(max(0.0, self._batch_deadline - now),
+                                  keepalive_s)
+                else:
+                    timeout = keepalive_s
+                done, _ = await asyncio.wait(
+                    waits, timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED)
+
+                # priority 1: shutdown
+                if shutdown_task in done:
+                    await self._drain()
+                    return ExitIntent.PAUSE
+                # priority 2: flush result
+                if self._in_flight is not None \
+                        and self._in_flight.task in done:
+                    intent = await self._handle_flush_result()
+                    if intent is not None:
+                        return intent
+                    continue  # re-select; a deadline flush may now proceed
+                # priority 3: batch deadline
+                if self._batch_deadline is not None \
+                        and time.monotonic() >= self._batch_deadline:
+                    self._maybe_dispatch_flush(force=True)
+                # priority 4: message
+                if msg_task in done:
+                    exc = msg_task.exception()
+                    if exc is not None:
+                        raise exc
+                    frame = msg_task.result()
+                    msg_task = None
+                    intent = await self._handle_frame(frame)
+                    if intent is not None:
+                        return intent
+                elif not done:
+                    # idle timeout: proactive keepalive + idle sync processing
+                    await self._send_status_update()
+                    if isinstance(self.ctx, ApplyContext):
+                        await self._process_syncing_tables(
+                            self.state.received_lsn)
+        finally:
+            for t in (msg_task, shutdown_task):
+                if t is not None and not t.done():
+                    t.cancel()
+                    try:
+                        await t
+                    except (asyncio.CancelledError, Exception):
+                        pass
+            await self.stream.close()
+
+    # -- frame handling ---------------------------------------------------------
+
+    async def _handle_frame(self, frame) -> ExitIntent | None:
+        if isinstance(frame, pgoutput.PrimaryKeepalive):
+            self.state.received_lsn = max(self.state.received_lsn,
+                                          frame.end_lsn)
+            if frame.reply_requested:
+                await self._send_status_update()
+            if isinstance(self.ctx, ApplyContext):
+                await self._process_syncing_tables(frame.end_lsn)
+            else:
+                return await self._check_catchup(frame.end_lsn)
+            return None
+        assert isinstance(frame, pgoutput.XLogData)
+        self.state.received_lsn = max(self.state.received_lsn, frame.start_lsn)
+        await self._handle_message(frame.start_lsn, frame.payload)
+        self._maybe_dispatch_flush()
+        # commit-boundary coordination
+        if frame.payload[:1] == b"C":
+            if isinstance(self.ctx, ApplyContext):
+                await self._process_syncing_tables(
+                    self.state.last_commit_end_lsn or frame.start_lsn)
+            else:
+                return await self._check_catchup(
+                    self.state.last_commit_end_lsn or frame.start_lsn)
+        return None
+
+    async def _handle_message(self, start_lsn: Lsn, payload: bytes) -> None:
+        st = self.state
+        msg = pgoutput.decode_logical_message(payload)
+        if isinstance(msg, pgoutput.BeginMessage):
+            st.current_commit_lsn = msg.final_lsn
+            st.tx_ordinal = 0
+            self.assembler.push_control(event_codec.decode_begin(msg, start_lsn))
+        elif isinstance(msg, pgoutput.CommitMessage):
+            ev = event_codec.decode_commit(msg, start_lsn)
+            self.assembler.push_control(ev)
+            st.last_commit_end_lsn = ev.end_lsn
+            st.batch_commit_end = ev.end_lsn
+            # a commit closes the unit destinations can make durable;
+            # size check happens in _maybe_dispatch_flush
+        elif isinstance(msg, pgoutput.RelationMessage):
+            schema = event_codec.schema_from_relation_message(msg)
+            prev = self.cache.get(msg.relation_id)
+            self.cache.set(schema)
+            if await self._table_owned(msg.relation_id) \
+                    and (prev is None or prev != schema):
+                self.assembler.push_control(RelationEvent(
+                    start_lsn, st.current_commit_lsn, schema))
+        elif isinstance(msg, (pgoutput.InsertMessage, pgoutput.UpdateMessage,
+                              pgoutput.DeleteMessage)):
+            if not await self._table_owned(msg.relation_id):
+                return
+            schema = self.cache.get(msg.relation_id)
+            if schema is None:
+                raise EtlError(ErrorKind.SCHEMA_NOT_FOUND,
+                               f"no RELATION seen for table {msg.relation_id}")
+            self.assembler.push_row_message(
+                msg, payload, schema, start_lsn, st.current_commit_lsn,
+                st.tx_ordinal)
+            st.tx_ordinal += 1
+        elif isinstance(msg, pgoutput.TruncateMessage):
+            schemas = []
+            for rid in msg.relation_ids:
+                if await self._table_owned(rid):
+                    sch = self.cache.get(rid)
+                    if sch is not None:
+                        schemas.append(sch)
+            if schemas:
+                self.assembler.push_control(TruncateEvent(
+                    start_lsn, st.current_commit_lsn, st.tx_ordinal,
+                    msg.options, tuple(schemas)))
+                st.tx_ordinal += 1
+        elif isinstance(msg, pgoutput.LogicalMessage):
+            if msg.prefix == event_codec.DDL_MESSAGE_PREFIX:
+                ev = event_codec.decode_schema_change(
+                    msg, start_lsn, st.current_commit_lsn)
+                if ev.new_schema is not None:
+                    await self.store.store_table_schema(
+                        ev.new_schema, int(start_lsn))
+                if await self._table_owned(ev.table_id):
+                    self.assembler.push_control(ev)
+        # Origin/Type messages are ignored
+        if self.assembler.size_bytes and self._batch_deadline is None:
+            self._batch_deadline = time.monotonic() \
+                + self.config.batch.max_fill_ms / 1000
+
+    # -- batching / flush -------------------------------------------------------
+
+    def _maybe_dispatch_flush(self, force: bool = False) -> None:
+        if self._in_flight is not None or len(self.assembler) == 0:
+            return
+        if not force and self.assembler.size_bytes \
+                < self.config.batch.max_size_bytes:
+            return
+        events = self.assembler.flush()
+        commit_end = self.state.batch_commit_end
+        self.state.batch_commit_end = None
+        self._batch_deadline = None
+
+        async def write() -> None:
+            ack = await self.destination.write_events(events)
+            await ack.wait_durable()
+
+        self._in_flight = _InFlight(task=asyncio.ensure_future(write()),
+                                    commit_end_lsn=commit_end,
+                                    n_events=len(events))
+
+    async def _apply_flush_result(self) -> bool:
+        """Consume the finished in-flight write; advance durable progress.
+        Returns True if progress advanced (commit boundary was covered)."""
+        inflight = self._in_flight
+        assert inflight is not None
+        self._in_flight = None
+        exc = inflight.task.exception()
+        if exc is not None:
+            raise exc if isinstance(exc, EtlError) else EtlError(
+                ErrorKind.DESTINATION_FAILED, str(exc))
+        if inflight.commit_end_lsn is None:
+            return False
+        self.state.durable_lsn = max(self.state.durable_lsn,
+                                     inflight.commit_end_lsn)
+        await self.store.update_durable_progress(
+            self.ctx.progress_key, self.state.durable_lsn)
+        await self._send_status_update()
+        return True
+
+    async def _handle_flush_result(self) -> ExitIntent | None:
+        advanced = await self._apply_flush_result()
+        if advanced:
+            if isinstance(self.ctx, ApplyContext):
+                await self._process_syncing_tables_after_flush()
+            else:
+                return await self._check_catchup(self.state.durable_lsn)
+        return None
+
+    async def _drain(self) -> None:
+        """Shutdown path: wait out the in-flight write, then stop without
+        flushing the open batch (it re-streams on resume — at-least-once)."""
+        if self._in_flight is not None:
+            try:
+                await self._handle_flush_result()
+            except EtlError:
+                pass  # resume re-delivers from durable progress
+
+    async def _send_status_update(self) -> None:
+        await self.stream.send_status_update(
+            written=self.state.received_lsn,
+            flushed=self.state.durable_lsn,
+            applied=self.state.durable_lsn)
+
+    # -- table-sync coordination (apply context) --------------------------------
+
+    async def _process_syncing_tables(self, current_lsn: Lsn) -> None:
+        coord = self.ctx.coordination
+        for tid, st in list(coord.syncing_table_states().items()):
+            if st.type is TableStateType.SYNC_WAIT:
+                target = max(st.lsn or Lsn.ZERO, current_lsn)
+                await coord.set_catchup(tid, target)
+                result = await coord.wait_for_sync_done_or_errored(tid)
+                if result.type is TableStateType.SYNC_DONE:
+                    # became SyncDone; Ready happens after a durable flush
+                    # covering its LSN (or immediately if already covered)
+                    await self._maybe_mark_ready(tid, result)
+            elif st.type is TableStateType.SYNC_DONE:
+                await self._maybe_mark_ready(tid, st)
+            elif st.type in (TableStateType.INIT, TableStateType.DATA_SYNC,
+                             TableStateType.FINISHED_COPY):
+                await coord.ensure_worker(tid)
+
+    async def _maybe_mark_ready(self, tid: TableId, st: TableState) -> None:
+        done_lsn = st.lsn or Lsn.ZERO
+        current = max(self.state.durable_lsn, self.state.received_lsn)
+        if current >= done_lsn:
+            await self.ctx.coordination.mark_ready(tid)
+            self._invalidate_ownership(tid)
+
+    async def _process_syncing_tables_after_flush(self) -> None:
+        coord = self.ctx.coordination
+        for tid, st in list(coord.syncing_table_states().items()):
+            if st.type is TableStateType.SYNC_DONE:
+                await self._maybe_mark_ready(tid, st)
+
+    # -- catchup (table-sync context) --------------------------------------------
+
+    async def _check_catchup(self, current_lsn: Lsn) -> ExitIntent | None:
+        ctx = self.ctx
+        assert isinstance(ctx, TableSyncContext)
+        if not ctx.catchup_target.done():
+            return None
+        target = ctx.catchup_target.result()
+        if current_lsn < target:
+            return None
+        # Reached the fence. Everything ≤ target MUST be durably flushed
+        # before SyncDone is recorded — the apply worker takes over from
+        # `target` believing this worker delivered durably up to it.
+        while len(self.assembler) > 0 or self._in_flight is not None:
+            self._maybe_dispatch_flush(force=True)
+            if self._in_flight is not None:
+                await asyncio.wait({self._in_flight.task})
+                await self._apply_flush_result()
+        done_lsn = max(self.state.durable_lsn, target)
+        await self.store.update_table_state(ctx.table_id,
+                                            TableState.sync_done(done_lsn))
+        return ExitIntent.COMPLETE

@@ -1,0 +1,131 @@
+"""Apply worker: owns the main replication slot and the retry loop.
+
+Reference parity: crates/etl/src/runtime/apply/worker.rs —
+start LSN = max(durable progress, slot confirmed_flush) (worker.rs:440-465);
+invalidated-slot handling per InvalidatedSlotBehavior (Error vs
+Recreate+reset-all-tables, worker.rs:476-527); policy-driven timed retry
+loop (worker.rs:148-207,237-281).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..config.pipeline import InvalidatedSlotBehavior, PipelineConfig
+from ..models.errors import (ErrorKind, EtlError, RetryKind, retry_directive)
+from ..models.lsn import Lsn
+from ..postgres.slots import apply_slot_name
+from ..postgres.source import ReplicationSource
+from ..store.base import PipelineStore
+from ..destinations.base import Destination
+from .apply_loop import ApplyContext, ApplyLoop, ExitIntent
+from .shutdown import ShutdownRequested, ShutdownSignal, or_shutdown
+from .table_cache import SharedTableCache
+from .table_sync import TableSyncWorkerPool
+
+logger = logging.getLogger("etl_tpu.apply_worker")
+
+
+class ApplyWorker:
+    def __init__(self, *, config: PipelineConfig, store: PipelineStore,
+                 destination: Destination, source_factory,
+                 pool: TableSyncWorkerPool, table_cache: SharedTableCache,
+                 shutdown: ShutdownSignal):
+        self.config = config
+        self.store = store
+        self.destination = destination
+        self.source_factory = source_factory
+        self.pool = pool
+        self.cache = table_cache
+        self.shutdown = shutdown
+        self.slot_name = apply_slot_name(config.pipeline_id)
+        self._task: asyncio.Task | None = None
+
+    def spawn(self) -> asyncio.Task:
+        self._task = asyncio.ensure_future(self._guarded_run())
+        return self._task
+
+    async def _guarded_run(self) -> None:
+        """Timed-retry wrapper (reference worker.rs:237-281)."""
+        attempt = 0
+        while not self.shutdown.is_triggered:
+            try:
+                await self._run_once()
+                return  # clean pause
+            except ShutdownRequested:
+                return
+            except asyncio.CancelledError:
+                raise
+            except EtlError as e:
+                directive = retry_directive(e)
+                if directive.kind is not RetryKind.TIMED \
+                        or attempt + 1 >= self.config.apply_retry.max_attempts:
+                    logger.error("apply worker failed permanently: %s", e)
+                    raise
+                attempt += 1
+                delay = self.config.apply_retry.delay_ms(attempt - 1) / 1000
+                logger.warning("apply worker error (attempt %d, retry in "
+                               "%.1fs): %s", attempt, delay, e)
+                try:
+                    await or_shutdown(self.shutdown, asyncio.sleep(delay))
+                except ShutdownRequested:
+                    return
+            except Exception as e:  # containment → timed retry
+                attempt += 1
+                if attempt >= self.config.apply_retry.max_attempts:
+                    raise EtlError(ErrorKind.WORKER_PANICKED, repr(e))
+                try:
+                    await or_shutdown(
+                        self.shutdown,
+                        asyncio.sleep(
+                            self.config.apply_retry.delay_ms(attempt - 1)
+                            / 1000))
+                except ShutdownRequested:
+                    return
+
+    async def _run_once(self) -> None:
+        source: ReplicationSource = self.source_factory()
+        await source.connect()
+        try:
+            start_lsn = await self._get_start_lsn(source)
+            await self.pool.refresh_states()
+            stream = await source.start_replication(
+                self.slot_name, self.config.publication_name, start_lsn)
+            ctx = ApplyContext(progress_key=self.slot_name,
+                               coordination=self.pool)
+            loop = ApplyLoop(ctx=ctx, stream=stream, store=self.store,
+                             destination=self.destination,
+                             table_cache=self.cache, config=self.config,
+                             shutdown=self.shutdown, start_lsn=start_lsn)
+            intent = await loop.run()
+            assert intent is ExitIntent.PAUSE
+        finally:
+            await source.close()
+
+    async def _get_start_lsn(self, source: ReplicationSource) -> Lsn:
+        """max(durable progress, slot confirmed_flush); create slot if
+        missing; invalidation policy (worker.rs:366-527)."""
+        slot = await source.get_slot(self.slot_name)
+        if slot is not None and slot.invalidated:
+            behavior = self.config.invalidated_slot_behavior
+            if behavior is InvalidatedSlotBehavior.ERROR:
+                raise EtlError(
+                    ErrorKind.SLOT_INVALIDATED,
+                    f"slot {self.slot_name} invalidated; configure "
+                    f"invalidated_slot_behavior=recreate_and_resync to "
+                    f"rebuild")
+            # recreate + full resync: reset every table and start fresh
+            await source.delete_slot(self.slot_name)
+            for tid in await source.get_publication_table_ids(
+                    self.config.publication_name):
+                await self.store.reset_table(tid)
+            await self.store.delete_durable_progress(self.slot_name)
+            slot = None
+        if slot is None:
+            created = await source.create_slot(self.slot_name)
+            slot_flush = created.consistent_point
+        else:
+            slot_flush = slot.confirmed_flush_lsn
+        durable = await self.store.get_durable_progress(self.slot_name)
+        return max(durable or Lsn.ZERO, slot_flush)

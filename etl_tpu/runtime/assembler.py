@@ -1,0 +1,136 @@
+"""Event assembly: pgoutput row messages → destination events, on either
+decode engine.
+
+The apply loop pushes raw row messages here; `flush()` returns the ordered
+event list for the destination write.
+
+- CPU engine: each message decodes immediately via the codec oracle
+  (reference-architecture per-tuple path, codec/event.rs).
+- TPU engine: row-message payloads accumulate as raw bytes per contiguous
+  same-table run; at flush, each run is framed (native framer), staged and
+  decoded on device in one batch, emitted as `DecodedBatchEvent`s. Control
+  events (Begin/Commit/Relation/Truncate/SchemaChange) stay host-decoded
+  and act as run barriers — mirroring the reference's per-table batching
+  between barriers (bigquery/core.rs:956-978).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..config.pipeline import BatchEngine
+from ..models.errors import ErrorKind, EtlError
+from ..models.event import DecodedBatchEvent, Event
+from ..models.lsn import Lsn
+from ..models.schema import ReplicatedTableSchema, TableId
+from ..ops.engine import DeviceDecoder
+from ..ops.wal import stage_wal_batch
+from ..postgres.codec import event as event_codec
+from ..postgres.codec import pgoutput
+
+
+@dataclass
+class _Run:
+    """A contiguous run of row messages for one table."""
+
+    table_id: TableId
+    schema: ReplicatedTableSchema
+    payloads: list[bytes] = field(default_factory=list)
+    start_lsns: list[int] = field(default_factory=list)
+    commit_lsns: list[int] = field(default_factory=list)
+    tx_ordinals: list[int] = field(default_factory=list)
+
+
+class EventAssembler:
+    def __init__(self, engine: BatchEngine):
+        self.engine = engine
+        self._events: list[Event] = []
+        self._run: _Run | None = None
+        self._decoders: dict[TableId, DeviceDecoder] = {}
+        self.size_bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._events) + (len(self._run.payloads) if self._run else 0)
+
+    # -- pushes ---------------------------------------------------------------
+
+    def push_control(self, ev: Event, size_hint: int = 64) -> None:
+        """Begin/Commit/Relation/Truncate/SchemaChange — barrier events."""
+        self._seal_run()
+        self._events.append(ev)
+        self.size_bytes += size_hint
+
+    def push_row_message(self, msg: pgoutput.LogicalReplicationMessage,
+                         payload: bytes, schema: ReplicatedTableSchema,
+                         start_lsn: Lsn, commit_lsn: Lsn,
+                         tx_ordinal: int) -> None:
+        if self.engine is BatchEngine.CPU:
+            if isinstance(msg, pgoutput.InsertMessage):
+                ev: Event = event_codec.decode_insert(
+                    msg, schema, start_lsn, commit_lsn, tx_ordinal)
+            elif isinstance(msg, pgoutput.UpdateMessage):
+                ev = event_codec.decode_update(
+                    msg, schema, start_lsn, commit_lsn, tx_ordinal)
+            elif isinstance(msg, pgoutput.DeleteMessage):
+                ev = event_codec.decode_delete(
+                    msg, schema, start_lsn, commit_lsn, tx_ordinal)
+            else:
+                raise EtlError(ErrorKind.REPLICATION_MESSAGE_INVALID,
+                               f"not a row message: {type(msg).__name__}")
+            self._events.append(ev)
+            self.size_bytes += 64 + len(payload)
+            return
+        # TPU path: defer decode, accumulate raw payloads
+        if self._run is None or self._run.table_id != schema.id \
+                or self._run.schema is not schema:
+            self._seal_run()
+            self._run = _Run(table_id=schema.id, schema=schema)
+        r = self._run
+        r.payloads.append(payload)
+        r.start_lsns.append(int(start_lsn))
+        r.commit_lsns.append(int(commit_lsn))
+        r.tx_ordinals.append(tx_ordinal)
+        self.size_bytes += 64 + len(payload)
+
+    # -- flush ----------------------------------------------------------------
+
+    def _seal_run(self) -> None:
+        if self._run is None or not self._run.payloads:
+            self._run = None
+            return
+        r = self._run
+        self._run = None
+        decoder = self._decoders.get(r.table_id)
+        if decoder is None or decoder.schema is not r.schema:
+            decoder = DeviceDecoder(r.schema)
+            self._decoders[r.table_id] = decoder
+        lens = np.fromiter((len(p) for p in r.payloads), dtype=np.int32,
+                           count=len(r.payloads))
+        offs = np.zeros(len(r.payloads), dtype=np.int64)
+        np.cumsum(lens[:-1], out=offs[1:])
+        buf = b"".join(r.payloads)
+        n_cols = r.schema.replicated_column_count()
+        wal = stage_wal_batch(buf, offs, lens, n_cols)
+        if wal.bad_from >= 0:
+            raise EtlError(ErrorKind.WAL_DECODE_FAILED,
+                           f"malformed row message at run index {wal.bad_from}")
+        batch = decoder.decode(wal.staged)
+        self._events.append(DecodedBatchEvent(
+            start_lsn=Lsn(r.start_lsns[0]),
+            commit_lsn=Lsn(r.commit_lsns[-1]),
+            schema=r.schema,
+            batch=batch,
+            change_types=wal.change_types,
+            commit_lsns=np.asarray(r.commit_lsns, dtype=np.uint64),
+            tx_ordinals=np.asarray(r.tx_ordinals, dtype=np.uint64),
+        ))
+
+    def flush(self) -> list[Event]:
+        """Seal any open run, return and reset the assembled events."""
+        self._seal_run()
+        events = self._events
+        self._events = []
+        self.size_bytes = 0
+        return events

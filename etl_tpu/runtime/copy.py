@@ -1,0 +1,158 @@
+"""Parallel table copy: CTID-range partitioning + shared work queue.
+
+Reference parity: crates/etl/src/replication/table_sync/copy.rs —
+plan `max(partitions_per_connection × connections, rows / rows_per_partition)`
+clamped to `max_partitions` (copy.rs:54-58,132-161); largest-range-first
+scheduling (copy.rs:541); N child connections sharing the exported snapshot
+(copy.rs:346-363) drain a shared queue (copy.rs:572-607); per-partition
+batched stream → `write_table_rows` (copy.rs:641-694).
+
+TPU-first: each partition's COPY chunks go through the vectorized staging
+scan + device decode (`batch_engine=tpu`) or the CPU oracle, producing
+ColumnarBatches for the destination.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from ..config.pipeline import BatchEngine, PipelineConfig
+from ..models.errors import ErrorKind, EtlError
+from ..models.schema import ReplicatedTableSchema
+from ..models.table_row import ColumnarBatch
+from ..ops.engine import DeviceDecoder
+from ..ops.staging import stage_copy_chunk
+from ..postgres.codec.copy_text import parse_copy_row
+from ..postgres.source import ReplicationSource
+from ..destinations.base import Destination, WriteAck
+from .shutdown import ShutdownRequested, ShutdownSignal, or_shutdown
+
+
+@dataclass(frozen=True)
+class CopyPartition:
+    """A CTID page range [start_page, end_page); end None = to table end."""
+
+    start_page: int
+    end_page: int | None
+    estimated_rows: int
+
+
+@dataclass
+class CopyProgress:
+    total_rows: int = 0
+    partitions_done: int = 0
+
+
+def plan_copy_partitions(estimated_rows: int, heap_pages: int,
+                         config: PipelineConfig) -> list[CopyPartition]:
+    """Reference planning math (copy.rs:54-58,457-547)."""
+    c = config.table_sync_copy
+    if estimated_rows <= 0 or heap_pages <= 0:
+        return [CopyPartition(0, None, max(0, estimated_rows))]
+    want = max(c.partitions_per_connection * c.max_connections,
+               estimated_rows // max(1, c.rows_per_partition_target))
+    n = int(min(max(1, want), c.max_partitions, heap_pages))
+    pages_per = heap_pages // n
+    extra = heap_pages % n
+    parts: list[CopyPartition] = []
+    page = 0
+    for i in range(n):
+        span = pages_per + (1 if i < extra else 0)
+        end = page + span
+        parts.append(CopyPartition(
+            page, None if i == n - 1 else end,
+            estimated_rows * span // heap_pages))
+        page = end
+    # largest-first so stragglers start early (copy.rs:541)
+    parts.sort(key=lambda p: -p.estimated_rows)
+    return parts
+
+
+async def _copy_partition(source: ReplicationSource,
+                          schema: ReplicatedTableSchema, snapshot_id: str,
+                          publication: str, part: CopyPartition,
+                          decoder: DeviceDecoder | None,
+                          destination: Destination,
+                          progress: CopyProgress,
+                          max_batch_bytes: int) -> None:
+    rng = None if part.end_page is None and part.start_page == 0 \
+        else (part.start_page, part.end_page if part.end_page is not None
+              else 1 << 30)
+    stream = await source.copy_table_stream(
+        schema.id, publication, snapshot_id, ctid_range=rng)
+    oids = [c.type_oid for c in schema.replicated_columns]
+    pending = b""
+    acks: list[WriteAck] = []
+
+    async def write_chunk(chunk: bytes) -> None:
+        if not chunk:
+            return
+        if decoder is not None:
+            staged = stage_copy_chunk(chunk, len(oids))
+            batch = decoder.decode(staged)
+        else:
+            rows = [parse_copy_row(line, oids)
+                    for line in chunk.split(b"\n") if line]
+            batch = ColumnarBatch.from_rows(schema, rows)
+        acks.append(await destination.write_table_rows(schema, batch))
+        progress.total_rows += batch.num_rows
+
+    async for raw in stream:
+        pending += raw
+        if len(pending) >= max_batch_bytes:
+            cut = pending.rfind(b"\n") + 1
+            await write_chunk(pending[:cut])
+            pending = pending[cut:]
+    await write_chunk(pending)
+    # durability barrier for this partition (mod.rs:360-378)
+    for ack in acks:
+        await ack.wait_durable()
+    progress.partitions_done += 1
+
+
+async def parallel_table_copy(*, source_factory, primary_source,
+                              schema: ReplicatedTableSchema,
+                              snapshot_id: str, config: PipelineConfig,
+                              destination: Destination,
+                              shutdown: ShutdownSignal) -> CopyProgress:
+    """Copy one table through N snapshot-sharing connections."""
+    est_rows, heap_pages = await primary_source.estimate_table_stats(schema.id)
+    parts = plan_copy_partitions(est_rows, heap_pages, config)
+    n_conns = min(config.table_sync_copy.max_connections, len(parts))
+    decoder = DeviceDecoder(schema) \
+        if config.batch.batch_engine is BatchEngine.TPU else None
+    progress = CopyProgress()
+    queue: asyncio.Queue[CopyPartition] = asyncio.Queue()
+    for p in parts:
+        queue.put_nowait(p)
+
+    async def worker(use_primary: bool) -> None:
+        src = primary_source if use_primary else source_factory()
+        if not use_primary:
+            await src.connect()
+        try:
+            while True:
+                try:
+                    part = queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    return
+                await or_shutdown(shutdown, _copy_partition(
+                    src, schema, snapshot_id, config.publication_name, part,
+                    decoder, destination, progress,
+                    config.batch.max_size_bytes))
+        finally:
+            if not use_primary:
+                await src.close()
+
+    tasks = [asyncio.ensure_future(worker(i == 0)) for i in range(n_conns)]
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    errors = [r for r in results if isinstance(r, BaseException)]
+    if errors:
+        for r in errors:
+            if isinstance(r, ShutdownRequested):
+                raise r
+        first = errors[0]
+        raise first if isinstance(first, EtlError) else EtlError(
+            ErrorKind.SOURCE_IO, f"copy failed: {first!r}")
+    return progress

@@ -1,0 +1,102 @@
+"""Pipeline orchestrator.
+
+Reference parity: `Pipeline` (crates/etl/src/pipeline.rs:74) —
+`new/start/wait/shutdown` (pipeline.rs:96,142,249,320) and
+`initialize_table_states` (pipeline.rs:354): tables in the publication get
+Init states if absent; tables no longer published are purged (state,
+schemas, destination metadata, slot).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..config.pipeline import PipelineConfig
+from ..models.errors import ErrorKind, EtlError
+from ..postgres.slots import table_sync_slot_name
+from ..postgres.source import ReplicationSource
+from ..store.base import PipelineStore
+from ..destinations.base import Destination
+from .apply_worker import ApplyWorker
+from .shutdown import ShutdownSignal
+from .state import TableState
+from .table_cache import SharedTableCache
+from .table_sync import TableSyncWorkerPool
+
+logger = logging.getLogger("etl_tpu.pipeline")
+
+
+class Pipeline:
+    """One replication pipeline: publication → destination."""
+
+    def __init__(self, *, config: PipelineConfig, store: PipelineStore,
+                 destination: Destination, source_factory):
+        config.validate()
+        self.config = config
+        self.store = store
+        self.destination = destination
+        self.source_factory = source_factory  # () -> ReplicationSource
+        self.shutdown_signal = ShutdownSignal()
+        self.table_cache = SharedTableCache()
+        self.pool: TableSyncWorkerPool | None = None
+        self.apply_worker: ApplyWorker | None = None
+        self._apply_task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        source = self.source_factory()
+        await source.connect()
+        try:
+            await self._initialize_table_states(source)
+        finally:
+            await source.close()
+        await self.destination.startup()
+        self.pool = TableSyncWorkerPool(
+            config=self.config, store=self.store,
+            destination=self.destination,
+            source_factory=self.source_factory,
+            table_cache=self.table_cache, shutdown=self.shutdown_signal)
+        await self.pool.refresh_states()
+        self.apply_worker = ApplyWorker(
+            config=self.config, store=self.store,
+            destination=self.destination,
+            source_factory=self.source_factory, pool=self.pool,
+            table_cache=self.table_cache, shutdown=self.shutdown_signal)
+        self._apply_task = self.apply_worker.spawn()
+
+    async def _initialize_table_states(self,
+                                       source: ReplicationSource) -> None:
+        pub = self.config.publication_name
+        if not await source.publication_exists(pub):
+            raise EtlError(ErrorKind.PUBLICATION_NOT_FOUND, pub)
+        published = set(await source.get_publication_table_ids(pub))
+        known = await self.store.get_table_states()
+        for tid in published:
+            if tid not in known:
+                await self.store.update_table_state(tid, TableState.init())
+        for tid in set(known) - published:
+            logger.info("purging table %s (no longer in publication)", tid)
+            await self.store.purge_table(tid)
+            await source.delete_slot(
+                table_sync_slot_name(self.config.pipeline_id, tid))
+
+    async def wait(self) -> None:
+        """Wait until the apply worker stops (shutdown or fatal error)."""
+        assert self._apply_task is not None, "pipeline not started"
+        try:
+            await self._apply_task
+        finally:
+            # a fatal apply error must release table-sync workers parked on
+            # catchup futures only the apply worker could resolve — trigger
+            # shutdown so wait_all() cannot hang and the error propagates
+            self.shutdown_signal.trigger()
+            if self.pool is not None:
+                await self.pool.wait_all()
+            await self.destination.shutdown()
+
+    async def shutdown(self) -> None:
+        self.shutdown_signal.trigger()
+
+    async def shutdown_and_wait(self) -> None:
+        await self.shutdown()
+        await self.wait()

@@ -1,0 +1,54 @@
+"""Shutdown signaling: a watch-channel analogue on asyncio.
+
+Reference parity: shutdown watch channel + `ShutdownResult`
+(crates/etl/src/runtime/concurrency/{shutdown,signal}.rs). One tx side held
+by the pipeline, many rx sides cloned into workers; `wait()` is cancel-safe
+and level-triggered.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, TypeVar
+
+T = TypeVar("T")
+
+
+class ShutdownSignal:
+    def __init__(self) -> None:
+        self._event = asyncio.Event()
+
+    def trigger(self) -> None:
+        self._event.set()
+
+    @property
+    def is_triggered(self) -> bool:
+        return self._event.is_set()
+
+    async def wait(self) -> None:
+        await self._event.wait()
+
+
+class ShutdownRequested(Exception):
+    """Raised by `or_shutdown` when the signal wins the race."""
+
+
+async def or_shutdown(shutdown: ShutdownSignal, aw: Awaitable[T]) -> T:
+    """Await `aw`, aborting with ShutdownRequested if shutdown triggers
+    first. The pending awaitable is cancelled on abort."""
+    task = asyncio.ensure_future(aw)
+    sd = asyncio.ensure_future(shutdown.wait())
+    try:
+        done, _ = await asyncio.wait({task, sd},
+                                     return_when=asyncio.FIRST_COMPLETED)
+        if task in done:
+            return task.result()
+        raise ShutdownRequested()
+    finally:
+        for t in (task, sd):
+            if not t.done():
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass

@@ -1,0 +1,30 @@
+"""Shared per-table protocol state.
+
+Reference parity: `SharedTableCache` (crates/etl/src/replication/
+table_cache.rs:53). Invariant (table_cache.rs:10-44): exactly one worker
+owns protocol interpretation for a table at a time — the apply worker for
+Ready tables, the table-sync worker for its own table. The cache maps
+relation id → the current positional decode view (from RELATION messages),
+shared so a handoff does not re-learn schemas.
+"""
+
+from __future__ import annotations
+
+from ..models.schema import ReplicatedTableSchema, TableId
+
+
+class SharedTableCache:
+    def __init__(self) -> None:
+        self._schemas: dict[TableId, ReplicatedTableSchema] = {}
+
+    def get(self, table_id: TableId) -> ReplicatedTableSchema | None:
+        return self._schemas.get(table_id)
+
+    def set(self, schema: ReplicatedTableSchema) -> None:
+        self._schemas[schema.id] = schema
+
+    def remove(self, table_id: TableId) -> None:
+        self._schemas.pop(table_id, None)
+
+    def table_ids(self) -> list[TableId]:
+        return list(self._schemas)

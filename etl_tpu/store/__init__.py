@@ -1,0 +1,5 @@
+"""State/schema stores."""
+
+from .base import (DestinationTableMetadata, PipelineStore, SchemaStore,
+                   StateStore)
+from .memory import MemoryStore, NotifyingStore

@@ -1,0 +1,131 @@
+"""Store traits: state, schema, and cleanup interfaces.
+
+Reference parity:
+  - `StateStore` (crates/etl/src/store/state/base.rs:25-139): table
+    replication states, monotonic durable progress LSN per worker,
+    destination table metadata.
+  - `SchemaStore` (crates/etl/src/store/schema/base.rs:19-69): table schemas
+    versioned by `SnapshotId` (the LSN of the DDL message creating the
+    version) with `get ≤ snapshot` semantics and pruning.
+  - `TableStateLifecycleStore` (store/lifecycle.rs): compound operations
+    spanning both (prepare-for-copy, reset, delete).
+
+Contracts the implementations must uphold:
+  - `update_durable_progress` is MONOTONIC: attempts to move the LSN
+    backwards are ignored (reference state/base.rs:81-89).
+  - Memory-only table states (SyncWait/Catchup) must never be persisted;
+    `update_table_state` raises on them.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+
+from ..models.lsn import Lsn
+from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
+from ..runtime.state import TableState
+
+# a worker's durable-progress key: the apply worker uses the pipeline slot
+# name, table-sync workers their per-table slot name (reference progress
+# rows keyed by slot)
+ProgressKey = str
+
+
+@dataclass(frozen=True)
+class DestinationTableMetadata:
+    """What the destination knows about a table (name mapping + generation
+    counter for truncate-versioned tables, reference table_mappings rows +
+    BigQuery `table_N` successors)."""
+
+    table_id: TableId
+    destination_table_name: str
+    generation: int = 0
+
+
+class StateStore(abc.ABC):
+    @abc.abstractmethod
+    async def get_table_states(self) -> dict[TableId, TableState]: ...
+
+    @abc.abstractmethod
+    async def get_table_state(self, table_id: TableId) -> TableState | None: ...
+
+    @abc.abstractmethod
+    async def update_table_state(self, table_id: TableId,
+                                 state: TableState) -> None: ...
+
+    @abc.abstractmethod
+    async def delete_table_state(self, table_id: TableId) -> None: ...
+
+    @abc.abstractmethod
+    async def get_durable_progress(self, key: ProgressKey) -> Lsn | None: ...
+
+    @abc.abstractmethod
+    async def update_durable_progress(self, key: ProgressKey,
+                                      lsn: Lsn) -> bool:
+        """Monotonic; returns False (and stores nothing) on regression."""
+
+    @abc.abstractmethod
+    async def delete_durable_progress(self, key: ProgressKey) -> None: ...
+
+    @abc.abstractmethod
+    async def get_destination_metadata(
+        self, table_id: TableId) -> DestinationTableMetadata | None: ...
+
+    @abc.abstractmethod
+    async def update_destination_metadata(
+        self, meta: DestinationTableMetadata) -> None: ...
+
+    @abc.abstractmethod
+    async def delete_destination_metadata(self, table_id: TableId) -> None: ...
+
+
+class SchemaStore(abc.ABC):
+    @abc.abstractmethod
+    async def store_table_schema(self, schema: ReplicatedTableSchema,
+                                 snapshot_id: SnapshotId) -> None: ...
+
+    @abc.abstractmethod
+    async def get_table_schema(
+        self, table_id: TableId,
+        at_snapshot: SnapshotId | None = None
+    ) -> ReplicatedTableSchema | None:
+        """Latest version with snapshot_id ≤ at_snapshot (or overall latest
+        when at_snapshot is None) — reference schema/base.rs `get ≤`."""
+
+    @abc.abstractmethod
+    async def get_schema_versions(self, table_id: TableId) -> list[SnapshotId]: ...
+
+    @abc.abstractmethod
+    async def prune_schema_versions(self, table_id: TableId,
+                                    older_than: SnapshotId) -> int:
+        """Drop versions strictly older than the newest one ≤ `older_than`
+        (keeping that one: it is still the decode view for `older_than`)."""
+
+    @abc.abstractmethod
+    async def delete_table_schemas(self, table_id: TableId) -> None: ...
+
+
+class PipelineStore(StateStore, SchemaStore, abc.ABC):
+    """The full store facade a pipeline needs (reference capabilities.rs).
+
+    Compound lifecycle ops (reference store/lifecycle.rs):"""
+
+    async def prepare_table_for_copy(self, table_id: TableId) -> None:
+        """Reset to DataSync and drop schema versions — the crash-consistent
+        pre-copy reset (reference table_sync/mod.rs:225-241)."""
+        await self.update_table_state(table_id, TableState.data_sync())
+        await self.delete_table_schemas(table_id)
+
+    async def reset_table(self, table_id: TableId) -> None:
+        """Full-resync reset. Destination metadata is deliberately KEPT: it
+        is the marker telling the next copy attempt to drop the (still
+        populated) destination table first — deleting it here would make an
+        invalidated-slot resync duplicate every existing row."""
+        await self.update_table_state(table_id, TableState.init())
+        await self.delete_table_schemas(table_id)
+
+    async def purge_table(self, table_id: TableId) -> None:
+        await self.delete_table_state(table_id)
+        await self.delete_table_schemas(table_id)
+        await self.delete_destination_metadata(table_id)

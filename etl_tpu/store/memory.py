@@ -1,0 +1,154 @@
+"""In-memory store + the notifying test wrapper.
+
+Reference parity: `MemoryStore` (crates/etl/src/store/both/memory.rs) and
+`NotifyingStore` (test_utils/notifying_store.rs:27-70) — tests await
+specific state transitions instead of sleeping; this is load-bearing for
+deterministic tests (SURVEY §4 fixtures note).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+
+from ..models.errors import ErrorKind, EtlError
+from ..models.lsn import Lsn
+from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
+from ..runtime.state import TableState, TableStateType
+from .base import (DestinationTableMetadata, PipelineStore, ProgressKey)
+
+
+class MemoryStore(PipelineStore):
+    def __init__(self) -> None:
+        self._states: dict[TableId, TableState] = {}
+        self._progress: dict[ProgressKey, Lsn] = {}
+        self._schemas: dict[TableId, list[tuple[SnapshotId, ReplicatedTableSchema]]] = \
+            defaultdict(list)  # sorted by snapshot id
+        self._dest_meta: dict[TableId, DestinationTableMetadata] = {}
+
+    # -- StateStore ----------------------------------------------------------
+
+    async def get_table_states(self) -> dict[TableId, TableState]:
+        return dict(self._states)
+
+    async def get_table_state(self, table_id: TableId) -> TableState | None:
+        return self._states.get(table_id)
+
+    async def update_table_state(self, table_id: TableId,
+                                 state: TableState) -> None:
+        if not state.is_persistent:
+            raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
+                           f"{state.type.value} is memory-only, not storable")
+        self._states[table_id] = state
+
+    async def delete_table_state(self, table_id: TableId) -> None:
+        self._states.pop(table_id, None)
+
+    async def get_durable_progress(self, key: ProgressKey) -> Lsn | None:
+        return self._progress.get(key)
+
+    async def update_durable_progress(self, key: ProgressKey,
+                                      lsn: Lsn) -> bool:
+        cur = self._progress.get(key)
+        if cur is not None and lsn < cur:
+            return False
+        self._progress[key] = lsn
+        return True
+
+    async def delete_durable_progress(self, key: ProgressKey) -> None:
+        self._progress.pop(key, None)
+
+    async def get_destination_metadata(
+            self, table_id: TableId) -> DestinationTableMetadata | None:
+        return self._dest_meta.get(table_id)
+
+    async def update_destination_metadata(
+            self, meta: DestinationTableMetadata) -> None:
+        self._dest_meta[meta.table_id] = meta
+
+    async def delete_destination_metadata(self, table_id: TableId) -> None:
+        self._dest_meta.pop(table_id, None)
+
+    # -- SchemaStore ---------------------------------------------------------
+
+    async def store_table_schema(self, schema: ReplicatedTableSchema,
+                                 snapshot_id: SnapshotId) -> None:
+        versions = self._schemas[schema.id]
+        versions[:] = [(s, v) for s, v in versions if s != snapshot_id]
+        versions.append((snapshot_id, schema))
+        versions.sort(key=lambda p: p[0])
+
+    async def get_table_schema(
+            self, table_id: TableId,
+            at_snapshot: SnapshotId | None = None
+    ) -> ReplicatedTableSchema | None:
+        versions = self._schemas.get(table_id)
+        if not versions:
+            return None
+        if at_snapshot is None:
+            return versions[-1][1]
+        best = None
+        for s, v in versions:
+            if s <= at_snapshot:
+                best = v
+            else:
+                break
+        return best
+
+    async def get_schema_versions(self, table_id: TableId) -> list[SnapshotId]:
+        return [s for s, _ in self._schemas.get(table_id, [])]
+
+    async def prune_schema_versions(self, table_id: TableId,
+                                    older_than: SnapshotId) -> int:
+        versions = self._schemas.get(table_id)
+        if not versions:
+            return 0
+        keep_from = 0
+        for i, (s, _) in enumerate(versions):
+            if s <= older_than:
+                keep_from = i
+        removed = keep_from
+        versions[:] = versions[keep_from:]
+        return removed
+
+    async def delete_table_schemas(self, table_id: TableId) -> None:
+        self._schemas.pop(table_id, None)
+
+
+class NotifyingStore(MemoryStore):
+    """MemoryStore that lets tests await specific table-state transitions
+    (reference NotifyingStore, notifying_store.rs:27-70)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._waiters: list[tuple] = []  # (table_id, state_type, future)
+        self.state_history: list[tuple[TableId, TableState]] = []
+
+    async def update_table_state(self, table_id: TableId,
+                                 state: TableState) -> None:
+        await super().update_table_state(table_id, state)
+        self.state_history.append((table_id, state))
+        self._notify(table_id, state)
+
+    def _notify(self, table_id: TableId, state: TableState) -> None:
+        still = []
+        for tid, st, fut in self._waiters:
+            if tid == table_id and st is state.type and not fut.done():
+                fut.set_result(state)
+            elif not fut.done():
+                still.append((tid, st, fut))
+        self._waiters = still
+
+    def notify_on(self, table_id: TableId,
+                  state_type: TableStateType) -> "asyncio.Future[TableState]":
+        """Future resolving when the table ENTERS the given state (resolves
+        immediately if already there — no missed-wakeup, reference
+        worker.rs:211-264 subscribe-under-lock)."""
+        fut: asyncio.Future[TableState] = \
+            asyncio.get_event_loop().create_future()
+        cur = self._states.get(table_id)
+        if cur is not None and cur.type is state_type:
+            fut.set_result(cur)
+        else:
+            self._waiters.append((table_id, state_type, fut))
+        return fut

@@ -22,17 +22,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
-def pytest_collection_modifyitems(config, items):
+def pytest_pyfunc_call(pyfuncitem):
     """Run `async def` tests on a fresh event loop (no pytest-asyncio in the
     image)."""
-    for item in items:
-        if inspect.iscoroutinefunction(getattr(item, "function", None)):
-            item.obj = _sync_wrapper(item.function)
-
-
-def _sync_wrapper(fn):
-    @functools.wraps(fn)
-    def wrapper(*args, **kwargs):
-        asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=120))
-
-    return wrapper
+    if inspect.iscoroutinefunction(pyfuncitem.function):
+        kwargs = {name: pyfuncitem.funcargs[name]
+                  for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(asyncio.wait_for(pyfuncitem.obj(**kwargs), timeout=120))
+        return True
+    return None

@@ -1,0 +1,375 @@
+"""End-to-end pipeline tests against the in-memory fake walsender.
+
+Mirrors the reference integration strategy (crates/etl/tests/pipeline.rs,
+SURVEY §4.2): real pgoutput bytes flow through the full stack — fake
+walsender → replication stream → apply loop → decode engine →
+MemoryDestination — with notification-driven synchronization (no sleeps).
+"""
+
+import asyncio
+
+import pytest
+
+from etl_tpu.config import BatchConfig, BatchEngine, PipelineConfig
+from etl_tpu.destinations import (FaultAction, FaultInjectingDestination,
+                                  FaultKind, MemoryDestination)
+from etl_tpu.models import (ColumnSchema, InsertEvent, DeleteEvent, Lsn, Oid,
+                            TableName, TableSchema, UpdateEvent)
+from etl_tpu.postgres.fake import FakeDatabase, FakeSource
+from etl_tpu.runtime import Pipeline, TableStateType
+from etl_tpu.store import MemoryStore, NotifyingStore
+
+ACCOUNTS = 16384
+ORDERS = 16385
+
+
+def make_db() -> FakeDatabase:
+    db = FakeDatabase()
+    db.create_table(TableSchema(
+        ACCOUNTS, TableName("public", "accounts"),
+        (ColumnSchema("id", Oid.INT4, nullable=False, primary_key_ordinal=1),
+         ColumnSchema("name", Oid.TEXT),
+         ColumnSchema("balance", Oid.INT8))),
+        rows=[["1", "alice", "100"], ["2", "bob", "-5"], ["3", None, "0"]])
+    db.create_table(TableSchema(
+        ORDERS, TableName("public", "orders"),
+        (ColumnSchema("oid", Oid.INT4, nullable=False, primary_key_ordinal=1),
+         ColumnSchema("amount", Oid.NUMERIC))),
+        rows=[["10", "9.99"]])
+    db.create_publication("pub", [ACCOUNTS, ORDERS])
+    return db
+
+
+def make_pipeline(db, store=None, destination=None, engine=BatchEngine.TPU,
+                  **cfg):
+    config = PipelineConfig(
+        pipeline_id=1, publication_name="pub",
+        batch=BatchConfig(max_size_bytes=256 * 1024, max_fill_ms=50,
+                          batch_engine=engine),
+        **cfg)
+    store = store if store is not None else NotifyingStore()
+    destination = destination if destination is not None else MemoryDestination()
+    pipeline = Pipeline(config=config, store=store, destination=destination,
+                        source_factory=lambda: FakeSource(db),
+                        )
+    return pipeline, store, destination
+
+
+async def wait_ready(store, table_id, timeout=10.0):
+    await asyncio.wait_for(store.notify_on(table_id, TableStateType.READY),
+                           timeout)
+
+
+class TestInitialCopyAndCdc:
+    async def test_copy_then_ready(self):
+        db = make_db()
+        pipeline, store, dest = make_pipeline(db)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        await wait_ready(store, ORDERS)
+        rows = {tuple(r.values) for r in dest.table_rows[ACCOUNTS]}
+        assert rows == {(1, "alice", 100), (2, "bob", -5), (3, None, 0)}
+        from etl_tpu.models import PgNumeric
+        assert [tuple(r.values) for r in dest.table_rows[ORDERS]] == \
+            [(10, PgNumeric("9.99"))]
+        await pipeline.shutdown_and_wait()
+
+    async def test_cdc_after_ready(self):
+        db = make_db()
+        pipeline, store, dest = make_pipeline(db)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        async with db.transaction() as tx:
+            tx.insert(ACCOUNTS, ["4", "carol", "7"])
+            tx.update(ACCOUNTS, ["1", None, None], ["1", "alice", "150"])
+            tx.delete(ACCOUNTS, ["2", None, None])
+        # wait for the events to land (batch deadline = 50ms)
+        await _wait_for(lambda: len(_row_events(dest)) >= 3)
+        evs = _row_events(dest)
+        ins = [e for e in evs if isinstance(e, InsertEvent)]
+        upd = [e for e in evs if isinstance(e, UpdateEvent)]
+        dele = [e for e in evs if isinstance(e, DeleteEvent)]
+        assert [tuple(e.row.values) for e in ins] == [(4, "carol", 7)]
+        assert [tuple(e.row.values) for e in upd] == [(1, "alice", 150)]
+        assert len(dele) == 1 and dele[0].old_row.values[0] == 2
+        # ordering matches WAL order
+        assert [type(e).__name__ for e in evs] == \
+            ["InsertEvent", "UpdateEvent", "DeleteEvent"]
+        await pipeline.shutdown_and_wait()
+
+    async def test_rows_during_copy_window_arrive_once(self):
+        """Rows committed between pipeline start and catchup arrive exactly
+        once (via snapshot copy or CDC catchup, never both)."""
+        db = make_db()
+        pipeline, store, dest = make_pipeline(db)
+        await pipeline.start()
+        # race: insert while copy likely in flight
+        async with db.transaction() as tx:
+            tx.insert(ACCOUNTS, ["100", "race", "1"])
+        await wait_ready(store, ACCOUNTS)
+        async with db.transaction() as tx:
+            tx.insert(ACCOUNTS, ["101", "after", "2"])
+        await _wait_for(lambda: _account_ids(dest) >= {100, 101})
+        copied = [tuple(r.values) for r in dest.table_rows[ACCOUNTS]]
+        cdc = [tuple(e.row.values) for e in _row_events(dest)
+               if isinstance(e, InsertEvent) and e.schema.id == ACCOUNTS]
+        seen_100 = [v for v in copied + cdc if v[0] == 100]
+        assert len(seen_100) == 1, f"row 100 seen {len(seen_100)} times"
+        await pipeline.shutdown_and_wait()
+
+
+def _row_events(dest):
+    return [e for e in dest.events
+            if isinstance(e, (InsertEvent, UpdateEvent, DeleteEvent))]
+
+
+def _account_ids(dest):
+    ids = {r.values[0] for r in dest.table_rows[ACCOUNTS]}
+    for e in _row_events(dest):
+        if isinstance(e, InsertEvent) and e.schema.id == ACCOUNTS:
+            ids.add(e.row.values[0])
+    return ids
+
+
+async def _wait_for(cond, timeout=10.0, interval=0.02):
+    async def poll():
+        while not cond():
+            await asyncio.sleep(interval)
+
+    await asyncio.wait_for(poll(), timeout)
+
+
+class TestResume:
+    async def test_restart_resumes_without_duplicates(self):
+        db = make_db()
+        store = NotifyingStore()
+        dest = MemoryDestination()
+        pipeline, _, _ = make_pipeline(db, store=store, destination=dest)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        await wait_ready(store, ORDERS)
+        async with db.transaction() as tx:
+            tx.insert(ACCOUNTS, ["50", "first", "1"])
+        await _wait_for(lambda: 50 in _account_ids(dest))
+        await pipeline.shutdown_and_wait()
+        n_events_before = len(dest.events)
+
+        # offline WAL while pipeline is down
+        async with db.transaction() as tx:
+            tx.insert(ACCOUNTS, ["51", "offline", "2"])
+
+        pipeline2, _, _ = make_pipeline(db, store=store, destination=dest)
+        await pipeline2.start()
+        await _wait_for(lambda: 51 in _account_ids(dest))
+        ins_50 = [e for e in _row_events(dest)
+                  if isinstance(e, InsertEvent) and e.row.values[0] == 50]
+        assert len(ins_50) == 1, "event 50 re-delivered after restart"
+        # copy must not re-run: tables stayed READY
+        states = await store.get_table_states()
+        assert states[ACCOUNTS].type is TableStateType.READY
+        assert dest.dropped_tables == []
+        await pipeline2.shutdown_and_wait()
+
+
+class TestColumnFilters:
+    async def test_publication_column_list(self):
+        db = make_db()
+        db.create_publication("pub", [ACCOUNTS],
+                              column_filters={ACCOUNTS: ["id", "balance"]})
+        pipeline, store, dest = make_pipeline(db)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        rows = {tuple(r.values) for r in dest.table_rows[ACCOUNTS]}
+        assert rows == {(1, 100), (2, -5), (3, 0)}  # name filtered out
+        await pipeline.shutdown_and_wait()
+
+
+class TestTruncate:
+    async def test_truncate_event(self):
+        from etl_tpu.models import TruncateEvent
+
+        db = make_db()
+        pipeline, store, dest = make_pipeline(db)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        async with db.transaction() as tx:
+            tx.truncate([ACCOUNTS])
+        await _wait_for(lambda: any(isinstance(e, TruncateEvent)
+                                    for e in dest.events))
+        ev = next(e for e in dest.events if isinstance(e, TruncateEvent))
+        assert [s.id for s in ev.schemas] == [ACCOUNTS]
+        await pipeline.shutdown_and_wait()
+
+
+class TestEngines:
+    @pytest.mark.parametrize("engine", [BatchEngine.CPU, BatchEngine.TPU])
+    async def test_both_engines_same_events(self, engine):
+        db = make_db()
+        pipeline, store, dest = make_pipeline(db, engine=engine)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        async with db.transaction() as tx:
+            tx.insert(ACCOUNTS, ["7", "x\ty", None])
+            tx.insert(ACCOUNTS, ["8", None, "-9223372036854775808"])
+        await _wait_for(lambda: len(_row_events(dest)) >= 2)
+        vals = [tuple(e.row.values) for e in _row_events(dest)]
+        assert vals == [(7, "x\ty", None), (8, None, -9223372036854775808)]
+        await pipeline.shutdown_and_wait()
+
+
+class TestFaults:
+    async def test_copy_reject_then_retry_recovers(self):
+        db = make_db()
+        db.create_publication("pub", [ACCOUNTS])
+        dest = FaultInjectingDestination(MemoryDestination())
+        dest.script("write_table_rows", FaultAction(FaultKind.REJECT))
+        pipeline, store, _ = make_pipeline(
+            db, destination=dest,
+            table_retry=__import__("etl_tpu.config", fromlist=["RetryConfig"])
+            .RetryConfig(max_attempts=5, initial_delay_ms=20))
+        await pipeline.start()
+        # first copy attempt fails → Errored → timed retry → success
+        await asyncio.wait_for(
+            store.notify_on(ACCOUNTS, TableStateType.ERRORED), 10.0)
+        await wait_ready(store, ACCOUNTS)
+        rows = {tuple(r.values) for r in dest.inner.table_rows[ACCOUNTS]}
+        assert rows == {(1, "alice", 100), (2, "bob", -5), (3, None, 0)}
+        # crash-consistency: the second attempt dropped the half-written table
+        assert ACCOUNTS in dest.inner.dropped_tables
+        await pipeline.shutdown_and_wait()
+
+    async def test_held_write_defers_durability(self):
+        """An Accepted-but-not-durable write must not advance durable
+        progress until released (reference async_result.rs semantics)."""
+        db = make_db()
+        db.create_publication("pub", [ACCOUNTS])
+        release = asyncio.Event()
+        dest = FaultInjectingDestination(MemoryDestination())
+        pipeline, store, _ = make_pipeline(db, destination=dest)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        from etl_tpu.postgres.slots import apply_slot_name
+
+        key = apply_slot_name(1)
+        progress_before = await store.get_durable_progress(key)
+        dest.script("write_events", FaultAction(FaultKind.HOLD,
+                                                release_event=release))
+        async with db.transaction() as tx:
+            tx.insert(ACCOUNTS, ["60", "held", "0"])
+        await _wait_for(lambda: dest.write_events_calls >= 1)
+        await asyncio.sleep(0.1)  # give the loop a chance to (wrongly) ack
+        progress_held = await store.get_durable_progress(key)
+        assert progress_held == progress_before, \
+            "durable progress advanced on a non-durable ack"
+        release.set()
+        await _wait_for(lambda: (asyncio.get_event_loop(),)[0] is not None
+                        and True)
+        await _wait_for_progress(store, key, progress_before)
+        await pipeline.shutdown_and_wait()
+
+
+async def _wait_for_progress(store, key, above, timeout=10.0):
+    async def poll():
+        while True:
+            p = await store.get_durable_progress(key)
+            if p is not None and (above is None or p > above):
+                return
+            await asyncio.sleep(0.02)
+
+    await asyncio.wait_for(poll(), timeout)
+
+
+class TestPublicationChanges:
+    async def test_unpublished_table_purged(self):
+        db = make_db()
+        store = NotifyingStore()
+        pipeline, _, dest = make_pipeline(db, store=store)
+        await pipeline.start()
+        await wait_ready(store, ORDERS)
+        await pipeline.shutdown_and_wait()
+        # drop ORDERS from the publication and restart
+        db.create_publication("pub", [ACCOUNTS])
+        pipeline2, _, _ = make_pipeline(db, store=store, destination=dest)
+        await pipeline2.start()
+        await _wait_for(lambda: True)
+        states = await store.get_table_states()
+        assert ORDERS not in states
+        await pipeline2.shutdown_and_wait()
+
+
+class TestReviewRegressions:
+    async def test_fatal_apply_error_propagates_and_releases_workers(self):
+        """A fatal apply-worker error must not leave wait() hanging on
+        parked sync workers (reviewed failure: catchup futures only the
+        dead apply worker could resolve)."""
+        from etl_tpu.config import InvalidatedSlotBehavior, RetryConfig
+        db = make_db()
+        pipeline, store, dest = make_pipeline(
+            db, apply_retry=RetryConfig(max_attempts=1, initial_delay_ms=10))
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        await wait_ready(store, ORDERS)
+        # invalidate the apply slot mid-stream: behavior=ERROR is fatal
+        from etl_tpu.postgres.slots import apply_slot_name
+        db.invalidate_slot(apply_slot_name(1))
+        async with db.transaction() as tx:
+            tx.insert(ACCOUNTS, ["70", "x", "1"])
+        from etl_tpu.models import ErrorKind, EtlError
+        with pytest.raises(EtlError) as ei:
+            await asyncio.wait_for(pipeline.wait(), 20)
+        assert ErrorKind.SLOT_INVALIDATED in ei.value.kinds()
+
+    async def test_invalidated_slot_resync_drops_destination_tables(self):
+        """recreate_and_resync must drop populated destination tables before
+        recopying (reviewed failure: reset_table deleted the drop marker)."""
+        from etl_tpu.config import InvalidatedSlotBehavior
+        db = make_db()
+        db.create_publication("pub", [ACCOUNTS])
+        store = NotifyingStore()
+        dest = MemoryDestination()
+        pipeline, _, _ = make_pipeline(db, store=store, destination=dest)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        await pipeline.shutdown_and_wait()
+        assert len(dest.table_rows[ACCOUNTS]) == 3
+
+        from etl_tpu.postgres.slots import apply_slot_name
+        db.invalidate_slot(apply_slot_name(1))
+        pipeline2, _, _ = make_pipeline(
+            db, store=store, destination=dest,
+            invalidated_slot_behavior=InvalidatedSlotBehavior.RECREATE_AND_RESYNC)
+        reset_seen = store.notify_on(ACCOUNTS, TableStateType.INIT)
+        await pipeline2.start()
+        await asyncio.wait_for(reset_seen, 20)  # table reset for resync
+        await wait_ready(store, ACCOUNTS, timeout=20)
+        # no duplicates: table was dropped then recopied
+        assert ACCOUNTS in dest.dropped_tables
+        assert len(dest.table_rows[ACCOUNTS]) == 3
+        await pipeline2.shutdown_and_wait()
+
+    async def test_sync_done_window_events_not_lost(self):
+        """Transactions committing after a table's sync-done LSN but before
+        its Ready transition must be applied by the apply worker (reviewed
+        failure: permanent event loss in the SYNC_DONE window)."""
+        from etl_tpu.models.lsn import Lsn
+        from etl_tpu.runtime.apply_loop import ApplyContext, ApplyLoop
+        from etl_tpu.runtime.state import TableState
+        from etl_tpu.config import PipelineConfig
+
+        class StubCoord:
+            def table_state(self, tid):
+                return TableState.sync_done(Lsn(0x5000))
+
+        loop = ApplyLoop.__new__(ApplyLoop)
+        loop.ctx = ApplyContext(progress_key="k", coordination=StubCoord())
+        loop._ready_states = {}
+        from etl_tpu.runtime.apply_loop import _LoopState
+        loop.state = _LoopState()
+        # tx committing BEFORE done lsn: sync worker delivered it → skip
+        loop.state.current_commit_lsn = Lsn(0x4000)
+        assert not await loop._table_owned(ACCOUNTS)
+        # tx committing AT/AFTER done lsn: apply worker must own it
+        loop.state.current_commit_lsn = Lsn(0x5000)
+        assert await loop._table_owned(ACCOUNTS)
+        loop.state.current_commit_lsn = Lsn(0x6000)
+        assert await loop._table_owned(ACCOUNTS)

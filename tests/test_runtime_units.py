@@ -1,0 +1,152 @@
+"""Runtime unit tests: state machine, slots, copy planning, stores,
+destinations (reference strategy: in-module unit tests, SURVEY §4.1)."""
+
+import asyncio
+
+import pytest
+
+from etl_tpu.config import PipelineConfig, RetryConfig
+from etl_tpu.models import (ColumnSchema, EtlError, Lsn, Oid,
+                            ReplicatedTableSchema, RetryKind, TableName,
+                            TableSchema)
+from etl_tpu.postgres.slots import (apply_slot_name, parse_slot_name,
+                                    slots_for_pipeline, table_sync_slot_name)
+from etl_tpu.runtime.copy import plan_copy_partitions
+from etl_tpu.runtime.state import TableState, TableStateType
+from etl_tpu.store import MemoryStore
+
+
+class TestTableState:
+    def test_happy_path_transitions(self):
+        st = TableState.init()
+        seq = [TableState.data_sync(), TableState.finished_copy(),
+               TableState.sync_wait(Lsn(1)), TableState.catchup(Lsn(2)),
+               TableState.sync_done(Lsn(3)), TableState.ready()]
+        for nxt in seq:
+            st = st.transition_to(nxt)
+        assert st.type is TableStateType.READY
+
+    def test_invalid_transition_rejected(self):
+        with pytest.raises(EtlError):
+            TableState.init().transition_to(TableState.ready())
+        with pytest.raises(EtlError):
+            TableState.ready().transition_to(TableState.data_sync())
+
+    def test_error_and_rollback_from_any_state(self):
+        for st in [TableState.init(), TableState.catchup(Lsn(1)),
+                   TableState.ready()]:
+            assert st.can_transition_to(TableStateType.ERRORED)
+            assert st.can_transition_to(TableStateType.INIT)
+
+    def test_serialization_roundtrip(self):
+        for st in [TableState.init(), TableState.finished_copy(),
+                   TableState.sync_done(Lsn("AB/CD")), TableState.ready(),
+                   TableState.errored("boom", solution="fix it",
+                                      retry_policy=RetryKind.MANUAL,
+                                      retry_attempts=3)]:
+            assert TableState.from_json(st.to_json()) == st
+
+    def test_memory_only_states_not_serializable(self):
+        for st in [TableState.sync_wait(Lsn(1)), TableState.catchup(Lsn(2))]:
+            with pytest.raises(EtlError):
+                st.to_json()
+
+    async def test_memory_store_rejects_memory_only(self):
+        store = MemoryStore()
+        with pytest.raises(EtlError):
+            await store.update_table_state(1, TableState.sync_wait(Lsn(1)))
+
+
+class TestSlots:
+    def test_names(self):
+        assert apply_slot_name(7) == "supabase_etl_apply_7"
+        assert table_sync_slot_name(7, 16384) == \
+            "supabase_etl_table_sync_7_16384"
+
+    def test_parse(self):
+        p = parse_slot_name("supabase_etl_apply_12")
+        assert p.pipeline_id == 12 and p.is_apply
+        p = parse_slot_name("supabase_etl_table_sync_12_99")
+        assert (p.pipeline_id, p.table_id) == (12, 99)
+        assert parse_slot_name("someone_elses_slot") is None
+        assert parse_slot_name("supabase_etl_apply_xyz") is None
+
+    def test_filter_for_pipeline(self):
+        names = ["supabase_etl_apply_1", "supabase_etl_apply_2",
+                 "supabase_etl_table_sync_1_5", "other"]
+        assert slots_for_pipeline(names, 1) == \
+            ["supabase_etl_apply_1", "supabase_etl_table_sync_1_5"]
+
+    def test_length_limit(self):
+        with pytest.raises(EtlError):
+            table_sync_slot_name(10**40, 10**40)
+
+
+class TestCopyPlanning:
+    def cfg(self):
+        return PipelineConfig(pipeline_id=1, publication_name="p")
+
+    def test_small_table_single_partition(self):
+        parts = plan_copy_partitions(100, 2, self.cfg())
+        assert len(parts) <= 2
+        assert sum(p.estimated_rows for p in parts) <= 100 + len(parts)
+
+    def test_partition_count_math(self):
+        # 10M rows / 250k target = 40 partitions (> 4×4 floor)
+        parts = plan_copy_partitions(10_000_000, 100_000, self.cfg())
+        assert len(parts) == 40
+        # page ranges tile [0, heap_pages) exactly
+        ordered = sorted(parts, key=lambda p: p.start_page)
+        assert ordered[0].start_page == 0
+        for a, b in zip(ordered, ordered[1:]):
+            assert a.end_page == b.start_page
+        assert ordered[-1].end_page is None
+
+    def test_clamped_to_max_partitions(self):
+        parts = plan_copy_partitions(10**9, 10**6, self.cfg())
+        assert len(parts) == 1024
+
+    def test_largest_first(self):
+        parts = plan_copy_partitions(1_000_000, 101, self.cfg())
+        sizes = [p.estimated_rows for p in parts]
+        assert sizes == sorted(sizes, reverse=True)
+
+    def test_empty_stats(self):
+        parts = plan_copy_partitions(0, 0, self.cfg())
+        assert len(parts) == 1 and parts[0].start_page == 0
+
+
+class TestRetryConfig:
+    def test_backoff(self):
+        r = RetryConfig(max_attempts=5, initial_delay_ms=100,
+                        max_delay_ms=1000, backoff_factor=2.0)
+        assert [r.delay_ms(i) for i in range(5)] == [100, 200, 400, 800, 1000]
+
+
+class TestMemoryStoreContracts:
+    async def test_progress_monotonic(self):
+        store = MemoryStore()
+        assert await store.update_durable_progress("k", Lsn(100))
+        assert not await store.update_durable_progress("k", Lsn(50))
+        assert await store.get_durable_progress("k") == Lsn(100)
+        assert await store.update_durable_progress("k", Lsn(100))  # equal ok
+
+    async def test_schema_versioning(self):
+        store = MemoryStore()
+        s = TableSchema(5, TableName("p", "t"),
+                        (ColumnSchema("a", Oid.INT4),))
+        s2 = TableSchema(5, TableName("p", "t"),
+                         (ColumnSchema("a", Oid.INT4),
+                          ColumnSchema("b", Oid.TEXT)))
+        r1 = ReplicatedTableSchema.with_all_columns(s)
+        r2 = ReplicatedTableSchema.with_all_columns(s2)
+        await store.store_table_schema(r1, 10)
+        await store.store_table_schema(r2, 20)
+        assert (await store.get_table_schema(5)).table_schema == s2
+        assert (await store.get_table_schema(5, at_snapshot=15)) \
+            .table_schema == s
+        assert (await store.get_table_schema(5, at_snapshot=5)) is None
+        # prune keeps the version still needed for snapshot 20
+        removed = await store.prune_schema_versions(5, 25)
+        assert removed == 1
+        assert await store.get_schema_versions(5) == [20]
