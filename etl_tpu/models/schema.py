@@ -251,6 +251,23 @@ class ReplicatedTableSchema:
             and self.identity_mask == other.identity_mask
         )
 
+    def to_json(self) -> dict:
+        return {
+            "table": self.table_schema.to_json(),
+            "replicated": self.replication_mask.indices(),
+            "identity": self.identity_mask.indices(),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ReplicatedTableSchema":
+        schema = TableSchema.from_json(d["table"])
+        n = len(schema.columns)
+        repl = set(d["replicated"])
+        ident = set(d["identity"])
+        return cls(schema,
+                   ColumnMask(i in repl for i in range(n)),
+                   ColumnMask(i in ident for i in range(n)))
+
     def __repr__(self) -> str:
         return (f"ReplicatedTableSchema({self.table_schema.name}, "
                 f"repl={self.replication_mask}, ident={self.identity_mask})")

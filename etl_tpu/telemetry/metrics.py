@@ -1,0 +1,147 @@
+"""Metrics: registry + Prometheus text exposition.
+
+Reference parity: the `metrics` facade + Prometheus recorder
+(crates/etl-telemetry/src/metrics.rs:23-62) and the metric-name constants
+(crates/etl/src/observability.rs:7-72). Implemented dependency-free:
+counters/gauges/histograms in-process, rendered in Prometheus text format
+for the API `/metrics` route and the replicator's endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+# --- metric names (reference observability.rs) ------------------------------
+
+ETL_TABLE_COPY_ROWS_TOTAL = "etl_table_copy_rows_total"
+ETL_TABLE_COPY_BYTES_TOTAL = "etl_table_copy_bytes_total"
+ETL_TABLE_COPY_DURATION_SECONDS = "etl_table_copy_duration_seconds"
+ETL_TABLE_COPY_END_TO_END_LAG_BYTES = "etl_table_copy_end_to_end_lag_bytes"
+ETL_APPLY_LOOP_EVENTS_TOTAL = "etl_apply_loop_events_total"
+ETL_APPLY_LOOP_BATCHES_TOTAL = "etl_apply_loop_batches_total"
+ETL_APPLY_LOOP_RECEIVED_LAG_BYTES = "etl_apply_loop_received_lag_bytes"
+ETL_APPLY_LOOP_FLUSH_LAG_BYTES = "etl_apply_loop_flush_lag_bytes"
+ETL_APPLY_LOOP_EFFECTIVE_FLUSH_LAG_BYTES = \
+    "etl_apply_loop_effective_flush_lag_bytes"
+ETL_APPLY_LOOP_END_TO_END_LAG_BYTES = "etl_apply_loop_end_to_end_lag_bytes"
+ETL_TRANSACTION_SIZE_BYTES = "etl_transaction_size_bytes"
+ETL_TRANSACTIONS_TOTAL = "etl_transactions_total"
+ETL_MEMORY_BACKPRESSURE_ACTIVATIONS_TOTAL = \
+    "etl_memory_backpressure_activations_total"
+ETL_MEMORY_BACKPRESSURE_ACTIVE = "etl_memory_backpressure_active"
+ETL_WORKER_ERRORS_TOTAL = "etl_worker_errors_total"
+ETL_SLOT_INVALIDATIONS_TOTAL = "etl_slot_invalidations_total"
+ETL_TABLES_TOTAL = "etl_tables_total"
+ETL_TABLES_READY = "etl_tables_ready"
+ETL_TABLES_ERRORED = "etl_tables_errored"
+ETL_DEVICE_DECODE_ROWS_TOTAL = "etl_device_decode_rows_total"
+ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL = \
+    "etl_device_decode_fallback_rows_total"
+ETL_DEVICE_DECODE_SECONDS = "etl_device_decode_seconds"
+ETL_PROCESSED_BYTES_TOTAL = "etl_processed_bytes_total"
+
+# label keys
+LABEL_PIPELINE_ID = "pipeline_id"
+LABEL_TABLE = "table"
+LABEL_WORKER_TYPE = "worker_type"
+LABEL_DESTINATION = "destination"
+
+_HISTOGRAM_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                      30.0, 60.0)
+
+LabelSet = tuple[tuple[str, str], ...]
+
+
+def _labels(labels: dict[str, str] | None) -> LabelSet:
+    return tuple(sorted((labels or {}).items()))
+
+
+@dataclass
+class _Histogram:
+    buckets: list[int] = field(
+        default_factory=lambda: [0] * (len(_HISTOGRAM_BUCKETS) + 1))
+    total: float = 0.0
+    count: int = 0
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, dict[LabelSet, float]] = defaultdict(dict)
+        self._gauges: dict[str, dict[LabelSet, float]] = defaultdict(dict)
+        self._histograms: dict[str, dict[LabelSet, _Histogram]] = \
+            defaultdict(dict)
+
+    def counter_inc(self, name: str, value: float = 1.0,
+                    labels: dict[str, str] | None = None) -> None:
+        key = _labels(labels)
+        with self._lock:
+            self._counters[name][key] = \
+                self._counters[name].get(key, 0.0) + value
+
+    def gauge_set(self, name: str, value: float,
+                  labels: dict[str, str] | None = None) -> None:
+        with self._lock:
+            self._gauges[name][_labels(labels)] = value
+
+    def histogram_observe(self, name: str, value: float,
+                          labels: dict[str, str] | None = None) -> None:
+        key = _labels(labels)
+        with self._lock:
+            h = self._histograms[name].setdefault(key, _Histogram())
+            h.total += value
+            h.count += 1
+            for i, b in enumerate(_HISTOGRAM_BUCKETS):
+                if value <= b:
+                    h.buckets[i] += 1
+                    return
+            h.buckets[-1] += 1
+
+    def get_counter(self, name: str,
+                    labels: dict[str, str] | None = None) -> float:
+        return self._counters.get(name, {}).get(_labels(labels), 0.0)
+
+    def get_gauge(self, name: str,
+                  labels: dict[str, str] | None = None) -> float | None:
+        return self._gauges.get(name, {}).get(_labels(labels))
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        out: list[str] = []
+
+        def fmt_labels(key: LabelSet, extra: str = "") -> str:
+            parts = [f'{k}="{v}"' for k, v in key]
+            if extra:
+                parts.append(extra)
+            return "{" + ",".join(parts) + "}" if parts else ""
+
+        with self._lock:
+            for name in sorted(self._counters):
+                out.append(f"# TYPE {name} counter")
+                for key, v in sorted(self._counters[name].items()):
+                    out.append(f"{name}{fmt_labels(key)} {v:g}")
+            for name in sorted(self._gauges):
+                out.append(f"# TYPE {name} gauge")
+                for key, v in sorted(self._gauges[name].items()):
+                    out.append(f"{name}{fmt_labels(key)} {v:g}")
+            for name in sorted(self._histograms):
+                out.append(f"# TYPE {name} histogram")
+                for key, h in sorted(self._histograms[name].items()):
+                    cum = 0
+                    for i, b in enumerate(_HISTOGRAM_BUCKETS):
+                        cum += h.buckets[i]
+                        out.append(
+                            f"{name}_bucket"
+                            f"{fmt_labels(key, f'le=\"{b:g}\"')} {cum}")
+                    cum += h.buckets[-1]
+                    out.append(
+                        f"{name}_bucket{fmt_labels(key, 'le=\"+Inf\"')} {cum}")
+                    out.append(f"{name}_sum{fmt_labels(key)} {h.total:g}")
+                    out.append(f"{name}_count{fmt_labels(key)} {h.count}")
+        return "\n".join(out) + "\n"
+
+
+# process-global registry (reference: once-only Prometheus recorder)
+registry = MetricsRegistry()

@@ -1,0 +1,516 @@
+"""Socket-level fake Postgres backend for wire-client tests.
+
+Speaks protocol v3 over real TCP: startup (trust or SCRAM-SHA-256), the
+simple-query subset the framework issues (catalog introspection, slot
+management, snapshot transactions, COPY OUT), and the replication
+sub-protocol (CREATE/DROP_REPLICATION_SLOT, START_REPLICATION with
+CopyBoth + standby status updates). Backed by the same FakeDatabase used
+by the in-process fake source, so wire-level pipelines exercise identical
+semantics.
+
+This is the analogue of the reference's dockerized test clusters
+(SURVEY §4.2) for an environment with no Postgres server, and of its mock
+`K8sClient` pattern — the protocol seam is faked at the lowest level the
+environment allows.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import os
+import re
+import struct
+import time
+from dataclasses import dataclass
+
+from ..models.lsn import Lsn
+from ..postgres import fake as fakemod
+from ..postgres.codec import pgoutput
+from ..postgres.codec.copy_text import encode_copy_row
+from ..postgres.fake import FakeDatabase
+
+
+def _msg(tag: bytes, payload: bytes = b"") -> bytes:
+    return tag + struct.pack(">i", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+def _error(code: str, message: str) -> bytes:
+    payload = b"SERROR\x00" + b"C" + _cstr(code) + b"M" + _cstr(message) + b"\x00"
+    return _msg(b"E", payload)
+
+
+def _row_description(names: list[str], oids: list[int] | None = None) -> bytes:
+    oids = oids or [25] * len(names)
+    payload = struct.pack(">h", len(names))
+    for name, oid in zip(names, oids):
+        payload += _cstr(name) + struct.pack(">ihihih", 0, 0, oid, -1, -1, 0)
+    return _msg(b"T", payload)
+
+
+def _data_row(values: list[str | None]) -> bytes:
+    payload = struct.pack(">h", len(values))
+    for v in values:
+        if v is None:
+            payload += struct.pack(">i", -1)
+        else:
+            b = v.encode()
+            payload += struct.pack(">i", len(b)) + b
+    return _msg(b"D", payload)
+
+
+def _command_complete(tag: str) -> bytes:
+    return _msg(b"C", _cstr(tag))
+
+
+READY = _msg(b"Z", b"I")
+
+
+@dataclass
+class _Session:
+    reader: asyncio.StreamReader
+    writer: asyncio.StreamWriter
+    replication: bool = False
+    user: str = ""
+    snapshot_id: str | None = None  # pinned via SET TRANSACTION SNAPSHOT
+
+
+class FakePgServer:
+    """asyncio TCP server; `await start()` then connect clients to
+    `('127.0.0.1', server.port)`."""
+
+    def __init__(self, db: FakeDatabase, *, password: str | None = None,
+                 keepalive_interval_s: float = 0.05):
+        self.db = db
+        self.password = password  # None = trust auth
+        self.keepalive_interval_s = keepalive_interval_s
+        self._server: asyncio.AbstractServer | None = None
+        self.port = 0
+        self.connections = 0
+        self._writers: set[asyncio.StreamWriter] = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # 3.12's wait_closed blocks until every handler exits — force
+            # lingering client connections shut first
+            for w in list(self._writers):
+                w.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- connection handling ---------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self.connections += 1
+        self._writers.add(writer)
+        sess = _Session(reader, writer)
+        try:
+            if not await self._startup(sess):
+                return
+            while True:
+                header = await reader.readexactly(5)
+                tag = header[:1]
+                (length,) = struct.unpack(">i", header[1:5])
+                payload = await reader.readexactly(length - 4)
+                if tag == b"X":
+                    return
+                if tag == b"Q":
+                    sql = payload.rstrip(b"\x00").decode()
+                    await self._dispatch(sess, sql)
+                # CopyData outside CopyBoth: ignore
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+
+    async def _startup(self, sess: _Session) -> bool:
+        r = sess.reader
+        w = sess.writer
+        (length,) = struct.unpack(">i", await r.readexactly(4))
+        body = await r.readexactly(length - 4)
+        (version,) = struct.unpack(">i", body[:4])
+        if version == 80877103:  # SSLRequest → refuse, expect retry
+            w.write(b"N")
+            await w.drain()
+            return await self._startup(sess)
+        params: dict[str, str] = {}
+        parts = body[4:].split(b"\x00")
+        for k, v in zip(parts[::2], parts[1::2]):
+            if k:
+                params[k.decode()] = v.decode()
+        sess.user = params.get("user", "")
+        sess.replication = params.get("replication") == "database"
+        if self.password is not None:
+            if not await self._scram(sess):
+                return False
+        w.write(_msg(b"R", struct.pack(">i", 0)))  # AuthenticationOk
+        w.write(_msg(b"S", _cstr("server_version") + _cstr("16.3")))
+        w.write(_msg(b"S", _cstr("client_encoding") + _cstr("UTF8")))
+        w.write(_msg(b"K", struct.pack(">ii", os.getpid(), 12345)))
+        w.write(READY)
+        await w.drain()
+        return True
+
+    async def _scram(self, sess: _Session) -> bool:
+        r, w = sess.reader, sess.writer
+        w.write(_msg(b"R", struct.pack(">i", 10) + _cstr("SCRAM-SHA-256")
+                     + b"\x00"))
+        await w.drain()
+        header = await r.readexactly(5)
+        (length,) = struct.unpack(">i", header[1:5])
+        payload = await r.readexactly(length - 4)
+        mech_end = payload.index(b"\x00")
+        (resp_len,) = struct.unpack(">i", payload[mech_end + 1 : mech_end + 5])
+        client_first = payload[mech_end + 5 :][:resp_len].decode()
+        bare = client_first.split(",", 2)[2]
+        client_nonce = dict(p.split("=", 1)
+                            for p in bare.split(","))["r"]
+        salt = os.urandom(16)
+        iterations = 4096
+        server_nonce = client_nonce + base64.b64encode(os.urandom(9)).decode()
+        server_first = (f"r={server_nonce},"
+                        f"s={base64.b64encode(salt).decode()},i={iterations}")
+        w.write(_msg(b"R", struct.pack(">i", 11) + server_first.encode()))
+        await w.drain()
+        header = await r.readexactly(5)
+        (length,) = struct.unpack(">i", header[1:5])
+        client_final = (await r.readexactly(length - 4)).decode()
+        attrs = dict(p.split("=", 1) for p in client_final.split(","))
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(), salt,
+                                     iterations)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored = hashlib.sha256(client_key).digest()
+        without_proof = client_final.rsplit(",p=", 1)[0]
+        auth_message = ",".join([bare, server_first, without_proof])
+        sig = hmac.new(stored, auth_message.encode(), hashlib.sha256).digest()
+        expected = bytes(a ^ b for a, b in zip(client_key, sig))
+        if base64.b64decode(attrs.get("p", "")) != expected:
+            w.write(_error("28P01", "password authentication failed"))
+            await w.drain()
+            return False
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        verifier = hmac.new(server_key, auth_message.encode(),
+                            hashlib.sha256).digest()
+        final = f"v={base64.b64encode(verifier).decode()}"
+        w.write(_msg(b"R", struct.pack(">i", 12) + final.encode()))
+        return True
+
+    # -- SQL dispatch ------------------------------------------------------------
+
+    async def _dispatch(self, sess: _Session, sql: str) -> None:
+        w = sess.writer
+        db = self.db
+        norm = " ".join(sql.split())
+        try:
+            handled = await self._try_handle(sess, norm, sql)
+        except Exception as e:  # surface as server error, keep session alive
+            w.write(_error("XX000", f"fake server error: {e!r}"))
+            w.write(READY)
+            await w.drain()
+            return
+        if not handled:
+            w.write(_error("0A000", f"fake server: unhandled SQL: {norm[:120]}"))
+            w.write(READY)
+        await w.drain()
+
+    async def _try_handle(self, sess: _Session, norm: str, sql: str) -> bool:
+        w = sess.writer
+        db = self.db
+
+        m = re.match(r"SELECT 1 FROM pg_publication WHERE pubname = '([^']*)'",
+                     norm)
+        if m:
+            rows = [["1"]] if m.group(1) in db.publications else []
+            self._send_rows(w, ["?column?"], rows)
+            return True
+
+        if "FROM pg_publication_tables pt" in norm and "SELECT c.oid" in norm:
+            m = re.search(r"pt\.pubname = '([^']*)'", norm)
+            tids = db.publications.get(m.group(1), []) if m else []
+            self._send_rows(w, ["oid"], [[str(t)] for t in sorted(tids)])
+            return True
+
+        m = re.match(r"SELECT n\.nspname, c\.relname, c\.relreplident .*"
+                     r"WHERE c\.oid = (\d+)", norm)
+        if m:
+            t = db.tables.get(int(m.group(1)))
+            rows = [[t.schema.name.schema, t.schema.name.name,
+                     chr(t.replica_identity)]] if t else []
+            self._send_rows(w, ["nspname", "relname", "relreplident"], rows)
+            return True
+
+        m = re.match(r"SELECT n\.nspname, c\.relname FROM pg_class c .*"
+                     r"WHERE c\.oid = (\d+)", norm)
+        if m:
+            t = db.tables.get(int(m.group(1)))
+            rows = [[t.schema.name.schema, t.schema.name.name]] if t else []
+            self._send_rows(w, ["nspname", "relname"], rows)
+            return True
+
+        m = re.match(r"SELECT a\.attname FROM pg_attribute a WHERE "
+                     r"a\.attrelid = (\d+)", norm)
+        if m:
+            t = db.tables.get(int(m.group(1)))
+            rows = [[c.name] for c in t.schema.columns] if t else []
+            self._send_rows(w, ["attname"], rows)
+            return True
+
+        m = re.search(r"SELECT a\.attname, a\.atttypid.*a\.attrelid = (\d+)",
+                      norm)
+        if m:
+            t = db.tables.get(int(m.group(1)))
+            rows = []
+            if t:
+                for c in t.schema.columns:
+                    rows.append([c.name, str(c.type_oid), str(c.modifier),
+                                 "t" if not c.nullable else "f",
+                                 str(c.primary_key_ordinal or 0),
+                                 c.default_expression])
+            self._send_rows(w, ["attname", "atttypid", "atttypmod",
+                                "attnotnull", "ord", "default"], rows)
+            return True
+
+        if "SELECT pt.attnames FROM pg_publication_tables" in norm:
+            pub = re.search(r"pt\.pubname = '([^']*)'", norm).group(1)
+            tid = int(re.search(r"pc\.oid = (\d+)", norm).group(1))
+            filt = db.column_filters.get((pub, tid))
+            rows = [["{" + ",".join(filt) + "}"]] if filt else [[None]] \
+                if tid in db.publications.get(pub, []) else []
+            self._send_rows(w, ["attnames"], rows)
+            return True
+
+        if norm == "SELECT pg_current_wal_lsn()":
+            self._send_rows(w, ["pg_current_wal_lsn"], [[str(db.current_lsn)]])
+            return True
+
+        m = re.search(r"FROM pg_replication_slots WHERE slot_name = '([^']*)'",
+                      norm)
+        if m:
+            s = db.slots.get(m.group(1))
+            rows = []
+            if s is not None:
+                rows = [[str(s.confirmed_flush),
+                         "t" if s.active else "f",
+                         "lost" if s.invalidated else "reserved"]]
+            self._send_rows(w, ["confirmed_flush_lsn", "active", "wal_status"],
+                            rows)
+            return True
+
+        m = re.match(r'CREATE_REPLICATION_SLOT "([^"]+)" LOGICAL pgoutput',
+                     norm)
+        if m:
+            name = m.group(1)
+            if name in db.slots:
+                w.write(_error("42710", f'slot "{name}" already exists'))
+                w.write(READY)
+                return True
+            point = db.current_lsn
+            sid = db.take_snapshot()
+            db.slots[name] = fakemod._FakeSlot(
+                name=name, consistent_point=point, confirmed_flush=point,
+                snapshot_id=sid)
+            self._send_rows(
+                w, ["slot_name", "consistent_point", "snapshot_name",
+                    "output_plugin"],
+                [[name, str(point), sid, "pgoutput"]])
+            return True
+
+        m = re.match(r'DROP_REPLICATION_SLOT "([^"]+)"', norm)
+        if m:
+            if m.group(1) not in db.slots:
+                w.write(_error("42704",
+                               f'replication slot "{m.group(1)}" does not exist'))
+                w.write(READY)
+                return True
+            db.slots.pop(m.group(1), None)
+            w.write(_command_complete("DROP_REPLICATION_SLOT"))
+            w.write(READY)
+            return True
+
+        if norm.startswith("BEGIN"):
+            w.write(_command_complete("BEGIN"))
+            w.write(READY)
+            return True
+
+        m = re.match(r"SET TRANSACTION SNAPSHOT '([^']*)'", norm)
+        if m:
+            sess.snapshot_id = m.group(1)
+            w.write(_command_complete("SET"))
+            w.write(READY)
+            return True
+
+        m = re.match(r"COPY \(SELECT (.+) FROM \"([^\"]+)\"\.\"([^\"]+)\""
+                     r"(?: WHERE ctid >= '\((\d+),0\)' AND ctid < "
+                     r"'\((\d+),0\)')?\) TO STDOUT", norm)
+        if m:
+            await self._copy_out(sess, m)
+            return True
+
+        m = re.search(r"FROM pg_class WHERE oid = (\d+)", norm)
+        if m and "reltuples" in norm:
+            t = db.tables.get(int(m.group(1)))
+            n = len(t.rows) if t else 0
+            self._send_rows(w, ["reltuples", "relpages"],
+                            [[str(n), str(max(1, n // 64))]])
+            return True
+
+        m = re.match(r'START_REPLICATION SLOT "([^"]+)" LOGICAL '
+                     r"([0-9A-Fa-f]+/[0-9A-Fa-f]+) \((.*)\)", norm)
+        if m:
+            await self._start_replication(sess, m.group(1), Lsn(m.group(2)),
+                                          m.group(3))
+            return True
+
+        return False
+
+    def _send_rows(self, w, names: list[str],
+                   rows: list[list[str | None]]) -> None:
+        w.write(_row_description(names))
+        for row in rows:
+            w.write(_data_row(row))
+        w.write(_command_complete(f"SELECT {len(rows)}"))
+        w.write(READY)
+
+    async def _copy_out(self, sess: _Session, m: re.Match) -> None:
+        w = sess.writer
+        db = self.db
+        col_sql, schema_name, rel_name = m.group(1), m.group(2), m.group(3)
+        lo = int(m.group(4)) if m.group(4) else None
+        hi = int(m.group(5)) if m.group(5) else None
+        table = next((t for t in db.tables.values()
+                      if t.schema.name.schema == schema_name
+                      and t.schema.name.name == rel_name), None)
+        if table is None:
+            w.write(_error("42P01", f"relation {rel_name} does not exist"))
+            w.write(READY)
+            return
+        snap = db.snapshots.get(sess.snapshot_id or "", None)
+        rows = snap.get(table.schema.id, []) if snap is not None \
+            else table.rows
+        if lo is not None:
+            rows = rows[lo * 64 : hi * 64]
+        wanted = [c.strip().strip('"') for c in col_sql.split(",")]
+        idx = [table.schema.column_index(c) for c in wanted]
+        w.write(_msg(b"H", struct.pack(">bh", 0, len(idx))
+                     + b"\x00\x00" * len(idx)))
+        for row in rows:
+            line = encode_copy_row([row[i] for i in idx]) + b"\n"
+            w.write(_msg(b"d", line))
+        w.write(_msg(b"c"))
+        w.write(_command_complete(f"COPY {len(rows)}"))
+        w.write(READY)
+        await w.drain()
+
+    async def _start_replication(self, sess: _Session, slot_name: str,
+                                 start_lsn: Lsn, opts: str) -> None:
+        w = sess.writer
+        db = self.db
+        slot = db.slots.get(slot_name)
+        if slot is None:
+            w.write(_error("42704", f'slot "{slot_name}" does not exist'))
+            w.write(READY)
+            await w.drain()
+            return
+        if slot.invalidated:
+            w.write(_error("55000", "can no longer get changes from "
+                           "replication slot (invalidated)"))
+            w.write(READY)
+            await w.drain()
+            return
+        m = re.search(r"publication_names '([^']*)'", opts)
+        publication = m.group(1) if m else ""
+        pub_tables = set(db.publications.get(publication, []))
+        slot.active = True
+        w.write(_msg(b"W", struct.pack(">bh", 0, 0)))
+        await w.drain()
+
+        pos = max(start_lsn, slot.confirmed_flush)
+        wal_index = 0
+        reader_task = asyncio.ensure_future(
+            self._read_status_updates(sess, slot))
+        try:
+            while not reader_task.done():
+                sent = False
+                while wal_index < len(db.wal):
+                    lsn, payload = db.wal[wal_index]
+                    wal_index += 1
+                    if lsn <= pos:
+                        continue
+                    if not self._pub_allows(payload, pub_tables):
+                        continue
+                    frame = pgoutput.encode_xlog_data(
+                        int(lsn), int(db.current_lsn),
+                        int(time.time() * 1e6), payload)
+                    w.write(_msg(b"d", frame))
+                    sent = True
+                if sent:
+                    await w.drain()
+                try:
+                    async with db._wal_cond:
+                        await asyncio.wait_for(
+                            db._wal_cond.wait(),
+                            timeout=self.keepalive_interval_s)
+                except asyncio.TimeoutError:
+                    if slot.invalidated:
+                        return
+                    ka = pgoutput.encode_primary_keepalive(
+                        int(db.current_lsn), int(time.time() * 1e6), True)
+                    w.write(_msg(b"d", ka))
+                    await w.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            slot.active = False
+            if not reader_task.done():
+                reader_task.cancel()
+            try:
+                await reader_task
+            except (asyncio.CancelledError, asyncio.IncompleteReadError,
+                    ConnectionResetError):
+                pass
+
+    def _pub_allows(self, payload: bytes, pub_tables: set[int]) -> bool:
+        tag = payload[0:1]
+        if tag in (b"I", b"U", b"D", b"R"):
+            rid = int.from_bytes(payload[1:5], "big")
+            return rid in pub_tables
+        if tag == b"T":
+            n = int.from_bytes(payload[1:5], "big")
+            rids = [int.from_bytes(payload[6 + 4 * i : 10 + 4 * i], "big")
+                    for i in range(n)]
+            return any(r in pub_tables for r in rids)
+        return True
+
+    async def _read_status_updates(self, sess: _Session,
+                                   slot) -> None:
+        """Drain incoming CopyData standby status updates ('r' frames)."""
+        r = sess.reader
+        while True:
+            header = await r.readexactly(5)
+            tag = header[:1]
+            (length,) = struct.unpack(">i", header[1:5])
+            payload = await r.readexactly(length - 4)
+            if tag == b"d" and payload[:1] == b"r":
+                upd = pgoutput.decode_standby_status_update(payload)
+                if upd.flushed > slot.confirmed_flush:
+                    slot.confirmed_flush = upd.flushed
+            elif tag in (b"c", b"X"):
+                return

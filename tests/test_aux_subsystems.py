@@ -1,0 +1,198 @@
+"""Aux subsystem tests: backpressure, budgets, metrics, config, failpoints
+(reference strategy: SURVEY §4.1 units + §4.3 failpoint restarts)."""
+
+import asyncio
+
+import pytest
+
+from etl_tpu.config import MemoryBackpressureConfig
+from etl_tpu.config.load import (Environment, Secret, env_overlay,
+                                 load_config_dict, load_pipeline_config,
+                                 pipeline_config_from_dict)
+from etl_tpu.models import ErrorKind, EtlError
+from etl_tpu.runtime import failpoints
+from etl_tpu.runtime.backpressure import (Batch, BatchBudgetController,
+                                          MemoryMonitor, batch_with_budget)
+from etl_tpu.telemetry.metrics import MetricsRegistry
+
+
+class TestMemoryMonitor:
+    def cfg(self):
+        return MemoryBackpressureConfig(activate_ratio=0.85,
+                                        resume_ratio=0.75,
+                                        refresh_interval_ms=10)
+
+    async def test_hysteresis(self):
+        rss = [0]
+        m = MemoryMonitor(self.cfg(), limit_bytes=1000,
+                          rss_reader=lambda: rss[0])
+        rss[0] = 800
+        assert m.sample_once() is False
+        rss[0] = 900  # above activate
+        assert m.sample_once() is True
+        rss[0] = 800  # between resume and activate: stays pressured
+        assert m.sample_once() is True
+        rss[0] = 700  # below resume
+        assert m.sample_once() is False
+
+    async def test_wait_until_resumed(self):
+        rss = [900]
+        m = MemoryMonitor(self.cfg(), limit_bytes=1000,
+                          rss_reader=lambda: rss[0])
+        m.sample_once()
+        assert m.pressure
+        waiter = asyncio.ensure_future(m.wait_until_resumed())
+        await asyncio.sleep(0.01)
+        assert not waiter.done()
+        rss[0] = 100
+        m.sample_once()
+        await asyncio.wait_for(waiter, 1)
+
+    def test_real_limit_readable(self):
+        m = MemoryMonitor(self.cfg())
+        assert m.limit_bytes > 1 << 20
+        m.sample_once()
+        assert m.last_rss > 0
+
+
+class TestBatchBudget:
+    def test_share_math(self):
+        c = BatchBudgetController(
+            MemoryBackpressureConfig(memory_ratio=0.2), max_bytes=8 << 20,
+            limit_bytes=100 << 20)
+        l1 = c.register_stream()
+        assert l1.ideal_batch_bytes() == 8 << 20  # capped at max
+        leases = [c.register_stream() for _ in range(9)]  # 10 active
+        # 100MB × 0.2 / 10 = 2MB < max
+        assert l1.ideal_batch_bytes() == 2 << 20
+        for le in leases:
+            le.release()
+        assert l1.ideal_batch_bytes() == 8 << 20
+
+    async def test_batching_by_budget_and_deadline(self):
+        c = BatchBudgetController(
+            MemoryBackpressureConfig(memory_ratio=1.0), max_bytes=100,
+            limit_bytes=100)
+
+        async def gen():
+            for i in range(7):
+                yield i
+                if i == 4:
+                    await asyncio.sleep(0.15)  # force a deadline flush
+
+        lease = c.register_stream()
+        batches = []
+        async for b in batch_with_budget(gen(), lambda _: 30, lease,
+                                         max_fill_s=0.05):
+            batches.append(b.items)
+        assert [len(b) for b in batches] == [4, 1, 2]
+        assert sum(batches, []) == list(range(7))
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram(self):
+        r = MetricsRegistry()
+        r.counter_inc("c_total", 2, {"t": "x"})
+        r.counter_inc("c_total", 3, {"t": "x"})
+        r.gauge_set("g", 7.5)
+        r.histogram_observe("h_seconds", 0.003)
+        r.histogram_observe("h_seconds", 99.0)
+        assert r.get_counter("c_total", {"t": "x"}) == 5
+        text = r.render_prometheus()
+        assert 'c_total{t="x"} 5' in text
+        assert "# TYPE g gauge" in text
+        assert 'h_seconds_bucket{le="0.005"} 1' in text
+        assert 'h_seconds_bucket{le="+Inf"} 2' in text
+        assert "h_seconds_count 2" in text
+
+
+class TestConfigLoad:
+    def test_env_overlay_nesting(self):
+        env = {"APP_PG_CONNECTION__HOST": "db.example",
+               "APP_PG_CONNECTION__PORT": "6432",
+               "APP_BATCH__MAX_FILL_MS": "500",
+               "APP_PIPELINE_ID": "3",
+               "APP_ENVIRONMENT": "prod",
+               "UNRELATED": "x"}
+        doc = env_overlay(env)
+        assert doc == {"pg_connection": {"host": "db.example", "port": 6432},
+                       "batch": {"max_fill_ms": 500}, "pipeline_id": 3}
+
+    def test_yaml_plus_env(self, tmp_path):
+        (tmp_path / "base.yaml").write_text(
+            "pipeline_id: 1\npublication_name: pub\n"
+            "batch:\n  max_size_bytes: 1024\n")
+        (tmp_path / "prod.yaml").write_text("pipeline_id: 9\n")
+        cfg = load_pipeline_config(
+            tmp_path, Environment.PROD,
+            environ={"APP_BATCH__MAX_FILL_MS": "123"})
+        assert cfg.pipeline_id == 9  # env-file overlay wins over base
+        assert cfg.batch.max_size_bytes == 1024
+        assert cfg.batch.max_fill_ms == 123  # env var wins over files
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(EtlError) as ei:
+            pipeline_config_from_dict(
+                {"pipeline_id": 1, "publication_name": "p", "nope": 1})
+        assert ei.value.kind is ErrorKind.CONFIG_INVALID
+
+    def test_validation_runs(self):
+        with pytest.raises(EtlError):
+            pipeline_config_from_dict(
+                {"pipeline_id": 1, "publication_name": "p",
+                 "pg_connection": {"port": 99999}})
+
+    def test_secret_redaction(self):
+        s = Secret("hunter2")
+        assert "hunter2" not in repr(s)
+        assert s.expose() == "hunter2"
+        cfg = pipeline_config_from_dict(
+            {"pipeline_id": 1, "publication_name": "p",
+             "pg_connection": {"password": "pw123"}})
+        assert "pw123" not in repr(cfg.pg_connection.password)
+        assert cfg.pg_connection.password.expose() == "pw123"
+
+
+class TestFailpointRestarts:
+    """Failpoint-driven worker kills at precise points, exercising the
+    restart/rollback/recopy paths (reference pipeline_with_failpoints.rs)."""
+
+    def teardown_method(self):
+        failpoints.disarm_all()
+
+    async def _run(self, failpoint_name):
+        from etl_tpu.config import RetryConfig
+        from tests.test_pipeline_e2e import (ACCOUNTS, make_db, make_pipeline,
+                                             wait_ready)
+
+        db = make_db()
+        db.create_publication("pub", [ACCOUNTS])
+        failpoints.arm_error(failpoint_name, ErrorKind.SOURCE_IO, times=1)
+        pipeline, store, dest = make_pipeline(
+            db, table_retry=RetryConfig(max_attempts=5, initial_delay_ms=20))
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS, timeout=20)
+        rows = {tuple(r.values) for r in _rows(dest, ACCOUNTS)}
+        assert rows == {(1, "alice", 100), (2, "bob", -5), (3, None, 0)}, \
+            f"after {failpoint_name}"
+        await pipeline.shutdown_and_wait()
+        return store, dest
+
+    async def test_kill_before_slot_creation(self):
+        store, dest = await self._run(failpoints.BEFORE_SLOT_CREATION)
+
+    async def test_kill_during_copy(self):
+        store, dest = await self._run(failpoints.DURING_COPY)
+        # partial copy must have been dropped on retry
+        assert 16384 in dest.dropped_tables
+
+    async def test_kill_after_finished_copy(self):
+        await self._run(failpoints.AFTER_FINISHED_COPY)
+
+    async def test_kill_before_streaming(self):
+        await self._run(failpoints.BEFORE_STREAMING)
+
+
+def _rows(dest, tid):
+    inner = getattr(dest, "inner", dest)
+    return inner.table_rows[tid]

@@ -1,0 +1,169 @@
+"""Durable SQL store tests: reference `etl` schema semantics on sqlite,
+including cross-process-style restart persistence (reference
+postgres_store.rs integration suite)."""
+
+import asyncio
+
+import pytest
+
+from etl_tpu.models import (ColumnSchema, Lsn, Oid, ReplicatedTableSchema,
+                            RetryKind, TableName, TableSchema)
+from etl_tpu.models.errors import EtlError
+from etl_tpu.runtime.state import TableState, TableStateType
+from etl_tpu.store.base import DestinationTableMetadata
+from etl_tpu.store.sql import SqliteStore
+
+
+def schema(tid=5):
+    return ReplicatedTableSchema.with_all_columns(TableSchema(
+        tid, TableName("public", "t"),
+        (ColumnSchema("a", Oid.INT4, nullable=False, primary_key_ordinal=1),
+         ColumnSchema("b", Oid.TEXT))))
+
+
+class TestSqliteStore:
+    async def test_states_persist_across_restart(self, tmp_path):
+        path = tmp_path / "store.db"
+        s1 = SqliteStore(path, pipeline_id=1)
+        await s1.connect()
+        await s1.update_table_state(5, TableState.init())
+        await s1.update_table_state(5, TableState.data_sync())
+        await s1.update_table_state(
+            5, TableState.errored("x", retry_policy=RetryKind.MANUAL,
+                                  retry_attempts=2))
+        await s1.close()
+
+        s2 = SqliteStore(path, pipeline_id=1)
+        await s2.connect()
+        st = await s2.get_table_state(5)
+        assert st.type is TableStateType.ERRORED
+        assert st.retry_policy is RetryKind.MANUAL
+        assert st.retry_attempts == 2
+        # prev-pointer history chain preserved oldest→newest
+        hist = await s2.state_history(5)
+        assert [h.type for h in hist] == [
+            TableStateType.INIT, TableStateType.DATA_SYNC,
+            TableStateType.ERRORED]
+        await s2.close()
+
+    async def test_pipeline_isolation(self, tmp_path):
+        path = tmp_path / "store.db"
+        a = SqliteStore(path, 1)
+        b = SqliteStore(path, 2)
+        await a.connect()
+        await b.connect()
+        await a.update_table_state(5, TableState.ready())
+        assert await b.get_table_state(5) is None
+        await a.close()
+        await b.close()
+
+    async def test_memory_only_rejected(self, tmp_path):
+        s = SqliteStore(tmp_path / "s.db", 1)
+        await s.connect()
+        with pytest.raises(EtlError):
+            await s.update_table_state(1, TableState.sync_wait(Lsn(1)))
+        await s.close()
+
+    async def test_progress_monotonic_and_durable(self, tmp_path):
+        path = tmp_path / "store.db"
+        s = SqliteStore(path, 1)
+        await s.connect()
+        assert await s.update_durable_progress("slot_a", Lsn(100))
+        assert not await s.update_durable_progress("slot_a", Lsn(50))
+        await s.close()
+        s2 = SqliteStore(path, 1)
+        await s2.connect()
+        assert await s2.get_durable_progress("slot_a") == Lsn(100)
+        # regression attempt after reload also rejected
+        assert not await s2.update_durable_progress("slot_a", Lsn(99))
+        await s2.delete_durable_progress("slot_a")
+        assert await s2.get_durable_progress("slot_a") is None
+        await s2.close()
+
+    async def test_schema_versions_durable(self, tmp_path):
+        path = tmp_path / "store.db"
+        s = SqliteStore(path, 1)
+        await s.connect()
+        r1 = schema()
+        await s.store_table_schema(r1, 0)
+        cols2 = r1.table_schema.columns + (ColumnSchema("c", Oid.BOOL),)
+        r2 = ReplicatedTableSchema.with_all_columns(
+            TableSchema(5, r1.name, cols2))
+        await s.store_table_schema(r2, 500)
+        await s.close()
+
+        s2 = SqliteStore(path, 1)
+        await s2.connect()
+        assert (await s2.get_table_schema(5, at_snapshot=100)) == r1
+        assert (await s2.get_table_schema(5)) == r2
+        assert await s2.get_schema_versions(5) == [0, 500]
+        assert await s2.prune_schema_versions(5, 600) == 1
+        assert await s2.get_schema_versions(5) == [500]
+        await s2.close()
+        # prune is durable too
+        s3 = SqliteStore(path, 1)
+        await s3.connect()
+        assert await s3.get_schema_versions(5) == [500]
+        await s3.close()
+
+    async def test_destination_metadata(self, tmp_path):
+        path = tmp_path / "store.db"
+        s = SqliteStore(path, 1)
+        await s.connect()
+        await s.update_destination_metadata(
+            DestinationTableMetadata(5, "public_t", generation=2))
+        await s.close()
+        s2 = SqliteStore(path, 1)
+        await s2.connect()
+        m = await s2.get_destination_metadata(5)
+        assert m.destination_table_name == "public_t" and m.generation == 2
+        await s2.close()
+
+
+class TestPipelineWithSqliteStore:
+    async def test_e2e_with_durable_store(self, tmp_path):
+        """Pipeline restart with a durable store: states and progress come
+        from disk, copy doesn't re-run."""
+        from etl_tpu.destinations import MemoryDestination
+        from etl_tpu.models import InsertEvent
+        from etl_tpu.postgres.fake import FakeSource
+        from etl_tpu.runtime import Pipeline
+        from etl_tpu.config import BatchConfig, BatchEngine, PipelineConfig
+        from tests.test_pipeline_e2e import ACCOUNTS, make_db, _wait_for
+
+        db = make_db()
+        db.create_publication("pub", [ACCOUNTS])
+        dest = MemoryDestination()
+        path = tmp_path / "pipeline.db"
+
+        async def run_once(insert_id=None):
+            store = SqliteStore(path, 1)
+            await store.connect()
+            p = Pipeline(
+                config=PipelineConfig(
+                    pipeline_id=1, publication_name="pub",
+                    batch=BatchConfig(max_size_bytes=1 << 20, max_fill_ms=30,
+                                      batch_engine=BatchEngine.TPU)),
+                store=store, destination=dest,
+                source_factory=lambda: FakeSource(db))
+            await p.start()
+            await _wait_for(lambda: store._states.get(ACCOUNTS) is not None
+                            and store._states[ACCOUNTS].type
+                            is TableStateType.READY, timeout=15)
+            if insert_id is not None:
+                async with db.transaction() as tx:
+                    tx.insert(ACCOUNTS, [str(insert_id), "d", "0"])
+                await _wait_for(lambda: any(
+                    isinstance(e, InsertEvent)
+                    and e.row.values[0] == insert_id for e in dest.events))
+            await p.shutdown_and_wait()
+            await store.close()
+
+        await run_once(insert_id=80)
+        assert len(dest.table_rows[ACCOUNTS]) == 3
+        await run_once(insert_id=81)
+        # copy did not re-run; no duplicate CDC for 80
+        assert len(dest.table_rows[ACCOUNTS]) == 3
+        n80 = sum(1 for e in dest.events
+                  if getattr(e, "row", None) and e.row.values[0] == 80)
+        assert n80 == 1
