@@ -3,3 +3,4 @@
 from .base import Destination, WriteAck, expand_batch_events
 from .memory import (FaultAction, FaultInjectingDestination, FaultKind,
                      MemoryDestination)
+from .registry import build_destination

@@ -1,0 +1,291 @@
+"""Lake destination: a local lakehouse — Parquet data + SQL catalog.
+
+The DuckLake-analogue (reference crates/etl-destinations/src/ducklake/,
+13.5k LoC: DuckDB writing Parquet to S3 with a Postgres-backed catalog).
+Here: pyarrow Parquet files in a warehouse directory with a sqlite catalog
+— the same architecture with the embedded pieces this environment has.
+Carried over semantics:
+
+  - batch mutation application with retry (ducklake/batches.rs): every
+    write lands as an immutable Parquet file recorded in the catalog;
+  - replay-epoch markers for at-least-once dedup (replay_epoch.rs): CDC
+    files carry their max sequence key; a re-delivered batch whose max
+    sequence ≤ the table's high watermark is skipped;
+  - truncate handling via generations; snapshot reads collapse CDC files
+    by identity + sequence order (the `_current` semantics);
+  - external maintenance handoff (external_maintenance.rs): `compact()`
+    merges CDC files into a new base file under a catalog transaction,
+    coordinated with writers through a catalog maintenance flag.
+
+TPU-first payoff: ColumnarBatch → Arrow RecordBatch → Parquet without any
+per-row Python objects for device-decoded columns.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+import uuid
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Sequence
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..models.errors import ErrorKind, EtlError
+from ..models.event import (ChangeType, DecodedBatchEvent, DeleteEvent,
+                            Event, InsertEvent, SchemaChangeEvent,
+                            TruncateEvent, UpdateEvent)
+from ..models.schema import ReplicatedTableSchema, TableId
+from ..models.table_row import ColumnarBatch
+from .base import Destination, WriteAck, expand_batch_events
+from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN, CDC_DELETE,
+                   CDC_UPSERT, change_type_label, escaped_table_name,
+                   sequential_event_program)
+
+
+@dataclass(frozen=True)
+class LakeConfig:
+    warehouse_path: str  # directory for parquet files + catalog
+    compact_min_files: int = 8  # compaction trigger threshold
+
+
+class LakeDestination(Destination):
+    def __init__(self, config: LakeConfig):
+        self.config = config
+        self.root = Path(config.warehouse_path)
+        self._db: sqlite3.Connection | None = None
+
+    # -- catalog ----------------------------------------------------------------
+
+    async def startup(self) -> None:
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._db = sqlite3.connect(self.root / "catalog.db")
+        self._db.executescript("""
+CREATE TABLE IF NOT EXISTS lake_tables (
+    table_id BIGINT PRIMARY KEY,
+    name TEXT NOT NULL,
+    schema_json TEXT NOT NULL,
+    generation BIGINT NOT NULL DEFAULT 0,
+    max_seq TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS lake_files (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    table_id BIGINT NOT NULL,
+    generation BIGINT NOT NULL,
+    path TEXT NOT NULL,
+    kind TEXT NOT NULL,          -- 'base' | 'cdc'
+    row_count BIGINT NOT NULL,
+    max_seq TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS lake_maintenance (
+    table_id BIGINT PRIMARY KEY,
+    in_progress INTEGER NOT NULL DEFAULT 0
+);
+""")
+        self._db.commit()
+
+    def _catalog(self) -> sqlite3.Connection:
+        if self._db is None:
+            raise EtlError(ErrorKind.DESTINATION_FAILED,
+                           "lake destination not started")
+        return self._db
+
+    def _table_row(self, table_id: TableId):
+        return self._catalog().execute(
+            "SELECT name, schema_json, generation, max_seq FROM lake_tables "
+            "WHERE table_id = ?", (table_id,)).fetchone()
+
+    def _ensure_table(self, schema: ReplicatedTableSchema) -> tuple[str, int]:
+        row = self._table_row(schema.id)
+        name = escaped_table_name(schema.name)
+        db = self._catalog()
+        if row is None:
+            db.execute(
+                "INSERT INTO lake_tables (table_id, name, schema_json) "
+                "VALUES (?, ?, ?)",
+                (schema.id, name, json.dumps(schema.to_json())))
+            db.commit()
+            return name, 0
+        if json.loads(row[1]) != schema.to_json():
+            db.execute("UPDATE lake_tables SET schema_json = ? "
+                       "WHERE table_id = ?",
+                       (json.dumps(schema.to_json()), schema.id))
+            db.commit()
+        return row[0], row[2]
+
+    # -- file writing -------------------------------------------------------------
+
+    def _write_parquet(self, table_dir: Path, rb: pa.RecordBatch) -> Path:
+        table_dir.mkdir(parents=True, exist_ok=True)
+        path = table_dir / f"data-{uuid.uuid4().hex}.parquet"
+        pq.write_table(pa.Table.from_batches([rb]), path)
+        return path
+
+    def _record_file(self, table_id: TableId, generation: int, path: Path,
+                     kind: str, rows: int, max_seq: str) -> None:
+        db = self._catalog()
+        db.execute(
+            "INSERT INTO lake_files (table_id, generation, path, kind, "
+            "row_count, max_seq) VALUES (?, ?, ?, ?, ?, ?)",
+            (table_id, generation, str(path), kind, rows, max_seq))
+        if max_seq:
+            db.execute("UPDATE lake_tables SET max_seq = MAX(max_seq, ?) "
+                       "WHERE table_id = ?", (max_seq, table_id))
+        db.commit()
+
+    # -- Destination ---------------------------------------------------------------
+
+    async def write_table_rows(self, schema: ReplicatedTableSchema,
+                               batch: ColumnarBatch) -> WriteAck:
+        name, gen = self._ensure_table(schema)
+        if batch.num_rows:
+            rb = batch.to_arrow()
+            path = self._write_parquet(self.root / name, rb)
+            self._record_file(schema.id, gen, path, "base", batch.num_rows,
+                              "")
+        return WriteAck.durable()
+
+    async def write_events(self, events: Sequence[Event]) -> WriteAck:
+        for op in sequential_event_program(expand_batch_events(events)):
+            if op[0] == "rows":
+                _, schema, evs = op
+                await self._write_cdc_file(schema, evs)
+            elif op[0] == "truncate":
+                for sch in op[1].schemas:
+                    await self.truncate_table(sch.id)
+            else:
+                self._ensure_table(op[1].new_schema)
+        return WriteAck.durable()
+
+    async def _write_cdc_file(self, schema: ReplicatedTableSchema,
+                              evs: list) -> None:
+        name, gen = self._ensure_table(schema)
+        row = self._table_row(schema.id)
+        watermark = row[3] if row else ""
+        seqs, types, rows = [], [], []
+        for i, e in enumerate(evs):
+            seq = e.sequence_key.with_ordinal(i)
+            seqs.append(seq)
+            if isinstance(e, DeleteEvent):
+                types.append(CDC_DELETE)
+                rows.append(e.old_row)
+            else:
+                types.append(CDC_UPSERT)
+                rows.append(e.row)
+        max_seq = max(seqs)
+        if watermark and max_seq <= watermark:
+            return  # replay-epoch dedup: whole batch already applied
+        batch = ColumnarBatch.from_rows(schema, rows)
+        rb = batch.to_arrow()
+        rb = rb.append_column(CHANGE_TYPE_COLUMN,
+                              pa.array(types, type=pa.string()))
+        rb = rb.append_column(CHANGE_SEQUENCE_COLUMN,
+                              pa.array(seqs, type=pa.string()))
+        path = self._write_parquet(self.root / name, rb)
+        self._record_file(schema.id, gen, path, "cdc", len(rows), max_seq)
+        if self._cdc_file_count(schema.id, gen) >= self.config.compact_min_files:
+            await self.compact(schema.id)
+
+    def _cdc_file_count(self, table_id: TableId, gen: int) -> int:
+        return self._catalog().execute(
+            "SELECT COUNT(*) FROM lake_files WHERE table_id = ? AND "
+            "generation = ? AND kind = 'cdc'", (table_id, gen)).fetchone()[0]
+
+    async def drop_table(self, table_id: TableId) -> None:
+        db = self._catalog()
+        for (path,) in db.execute("SELECT path FROM lake_files WHERE "
+                                  "table_id = ?", (table_id,)):
+            Path(path).unlink(missing_ok=True)
+        db.execute("DELETE FROM lake_files WHERE table_id = ?", (table_id,))
+        db.execute("DELETE FROM lake_tables WHERE table_id = ?", (table_id,))
+        db.commit()
+
+    async def truncate_table(self, table_id: TableId) -> None:
+        """Generation bump: old files stay until vacuum, reads see only the
+        current generation (the versioned-successor stance)."""
+        db = self._catalog()
+        db.execute("UPDATE lake_tables SET generation = generation + 1, "
+                   "max_seq = '' WHERE table_id = ?", (table_id,))
+        db.commit()
+
+    async def shutdown(self) -> None:
+        if self._db is not None:
+            self._db.close()
+            self._db = None
+
+    # -- reads (the `_current` semantics) -----------------------------------------
+
+    def read_current(self, table_id: TableId) -> pa.Table:
+        """Collapse base + CDC files into live rows: per identity key, the
+        highest sequence wins; deletes drop the key."""
+        row = self._table_row(table_id)
+        if row is None:
+            raise EtlError(ErrorKind.DESTINATION_FAILED,
+                           f"unknown table {table_id}")
+        name, schema_json, gen, _ = row
+        schema = ReplicatedTableSchema.from_json(json.loads(schema_json))
+        key_cols = [c.name for c in schema.identity_columns()] or \
+            [c.name for c in schema.replicated_columns]
+        files = self._catalog().execute(
+            "SELECT path, kind FROM lake_files WHERE table_id = ? AND "
+            "generation = ? ORDER BY id", (table_id, gen)).fetchall()
+        live: dict[tuple, dict] = {}
+        for path, kind in files:
+            t = pq.read_table(path)
+            for rec in t.to_pylist():
+                key = tuple(rec[k] for k in key_cols)
+                if kind == "cdc" and rec.get(CHANGE_TYPE_COLUMN) == CDC_DELETE:
+                    live.pop(key, None)
+                else:
+                    rec.pop(CHANGE_TYPE_COLUMN, None)
+                    rec.pop(CHANGE_SEQUENCE_COLUMN, None)
+                    live[key] = rec
+        if not live:
+            return pa.table({c.name: [] for c in schema.replicated_columns})
+        return pa.Table.from_pylist(list(live.values()))
+
+    # -- maintenance (external-maintenance parity) ----------------------------------
+
+    async def compact(self, table_id: TableId) -> int:
+        """Merge the current generation's files into one base file.
+        Returns merged file count. Guarded by the catalog maintenance flag
+        (reference external_maintenance.rs coordination)."""
+        db = self._catalog()
+        busy = db.execute("SELECT in_progress FROM lake_maintenance WHERE "
+                          "table_id = ?", (table_id,)).fetchone()
+        if busy and busy[0]:
+            return 0
+        db.execute("INSERT INTO lake_maintenance (table_id, in_progress) "
+                   "VALUES (?, 1) ON CONFLICT (table_id) DO UPDATE SET "
+                   "in_progress = 1", (table_id,))
+        db.commit()
+        try:
+            row = self._table_row(table_id)
+            if row is None:
+                return 0
+            name, _, gen, max_seq = row
+            files = db.execute(
+                "SELECT id, path FROM lake_files WHERE table_id = ? AND "
+                "generation = ?", (table_id, gen)).fetchall()
+            if len(files) < 2:
+                return 0
+            merged = self.read_current(table_id)
+            path = self.root / name / f"data-{uuid.uuid4().hex}.parquet"
+            pq.write_table(merged, path)
+            db.execute("DELETE FROM lake_files WHERE table_id = ? AND "
+                       "generation = ?", (table_id, gen))
+            db.execute(
+                "INSERT INTO lake_files (table_id, generation, path, kind, "
+                "row_count, max_seq) VALUES (?, ?, ?, 'base', ?, ?)",
+                (table_id, gen, str(path), merged.num_rows, max_seq))
+            db.commit()
+            for _id, p in files:
+                Path(p).unlink(missing_ok=True)
+            return len(files)
+        finally:
+            db.execute("UPDATE lake_maintenance SET in_progress = 0 WHERE "
+                       "table_id = ?", (table_id,))
+            db.commit()

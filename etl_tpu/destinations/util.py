@@ -1,0 +1,146 @@
+"""Shared destination helpers.
+
+Reference parity: crates/etl-destinations/src/{retry.rs (classify-and-
+backoff), table_name.rs (underscore-escaped naming), recovery.rs} and the
+CDC metadata conventions shared by the cloud writers (BigQuery
+`_CHANGE_TYPE`/`_CHANGE_SEQUENCE_NUMBER`, Snowflake CdcMeta/CdcOperation).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+from typing import Awaitable, Callable, TypeVar
+
+from ..models.errors import ErrorKind, EtlError
+from ..models.event import ChangeType, EventSequenceKey
+from ..models.schema import TableName
+
+T = TypeVar("T")
+
+# CDC metadata column names (reference bigquery/core.rs:42-45)
+CHANGE_TYPE_COLUMN = "_CHANGE_TYPE"
+CHANGE_SEQUENCE_COLUMN = "_CHANGE_SEQUENCE_NUMBER"
+
+CDC_UPSERT = "UPSERT"
+CDC_DELETE = "DELETE"
+
+
+def change_type_label(ct: ChangeType) -> str:
+    return CDC_DELETE if ct is ChangeType.DELETE else CDC_UPSERT
+
+
+def sequence_number(key: EventSequenceKey, ordinal: int) -> str:
+    """Hex ordering key commit_lsn/tx_ordinal/ordinal
+    (reference bigquery/core.rs:980-996)."""
+    return key.with_ordinal(ordinal)
+
+
+def escaped_table_name(name: TableName) -> str:
+    """`schema_table` with underscores in parts doubled so the mapping is
+    injective (reference table_name.rs)."""
+    return (name.schema.replace("_", "__") + "_"
+            + name.name.replace("_", "__"))
+
+
+def versioned_table_name(base: str, generation: int) -> str:
+    """Truncate-versioned successor tables `base`, `base_1`, `base_2`…
+    (reference bigquery/core.rs:55-106)."""
+    return base if generation == 0 else f"{base}_{generation}"
+
+
+# transient classification (reference retry.rs)
+_RETRYABLE_HTTP = frozenset({408, 409, 429, 500, 502, 503, 504})
+
+
+def http_status_retryable(status: int) -> bool:
+    return status in _RETRYABLE_HTTP
+
+
+@dataclass(frozen=True)
+class DestinationRetryPolicy:
+    max_attempts: int = 5
+    initial_delay_s: float = 0.2
+    max_delay_s: float = 10.0
+    multiplier: float = 2.0
+    jitter: float = 0.2
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.initial_delay_s * self.multiplier**attempt,
+                self.max_delay_s)
+        return d * (1 + random.random() * self.jitter)
+
+
+async def with_retries(op: Callable[[], Awaitable[T]],
+                       policy: DestinationRetryPolicy,
+                       retryable: Callable[[BaseException], bool]) -> T:
+    """Classify-and-backoff retry wrapper (reference retry.rs:classify)."""
+    last: BaseException | None = None
+    for attempt in range(policy.max_attempts):
+        try:
+            return await op()
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            if not retryable(e) or attempt + 1 >= policy.max_attempts:
+                raise
+            last = e
+            await asyncio.sleep(policy.delay(attempt))
+    raise last  # pragma: no cover
+
+
+class TaskSet:
+    """Background destination tasks with joined shutdown
+    (reference concurrency/task_set.rs)."""
+
+    def __init__(self) -> None:
+        self._tasks: set[asyncio.Task] = set()
+
+    def spawn(self, coro) -> asyncio.Task:
+        t = asyncio.ensure_future(coro)
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+        return t
+
+    async def join(self) -> None:
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)
+
+    async def cancel_all(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()
+        await self.join()
+
+
+def sequential_event_program(events):
+    """Order-preserving destination program: yields ("rows", schema, [row
+    events…]) runs and ("truncate", event) / ("schema_change", event)
+    barriers, splitting runs so WAL order is preserved — rows preceding a
+    truncate in the batch must land before it executes.
+
+    Accepts expanded per-row events (use expand_batch_events first)."""
+    from ..models.event import (DeleteEvent, InsertEvent, SchemaChangeEvent,
+                                TruncateEvent, UpdateEvent)
+
+    run_schema = None
+    run: list = []
+    for e in events:
+        if isinstance(e, (InsertEvent, UpdateEvent, DeleteEvent)):
+            if run_schema is not None and (run_schema.id != e.schema.id
+                                           or run_schema != e.schema):
+                yield ("rows", run_schema, run)
+                run = []
+            run_schema = e.schema
+            run.append(e)
+        elif isinstance(e, (TruncateEvent, SchemaChangeEvent)):
+            if run:
+                yield ("rows", run_schema, run)
+                run, run_schema = [], None
+            if isinstance(e, TruncateEvent):
+                yield ("truncate", e)
+            elif e.new_schema is not None:
+                yield ("schema_change", e)
+        # Begin/Commit/Relation: ordering barriers with no destination op
+    if run:
+        yield ("rows", run_schema, run)

@@ -256,7 +256,9 @@ class _FakeReplicationStream(ReplicationStream):
             while self._wal_index < len(db.wal):
                 lsn, payload = db.wal[self._wal_index]
                 self._wal_index += 1
-                if lsn <= self.pos_lsn:
+                # START_REPLICATION is INCLUSIVE of the requested LSN: the
+                # next tx's BEGIN sits exactly at the prior commit's end
+                if lsn < self.pos_lsn:
                     continue
                 if not self._publication_allows(payload, pub_tables):
                     continue

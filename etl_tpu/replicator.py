@@ -1,0 +1,126 @@
+"""The replicator binary: `python -m etl_tpu.replicator --config-dir DIR`.
+
+Reference parity: crates/etl-replicator/src/main.rs:76 — config load →
+tracing/metrics init → destination dispatch from config → pipeline start →
+signal-driven graceful shutdown; plus the /metrics HTTP endpoint the
+reference exposes through etl-telemetry.
+
+Extra config keys consumed here (beyond PipelineConfig):
+  destination: {type: memory|clickhouse|bigquery|lake|iceberg|snowflake, …}
+  store:       {type: memory|sqlite, path: …}
+  metrics_port: 0 disables the endpoint
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+from aiohttp import web
+
+from .config.load import (Environment, load_config_dict,
+                          pipeline_config_from_dict)
+from .destinations.registry import build_destination
+from .models.errors import EtlError
+from .postgres.client import PgReplicationClient
+from .runtime.pipeline import Pipeline
+from .store.memory import MemoryStore
+from .store.sql import SqliteStore
+from .telemetry.metrics import registry
+from .telemetry.tracing import init_tracing
+
+logger = logging.getLogger("etl_tpu.replicator")
+
+
+async def serve_metrics(port: int) -> web.AppRunner | None:
+    if not port:
+        return None
+
+    async def metrics(_request: web.Request) -> web.Response:
+        return web.Response(text=registry.render_prometheus(),
+                            content_type="text/plain")
+
+    async def health(_request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/health", health)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, "0.0.0.0", port).start()
+    logger.info("metrics on :%d/metrics", port)
+    return runner
+
+
+async def run_replicator(config_dir: str,
+                         environment: Environment | None = None) -> None:
+    doc = load_config_dict(config_dir, environment)
+    dest_doc = doc.pop("destination", {"type": "memory"})
+    store_doc = doc.pop("store", {"type": "memory"})
+    metrics_port = doc.pop("metrics_port", 0)
+    project_ref = doc.pop("project_ref", "")
+    config = pipeline_config_from_dict(doc)
+
+    env = environment or Environment.current()
+    init_tracing(environment=env.value, project_ref=project_ref,
+                 pipeline_id=config.pipeline_id)
+    logger.info("starting replicator pipeline=%s publication=%s engine=%s",
+                config.pipeline_id, config.publication_name,
+                config.batch.batch_engine.value)
+
+    if store_doc.get("type") == "sqlite":
+        store = SqliteStore(store_doc["path"], config.pipeline_id)
+        await store.connect()
+    else:
+        store = MemoryStore()
+    destination = build_destination(dest_doc)
+
+    pipeline = Pipeline(
+        config=config, store=store, destination=destination,
+        source_factory=lambda: PgReplicationClient(config.pg_connection))
+
+    metrics_runner = await serve_metrics(metrics_port)
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(
+            sig, lambda: asyncio.ensure_future(pipeline.shutdown()))
+
+    await pipeline.start()
+    logger.info("pipeline started")
+    try:
+        await pipeline.wait()
+        logger.info("pipeline stopped cleanly")
+    finally:
+        if metrics_runner is not None:
+            await metrics_runner.cleanup()
+        close = getattr(store, "close", None)
+        if close is not None:
+            await close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="etl_tpu.replicator",
+        description="TPU-native Postgres logical-replication replicator")
+    parser.add_argument("--config-dir", required=True,
+                        help="directory with base.yaml / {env}.yaml")
+    parser.add_argument("--environment", choices=[e.value for e in Environment],
+                        default=None)
+    args = parser.parse_args(argv)
+    env = Environment(args.environment) if args.environment else None
+    try:
+        asyncio.run(run_replicator(args.config_dir, env))
+        return 0
+    except KeyboardInterrupt:
+        return 0
+    except EtlError as e:
+        logger.error("replicator failed: %s", e)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -452,7 +452,9 @@ class FakePgServer:
                 while wal_index < len(db.wal):
                     lsn, payload = db.wal[wal_index]
                     wal_index += 1
-                    if lsn <= pos:
+                    # inclusive of the requested start position (see
+                    # fake.py note: BEGIN lands at the prior commit's end)
+                    if lsn < pos:
                         continue
                     if not self._pub_allows(payload, pub_tables):
                         continue
