@@ -1,0 +1,397 @@
+"""Control-plane REST API.
+
+Reference parity: crates/etl-api (19k LoC) — tenants / sources /
+destinations / pipelines CRUD with per-tenant isolation via the `tenant_id`
+header (routes/mod.rs:40-73), encrypted source/destination configs,
+pipeline lifecycle routes `start/stop/restart/status/replication-status/
+rollback-tables` (routes/pipelines.rs:662-1618), orchestration through the
+fakeable deploy seam (k8s/base.rs:197), OpenAPI document, /metrics.
+
+Storage: sqlite (the reference uses its own Postgres with sqlx migrations).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+from pathlib import Path
+
+from aiohttp import web
+
+from ..store.sql import SqliteStore
+from ..telemetry.metrics import registry
+from .crypto import ConfigCipher
+from .orchestrator import Orchestrator, ReplicatorSpec
+
+TENANT_HEADER = "tenant_id"
+MAX_TENANT_ID_LEN = 64
+
+
+def _require_tenant(request: web.Request) -> str:
+    tenant = request.headers.get(TENANT_HEADER, "")
+    if not tenant or len(tenant) > MAX_TENANT_ID_LEN \
+            or not tenant.replace("-", "").replace("_", "").isalnum():
+        raise web.HTTPUnauthorized(
+            text=json.dumps({"error": "missing or invalid tenant_id header"}),
+            content_type="application/json")
+    return tenant
+
+
+def _path_id(request: web.Request) -> int:
+    raw = request.match_info["id"]
+    if not raw.isdigit():
+        raise _json_error(404, "not found")
+    return int(raw)
+
+
+async def _json_body(request: web.Request) -> dict:
+    try:
+        doc = await request.json()
+    except Exception:
+        raise _json_error(400, "request body must be JSON")
+    if not isinstance(doc, dict):
+        raise _json_error(400, "request body must be a JSON object")
+    return doc
+
+
+def _json_error(status: int, message: str) -> web.HTTPException:
+    cls = {400: web.HTTPBadRequest, 404: web.HTTPNotFound,
+           409: web.HTTPConflict}.get(status, web.HTTPInternalServerError)
+    return cls(text=json.dumps({"error": message}),
+               content_type="application/json")
+
+
+class ApiState:
+    def __init__(self, db_path: str, cipher: ConfigCipher,
+                 orchestrator: Orchestrator):
+        self.cipher = cipher
+        self.orchestrator = orchestrator
+        self.db = sqlite3.connect(db_path)
+        self.db.executescript("""
+CREATE TABLE IF NOT EXISTS api_tenants (
+    id TEXT PRIMARY KEY, name TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS api_sources (
+    id INTEGER PRIMARY KEY AUTOINCREMENT, tenant_id TEXT NOT NULL,
+    name TEXT NOT NULL, config_enc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS api_destinations (
+    id INTEGER PRIMARY KEY AUTOINCREMENT, tenant_id TEXT NOT NULL,
+    name TEXT NOT NULL, config_enc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS api_pipelines (
+    id INTEGER PRIMARY KEY AUTOINCREMENT, tenant_id TEXT NOT NULL,
+    source_id INTEGER NOT NULL, destination_id INTEGER NOT NULL,
+    publication_name TEXT NOT NULL, config_json TEXT NOT NULL DEFAULT '{}',
+    store_path TEXT NOT NULL DEFAULT '');
+""")
+        self.db.commit()
+
+    # -- row helpers ------------------------------------------------------------
+
+    def fetch_owned(self, table: str, row_id: int, tenant: str):
+        row = self.db.execute(
+            f"SELECT * FROM {table} WHERE id = ? AND tenant_id = ?",
+            (row_id, tenant)).fetchone()
+        return row
+
+    def pipeline_config(self, row) -> dict:
+        """Assemble the full replicator config for a pipeline row."""
+        _, tenant, source_id, dest_id, publication, config_json, store_path = row
+        src = self.fetch_owned("api_sources", source_id, tenant)
+        dst = self.fetch_owned("api_destinations", dest_id, tenant)
+        if src is None or dst is None:
+            raise _json_error(404, "source or destination missing")
+        extra = json.loads(config_json)
+        doc = {
+            "pipeline_id": row[0],
+            "publication_name": publication,
+            "pg_connection": self.cipher.decrypt(src[3]),
+            "destination": self.cipher.decrypt(dst[3]),
+            **extra,
+        }
+        if store_path:
+            doc["store"] = {"type": "sqlite", "path": store_path}
+        return doc
+
+
+def build_app(state: ApiState) -> web.Application:
+    app = web.Application()
+    r = app.router
+
+    # -- health / metrics / openapi --------------------------------------------
+
+    async def health(_req):
+        return web.json_response({"status": "ok"})
+
+    async def metrics(_req):
+        return web.Response(text=registry.render_prometheus(),
+                            content_type="text/plain")
+
+    async def openapi(_req):
+        return web.json_response(OPENAPI_DOC)
+
+    r.add_get("/health", health)
+    r.add_get("/metrics", metrics)
+    r.add_get("/openapi.json", openapi)
+
+    # -- tenants ----------------------------------------------------------------
+
+    async def create_tenant(req: web.Request):
+        doc = await _json_body(req)
+        tid, name = doc.get("id"), doc.get("name")
+        if not tid or not name:
+            raise _json_error(400, "id and name required")
+        try:
+            state.db.execute("INSERT INTO api_tenants (id, name) VALUES (?, ?)",
+                             (tid, name))
+            state.db.commit()
+        except sqlite3.IntegrityError:
+            raise _json_error(409, f"tenant {tid} exists")
+        return web.json_response({"id": tid, "name": name}, status=201)
+
+    async def list_tenants(_req):
+        rows = state.db.execute("SELECT id, name FROM api_tenants").fetchall()
+        return web.json_response([{"id": i, "name": n} for i, n in rows])
+
+    r.add_post("/v1/tenants", create_tenant)
+    r.add_get("/v1/tenants", list_tenants)
+
+    # -- sources / destinations (same shape) ------------------------------------
+
+    def make_config_routes(table: str, path: str):
+        async def create(req: web.Request):
+            tenant = _require_tenant(req)
+            doc = await _json_body(req)
+            name, config = doc.get("name"), doc.get("config")
+            if not name or not isinstance(config, dict):
+                raise _json_error(400, "name and config required")
+            cur = state.db.execute(
+                f"INSERT INTO {table} (tenant_id, name, config_enc) "
+                "VALUES (?, ?, ?)", (tenant, name, state.cipher.encrypt(config)))
+            state.db.commit()
+            return web.json_response({"id": cur.lastrowid, "name": name},
+                                     status=201)
+
+        async def list_(req: web.Request):
+            tenant = _require_tenant(req)
+            rows = state.db.execute(
+                f"SELECT id, name FROM {table} WHERE tenant_id = ?",
+                (tenant,)).fetchall()
+            return web.json_response([{"id": i, "name": n} for i, n in rows])
+
+        async def get(req: web.Request):
+            tenant = _require_tenant(req)
+            row = state.fetch_owned(table, _path_id(req), tenant)
+            if row is None:
+                raise _json_error(404, "not found")
+            return web.json_response({
+                "id": row[0], "name": row[2],
+                "config": state.cipher.decrypt(row[3])})
+
+        async def update(req: web.Request):
+            tenant = _require_tenant(req)
+            row = state.fetch_owned(table, _path_id(req), tenant)
+            if row is None:
+                raise _json_error(404, "not found")
+            doc = await _json_body(req)
+            config = doc.get("config")
+            name = doc.get("name", row[2])
+            enc = state.cipher.encrypt(config) if config is not None else row[3]
+            state.db.execute(
+                f"UPDATE {table} SET name = ?, config_enc = ? WHERE id = ?",
+                (name, enc, row[0]))
+            state.db.commit()
+            return web.json_response({"id": row[0], "name": name})
+
+        async def delete(req: web.Request):
+            tenant = _require_tenant(req)
+            row_id = _path_id(req)
+            ref_col = "source_id" if table == "api_sources" \
+                else "destination_id"
+            used = state.db.execute(
+                f"SELECT id FROM api_pipelines WHERE {ref_col} = ? AND "
+                "tenant_id = ?", (row_id, tenant)).fetchall()
+            if used:
+                raise _json_error(
+                    409, f"in use by pipelines {[r[0] for r in used]}")
+            state.db.execute(
+                f"DELETE FROM {table} WHERE id = ? AND tenant_id = ?",
+                (row_id, tenant))
+            state.db.commit()
+            return web.json_response({}, status=204)
+
+        r.add_post(path, create)
+        r.add_get(path, list_)
+        r.add_get(path + "/{id}", get)
+        r.add_put(path + "/{id}", update)
+        r.add_delete(path + "/{id}", delete)
+
+    make_config_routes("api_sources", "/v1/sources")
+    make_config_routes("api_destinations", "/v1/destinations")
+
+    # -- pipelines ----------------------------------------------------------------
+
+    async def create_pipeline(req: web.Request):
+        tenant = _require_tenant(req)
+        doc = await _json_body(req)
+        try:
+            source_id = int(doc["source_id"])
+            dest_id = int(doc["destination_id"])
+            publication = doc["publication_name"]
+        except (KeyError, TypeError, ValueError):
+            raise _json_error(
+                400, "source_id, destination_id, publication_name required")
+        if state.fetch_owned("api_sources", source_id, tenant) is None:
+            raise _json_error(404, f"source {source_id} not found")
+        if state.fetch_owned("api_destinations", dest_id, tenant) is None:
+            raise _json_error(404, f"destination {dest_id} not found")
+        cur = state.db.execute(
+            "INSERT INTO api_pipelines (tenant_id, source_id, destination_id,"
+            " publication_name, config_json, store_path) VALUES "
+            "(?, ?, ?, ?, ?, ?)",
+            (tenant, source_id, dest_id, publication,
+             json.dumps(doc.get("config", {})), doc.get("store_path", "")))
+        state.db.commit()
+        return web.json_response({"id": cur.lastrowid}, status=201)
+
+    async def list_pipelines(req: web.Request):
+        tenant = _require_tenant(req)
+        rows = state.db.execute(
+            "SELECT id, source_id, destination_id, publication_name FROM "
+            "api_pipelines WHERE tenant_id = ?", (tenant,)).fetchall()
+        return web.json_response([
+            {"id": i, "source_id": s, "destination_id": d,
+             "publication_name": p} for i, s, d, p in rows])
+
+    def _pipeline_row(req: web.Request, tenant: str):
+        row = state.fetch_owned("api_pipelines",
+                                _path_id(req), tenant)
+        if row is None:
+            raise _json_error(404, "pipeline not found")
+        return row
+
+    async def get_pipeline(req: web.Request):
+        tenant = _require_tenant(req)
+        row = _pipeline_row(req, tenant)
+        return web.json_response({
+            "id": row[0], "source_id": row[2], "destination_id": row[3],
+            "publication_name": row[4], "config": json.loads(row[5])})
+
+    async def delete_pipeline(req: web.Request):
+        tenant = _require_tenant(req)
+        row = _pipeline_row(req, tenant)
+        await state.orchestrator.stop_pipeline(row[0])
+        state.db.execute("DELETE FROM api_pipelines WHERE id = ?", (row[0],))
+        state.db.commit()
+        return web.json_response({}, status=204)
+
+    async def start_pipeline(req: web.Request):
+        tenant = _require_tenant(req)
+        row = _pipeline_row(req, tenant)
+        config = state.pipeline_config(row)
+        await state.orchestrator.start_pipeline(ReplicatorSpec(
+            pipeline_id=row[0], tenant_id=tenant, config=config))
+        return web.json_response({"status": "starting"}, status=202)
+
+    async def stop_pipeline(req: web.Request):
+        tenant = _require_tenant(req)
+        row = _pipeline_row(req, tenant)
+        await state.orchestrator.stop_pipeline(row[0])
+        return web.json_response({"status": "stopping"}, status=202)
+
+    async def restart_pipeline(req: web.Request):
+        tenant = _require_tenant(req)
+        row = _pipeline_row(req, tenant)
+        config = state.pipeline_config(row)
+        await state.orchestrator.restart_pipeline(ReplicatorSpec(
+            pipeline_id=row[0], tenant_id=tenant, config=config))
+        return web.json_response({"status": "restarting"}, status=202)
+
+    async def pipeline_status(req: web.Request):
+        tenant = _require_tenant(req)
+        row = _pipeline_row(req, tenant)
+        st = await state.orchestrator.status(row[0])
+        return web.json_response({"pipeline_id": st.pipeline_id,
+                                  "state": st.state, "detail": st.detail})
+
+    async def replication_status(req: web.Request):
+        """Table states from the pipeline's durable store
+        (reference routes/pipelines.rs replication-status)."""
+        tenant = _require_tenant(req)
+        row = _pipeline_row(req, tenant)
+        store_path = row[6]
+        if not store_path or not Path(store_path).exists():
+            raise _json_error(404, "pipeline has no durable store")
+        store = SqliteStore(store_path, row[0])
+        await store.connect()
+        try:
+            states = await store.get_table_states()
+            out = []
+            for tid, st in sorted(states.items()):
+                doc = {"table_id": tid, "state": st.type.value}
+                if st.lsn is not None:
+                    doc["lsn"] = str(st.lsn)
+                if st.is_errored:
+                    doc.update(reason=st.reason,
+                               retry_policy=st.retry_policy.value,
+                               retry_attempts=st.retry_attempts)
+                out.append(doc)
+            return web.json_response({"tables": out})
+        finally:
+            await store.close()
+
+    async def rollback_tables(req: web.Request):
+        """Repair op: reset errored tables to Init so they resync
+        (reference routes/pipelines.rs:1372 rollback-tables)."""
+        tenant = _require_tenant(req)
+        row = _pipeline_row(req, tenant)
+        store_path = row[6]
+        if not store_path or not Path(store_path).exists():
+            raise _json_error(404, "pipeline has no durable store")
+        doc = await _json_body(req)
+        table_ids = doc.get("table_ids")
+        store = SqliteStore(store_path, row[0])
+        await store.connect()
+        try:
+            states = await store.get_table_states()
+            targets = [tid for tid in states
+                       if table_ids is None or tid in table_ids]
+            rolled = []
+            for tid in targets:
+                if table_ids is not None or states[tid].is_errored:
+                    await store.reset_table(tid)
+                    rolled.append(tid)
+            return web.json_response({"rolled_back": sorted(rolled)})
+        finally:
+            await store.close()
+
+    r.add_post("/v1/pipelines", create_pipeline)
+    r.add_get("/v1/pipelines", list_pipelines)
+    r.add_get("/v1/pipelines/{id}", get_pipeline)
+    r.add_delete("/v1/pipelines/{id}", delete_pipeline)
+    r.add_post("/v1/pipelines/{id}/start", start_pipeline)
+    r.add_post("/v1/pipelines/{id}/stop", stop_pipeline)
+    r.add_post("/v1/pipelines/{id}/restart", restart_pipeline)
+    r.add_get("/v1/pipelines/{id}/status", pipeline_status)
+    r.add_get("/v1/pipelines/{id}/replication-status", replication_status)
+    r.add_post("/v1/pipelines/{id}/rollback-tables", rollback_tables)
+    return app
+
+
+OPENAPI_DOC = {
+    "openapi": "3.0.0",
+    "info": {"title": "etl_tpu control plane", "version": "0.1.0"},
+    "paths": {
+        "/v1/tenants": {"post": {}, "get": {}},
+        "/v1/sources": {"post": {}, "get": {}},
+        "/v1/sources/{id}": {"get": {}, "put": {}, "delete": {}},
+        "/v1/destinations": {"post": {}, "get": {}},
+        "/v1/destinations/{id}": {"get": {}, "put": {}, "delete": {}},
+        "/v1/pipelines": {"post": {}, "get": {}},
+        "/v1/pipelines/{id}": {"get": {}, "delete": {}},
+        "/v1/pipelines/{id}/start": {"post": {}},
+        "/v1/pipelines/{id}/stop": {"post": {}},
+        "/v1/pipelines/{id}/restart": {"post": {}},
+        "/v1/pipelines/{id}/status": {"get": {}},
+        "/v1/pipelines/{id}/replication-status": {"get": {}},
+        "/v1/pipelines/{id}/rollback-tables": {"post": {}},
+    },
+}

@@ -1,0 +1,79 @@
+"""Config encryption at rest.
+
+Reference parity: etl-api encrypted source/destination configs
+(crates/etl-api/src/configs/encryption.rs) — AES-256-GCM with random
+nonces, key from configuration, plus key-id tagging so keys can rotate
+(the reference ships an encryption-key rotation xtask)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from ..models.errors import ErrorKind, EtlError
+
+
+@dataclass(frozen=True)
+class EncryptionKey:
+    key_id: int
+    key: bytes  # 32 bytes
+
+    @classmethod
+    def generate(cls, key_id: int = 0) -> "EncryptionKey":
+        return cls(key_id, AESGCM.generate_key(256))
+
+    @classmethod
+    def from_base64(cls, key_id: int, b64: str) -> "EncryptionKey":
+        raw = base64.b64decode(b64)
+        if len(raw) != 32:
+            raise EtlError(ErrorKind.CONFIG_INVALID,
+                           "encryption key must be 32 bytes")
+        return cls(key_id, raw)
+
+
+class ConfigCipher:
+    """Encrypt/decrypt JSON config documents; supports multiple keys for
+    rotation (encrypt with the primary, decrypt with any known key)."""
+
+    def __init__(self, primary: EncryptionKey,
+                 others: list[EncryptionKey] | None = None):
+        self._keys = {primary.key_id: primary}
+        for k in others or []:
+            self._keys[k.key_id] = k
+        self._primary = primary
+
+    def encrypt(self, doc: dict) -> str:
+        nonce = os.urandom(12)
+        ct = AESGCM(self._primary.key).encrypt(
+            nonce, json.dumps(doc).encode(), None)
+        envelope = {
+            "key_id": self._primary.key_id,
+            "nonce": base64.b64encode(nonce).decode(),
+            "ciphertext": base64.b64encode(ct).decode(),
+        }
+        return json.dumps(envelope)
+
+    def decrypt(self, raw: str) -> dict:
+        try:
+            env = json.loads(raw)
+            key = self._keys.get(env["key_id"])
+            if key is None:
+                raise EtlError(ErrorKind.CONFIG_INVALID,
+                               f"unknown encryption key id {env['key_id']}")
+            pt = AESGCM(key.key).decrypt(
+                base64.b64decode(env["nonce"]),
+                base64.b64decode(env["ciphertext"]), None)
+            return json.loads(pt)
+        except EtlError:
+            raise
+        except Exception as e:
+            raise EtlError(ErrorKind.CONFIG_INVALID,
+                           f"config decryption failed: {type(e).__name__}")
+
+    def rotate(self, raw: str) -> str:
+        """Re-encrypt an envelope under the primary key (xtask parity)."""
+        return self.encrypt(self.decrypt(raw))

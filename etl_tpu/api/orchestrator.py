@@ -1,0 +1,222 @@
+"""Replicator orchestration: where pipelines actually run.
+
+Reference parity: the `K8sClient` trait (crates/etl-api/src/k8s/base.rs:197)
+with its HTTP implementation (k8s/http.rs, 3.2k LoC) creating per-pipeline
+StatefulSets/Secrets/ConfigMaps — and, crucially, the trait seam that makes
+multi-node fully testable without a cluster (SURVEY §4.7).
+
+Implementations:
+  - K8sOrchestrator: talks to the Kubernetes API over HTTP (fake server in
+    tests) creating the same resource triple per pipeline;
+  - LocalOrchestrator: runs replicator subprocesses on this host — the
+    single-node deployment and the demo path.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import json
+import signal
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+
+import aiohttp
+import yaml
+
+from ..models.errors import ErrorKind, EtlError
+
+
+@dataclass(frozen=True)
+class ReplicatorSpec:
+    pipeline_id: int
+    tenant_id: str
+    config: dict  # full replicator config document (plaintext)
+
+
+@dataclass
+class ReplicatorStatus:
+    pipeline_id: int
+    state: str  # "stopped" | "starting" | "running" | "failed"
+    detail: str = ""
+
+
+class Orchestrator(abc.ABC):
+    @abc.abstractmethod
+    async def start_pipeline(self, spec: ReplicatorSpec) -> None: ...
+
+    @abc.abstractmethod
+    async def stop_pipeline(self, pipeline_id: int) -> None: ...
+
+    @abc.abstractmethod
+    async def status(self, pipeline_id: int) -> ReplicatorStatus: ...
+
+    async def restart_pipeline(self, spec: ReplicatorSpec) -> None:
+        await self.stop_pipeline(spec.pipeline_id)
+        await self.start_pipeline(spec)
+
+    async def shutdown(self) -> None:
+        return None
+
+
+class K8sOrchestrator(Orchestrator):
+    """Creates Secret + ConfigMap + StatefulSet per pipeline, mirroring the
+    reference resource layout (k8s/http.rs)."""
+
+    def __init__(self, *, api_url: str, namespace: str = "etl",
+                 image: str = "etl-tpu-replicator:latest",
+                 token: str = ""):
+        self.api_url = api_url
+        self.namespace = namespace
+        self.image = image
+        self.token = token
+        self._session: aiohttp.ClientSession | None = None
+
+    def _name(self, pipeline_id: int) -> str:
+        return f"etl-replicator-{pipeline_id}"
+
+    async def _api(self, method: str, path: str,
+                   body: dict | None = None) -> tuple[int, dict]:
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        headers = {"Authorization": f"Bearer {self.token}"} if self.token \
+            else {}
+        async with self._session.request(
+                method, f"{self.api_url}{path}", json=body,
+                headers=headers) as resp:
+            text = await resp.text()
+            try:
+                doc = json.loads(text) if text else {}
+            except json.JSONDecodeError:
+                doc = {"raw": text}
+            return resp.status, doc
+
+    async def start_pipeline(self, spec: ReplicatorSpec) -> None:
+        ns = self.namespace
+        name = self._name(spec.pipeline_id)
+        config_yaml = yaml.safe_dump(spec.config)
+        resources = [
+            ("POST", f"/api/v1/namespaces/{ns}/secrets", {
+                "metadata": {"name": f"{name}-secrets"},
+                "stringData": {"config.yaml": config_yaml},
+            }),
+            ("POST", f"/api/v1/namespaces/{ns}/configmaps", {
+                "metadata": {"name": f"{name}-config"},
+                "data": {"pipeline_id": str(spec.pipeline_id),
+                         "tenant_id": spec.tenant_id},
+            }),
+            ("POST", f"/apis/apps/v1/namespaces/{ns}/statefulsets", {
+                "metadata": {"name": name,
+                             "labels": {"app": "etl-replicator",
+                                        "pipeline_id": str(spec.pipeline_id),
+                                        "tenant_id": spec.tenant_id}},
+                "spec": {
+                    "serviceName": name, "replicas": 1,
+                    "selector": {"matchLabels": {"app": name}},
+                    "template": {
+                        "metadata": {"labels": {"app": name}},
+                        "spec": {"containers": [{
+                            "name": "replicator", "image": self.image,
+                            "args": ["--config-dir", "/etc/etl"],
+                            "volumeMounts": [{"name": "config",
+                                              "mountPath": "/etc/etl"}],
+                        }], "volumes": [{
+                            "name": "config",
+                            "secret": {"secretName": f"{name}-secrets"},
+                        }]},
+                    },
+                },
+            }),
+        ]
+        for method, path, body in resources:
+            status, _ = await self._api(method, path, body)
+            if status == 409:  # exists → patch-equivalent: replace
+                put_path = f"{path}/{body['metadata']['name']}"
+                status, _ = await self._api("PUT", put_path, body)
+            if status >= 400:
+                raise EtlError(ErrorKind.DESTINATION_FAILED,
+                               f"k8s {method} {path} → {status}")
+
+    async def stop_pipeline(self, pipeline_id: int) -> None:
+        ns = self.namespace
+        name = self._name(pipeline_id)
+        for path in (f"/apis/apps/v1/namespaces/{ns}/statefulsets/{name}",
+                     f"/api/v1/namespaces/{ns}/secrets/{name}-secrets",
+                     f"/api/v1/namespaces/{ns}/configmaps/{name}-config"):
+            status, _ = await self._api("DELETE", path)
+            if status >= 400 and status != 404:
+                raise EtlError(ErrorKind.DESTINATION_FAILED,
+                               f"k8s DELETE {path} → {status}")
+
+    async def status(self, pipeline_id: int) -> ReplicatorStatus:
+        ns = self.namespace
+        name = self._name(pipeline_id)
+        status, doc = await self._api(
+            "GET", f"/apis/apps/v1/namespaces/{ns}/statefulsets/{name}")
+        if status == 404:
+            return ReplicatorStatus(pipeline_id, "stopped")
+        if status >= 400:
+            return ReplicatorStatus(pipeline_id, "failed",
+                                    f"k8s status {status}")
+        ready = doc.get("status", {}).get("readyReplicas", 0)
+        return ReplicatorStatus(pipeline_id,
+                                "running" if ready else "starting")
+
+    async def shutdown(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class LocalOrchestrator(Orchestrator):
+    """Runs `python -m etl_tpu.replicator` subprocesses on this host."""
+
+    def __init__(self, work_dir: str):
+        self.work_dir = Path(work_dir)
+        self._procs: dict[int, asyncio.subprocess.Process] = {}
+
+    async def start_pipeline(self, spec: ReplicatorSpec) -> None:
+        existing = self._procs.get(spec.pipeline_id)
+        if existing is not None and existing.returncode is None:
+            return
+        conf_dir = self.work_dir / f"pipeline-{spec.pipeline_id}"
+        conf_dir.mkdir(parents=True, exist_ok=True)
+        (conf_dir / "base.yaml").write_text(yaml.safe_dump(spec.config))
+        # logs go to a file: an unread PIPE would block the replicator once
+        # the OS buffer fills (~64KB of log output)
+        log = open(conf_dir / "replicator.log", "ab")
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "etl_tpu.replicator",
+                "--config-dir", str(conf_dir),
+                cwd=str(Path(__file__).resolve().parents[2]),
+                stdout=log, stderr=asyncio.subprocess.STDOUT)
+        finally:
+            log.close()
+        self._procs[spec.pipeline_id] = proc
+
+    async def stop_pipeline(self, pipeline_id: int) -> None:
+        proc = self._procs.pop(pipeline_id, None)
+        if proc is None or proc.returncode is not None:
+            return
+        proc.send_signal(signal.SIGTERM)
+        try:
+            await asyncio.wait_for(proc.wait(), timeout=30)
+        except asyncio.TimeoutError:
+            proc.kill()
+            await proc.wait()
+
+    async def status(self, pipeline_id: int) -> ReplicatorStatus:
+        proc = self._procs.get(pipeline_id)
+        if proc is None:
+            return ReplicatorStatus(pipeline_id, "stopped")
+        if proc.returncode is None:
+            return ReplicatorStatus(pipeline_id, "running")
+        return ReplicatorStatus(
+            pipeline_id, "failed" if proc.returncode else "stopped",
+            f"exit code {proc.returncode}")
+
+    async def shutdown(self) -> None:
+        for pid in list(self._procs):
+            await self.stop_pipeline(pid)

@@ -24,7 +24,7 @@ from dataclasses import dataclass
 
 from ..models.lsn import Lsn
 from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
-from ..runtime.state import TableState
+from ..models.table_state import TableState
 
 # a worker's durable-progress key: the apply worker uses the pipeline slot
 # name, table-sync workers their per-table slot name (reference progress

@@ -14,7 +14,7 @@ from collections import defaultdict
 from ..models.errors import ErrorKind, EtlError
 from ..models.lsn import Lsn
 from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
-from ..runtime.state import TableState, TableStateType
+from ..models.table_state import TableState, TableStateType
 from .base import (DestinationTableMetadata, PipelineStore, ProgressKey)
 
 

@@ -30,7 +30,7 @@ from pathlib import Path
 from ..models.errors import ErrorKind, EtlError
 from ..models.lsn import Lsn
 from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
-from ..runtime.state import TableState
+from ..models.table_state import TableState
 from .base import DestinationTableMetadata, PipelineStore, ProgressKey
 
 MIGRATIONS: list[tuple[str, str]] = [

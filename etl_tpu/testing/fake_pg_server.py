@@ -297,6 +297,20 @@ class FakePgServer:
             self._send_rows(w, ["attnames"], rows)
             return True
 
+        if "FROM pg_replication_slots s" in norm and "LEFT JOIN" in norm:
+            rows = []
+            for slot in db.slots.values():
+                rows.append([
+                    slot.name, "t" if slot.active else "f",
+                    "lost" if slot.invalidated else "reserved",
+                    str(int(db.current_lsn) - int(slot.consistent_point)),
+                    str(int(db.current_lsn) - int(slot.confirmed_flush)),
+                    None, None, None, None])
+            self._send_rows(w, ["slot_name", "active", "wal_status",
+                                "restart_lag", "flush_lag", "safe_wal",
+                                "write_ms", "flush_ms", "replay_ms"], rows)
+            return True
+
         if norm == "SELECT pg_current_wal_lsn()":
             self._send_rows(w, ["pg_current_wal_lsn"], [[str(db.current_lsn)]])
             return True

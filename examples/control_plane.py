@@ -1,0 +1,32 @@
+"""Example: run the control-plane API with the local orchestrator.
+
+POST tenants/sources/destinations/pipelines, then
+POST /v1/pipelines/1/start to launch a replicator subprocess."""
+
+import asyncio
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from aiohttp import web
+
+from etl_tpu.api.app import ApiState, build_app
+from etl_tpu.api.crypto import ConfigCipher, EncryptionKey
+from etl_tpu.api.orchestrator import LocalOrchestrator
+
+
+async def main() -> None:
+    work = tempfile.mkdtemp(prefix="etl-api-")
+    state = ApiState(f"{work}/api.db", ConfigCipher(EncryptionKey.generate()),
+                     LocalOrchestrator(work))
+    runner = web.AppRunner(build_app(state))
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", 8080).start()
+    print("control plane on http://127.0.0.1:8080 (see /openapi.json)")
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
