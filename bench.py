@@ -23,8 +23,8 @@ import time
 
 import numpy as np
 
-N_ROWS = 65_536
-N_ITERS = 5
+N_ROWS = 131_072
+N_ITERS = 7
 CPU_SAMPLE_ROWS = 16_384  # CPU path timed on a sample, scaled (it's O(n))
 
 
@@ -66,7 +66,7 @@ def bench_cpu(payloads, schema, n_rows):
 
     sample = payloads[1 : 1 + CPU_SAMPLE_ROWS]
     times = []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
         ordinal = 0
         for p in sample:
@@ -104,7 +104,7 @@ def bench_tpu(payloads, schema, n_rows):
         for _ in range(n_batches):
             wal = stage()
             pending.append(decoder.decode_async(wal.staged))
-            if len(pending) >= 3:  # keep ≤2 in flight ahead of completion
+            if len(pending) >= 4:  # keep ≤3 in flight ahead of completion
                 batch = pending.pop(0).result()
                 assert batch.num_rows == n_rows
                 done += 1

@@ -40,6 +40,30 @@ def _load() -> ctypes.CDLL | None:
                 check=True, capture_output=True, timeout=120)
         lib = ctypes.CDLL(str(so))
         lib.etl_frame_pgoutput.restype = ctypes.c_int64
+        lib.etl_pack_bmat.restype = None
+        lib.etl_pack_bmat.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,  # data, data_len
+            ctypes.c_void_p, ctypes.c_void_p,  # offsets, lengths [R,C]
+            ctypes.c_int64, ctypes.c_int32,  # n_rows, n_cols
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,  # cols,widths,n
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,  # bmat,tw,lens
+        ]
+        lib.etl_pack_bmat_nibble.restype = None
+        lib.etl_pack_bmat_nibble.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p,  # bad_rows
+        ]
+        lib.etl_gather_string.restype = ctypes.c_int64
+        lib.etl_gather_string.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,  # off,len,valid
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,  # R, C, col
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,  # aoff,vals,cap
+        ]
         lib.etl_frame_pgoutput.argtypes = [
             ctypes.c_void_p, ctypes.c_int64,  # buf, buf_len
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,  # msg_off/len/n
@@ -57,6 +81,10 @@ def _load() -> ctypes.CDLL | None:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def _ptr(a: np.ndarray) -> ctypes.c_void_p:
+    return a.ctypes.data_as(ctypes.c_void_p)
 
 
 class FramedBatch:
@@ -96,9 +124,7 @@ def frame_pgoutput(buf: bytes | np.ndarray, msg_off: np.ndarray,
     out = FramedBatch(data, n, n_cols)
     lib = _load()
     if lib is not None:
-        def p(a):
-            return a.ctypes.data_as(ctypes.c_void_p)
-
+        p = _ptr
         bad = lib.etl_frame_pgoutput(
             p(data), len(data), p(msg_off), p(msg_len), n, n_cols,
             p(out.kind), p(out.relid), p(out.old_kind),
@@ -195,3 +221,47 @@ def _frame_py(data: np.ndarray, msg_off: np.ndarray, msg_len: np.ndarray,
                 out.kind[i] = 0
                 return out, i
     return out, -1
+
+
+def pack_bmat(data, offsets, lengths, col_idx, widths, bmat, lens_out) -> bool:
+    """C fast path for the device byte-matrix pack; False if unavailable."""
+    lib = _load()
+    if lib is None or len(col_idx) > 64:
+        return False
+    p = _ptr
+    R, C = offsets.shape
+    cols = np.ascontiguousarray(col_idx, dtype=np.int32)
+    ws = np.ascontiguousarray(widths, dtype=np.int32)
+    lib.etl_pack_bmat(p(data), len(data), p(offsets), p(lengths), R, C,
+                      p(cols), p(ws), len(cols), p(bmat), bmat.shape[1],
+                      p(lens_out))
+    return True
+
+
+def gather_string(data, offsets, lengths, valid, col,
+                  arrow_offsets, values) -> int:
+    """C fast path for Arrow string gather; -2 if unavailable."""
+    lib = _load()
+    if lib is None:
+        return -2
+    p = _ptr
+    R, C = offsets.shape
+    return lib.etl_gather_string(p(data), len(data), p(offsets), p(lengths),
+                                 p(valid), R, C, col, p(arrow_offsets),
+                                 p(values), len(values))
+
+
+def pack_bmat_nibble(data, offsets, lengths, col_idx, widths, bmat,
+                     lens_out, bad_rows) -> bool:
+    """C nibble pack (two symbols/byte); False if unavailable."""
+    lib = _load()
+    if lib is None or len(col_idx) > 64:
+        return False
+    p = _ptr
+    R, C = offsets.shape
+    cols = np.ascontiguousarray(col_idx, dtype=np.int32)
+    ws = np.ascontiguousarray(widths, dtype=np.int32)
+    lib.etl_pack_bmat_nibble(p(data), len(data), p(offsets), p(lengths), R, C,
+                             p(cols), p(ws), len(cols), p(bmat),
+                             bmat.shape[1], p(lens_out), p(bad_rows))
+    return True

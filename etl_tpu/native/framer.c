@@ -165,3 +165,137 @@ int64_t etl_scan_copy_delims(const uint8_t *buf, int64_t n, int64_t *out,
     }
     return k;
 }
+
+/* Pack dense-column field bytes into the device byte matrix.
+ *
+ * bmat[r, w_off(c)..w_off(c)+min(len, width)) = field bytes, zero elsewhere;
+ * lens_out[r*n_dense + j] = min(len, 255, width). The engine uploads bmat +
+ * lens; this replaces a per-column numpy gather (one pass, cache-friendly).
+ * bmat must be zeroed by the caller (numpy zeros) or dirty regions beyond
+ * lens are never read by the device program anyway — we still zero pad up
+ * to width for deterministic device inputs. */
+void etl_pack_bmat(const uint8_t *data, int64_t data_len,
+                   const int32_t *offsets, const int32_t *lengths,
+                   int64_t n_rows, int32_t n_cols, const int32_t *col_idx,
+                   const int32_t *widths, int32_t n_dense, uint8_t *bmat,
+                   int32_t total_w, uint8_t *lens_out) {
+    /* per-column output offsets */
+    int32_t w_off[64];
+    int32_t acc = 0;
+    for (int32_t j = 0; j < n_dense && j < 64; j++) {
+        w_off[j] = acc;
+        acc += widths[j];
+    }
+    for (int64_t r = 0; r < n_rows; r++) {
+        const int32_t *row_off = offsets + r * n_cols;
+        const int32_t *row_len = lengths + r * n_cols;
+        uint8_t *out_row = bmat + r * total_w;
+        for (int32_t j = 0; j < n_dense; j++) {
+            int32_t c = col_idx[j];
+            int32_t w = widths[j];
+            int32_t len = row_len[c];
+            if (len > w) len = w;
+            int64_t off = row_off[c];
+            if (off < 0 || off + len > data_len) len = 0;
+            uint8_t *dst = out_row + w_off[j];
+            const uint8_t *src = data + off;
+            for (int32_t k = 0; k < len; k++) dst[k] = src[k];
+            for (int32_t k = len; k < w; k++) dst[k] = 0;
+            lens_out[r * n_dense + j] = (uint8_t)(len > 255 ? 255 : len);
+        }
+    }
+}
+
+/* Gather one string column into Arrow layout: contiguous values + int32
+ * offsets[n_rows+1]. valid[r]==0 rows contribute zero bytes. Returns total
+ * bytes written, or -1 if it would exceed cap. */
+int64_t etl_gather_string(const uint8_t *data, int64_t data_len,
+                          const int32_t *offsets, const int32_t *lengths,
+                          const uint8_t *valid, int64_t n_rows,
+                          int32_t n_cols, int32_t col,
+                          int32_t *arrow_offsets, uint8_t *values,
+                          int64_t cap) {
+    int64_t pos = 0;
+    arrow_offsets[0] = 0;
+    for (int64_t r = 0; r < n_rows; r++) {
+        if (valid[r]) {
+            int32_t len = lengths[r * n_cols + col];
+            int64_t off = offsets[r * n_cols + col];
+            if (len < 0 || off < 0 || off + len > data_len) len = 0;
+            if (pos + len > cap) return -1;
+            const uint8_t *src = data + off;
+            uint8_t *dst = values + pos;
+            for (int32_t k = 0; k < len; k++) dst[k] = src[k];
+            pos += len;
+        }
+        arrow_offsets[r + 1] = (int32_t)pos;
+    }
+    return pos;
+}
+
+/* Nibble-packed variant of etl_pack_bmat: two symbols per byte.
+ *
+ * Symbol alphabet (4 bits): 0-9 = digits, 10 '-', 11 '+', 12 '.', 13 ':',
+ * 14 ' ', 15 = pad. Covers int/float(fixed)/date/time/timestamp text;
+ * any other byte (e.g. 'e' exponents, NaN/Infinity) marks the row in
+ * bad_rows for the CPU oracle. Halves the host→device transfer — the
+ * binding resource on a tunnel/PCIe-attached accelerator.
+ * widths[] must all be even; bmat has sum(widths)/2 bytes per row. */
+void etl_pack_bmat_nibble(const uint8_t *data, int64_t data_len,
+                          const int32_t *offsets, const int32_t *lengths,
+                          int64_t n_rows, int32_t n_cols,
+                          const int32_t *col_idx, const int32_t *widths,
+                          int32_t n_dense, uint8_t *bmat, int32_t packed_w,
+                          uint8_t *lens_out, uint8_t *bad_rows) {
+    static uint8_t code_of[256];
+    static int init = 0;
+    if (!init) {
+        for (int i = 0; i < 256; i++) code_of[i] = 0xFF;
+        for (int d = 0; d < 10; d++) code_of['0' + d] = (uint8_t)d;
+        code_of['-'] = 10; code_of['+'] = 11; code_of['.'] = 12;
+        code_of[':'] = 13; code_of[' '] = 14;
+        init = 1;
+    }
+    int32_t w_off[64];
+    int32_t acc = 0;
+    for (int32_t j = 0; j < n_dense && j < 64; j++) {
+        w_off[j] = acc;
+        acc += widths[j] / 2;
+    }
+    for (int64_t r = 0; r < n_rows; r++) {
+        const int32_t *row_off = offsets + r * n_cols;
+        const int32_t *row_len = lengths + r * n_cols;
+        uint8_t *out_row = bmat + r * packed_w;
+        uint8_t bad = 0;
+        for (int32_t j = 0; j < n_dense; j++) {
+            int32_t c = col_idx[j];
+            int32_t w = widths[j];
+            int32_t len = row_len[c];
+            if (len > w) len = w;
+            int64_t off = row_off[c];
+            if (off < 0 || off + len > data_len) len = 0;
+            const uint8_t *src = data + off;
+            uint8_t *dst = out_row + w_off[j];
+            /* PLANAR layout: byte k holds symbol k in the high nibble and
+             * symbol k + w/2 in the low nibble — the device reassembles
+             * with a lane concatenation (interleave reshapes don't lower
+             * under Mosaic). */
+            int32_t half = w / 2;
+            for (int32_t k = 0; k < half; k++) {
+                uint8_t a = 0x0F, b = 0x0F;
+                if (k < len) {
+                    a = code_of[src[k]];
+                    bad |= (uint8_t)(a >> 7);
+                }
+                int32_t k2 = k + half;
+                if (k2 < len) {
+                    b = code_of[src[k2]];
+                    bad |= (uint8_t)(b >> 7);
+                }
+                dst[k] = (uint8_t)((a << 4) | (b & 0x0F));
+            }
+            lens_out[r * n_dense + j] = (uint8_t)(len > 255 ? 255 : len);
+        }
+        bad_rows[r] = bad ? 1 : 0;
+    }
+}

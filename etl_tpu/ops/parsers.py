@@ -34,6 +34,18 @@ SPACE = ord(" ")
 POW10 = np.array([10**k for k in range(9)], dtype=np.int32)
 
 
+def pow10(e: jax.Array) -> jax.Array:
+    """10**clip(e,0,8) as a select chain — no constant-array gather, so the
+    same code lowers under both XLA and Pallas (Pallas kernels cannot
+    capture constant arrays)."""
+    out = jnp.ones_like(e)
+    acc = 1
+    for k in range(1, 9):
+        acc *= 10
+        out = jnp.where(e >= k, acc, out)
+    return out
+
+
 def gather_fields(data: jax.Array, offsets: jax.Array, lengths: jax.Array,
                   width: int) -> jax.Array:
     """Gather each row's field bytes into an int32 `[R, width]` matrix,
@@ -58,7 +70,7 @@ def _digit_limbs(bmat: jax.Array, lengths: jax.Array, start: jax.Array,
     is_digit = (d >= 0) & (d <= 9)
     all_digits = jnp.where(in_range, is_digit, True).all(axis=1)
     r = lengths[:, None] - 1 - pos  # digit position from the right
-    weight = jnp.take(POW10, jnp.clip(r % 9, 0, 8))
+    weight = pow10(r % 9)
     dd = jnp.where(in_range & is_digit, d, 0)
     limbs = []
     for k in range(n_limbs):
@@ -148,7 +160,7 @@ def _parse_hms_at(bmat: jax.Array, lengths: jax.Array, base: int):
                             axis=1) * in_frac_window, axis=1),
         0).astype(jnp.int32)
     k = pos - frac_start  # 0-based frac index
-    scale = jnp.take(POW10, jnp.clip(5 - k, 0, 8))
+    scale = pow10(jnp.clip(5 - k, 0, 8))
     us = jnp.where(frac_digit & (k < run[:, None]), d * scale, 0) \
         .sum(axis=1, dtype=jnp.int32)
     frac_ok = jnp.where(has_dot, run >= 1, True)
@@ -225,11 +237,13 @@ def parse_float(bmat: jax.Array, lengths: jax.Array):
     pos = jnp.arange(L, dtype=jnp.int32)[None, :]
     in_len = pos < lengths[:, None]
 
-    # specials: NaN / Infinity / -Infinity
+    # specials: NaN / Infinity / -Infinity (per-position scalar compares —
+    # no captured constant arrays, Pallas-compatible)
     def match(lit: bytes):
-        arr = np.zeros(L, dtype=np.int32)
-        arr[: len(lit)] = np.frombuffer(lit, dtype=np.uint8)
-        return (lengths == len(lit)) & (bmat == jnp.asarray(arr)).all(axis=1)
+        ok = lengths == len(lit)
+        for i, ch in enumerate(lit):
+            ok = ok & (bmat[:, i] == ch)
+        return ok
 
     is_nan = match(b"NaN")
     is_pinf = match(b"Infinity")
@@ -263,7 +277,7 @@ def parse_float(bmat: jax.Array, lengths: jax.Array):
     r = jnp.where(before_dot,
                   (dot_pos[:, None] - 1 - pos) + frac_count[:, None],
                   e_pos[:, None] - 1 - pos)
-    weight = jnp.take(POW10, jnp.clip(r % 9, 0, 8))
+    weight = pow10(r % 9)
     dd = jnp.where(mant_sel & is_digit, d, 0)
     limb0 = jnp.where(mant_sel & (r // 9 == 0), dd * weight, 0) \
         .sum(axis=1, dtype=jnp.int32)
@@ -282,7 +296,7 @@ def parse_float(bmat: jax.Array, lengths: jax.Array):
     exp_valid = jnp.where(exp_sel, is_digit, True).all(axis=1) \
         & jnp.where(has_e, lengths > exp_d_start, True)
     re = lengths[:, None] - 1 - pos
-    eweight = jnp.take(POW10, jnp.clip(re % 9, 0, 8))
+    eweight = pow10(re % 9)
     exp_val = jnp.where(exp_sel & is_digit & (re // 9 == 0), d * eweight, 0) \
         .sum(axis=1, dtype=jnp.int32)
     exp_val = jnp.where(exp_neg, -exp_val, exp_val)
@@ -378,3 +392,27 @@ def parse_column(kind, bmat: jax.Array, lengths: jax.Array):
             bmat, lengths, with_tz=kind is CellKind.TIMESTAMPTZ)
         return {"days": days, "ms": ms - tz * 1000, "us": us}, ok
     raise AssertionError(kind)
+
+
+def _nibble_to_ascii(code: jax.Array) -> jax.Array:
+    """Symbol code → ASCII (framer.c alphabet) via select chain (no
+    constant-array gather; Pallas-compatible)."""
+    out = ord("0") + code  # digits 0-9
+    out = jnp.where(code == 10, ord("-"), out)
+    out = jnp.where(code == 11, ord("+"), out)
+    out = jnp.where(code == 12, ord("."), out)
+    out = jnp.where(code == 13, ord(":"), out)
+    out = jnp.where(code == 14, ord(" "), out)
+    out = jnp.where(code == 15, 0, out)
+    return out
+
+
+def unpack_nibbles(packed: jax.Array, width: int) -> jax.Array:
+    """u8[R, W/2] planar nibble pairs → int32[R, W] ASCII bytes: byte k
+    carries symbol k (high nibble) and symbol k + W/2 (low nibble), so
+    reassembly is one lane concatenation (Mosaic-friendly — no interleave
+    reshape)."""
+    p = packed.astype(jnp.int32)
+    hi = (p >> 4) & 0xF
+    lo = p & 0xF
+    return _nibble_to_ascii(jnp.concatenate([hi, lo], axis=1))

@@ -27,7 +27,7 @@ from ..models.errors import ErrorKind, EtlError
 from ..postgres.codec.pgoutput import (TUPLE_NULL, TUPLE_TEXT,
                                        TUPLE_UNCHANGED_TOAST, TupleData)
 
-ROW_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144)
+ROW_BUCKETS = (256, 1024, 4096, 16384, 65536, 131072, 262144)
 
 
 def bucket_rows(n: int) -> int:

@@ -42,22 +42,39 @@ def _staged_from(framed: FramedBatch, rows: np.ndarray, off: np.ndarray,
     n = len(rows)
     cap = bucket_rows(n) if n else 0
     n_cols = off.shape[1]
+    contiguous = n > 0 and int(rows[-1]) - int(rows[0]) == n - 1
+    if contiguous and cap == n:
+        # common fast path (a full bucket of row messages): slice views
+        # into the framed arrays, no copies
+        lo, hi = int(rows[0]), int(rows[0]) + n
+        f = flag[lo:hi]
+        return StagedBatch(framed.buf, off[lo:hi], ln[lo:hi],
+                           f == FLAG_NULL, f == FLAG_TOAST, n,
+                           cpu_fallback_rows=_binary_fallback(f))
     offsets = np.zeros((cap, n_cols), dtype=np.int32)
     lengths = np.zeros((cap, n_cols), dtype=np.int32)
     nulls = np.ones((cap, n_cols), dtype=np.bool_)
     toast = np.zeros((cap, n_cols), dtype=np.bool_)
+    fallback = np.zeros(0, dtype=np.int64)
     if n:
-        offsets[:n] = off[rows]
-        lengths[:n] = ln[rows]
-        f = flag[rows]
+        src = slice(int(rows[0]), int(rows[0]) + n) if contiguous else rows
+        offsets[:n] = off[src]
+        lengths[:n] = ln[src]
+        f = flag[src]
         nulls[:n] = f == FLAG_NULL
         toast[:n] = f == FLAG_TOAST
-    if n and (flag[rows] == FLAG_BINARY).any():
+        fallback = _binary_fallback(f)
+    return StagedBatch(framed.buf, offsets, lengths, nulls, toast, n,
+                       cpu_fallback_rows=fallback)
+
+
+def _binary_fallback(flags: np.ndarray) -> np.ndarray:
+    if (flags == FLAG_BINARY).any():
         # binary tuple format is never requested; decoding it as text (in
         # either the device or the CPU-fixup path) would corrupt values
         raise EtlError(ErrorKind.UNSUPPORTED_TYPE,
                        "binary tuple format not enabled in START_REPLICATION")
-    return StagedBatch(framed.buf, offsets, lengths, nulls, toast, n)
+    return np.zeros(0, dtype=np.int64)
 
 
 def stage_wal_batch(buf: bytes | np.ndarray, msg_off: np.ndarray,
@@ -82,14 +99,18 @@ def stage_wal_batch(buf: bytes | np.ndarray, msg_off: np.ndarray,
     change[is_u[row_idx]] = ChangeType.UPDATE
     change[is_d[row_idx]] = ChangeType.DELETE
 
-    # main tuple: new for I/U, old for D
-    off = framed.new_off.copy()
-    ln = framed.new_len.copy()
-    fl = framed.new_flag.copy()
+    # main tuple: new for I/U, old for D (no copies when the batch has no
+    # deletes — the common insert/update-heavy case)
     d_rows = np.flatnonzero(is_d)
-    off[d_rows] = framed.old_off[d_rows]
-    ln[d_rows] = framed.old_len[d_rows]
-    fl[d_rows] = framed.old_flag[d_rows]
+    if len(d_rows):
+        off = framed.new_off.copy()
+        ln = framed.new_len.copy()
+        fl = framed.new_flag.copy()
+        off[d_rows] = framed.old_off[d_rows]
+        ln[d_rows] = framed.old_len[d_rows]
+        fl[d_rows] = framed.old_flag[d_rows]
+    else:
+        off, ln, fl = framed.new_off, framed.new_len, framed.new_flag
     staged = _staged_from(framed, row_idx, off, ln, fl)
 
     # old tuples for updates that sent one

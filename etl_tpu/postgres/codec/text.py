@@ -44,10 +44,12 @@ def parse_bool(text: str) -> bool:
 
 
 def parse_int(text: str) -> int:
-    try:
-        return int(text)
-    except ValueError as e:
-        raise _invalid("integer", text, e)
+    # strict: Python's int() accepts underscores/whitespace which Postgres
+    # never emits — the oracle must reject what the device rejects
+    body = text[1:] if text[:1] in "+-" else text
+    if not body.isdigit():
+        raise _invalid("integer", text)
+    return int(text)
 
 
 def parse_float(text: str) -> float:

@@ -344,3 +344,22 @@ class TestReviewRegressions:
         with pytest.raises(EtlError) as ei:
             stage_tuples([tup], 1)
         assert ei.value.kind is ErrorKind.UNSUPPORTED_TYPE
+
+
+class TestPallasKernel:
+    """The Pallas program (interpret mode on CPU) must agree with the XLA
+    program bit-for-bit; on TPU the engine falls back to XLA automatically
+    if Mosaic rejects the lowering."""
+
+    def test_pallas_matches_xla(self):
+        oids = [Oid.INT4, Oid.INT8, Oid.DATE, Oid.TIMESTAMPTZ]
+        rows = []
+        for i in range(256):
+            rows.append([str(i - 128), str(rng.randrange(-2**62, 2**62)),
+                         f"20{i % 100:02d}-03-{1 + i % 28:02d}",
+                         f"2024-05-01 12:{i % 60:02d}:33.25+0{i % 9}"])
+        schema = make_schema(oids)
+        staged = stage_tuples(tuples_from_texts(rows), len(oids))
+        a = DeviceDecoder(schema).decode(staged)
+        b = DeviceDecoder(schema, use_pallas=True).decode(staged)
+        assert_batches_equal(a, b)
