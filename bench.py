@@ -117,7 +117,29 @@ def bench_tpu(payloads, schema, n_rows):
 
 
 def main():
+    import argparse
+
     import jax
+
+    parser = argparse.ArgumentParser(prog="bench.py")
+    parser.add_argument("--mode", default="decode",
+                        choices=["decode", "table_copy", "table_streaming",
+                                 "wide_row"])
+    parser.add_argument("--engine", default="tpu", choices=["tpu", "cpu"])
+    args = parser.parse_args()
+    if args.mode != "decode":
+        import asyncio
+
+        from etl_tpu.benchmarks import harness
+
+        if args.mode == "table_copy":
+            out = asyncio.run(harness.run_table_copy(engine=args.engine))
+        elif args.mode == "table_streaming":
+            out = asyncio.run(harness.run_table_streaming(engine=args.engine))
+        else:
+            out = harness.run_wide_row()
+        print(json.dumps(out))
+        return
 
     payloads = build_workload(N_ROWS)
     schema = make_schema()

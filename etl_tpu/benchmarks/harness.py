@@ -1,0 +1,226 @@
+"""Benchmark harness: the reference etl-benchmarks surface.
+
+Modes (reference crates/etl-benchmarks/src/{table_copy,table_streaming}.rs):
+  decode           WAL records/sec decoded, TPU vs CPU (bench.py default)
+  table_copy       full-pipeline initial copy: rows/s, MiB/s, phase timings
+  table_streaming  CDC through the pipeline: producer + end-to-end events/s
+  wide_row         100-column mixed-type decode (BASELINE.json config)
+
+Each mode emits a JSON report; `python -m etl_tpu.benchmarks.compare A B`
+diffs two reports (reference `cargo x benchmark-compare`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import statistics
+import time
+
+
+def _median(xs):
+    return statistics.median(xs)
+
+
+# ---------------------------------------------------------------------------
+# table_copy (reference table_copy.rs:74-183)
+# ---------------------------------------------------------------------------
+
+
+async def run_table_copy(n_rows: int = 100_000, samples: int = 3,
+                         engine: str = "tpu") -> dict:
+    from ..config import BatchConfig, BatchEngine, PipelineConfig
+    from ..destinations import MemoryDestination
+    from ..models import ColumnSchema, Oid, TableName, TableSchema
+    from ..models.table_state import TableStateType
+    from ..postgres.fake import FakeDatabase, FakeSource
+    from ..runtime import Pipeline
+    from ..store import NotifyingStore
+
+    TID = 16384
+    rows = [[str(i), str(i % 100), str(i * 7 % 10**9), "x" * 64]
+            for i in range(n_rows)]
+    bytes_estimate = sum(len("\t".join(r)) + 1 for r in rows[:1000]) \
+        * (n_rows / min(1000, max(1, n_rows)))
+
+    results = []
+    for _ in range(samples):
+        db = FakeDatabase()
+        db.create_table(TableSchema(
+            TID, TableName("public", "bench_copy"),
+            (ColumnSchema("id", Oid.INT8, nullable=False,
+                          primary_key_ordinal=1),
+             ColumnSchema("bucket", Oid.INT4),
+             ColumnSchema("val", Oid.INT8),
+             ColumnSchema("filler", Oid.TEXT))), rows=rows)
+        db.create_publication("pub", [TID])
+        store = NotifyingStore()
+        pipeline = Pipeline(
+            config=PipelineConfig(
+                pipeline_id=1, publication_name="pub",
+                batch=BatchConfig(max_fill_ms=40,
+                                  batch_engine=BatchEngine(engine))),
+            store=store, destination=MemoryDestination(),
+            source_factory=lambda: FakeSource(db))
+        t0 = time.perf_counter()
+        await pipeline.start()
+        t_started = time.perf_counter()
+        await asyncio.wait_for(store.notify_on(TID, TableStateType.READY), 300)
+        t_copied = time.perf_counter()
+        await pipeline.shutdown_and_wait()
+        t_done = time.perf_counter()
+        results.append({
+            "pipeline_start_ms": (t_started - t0) * 1000,
+            "copy_wait_ms": (t_copied - t_started) * 1000,
+            "shutdown_ms": (t_done - t_copied) * 1000,
+            "total_ms": (t_done - t0) * 1000,
+            "rows_per_second": n_rows / (t_copied - t_started),
+            "estimated_mib_per_second":
+                bytes_estimate / (1 << 20) / (t_copied - t_started),
+        })
+    agg = {k: _median([r[k] for r in results]) for k in results[0]}
+    return {"mode": "table_copy", "rows": n_rows, "samples": samples,
+            "engine": engine, **{k: round(v, 2) for k, v in agg.items()}}
+
+
+# ---------------------------------------------------------------------------
+# table_streaming (reference table_streaming.rs:86-118)
+# ---------------------------------------------------------------------------
+
+
+async def run_table_streaming(n_events: int = 20_000, tx_size: int = 500,
+                              engine: str = "tpu") -> dict:
+    from ..config import BatchConfig, BatchEngine, PipelineConfig
+    from ..destinations import MemoryDestination
+    from ..models import (ColumnSchema, InsertEvent, Oid, TableName,
+                          TableSchema)
+    from ..models.table_state import TableStateType
+    from ..postgres.fake import FakeDatabase, FakeSource
+    from ..runtime import Pipeline
+    from ..store import NotifyingStore
+
+    TID = 16385
+    db = FakeDatabase()
+    db.create_table(TableSchema(
+        TID, TableName("public", "bench_stream"),
+        (ColumnSchema("id", Oid.INT8, nullable=False, primary_key_ordinal=1),
+         ColumnSchema("v", Oid.INT4),
+         ColumnSchema("note", Oid.TEXT))))
+    db.create_publication("pub", [TID])
+    store = NotifyingStore()
+    dest = MemoryDestination()
+    pipeline = Pipeline(
+        config=PipelineConfig(
+            pipeline_id=1, publication_name="pub",
+            batch=BatchConfig(max_fill_ms=30,
+                              batch_engine=BatchEngine(engine))),
+        store=store, destination=dest,
+        source_factory=lambda: FakeSource(db))
+    await pipeline.start()
+    await asyncio.wait_for(store.notify_on(TID, TableStateType.READY), 60)
+
+    t_prod0 = time.perf_counter()
+    produced = 0
+    while produced < n_events:
+        async with db.transaction() as tx:
+            for _ in range(min(tx_size, n_events - produced)):
+                tx.insert(TID, [str(produced), str(produced % 97),
+                                f"note-{produced}"])
+                produced += 1
+    t_prod1 = time.perf_counter()
+
+    def delivered():
+        return sum(1 for e in dest.events if isinstance(e, InsertEvent))
+
+    async def wait_delivered():
+        while delivered() < n_events:
+            if pipeline._apply_task is not None \
+                    and pipeline._apply_task.done():
+                pipeline._apply_task.result()  # surface the pipeline error
+                raise RuntimeError("pipeline stopped before delivering")
+            await asyncio.sleep(0.02)
+
+    await asyncio.wait_for(wait_delivered(), timeout=300)
+    t_e2e = time.perf_counter()
+    await pipeline.shutdown_and_wait()
+    t_drain = time.perf_counter()
+    # NOTE: CDC flush runs are far below DeviceDecoder.DEVICE_MIN_ROWS, so
+    # this mode measures the host decode path for both engines (the hybrid
+    # threshold routes small runs to the CPU oracle by design); the device
+    # path is measured by the decode and wide_row modes.
+    return {
+        "mode": "table_streaming", "events": n_events, "engine": engine,
+        "producer_events_per_second":
+            round(n_events / (t_prod1 - t_prod0)),
+        "end_to_end_events_per_second":
+            round(n_events / (t_e2e - t_prod0)),
+        "end_to_end_with_shutdown_events_per_second":
+            round(n_events / (t_drain - t_prod0)),
+        "throughput_events": delivered(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# wide_row (BASELINE.json config: 100-col mixed types)
+# ---------------------------------------------------------------------------
+
+
+def run_wide_row(n_rows: int = 16_384, n_iters: int = 5) -> dict:
+    import random
+
+    from ..models import (ColumnSchema, Oid, ReplicatedTableSchema,
+                          TableName, TableSchema)
+    from ..ops import DeviceDecoder, stage_tuples
+    from ..postgres.codec.pgoutput import TUPLE_NULL, TUPLE_TEXT, TupleData
+
+    rng = random.Random(11)
+    kinds = [Oid.INT8, Oid.INT4, Oid.NUMERIC, Oid.TEXT, Oid.TIMESTAMPTZ,
+             Oid.DATE, Oid.BOOL, Oid.FLOAT8, Oid.JSONB, Oid.UUID]
+    oids = [kinds[i % len(kinds)] for i in range(100)]
+    cols = tuple(ColumnSchema(f"c{i}", oid) for i, oid in enumerate(oids))
+    schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+        9, TableName("public", "wide"), cols))
+
+    def text_for(oid):
+        if oid == Oid.INT8:
+            return str(rng.randrange(-10**12, 10**12))
+        if oid == Oid.INT4:
+            return str(rng.randrange(-10**9, 10**9))
+        if oid == Oid.NUMERIC:
+            return f"{rng.randrange(0, 10**8)}.{rng.randrange(0, 100):02d}"
+        if oid == Oid.TEXT:
+            return "text-" + str(rng.randrange(10**6))
+        if oid == Oid.TIMESTAMPTZ:
+            return "2024-05-01 12:34:56.789+00"
+        if oid == Oid.DATE:
+            return "2024-05-01"
+        if oid == Oid.BOOL:
+            return rng.choice(["t", "f"])
+        if oid == Oid.FLOAT8:
+            return f"{rng.uniform(-1e6, 1e6):.6f}"
+        if oid == Oid.JSONB:
+            return '{"k": %d}' % rng.randrange(1000)
+        return "a0eebc99-9c0b-4ef8-bb6d-6bb9bd380a11"
+
+    tuples = []
+    for _ in range(n_rows):
+        vals = []
+        for oid in oids:
+            if rng.random() < 0.05:
+                vals.append(None)
+            else:
+                vals.append(text_for(oid).encode())
+        tuples.append(TupleData(
+            [TUPLE_NULL if v is None else TUPLE_TEXT for v in vals], vals))
+
+    staged = stage_tuples(tuples, 100)
+    dec = DeviceDecoder(schema)
+    dec.decode(staged)  # warmup
+    times = []
+    for _ in range(n_iters):
+        t0 = time.perf_counter()
+        dec.decode(staged)
+        times.append(time.perf_counter() - t0)
+    rps = n_rows / _median(times)
+    return {"mode": "wide_row", "rows": n_rows, "columns": 100,
+            "rows_per_second": round(rps),
+            "cells_per_second": round(rps * 100)}

@@ -188,10 +188,18 @@ class DeviceDecoder:
     """Schema-bound batch decoder. jit caches are per-instance, keyed by
     (row_capacity, width-signature)."""
 
+    # below this row count the device round trip (latency-bound) loses to
+    # the CPU oracle; small CDC flushes decode on host, WAL bursts and copy
+    # partitions go to the device
+    DEVICE_MIN_ROWS = 8192
+
     def __init__(self, schema: ReplicatedTableSchema, *,
-                 numeric_mode: str = "text", use_pallas: bool = False):
+                 numeric_mode: str = "text", use_pallas: bool = False,
+                 device_min_rows: int | None = None):
         self.schema = schema
         self.use_pallas = use_pallas
+        self.device_min_rows = self.DEVICE_MIN_ROWS \
+            if device_min_rows is None else device_min_rows
         cols = schema.replicated_columns
         self._numeric_mode = numeric_mode
         self._dense: list[_ColSpec] = []
@@ -406,27 +414,36 @@ class DeviceDecoder:
 
         columns: list[Column] = [None] * len(cols)  # type: ignore[list-item]
         fallback = set(int(r) for r in staged.cpu_fallback_rows)
+        if packed_np is None and self._dense:
+            # small batch: every row goes to the oracle once; skip the
+            # per-column width/ok machinery entirely
+            fallback.update(range(n))
         if bad_rows is not None:
             # nibble pack flagged bytes outside the symbol alphabet
             fallback.update(np.flatnonzero(bad_rows[:n]).tolist())
-        for spec, w in zip(self._dense, widths):
-            if staged.max_field_len(spec.index) > w:
-                too_big = staged.lengths[:n, spec.index] > w
-                fallback.update(np.flatnonzero(too_big).tolist())
+        if packed_np is not None:
+            for spec, w in zip(self._dense, widths):
+                if staged.max_field_len(spec.index) > w:
+                    too_big = staged.lengths[:n, spec.index] > w
+                    fallback.update(np.flatnonzero(too_big).tolist())
 
         row_off = 1  # row 0 = ok bitfield
         okbits = packed_np[0] if packed_np is not None else None
         for j, spec in enumerate(self._dense):
-            k = _PACK_ROWS[spec.kind]
-            rows = packed_np[row_off : row_off + k]
-            row_off += k
             valid = valid_full[:n, spec.index].copy()
-            ok = (okbits.astype(np.int32) >> j) & 1
-            bad = (ok[:n] == 0) & valid
-            if bad.any():
-                fallback.update(np.flatnonzero(bad).tolist())
-            data = _combine(spec.kind, rows[:, :n]).copy()
             toast_col = staged.toast[:n, spec.index]
+            if packed_np is None:
+                # small batch: host decode of every row via the oracle
+                data = np.zeros(n, dtype=dense_dtype(spec.kind))
+            else:
+                k = _PACK_ROWS[spec.kind]
+                rows = packed_np[row_off : row_off + k]
+                row_off += k
+                ok = (okbits.astype(np.int32) >> j) & 1
+                bad = (ok[:n] == 0) & valid
+                if bad.any():
+                    fallback.update(np.flatnonzero(bad).tolist())
+                data = _combine(spec.kind, rows[:, :n]).copy()
             columns[spec.index] = Column(
                 cols[spec.index], data, valid,
                 toast_col if toast_col.any() else None)
@@ -458,10 +475,11 @@ class DeviceDecoder:
             raise ValueError(
                 f"staged batch has {staged.n_cols} cols, schema expects "
                 f"{len(cols)}")
-        widths = self._widths(staged)
-        if self._dense:
+        if self._dense and staged.n_rows >= self.device_min_rows:
+            widths = self._widths(staged)
             packed, bad_rows = self._device_call(staged, widths)
         else:
+            widths = ()
             packed, bad_rows = None, None
         return _PendingDecode(self, staged, widths, packed, bad_rows)
 

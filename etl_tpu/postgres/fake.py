@@ -65,6 +65,7 @@ class FakeDatabase:
         self.snapshots: dict[str, dict[TableId, list[list[str | None]]]] = {}
         self.slots: dict[str, _FakeSlot] = {}
         self._wal_cond = asyncio.Condition()
+        self.active_streams: list["_FakeReplicationStream"] = []
         self._snapshot_seq = 0
         self._relation_sent: set[tuple[int, int]] = set()  # (stream id, table)
 
@@ -103,6 +104,13 @@ class FakeDatabase:
 
     def invalidate_slot(self, name: str) -> None:
         self.slots[name].invalidated = True
+
+    async def sever_streams(self) -> None:
+        """Chaos helper: cut every live replication stream (the
+        NetworkChaos partition analogue)."""
+        for s in list(self.active_streams):
+            await s.close()
+        self.active_streams.clear()
 
     # -- walsender internals ---------------------------------------------------
 
@@ -241,6 +249,7 @@ class _FakeReplicationStream(ReplicationStream):
         _FakeReplicationStream._ids += 1
         self.id = _FakeReplicationStream._ids
         self._wal_index = 0
+        db.active_streams.append(self)
 
     def __aiter__(self) -> AsyncIterator[pgoutput.ReplicationFrame]:
         return self._frames()
@@ -299,6 +308,8 @@ class _FakeReplicationStream(ReplicationStream):
     async def close(self) -> None:
         self._closed = True
         self.slot.active = False
+        if self in self.db.active_streams:
+            self.db.active_streams.remove(self)
 
 
 class _FakeCopyStream(CopyStream):

@@ -91,7 +91,7 @@ class TestWalStaging:
         assert list(wal.non_row_indices) == [0, 5]  # begin, commit
         assert (wal.relids == 42).all()
 
-        batch = DeviceDecoder(self.make_schema()).decode(wal.staged)
+        batch = DeviceDecoder(self.make_schema(), device_min_rows=0).decode(wal.staged)
         assert batch.num_rows == 4
         np.testing.assert_array_equal(batch.columns[0].data, [1, 2, 1, 2])
         assert batch.columns[1].value(0) == "alice"
@@ -107,7 +107,7 @@ class TestWalStaging:
         assert wal.old_staged is not None
         assert list(wal.old_rows) == [2]  # the update row
         assert list(wal.old_is_key) == [True]
-        old = DeviceDecoder(self.make_schema()).decode(wal.old_staged)
+        old = DeviceDecoder(self.make_schema(), device_min_rows=0).decode(wal.old_staged)
         assert old.columns[0].data[0] == 1
 
     def test_malformed_batch_reports_bad_from(self):

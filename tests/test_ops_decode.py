@@ -44,7 +44,10 @@ def decode_both(col_oids, text_rows):
     """Decode text rows via device engine and CPU oracle; return both."""
     schema = make_schema(col_oids)
     staged = stage_tuples(tuples_from_texts(text_rows), len(col_oids))
-    dev_batch = DeviceDecoder(schema).decode(staged)
+    # device_min_rows=0: differential tests must exercise the device path
+    # (the production default routes small batches to the CPU oracle, which
+    # would make this comparison vacuous)
+    dev_batch = DeviceDecoder(schema, device_min_rows=0).decode(staged)
     cpu_rows = [
         TableRow([None if v is None else
                   __import__("etl_tpu.postgres.codec.text",
@@ -209,7 +212,7 @@ class TestObjectColumns:
     def test_numeric_f64_mode(self):
         schema = make_schema([Oid.NUMERIC])
         staged = stage_tuples(tuples_from_texts([["12.5"], ["-3"]]), 1)
-        batch = DeviceDecoder(schema, numeric_mode="f64").decode(staged)
+        batch = DeviceDecoder(schema, numeric_mode="f64", device_min_rows=0).decode(staged)
         assert batch.columns[0].is_dense
         np.testing.assert_array_equal(batch.columns[0].data, [12.5, -3.0])
 
@@ -218,7 +221,7 @@ class TestToastAndNulls:
     def test_toast_passthrough(self):
         schema = make_schema([Oid.INT4, Oid.TEXT])
         tup = TupleData([TUPLE_TEXT, TUPLE_UNCHANGED_TOAST], [b"5", None])
-        batch = DeviceDecoder(schema).decode(stage_tuples([tup], 2))
+        batch = DeviceDecoder(schema, device_min_rows=0).decode(stage_tuples([tup], 2))
         assert batch.columns[0].data[0] == 5
         assert not batch.columns[1].validity[0]
         assert batch.columns[1].is_toast_unchanged(0)
@@ -241,7 +244,7 @@ class TestCopyStaging:
         assert staged.n_rows == 50
         assert len(staged.cpu_fallback_rows) == 0
         schema = make_schema([Oid.INT4, Oid.TEXT, Oid.FLOAT8])
-        batch = DeviceDecoder(schema).decode(staged)
+        batch = DeviceDecoder(schema, device_min_rows=0).decode(staged)
         for i, texts in enumerate(expected):
             assert batch.columns[0].data[i] == i
             if texts[1] is None:
@@ -256,7 +259,7 @@ class TestCopyStaging:
         staged = stage_copy_chunk(b"\n".join(lines) + b"\n", 2)
         assert list(staged.cpu_fallback_rows) == [1]
         schema = make_schema([Oid.INT4, Oid.TEXT])
-        batch = DeviceDecoder(schema).decode(staged)
+        batch = DeviceDecoder(schema, device_min_rows=0).decode(staged)
         assert batch.columns[1].value(1) == "tab\there"
         assert not batch.columns[1].validity[2]
 
@@ -278,7 +281,7 @@ class TestCopyStaging:
             cpu_rows.append(parse_copy_row(line, oids))
         staged = stage_copy_chunk(b"\n".join(lines) + b"\n", 4)
         schema = make_schema(oids)
-        dev = DeviceDecoder(schema).decode(staged)
+        dev = DeviceDecoder(schema, device_min_rows=0).decode(staged)
         cpu = ColumnarBatch.from_rows(schema, cpu_rows)
         assert_batches_equal(dev, cpu)
 
@@ -286,7 +289,7 @@ class TestCopyStaging:
 class TestBuckets:
     def test_jit_cache_reuse_across_sizes(self):
         schema = make_schema([Oid.INT4])
-        dec = DeviceDecoder(schema)
+        dec = DeviceDecoder(schema, device_min_rows=0)
         for n in (3, 100, 250):  # all inside the 256 bucket
             staged = stage_tuples(tuples_from_texts([[str(i)] for i in range(n)]), 1)
             batch = dec.decode(staged)
@@ -297,7 +300,7 @@ class TestBuckets:
         schema = make_schema([Oid.TEXT, Oid.INT4])
         big = "x" * 5000
         staged = stage_tuples(tuples_from_texts([[big, "7"]]), 2)
-        batch = DeviceDecoder(schema).decode(staged)
+        batch = DeviceDecoder(schema, device_min_rows=0).decode(staged)
         assert batch.columns[0].value(0) == big
         assert batch.columns[1].data[0] == 7
 
@@ -324,7 +327,7 @@ class TestReviewRegressions:
     def test_numeric_f64_to_arrow(self):
         schema = make_schema([Oid.NUMERIC])
         staged = stage_tuples(tuples_from_texts([["12.5"], [None]]), 1)
-        batch = DeviceDecoder(schema, numeric_mode="f64").decode(staged)
+        batch = DeviceDecoder(schema, numeric_mode="f64", device_min_rows=0).decode(staged)
         rb = batch.to_arrow()
         assert rb.column(0).to_pylist() == [12.5, None]
         assert batch.to_rows()[0].values[0] == 12.5
@@ -333,7 +336,7 @@ class TestReviewRegressions:
         schema = make_schema([Oid.JSONB])
         staged = stage_tuples(tuples_from_texts(
             [["null"], [None], ['{"a": 1}']]), 1)
-        batch = DeviceDecoder(schema).decode(staged)
+        batch = DeviceDecoder(schema, device_min_rows=0).decode(staged)
         rb = batch.to_arrow()
         assert rb.column(0).to_pylist() == ["null", None, '{"a": 1}']
 
@@ -360,6 +363,6 @@ class TestPallasKernel:
                          f"2024-05-01 12:{i % 60:02d}:33.25+0{i % 9}"])
         schema = make_schema(oids)
         staged = stage_tuples(tuples_from_texts(rows), len(oids))
-        a = DeviceDecoder(schema).decode(staged)
-        b = DeviceDecoder(schema, use_pallas=True).decode(staged)
+        a = DeviceDecoder(schema, device_min_rows=0).decode(staged)
+        b = DeviceDecoder(schema, use_pallas=True, device_min_rows=0).decode(staged)
         assert_batches_equal(a, b)

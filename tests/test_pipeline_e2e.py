@@ -373,3 +373,69 @@ class TestReviewRegressions:
         assert await loop._table_owned(ACCOUNTS)
         loop.state.current_commit_lsn = Lsn(0x6000)
         assert await loop._table_owned(ACCOUNTS)
+
+
+class TestSchemaChanges:
+    async def test_ddl_message_versions_schema_and_reaches_destination(self):
+        """DDL logical messages (the source event-trigger payload) version
+        the schema store and flow to the destination
+        (reference pipelines_with_schema_changes.rs)."""
+        from etl_tpu.models import SchemaChangeEvent
+        from etl_tpu.models.schema import ColumnSchema as CS, TableSchema as TS
+        from etl_tpu.postgres.codec.event import (DDL_MESSAGE_PREFIX,
+                                                  encode_schema_change)
+
+        db = make_db()
+        db.create_publication("pub", [ACCOUNTS])
+        pipeline, store, dest = make_pipeline(db)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+
+        old = db.tables[ACCOUNTS].schema
+        new_schema = TS(ACCOUNTS, old.name, old.columns
+                        + (CS("added_col", Oid.TEXT),))
+        async with db.transaction() as tx:
+            tx.logical_message(DDL_MESSAGE_PREFIX,
+                               encode_schema_change(ACCOUNTS, new_schema))
+        await _wait_for(lambda: any(isinstance(e, SchemaChangeEvent)
+                                    for e in dest.events))
+        ev = next(e for e in dest.events if isinstance(e, SchemaChangeEvent))
+        assert [c.name for c in ev.new_schema.table_schema.columns][-1] == \
+            "added_col"
+        # versioned store: old schema still readable below the DDL LSN
+        versions = await store.get_schema_versions(ACCOUNTS)
+        assert len(versions) == 2
+        at_old = await store.get_table_schema(ACCOUNTS,
+                                              at_snapshot=versions[0])
+        assert len(at_old.table_schema.columns) == 3
+        latest = await store.get_table_schema(ACCOUNTS)
+        assert len(latest.table_schema.columns) == 4
+        await pipeline.shutdown_and_wait()
+
+
+class TestConnectionChaos:
+    async def test_stream_drop_mid_cdc_recovers(self):
+        """Severing the replication stream mid-CDC (the NetworkChaos
+        analogue, SURVEY §4.8) must retry and deliver everything exactly
+        once past the durable watermark."""
+        from etl_tpu.config import RetryConfig
+
+        db = make_db()
+        db.create_publication("pub", [ACCOUNTS])
+        pipeline, store, dest = make_pipeline(
+            db, apply_retry=RetryConfig(max_attempts=10, initial_delay_ms=20))
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        async with db.transaction() as tx:
+            tx.insert(ACCOUNTS, ["600", "pre-cut", "1"])
+        await _wait_for(lambda: 600 in _account_ids(dest))
+        await db.sever_streams()
+        async with db.transaction() as tx:
+            tx.insert(ACCOUNTS, ["601", "post-cut", "2"])
+        await _wait_for(lambda: 601 in _account_ids(dest), timeout=20)
+        n600 = sum(1 for e in _row_events(dest)
+                   if isinstance(e, InsertEvent) and e.row.values[0] == 600)
+        assert n600 == 1, "duplicate delivery after reconnect"
+        await pipeline.shutdown_and_wait()
+
+
