@@ -128,9 +128,13 @@ class Column:
     codec/event.rs)."""
 
     schema: ColumnSchema
-    data: Any  # np.ndarray (dense) | pyarrow.Array (string) | list (object)
+    data: Any  # np.ndarray (dense) | pyarrow.Array (text) | list (object)
     validity: np.ndarray  # bool[n], True = value present (not NULL/unchanged)
     toast_unchanged: np.ndarray | None = None  # bool[n] or None if none set
+    # Arrow-text columns may carry UNPARSED Postgres text for typed kinds
+    # (numeric/uuid/json/…): exact for Arrow consumers, parsed lazily via
+    # value(). None = data is already the final representation.
+    lazy_text_oid: int | None = None
 
     def __len__(self) -> int:
         return len(self.validity)
@@ -154,7 +158,12 @@ class Column:
         if self.is_dense:
             return _from_dense(self.schema.kind, self.data[i])
         if self.is_arrow:
-            return self.data[i].as_py()
+            raw = self.data[i].as_py()
+            if self.lazy_text_oid is not None:
+                from ..postgres.codec.text import parse_cell_text
+
+                return parse_cell_text(raw, self.lazy_text_oid)
+            return raw
         return self.data[i]
 
     def is_toast_unchanged(self, i: int) -> bool:

@@ -8,7 +8,7 @@ Pipeline per batch (north star in BASELINE.json):
       bytes the device parses are uploaded, in one array)
     → device: one jitted program per (row-bucket, width-signature) parsing
       every dense column (ops/parsers.py) and emitting ONE packed int32
-      [K, R] result matrix + a per-row ok-bitfield row (single fetch —
+      [K, R] result matrix with leading ok-bit words (single fetch —
       the tunnel/PCIe round trip is latency-bound, so transfer count
       matters more than bytes)
     → host: exact numpy combines into int64/f64 columns
@@ -53,18 +53,29 @@ DEVICE_KINDS = frozenset({
     CellKind.TIMESTAMP, CellKind.TIMESTAMPTZ,
 })
 
+# minimum gather widths: enough for the parsers' static column indexing
+# (clipped gathers make larger fields safe — they fall back via the
+# oversize check); kept tight because upload bytes are the binding
+# resource on the device link
 _MIN_WIDTH = {
     CellKind.DATE: 16,
     CellKind.TIME: 16,
     CellKind.TIMESTAMP: 32,
-    CellKind.TIMESTAMPTZ: 64,
+    CellKind.TIMESTAMPTZ: 32,
     CellKind.F32: 16,
-    CellKind.F64: 32,
+    CellKind.F64: 16,
 }
 MAX_FIELD_WIDTH = 2048  # beyond this a field goes to CPU fallback
 
 # packed output rows per kind = its component count (parsers.COLUMN_COMPONENTS)
 _PACK_ROWS = {k: len(v) for k, v in parsers.COLUMN_COMPONENTS.items()}
+
+
+def n_ok_words(n_dense: int) -> int:
+    """Leading ok-bit int32 words in the packed output (31 bits per word;
+    the SINGLE definition shared by the XLA program, the Pallas kernel and
+    the host completion — layout drift here silently corrupts columns)."""
+    return max(1, -(-n_dense // 31))
 
 # kinds whose text always fits the 15-symbol nibble alphabet (framer.c):
 # digits, sign, dot, colon, space. BOOL ('t'/'f') doesn't; neither do
@@ -92,17 +103,20 @@ def build_device_program(specs: tuple[tuple[int, CellKind, int], ...],
              when `nibble` — two 4-bit symbols per byte, unpacked on device
              through a 16-entry table back to ASCII so the parsers are
              identical), lengths i32[R, n_dense]
-    Output:  packed i32[K, R]: row 0 = ok-bitfield (bit j = dense col j
-             parsed clean), then each column's value rows (_PACK_ROWS) —
-             ONE array so the latency-bound device→host link pays a single
+    Output:  packed i32[K, R]: the first n_ok_words(n_dense) rows are
+             ok-bit words (bit j%31 of word j//31 = dense col j parsed
+             clean), then each column's value rows (_PACK_ROWS) — ONE
+             array so the latency-bound device→host link pays a single
              fetch (a split ok output measured ~20% slower end to end).
     """
+
+    ok_words_n = n_ok_words(len(specs))
 
     def fn(bmat, lengths):
         lengths = lengths.astype(jnp.int32)
         R = bmat.shape[0]
         rows = []
-        okbits = jnp.zeros(R, dtype=jnp.int32)
+        ok_words = [jnp.zeros(R, dtype=jnp.int32) for _ in range(ok_words_n)]
         w_off = 0
         for j, (col_idx, kind, width) in enumerate(specs):
             if nibble:
@@ -113,8 +127,9 @@ def build_device_program(specs: tuple[tuple[int, CellKind, int], ...],
             w_off += width
             comp, ok = parsers.parse_column(kind, b, lengths[:, j])
             rows += [comp[k] for k in parsers.COLUMN_COMPONENTS[kind]]
-            okbits = okbits | (ok.astype(jnp.int32) << j)
-        return jnp.stack([okbits] + rows, axis=0)
+            ok_words[j // 31] = ok_words[j // 31] \
+                | (ok.astype(jnp.int32) << (j % 31))
+        return jnp.stack(ok_words + rows, axis=0)
 
     return fn
 
@@ -212,12 +227,12 @@ class DeviceDecoder:
                 self._dense.append(_ColSpec(i, kind))
             else:
                 self._object.append(_ColSpec(i, kind))
-        if len(self._dense) > 31:
-            # ok-bitfield packs into one int32 row; extraordinarily wide
-            # tables spill the tail columns to the host-object path
-            for spec in self._dense[31:]:
+        if len(self._dense) > 62:
+            # 62 device columns (2 ok words) covers the C packer's 64-column
+            # bound; wider tables spill the tail to the host-object path
+            for spec in self._dense[62:]:
                 self._object.append(spec)
-            self._dense = self._dense[:31]
+            self._dense = self._dense[:62]
         self._fn_cache: dict[tuple, Callable] = {}
 
     # -- internals ----------------------------------------------------------
@@ -347,11 +362,19 @@ class DeviceDecoder:
         return pa.StringArray.from_buffers(
             n, pa.py_buffer(arrow_offsets), pa.py_buffer(values), validity)
 
+    # object kinds whose Postgres text IS the exact destination form
+    # (Arrow/numeric-as-text stance, models/table_row.to_arrow): keep them
+    # as Arrow text columns, parse to Python objects only on value() access
+    _LAZY_TEXT_KINDS = frozenset({
+        CellKind.STRING, CellKind.NUMERIC, CellKind.UUID, CellKind.JSON,
+        CellKind.TIMETZ, CellKind.INTERVAL,
+    })
+
     def _decode_object_column(self, staged: StagedBatch, spec: _ColSpec,
                               valid: np.ndarray) -> Any:
         col = self.schema.replicated_columns[spec.index]
         n = staged.n_rows
-        if spec.kind is CellKind.STRING and not staged.copy_escapes:
+        if spec.kind in self._LAZY_TEXT_KINDS and not staged.copy_escapes:
             return self._gather_string_arrow(staged, spec, valid)
         out: list[Any] = [None] * n
         offs = staged.offsets[:, spec.index]
@@ -378,7 +401,15 @@ class DeviceDecoder:
         cols = self.schema.replicated_columns
         for c in columns:
             if c.is_arrow and rows.size:
-                c.data = c.data.to_pylist()  # rare: fixup needs mutability
+                # rare: fixup needs mutability — densify, PARSING lazy text
+                # so the column's value type stays consistent across rows
+                if c.lazy_text_oid is not None:
+                    oid = c.lazy_text_oid
+                    c.data = [None if v is None else parse_cell_text(v, oid)
+                              for v in c.data.to_pylist()]
+                    c.lazy_text_oid = None
+                else:
+                    c.data = c.data.to_pylist()
         for i in rows:
             for j, col in enumerate(cols):
                 c = columns[j]
@@ -427,8 +458,7 @@ class DeviceDecoder:
                     too_big = staged.lengths[:n, spec.index] > w
                     fallback.update(np.flatnonzero(too_big).tolist())
 
-        row_off = 1  # row 0 = ok bitfield
-        okbits = packed_np[0] if packed_np is not None else None
+        row_off = n_ok_words(len(self._dense))  # leading rows = ok words
         for j, spec in enumerate(self._dense):
             valid = valid_full[:n, spec.index].copy()
             toast_col = staged.toast[:n, spec.index]
@@ -439,7 +469,7 @@ class DeviceDecoder:
                 k = _PACK_ROWS[spec.kind]
                 rows = packed_np[row_off : row_off + k]
                 row_off += k
-                ok = (okbits.astype(np.int32) >> j) & 1
+                ok = (packed_np[j // 31].astype(np.int32) >> (j % 31)) & 1
                 bad = (ok[:n] == 0) & valid
                 if bad.any():
                     fallback.update(np.flatnonzero(bad).tolist())
@@ -455,9 +485,15 @@ class DeviceDecoder:
                 staged, spec,
                 valid & ~np.isin(np.arange(staged.row_capacity),
                                  list(fallback)) if fallback else valid)
+            lazy_oid = None
+            if spec.kind in self._LAZY_TEXT_KINDS \
+                    and spec.kind is not CellKind.STRING \
+                    and not staged.copy_escapes:
+                lazy_oid = cols[spec.index].type_oid
             columns[spec.index] = Column(
                 cols[spec.index], data_list, valid[:n].copy(),
-                toast_col if toast_col.any() else None)
+                toast_col if toast_col.any() else None,
+                lazy_text_oid=lazy_oid)
 
         if fallback:
             rows_arr = np.asarray(sorted(r for r in fallback if r < n),

@@ -28,10 +28,14 @@ DEFAULT_BLOCK_ROWS = 4096
 
 
 def _parse_block(bmat, lengths, specs, nibble: bool):
-    """Shared parse body over one row block (identical math to the XLA
-    program — single source of truth is parsers.parse_column)."""
+    """Shared parse body over one row block (identical math and OUTPUT
+    LAYOUT to the XLA program — parsers.parse_column and engine.n_ok_words
+    are the single sources of truth)."""
+    from .engine import n_ok_words
+
     rows = []
-    okbits = jnp.zeros(bmat.shape[0], dtype=jnp.int32)
+    ok_words = [jnp.zeros(bmat.shape[0], dtype=jnp.int32)
+                for _ in range(n_ok_words(len(specs)))]
     w_off = 0
     for j, (col_idx, kind, width) in enumerate(specs):
         if nibble:
@@ -42,8 +46,9 @@ def _parse_block(bmat, lengths, specs, nibble: bool):
         w_off += width
         comp, ok = parsers.parse_column(kind, b, lengths[:, j])
         rows += [comp[k] for k in parsers.COLUMN_COMPONENTS[kind]]
-        okbits = okbits | (ok.astype(jnp.int32) << j)
-    return jnp.stack([okbits] + rows, axis=0)
+        ok_words[j // 31] = ok_words[j // 31] \
+            | (ok.astype(jnp.int32) << (j % 31))
+    return jnp.stack(ok_words + rows, axis=0)
 
 
 def build_pallas_program(specs: tuple[tuple[int, CellKind, int], ...],
@@ -51,9 +56,10 @@ def build_pallas_program(specs: tuple[tuple[int, CellKind, int], ...],
                          block_rows: int = DEFAULT_BLOCK_ROWS,
                          interpret: bool | None = None):
     """Same contract as engine.build_device_program, lowered via Pallas."""
-    from .engine import _PACK_ROWS
+    from .engine import _PACK_ROWS, n_ok_words
 
-    k_out = 1 + sum(_PACK_ROWS[kind] for _, kind, _ in specs)
+    k_out = n_ok_words(len(specs)) + sum(_PACK_ROWS[kind]
+                                         for _, kind, _ in specs)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 

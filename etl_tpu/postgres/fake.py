@@ -60,7 +60,14 @@ class FakeDatabase:
         self.publications: dict[str, list[TableId]] = {}
         # publication column filters: (publication, table) -> column names
         self.column_filters: dict[tuple[str, TableId], list[str]] = {}
-        self.wal: list[tuple[Lsn, bytes]] = []  # (start_lsn, payload)
+        # PG15 row filters: (publication, table) -> predicate over the
+        # row's text values (the walsender-side WHERE clause analogue)
+        self.row_filters: dict[tuple[str, TableId], "callable"] = {}
+        # (start_lsn, payload, table_id|None, row_texts|None) — the row
+        # metadata lets streams evaluate publication row filters the way
+        # the walsender evaluates WHERE clauses at send time
+        self.wal: list[tuple[Lsn, bytes, TableId | None,
+                             list[str | None] | None]] = []
         self._lsn = 0x1000
         self.snapshots: dict[str, dict[TableId, list[list[str | None]]]] = {}
         self.slots: dict[str, _FakeSlot] = {}
@@ -78,11 +85,14 @@ class FakeDatabase:
         return t
 
     def create_publication(self, name: str, table_ids: list[TableId],
-                           column_filters: dict[TableId, list[str]] | None = None
+                           column_filters: dict[TableId, list[str]] | None = None,
+                           row_filters: "dict[TableId, callable] | None" = None
                            ) -> None:
         self.publications[name] = list(table_ids)
         for tid, cols in (column_filters or {}).items():
             self.column_filters[(name, tid)] = cols
+        for tid, pred in (row_filters or {}).items():
+            self.row_filters[(name, tid)] = pred
 
     def next_lsn(self, advance: int = 8) -> Lsn:
         self._lsn += advance
@@ -92,12 +102,21 @@ class FakeDatabase:
     def current_lsn(self) -> Lsn:
         return Lsn(self._lsn)
 
-    async def append_wal(self, payload: bytes, advance: int = 8) -> Lsn:
+    async def append_wal(self, payload: bytes, advance: int = 8,
+                         table_id: TableId | None = None,
+                         row: "list[str | None] | None" = None) -> Lsn:
         lsn = self.next_lsn(advance)
-        self.wal.append((lsn, payload))
+        self.wal.append((lsn, payload, table_id, row))
         async with self._wal_cond:
             self._wal_cond.notify_all()
         return lsn
+
+    def row_filter_allows(self, publication: str, table_id: TableId | None,
+                          row: "list[str | None] | None") -> bool:
+        if table_id is None or row is None:
+            return True
+        pred = self.row_filters.get((publication, table_id))
+        return True if pred is None else bool(pred(row))
 
     def transaction(self, xid: int | None = None) -> "FakeTransaction":
         return FakeTransaction(self, xid or (len(self.wal) + 100))
@@ -179,40 +198,44 @@ class FakeTransaction:
             kind = op[0]
             if kind == "I":
                 _, tid, values, _ = op
-                body_entries.append(pgoutput.encode_insert(
-                    tid, [None if v is None else v.encode() for v in values]))
+                body_entries.append((pgoutput.encode_insert(
+                    tid, [None if v is None else v.encode() for v in values]),
+                    tid, list(values)))
                 db.tables[tid].rows.append(list(values))
             elif kind == "U":
                 _, tid, values, key = op
                 t = db.tables[tid]
                 key_vals = [None if v is None else v.encode() for v in key]
-                body_entries.append(pgoutput.encode_update(
+                body_entries.append((pgoutput.encode_update(
                     tid, [None if v is None else v.encode() for v in values],
-                    key_values=key_vals))
+                    key_values=key_vals), tid, list(values)))
                 self._apply_update(t, key, values)
             elif kind == "D":
                 _, tid, _, key = op
                 t = db.tables[tid]
-                body_entries.append(pgoutput.encode_delete(
-                    tid, [None if v is None else v.encode() for v in key]))
+                body_entries.append((pgoutput.encode_delete(
+                    tid, [None if v is None else v.encode() for v in key]),
+                    tid, list(key)))
                 self._apply_delete(t, key)
             elif kind == "T":
                 _, tids, options, _ = op
-                body_entries.append(pgoutput.encode_truncate(list(tids),
-                                                             options))
+                body_entries.append((pgoutput.encode_truncate(
+                    list(tids), options), None, None))
                 for tid in tids:
                     db.tables[tid].rows.clear()
             elif kind == "M":
                 _, prefix, content, _ = op
-                body_entries.append(pgoutput.encode_logical_message(
-                    prefix, content, lsn=int(db.current_lsn)))
+                body_entries.append((pgoutput.encode_logical_message(
+                    prefix, content, lsn=int(db.current_lsn)), None, None))
 
         n_entries = len(entries) + len(body_entries) + 2  # + begin + commit
         commit_lsn = Lsn(int(begin_at) + 8 * (n_entries - 1))
         await db.append_wal(pgoutput.encode_begin(int(commit_lsn), ts,
                                                   self.xid))
-        for e in entries + body_entries:
+        for e in entries:
             await db.append_wal(e)
+        for payload, tid, row in body_entries:
+            await db.append_wal(payload, table_id=tid, row=row)
         end_lsn = await db.append_wal(
             pgoutput.encode_commit(int(commit_lsn), int(commit_lsn) + 8, ts))
         return commit_lsn
@@ -263,13 +286,15 @@ class _FakeReplicationStream(ReplicationStream):
                                f"slot {self.slot.name} invalidated")
             # drain available WAL
             while self._wal_index < len(db.wal):
-                lsn, payload = db.wal[self._wal_index]
+                lsn, payload, tid, row = db.wal[self._wal_index]
                 self._wal_index += 1
                 # START_REPLICATION is INCLUSIVE of the requested LSN: the
                 # next tx's BEGIN sits exactly at the prior commit's end
                 if lsn < self.pos_lsn:
                     continue
                 if not self._publication_allows(payload, pub_tables):
+                    continue
+                if not db.row_filter_allows(self.publication, tid, row):
                     continue
                 yield pgoutput.XLogData(
                     start_lsn=lsn, end_lsn=db.current_lsn,
@@ -403,6 +428,9 @@ class FakeSource(ReplicationSource):
         if snap is None:
             raise EtlError(ErrorKind.SNAPSHOT_EXPORT_FAILED, snapshot_id)
         rows = snap.get(table_id, [])
+        pred = self.db.row_filters.get((publication, table_id))
+        if pred is not None:
+            rows = [r for r in rows if pred(r)]
         if ctid_range is not None:
             # fake pages: 64 rows per heap page
             lo, hi = ctid_range

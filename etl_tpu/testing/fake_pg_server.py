@@ -419,6 +419,11 @@ class FakePgServer:
         snap = db.snapshots.get(sess.snapshot_id or "", None)
         rows = snap.get(table.schema.id, []) if snap is not None \
             else table.rows
+        # apply every publication row filter defined for this table (the
+        # fake has no session publication context on COPY; tests use one)
+        for (pub, tid), pred in db.row_filters.items():
+            if tid == table.schema.id:
+                rows = [r for r in rows if pred(r)]
         if lo is not None:
             rows = rows[lo * 64 : hi * 64]
         wanted = [c.strip().strip('"') for c in col_sql.split(",")]
@@ -464,13 +469,15 @@ class FakePgServer:
             while not reader_task.done():
                 sent = False
                 while wal_index < len(db.wal):
-                    lsn, payload = db.wal[wal_index]
+                    lsn, payload, tid, row = db.wal[wal_index]
                     wal_index += 1
                     # inclusive of the requested start position (see
                     # fake.py note: BEGIN lands at the prior commit's end)
                     if lsn < pos:
                         continue
                     if not self._pub_allows(payload, pub_tables):
+                        continue
+                    if not db.row_filter_allows(publication, tid, row):
                         continue
                     frame = pgoutput.encode_xlog_data(
                         int(lsn), int(db.current_lsn),

@@ -366,3 +366,28 @@ class TestPallasKernel:
         a = DeviceDecoder(schema, device_min_rows=0).decode(staged)
         b = DeviceDecoder(schema, use_pallas=True, device_min_rows=0).decode(staged)
         assert_batches_equal(a, b)
+
+
+class TestWideOkWords:
+    def test_35_dense_columns_both_programs(self):
+        """32-62 dense columns use two ok words; the XLA and Pallas
+        programs must agree on layout (reviewed failure)."""
+        oids = [Oid.INT4] * 35
+        rows = [[str(i * 100 + j) for j in range(35)] for i in range(64)]
+        schema = make_schema(oids)
+        staged = stage_tuples(tuples_from_texts(rows), 35)
+        a = DeviceDecoder(schema, device_min_rows=0).decode(staged)
+        b = DeviceDecoder(schema, use_pallas=True,
+                          device_min_rows=0).decode(staged)
+        assert_batches_equal(a, b)
+        for j in (0, 30, 31, 34):
+            assert a.columns[j].data[5] == 500 + j
+
+    def test_lazy_text_consistent_after_fixup(self):
+        """A single fallback row must not change other rows' value types
+        (reviewed failure: fixup densified lazy text without parsing)."""
+        rows = [["1.25", "2024-01-01"], ["3.50", "0044-03-15 BC"]]
+        dev, cpu = decode_both([Oid.NUMERIC, Oid.DATE], rows)
+        assert isinstance(dev.columns[0].value(0), PgNumeric)
+        assert isinstance(dev.columns[0].value(1), PgNumeric)
+        assert_batches_equal(dev, cpu)
