@@ -249,6 +249,39 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
 
     # -- maintenance (external-maintenance parity) ----------------------------------
 
+    async def vacuum(self, table_id: TableId) -> int:
+        """Delete files from superseded generations, under the maintenance
+        flag (a concurrent reader of the current generation never loses
+        files; old-generation files are unreachable once the bump commits,
+        but the flag still serializes vs. other maintenance)."""
+        db = self._catalog()
+        busy = db.execute("SELECT in_progress FROM lake_maintenance WHERE "
+                          "table_id = ?", (table_id,)).fetchone()
+        if busy and busy[0]:
+            return 0
+        db.execute("INSERT INTO lake_maintenance (table_id, in_progress) "
+                   "VALUES (?, 1) ON CONFLICT (table_id) DO UPDATE SET "
+                   "in_progress = 1", (table_id,))
+        db.commit()
+        try:
+            rows = db.execute(
+                "SELECT f.id, f.path FROM lake_files f JOIN lake_tables t "
+                "ON t.table_id = f.table_id WHERE f.table_id = ? "
+                "AND f.generation < t.generation", (table_id,)).fetchall()
+            for fid, path in rows:
+                Path(path).unlink(missing_ok=True)
+                db.execute("DELETE FROM lake_files WHERE id = ?", (fid,))
+            db.commit()
+            return len(rows)
+        finally:
+            db.execute("UPDATE lake_maintenance SET in_progress = 0 WHERE "
+                       "table_id = ?", (table_id,))
+            db.commit()
+
+    def table_ids(self) -> "list[TableId]":
+        return [r[0] for r in self._catalog().execute(
+            "SELECT table_id FROM lake_tables").fetchall()]
+
     async def compact(self, table_id: TableId) -> int:
         """Merge the current generation's files into one base file.
         Returns merged file count. Guarded by the catalog maintenance flag

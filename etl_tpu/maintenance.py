@@ -1,0 +1,103 @@
+"""Lake maintenance binary: `python -m etl_tpu.maintenance`.
+
+Reference parity: crates/etl-maintenance + the etl-ducklake-maintenance
+binary (etl-replicator/src/bin/etl-ducklake-maintenance.rs) — external
+maintenance (compaction/vacuum) coordinated with live writers through the
+catalog maintenance flag, optionally pausing/resuming the pipeline through
+the control-plane API around the operation (the reference's
+pause-replicator-around-compaction coordination).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from .destinations.lake import LakeConfig, LakeDestination
+
+
+async def run_maintenance(warehouse: str, *, vacuum: bool,
+                          api_url: str | None, pipeline_id: int | None,
+                          tenant_id: str | None) -> dict:
+    paused = False
+    session = None
+    if api_url and pipeline_id is not None:
+        import aiohttp
+
+        session = aiohttp.ClientSession(
+            headers={"tenant_id": tenant_id or ""})
+        try:
+            resp = await session.post(
+                f"{api_url}/v1/pipelines/{pipeline_id}/stop")
+            paused = resp.status in (200, 202)
+            if not paused:
+                # the operator asked for coordination; running maintenance
+                # against a live writer is exactly what they tried to avoid
+                raise RuntimeError(
+                    f"could not pause pipeline {pipeline_id}: "
+                    f"HTTP {resp.status} — aborting maintenance")
+        except BaseException:
+            await session.close()
+            raise
+    try:
+        lake = LakeDestination(LakeConfig(warehouse))
+        await lake.startup()
+        table_ids = lake.table_ids()
+        compacted = 0
+        vacuumed = 0
+        for tid in table_ids:
+            compacted += await lake.compact(tid)
+            if vacuum:
+                vacuumed += await lake.vacuum(tid)
+        await lake.shutdown()
+        return {"tables": len(table_ids), "compacted_files": compacted,
+                "vacuumed_files": vacuumed, "paused_pipeline": paused}
+    finally:
+        if session is not None:
+            try:
+                if paused:
+                    resp = await session.post(
+                        f"{api_url}/v1/pipelines/{pipeline_id}/start")
+                    if resp.status not in (200, 202):
+                        import logging
+
+                        logging.getLogger("etl_tpu.maintenance").error(
+                            "failed to resume pipeline %s: HTTP %s — "
+                            "resume it manually", pipeline_id, resp.status)
+            except Exception as e:
+                import logging
+
+                logging.getLogger("etl_tpu.maintenance").error(
+                    "failed to resume pipeline %s (%r) — resume it "
+                    "manually", pipeline_id, e)
+            finally:
+                await session.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="etl_tpu.maintenance")
+    p.add_argument("--warehouse", required=True)
+    p.add_argument("--vacuum", action="store_true",
+                   help="also delete files from superseded generations")
+    p.add_argument("--api-url", default=None,
+                   help="control-plane URL: pause/resume the pipeline "
+                        "around maintenance")
+    p.add_argument("--pipeline-id", type=int, default=None)
+    p.add_argument("--tenant-id", default=None)
+    args = p.parse_args(argv)
+    try:
+        out = asyncio.run(run_maintenance(
+            args.warehouse, vacuum=args.vacuum, api_url=args.api_url,
+            pipeline_id=args.pipeline_id, tenant_id=args.tenant_id))
+    except Exception as e:
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}),
+              file=sys.stderr)
+        return 1
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

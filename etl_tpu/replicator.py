@@ -63,11 +63,19 @@ async def run_replicator(config_dir: str,
     store_doc = doc.pop("store", {"type": "memory"})
     metrics_port = doc.pop("metrics_port", 0)
     project_ref = doc.pop("project_ref", "")
+    error_webhook = doc.pop("error_webhook_url", "")
     config = pipeline_config_from_dict(doc)
 
     env = environment or Environment.current()
     init_tracing(environment=env.value, project_ref=project_ref,
                  pipeline_id=config.pipeline_id)
+    notifier = None
+    if error_webhook:
+        from .telemetry.notify import WebhookErrorNotifier
+
+        notifier = WebhookErrorNotifier(error_webhook,
+                                        pipeline_id=config.pipeline_id)
+        notifier.install()
     logger.info("starting replicator pipeline=%s publication=%s engine=%s",
                 config.pipeline_id, config.publication_name,
                 config.batch.batch_engine.value)
@@ -89,17 +97,26 @@ async def run_replicator(config_dir: str,
         loop.add_signal_handler(
             sig, lambda: asyncio.ensure_future(pipeline.shutdown()))
 
-    await pipeline.start()
-    logger.info("pipeline started")
     try:
+        await pipeline.start()
+        logger.info("pipeline started")
         await pipeline.wait()
         logger.info("pipeline stopped cleanly")
+    except BaseException as e:
+        if not isinstance(e, asyncio.CancelledError):
+            # log INSIDE the loop so the error webhook can still fire
+            # (main() runs after asyncio.run() returns, where the hook
+            # has no loop to post from)
+            logger.error("replicator failed: %s", e)
+        raise
     finally:
         if metrics_runner is not None:
             await metrics_runner.cleanup()
         close = getattr(store, "close", None)
         if close is not None:
             await close()
+        if notifier is not None:
+            await notifier.close()  # awaits in-flight notifications
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -117,9 +134,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     except KeyboardInterrupt:
         return 0
-    except EtlError as e:
-        logger.error("replicator failed: %s", e)
-        return 1
+    except EtlError:
+        return 1  # already logged (and webhooked) inside the loop
 
 
 if __name__ == "__main__":

@@ -58,9 +58,14 @@ class _HookHandler(logging.Handler):
 
 
 def set_error_hook(hook: Callable[[logging.LogRecord], None]) -> None:
-    """Error capture hook (the Sentry-layer analogue)."""
+    """Error capture hook (the Sentry-layer analogue). Self-installing:
+    attaches the dispatch handler to the root logger if init_tracing has
+    not run yet."""
     global _error_hook
     _error_hook = hook
+    root = logging.getLogger()
+    if not any(isinstance(h, _HookHandler) for h in root.handlers):
+        root.addHandler(_HookHandler())
 
 
 def init_tracing(*, environment: str = "dev", project_ref: str = "",

@@ -1,0 +1,96 @@
+"""Maintenance binary + error webhook tests."""
+
+import asyncio
+import json
+import logging
+
+import pytest
+
+from etl_tpu.destinations.lake import LakeConfig, LakeDestination
+from etl_tpu.maintenance import run_maintenance
+from etl_tpu.telemetry.notify import WebhookErrorNotifier
+from etl_tpu.telemetry.tracing import set_error_hook
+from etl_tpu.testing.fake_http import RecordingHttpServer
+from tests.test_destinations import TID, batch, ins, make_schema
+
+
+class TestMaintenance:
+    async def test_compact_and_vacuum(self, tmp_path):
+        d = LakeDestination(LakeConfig(str(tmp_path), compact_min_files=99))
+        await d.startup()
+        await d.write_table_rows(make_schema(), batch([[1, "a", None]]))
+        for i in range(3):
+            await d.write_events([ins(0, [10 + i, "x", None],
+                                      lsn=0x100 + 16 * i)])
+        # truncate bumps the generation, leaving old-generation files
+        from etl_tpu.models import Lsn, TruncateEvent
+
+        await d.write_events([TruncateEvent(Lsn(1), Lsn(1), 0, 0,
+                                            (make_schema(),))])
+        await d.write_events([ins(0, [50, "post", None], lsn=0x500)])
+        await d.shutdown()
+
+        out = await run_maintenance(str(tmp_path), vacuum=True,
+                                    api_url=None, pipeline_id=None,
+                                    tenant_id=None)
+        assert out["tables"] == 1
+        assert out["vacuumed_files"] >= 4  # old generation cleaned
+        reader = LakeDestination(LakeConfig(str(tmp_path)))
+        await reader.startup()
+        assert [r["id"] for r in reader.read_current(TID).to_pylist()] == [50]
+        await reader.shutdown()
+
+    async def test_pause_resume_via_api(self, tmp_path):
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            d = LakeDestination(LakeConfig(str(tmp_path)))
+            await d.startup()
+            await d.write_table_rows(make_schema(), batch([[1, "a", None]]))
+            await d.shutdown()
+            await run_maintenance(str(tmp_path), vacuum=False,
+                                  api_url=server.url(), pipeline_id=7,
+                                  tenant_id="acme")
+            paths = server.paths()
+            assert paths[0] == "POST /v1/pipelines/7/stop"
+            assert paths[-1] == "POST /v1/pipelines/7/start"
+        finally:
+            await server.stop()
+
+
+class TestWebhookNotifier:
+    async def test_error_posts_webhook(self):
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            n = WebhookErrorNotifier(server.url() + "/hook", pipeline_id=3,
+                                     min_interval_s=0)
+            n.install()
+            logging.getLogger("etl_tpu.test").error("boom %s", "now")
+            for _ in range(100):
+                if server.requests:
+                    break
+                await asyncio.sleep(0.02)
+            assert server.requests, "webhook never fired"
+            doc = server.requests[0].json
+            assert doc["pipeline_id"] == 3
+            assert doc["message"] == "boom now"
+            await n.close()
+        finally:
+            set_error_hook(lambda r: None)
+            await server.stop()
+
+    async def test_rate_limited(self):
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            n = WebhookErrorNotifier(server.url(), min_interval_s=60)
+            n.install()
+            for _ in range(5):
+                logging.getLogger("etl_tpu.test").error("burst")
+            await asyncio.sleep(0.2)
+            assert len(server.requests) == 1  # only the first within window
+            await n.close()
+        finally:
+            set_error_hook(lambda r: None)
+            await server.stop()
