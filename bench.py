@@ -114,9 +114,10 @@ def bench_tpu(payloads, schema, n_rows):
             done += 1
         dt = time.perf_counter() - t0
         times.append(dt / n_batches)
-    # best iteration, symmetric with the CPU side's best sample — both
-    # paths are measured at their peak on shared, jittery infrastructure
-    return n_rows / min(times)
+    # MEDIAN of iterations: the number a sustained pipeline actually
+    # delivers (the CPU baseline still uses its FASTEST sample — the
+    # comparison is conservative in the baseline's favor)
+    return n_rows / sorted(times)[len(times) // 2]
 
 
 def main():

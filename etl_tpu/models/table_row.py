@@ -305,7 +305,11 @@ def _to_dense(kind: CellKind, v: Any):
 def _from_dense(kind: CellKind, v):
     if kind is CellKind.DATE:
         days = int(v)
-        if days < _MIN_DATE_DAYS:
+        if days == 2**31 - 1:
+            return PgSpecialDate(days, "infinity")
+        if days == -(2**31):
+            return PgSpecialDate(days, "-infinity")
+        if not _MIN_DATE_DAYS <= days <= _MAX_DATE_DAYS:
             return PgSpecialDate(days, f"<out-of-range date {days}d>")
         return _EPOCH_DATE + dt.timedelta(days=days)
     if kind is CellKind.TIME:
@@ -317,6 +321,10 @@ def _from_dense(kind: CellKind, v):
     if kind in (CellKind.TIMESTAMP, CellKind.TIMESTAMPTZ):
         us = int(v)
         tz_aware = kind is CellKind.TIMESTAMPTZ
+        if us == 2**63 - 1:
+            return PgSpecialTimestamp(us, "infinity", tz_aware=tz_aware)
+        if us == -(2**63):
+            return PgSpecialTimestamp(us, "-infinity", tz_aware=tz_aware)
         if not _MIN_TS_US <= us <= _MAX_TS_US:
             return PgSpecialTimestamp(us, f"<out-of-range timestamp {us}us>",
                                       tz_aware=tz_aware)

@@ -7,10 +7,11 @@ Pipeline per batch (north star in BASELINE.json):
       into ONE [R, ΣW] byte matrix (minimizes host↔device transfer: only
       bytes the device parses are uploaded, in one array)
     → device: one jitted program per (row-bucket, width-signature) parsing
-      every dense column (ops/parsers.py) and emitting ONE packed int32
-      [K, R] result matrix with leading ok-bit words (single fetch —
-      the tunnel/PCIe round trip is latency-bound, so transfer count
-      matters more than bytes)
+      every dense column (ops/parsers.py) and emitting ONE bit-packed
+      uint32[n_words, R] result (ops/bitpack.py: per row, each column's
+      ok bit + components at text-width-bounded offsets — the
+      device→host link is both latency-bound and ~40 MB/s, so transfer
+      count AND bytes are the binding resources)
     → host: exact numpy combines into int64/f64 columns
     → CPU-oracle fallback decode for flagged rows (escapes, BC dates,
       17-digit floats, oversized fields) — mixed batches partition,
@@ -67,15 +68,8 @@ _MIN_WIDTH = {
 }
 MAX_FIELD_WIDTH = 2048  # beyond this a field goes to CPU fallback
 
-# packed output rows per kind = its component count (parsers.COLUMN_COMPONENTS)
-_PACK_ROWS = {k: len(v) for k, v in parsers.COLUMN_COMPONENTS.items()}
-
-
-def n_ok_words(n_dense: int) -> int:
-    """Leading ok-bit int32 words in the packed output (31 bits per word;
-    the SINGLE definition shared by the XLA program, the Pallas kernel and
-    the host completion — layout drift here silently corrupts columns)."""
-    return max(1, -(-n_dense // 31))
+def round_up_even(n: int) -> int:
+    return (n + 1) & ~1
 
 # kinds whose text always fits the 15-symbol nibble alphabet (framer.c):
 # digits, sign, dot, colon, space. BOOL ('t'/'f') doesn't; neither do
@@ -95,7 +89,7 @@ class _ColSpec:
     kind: CellKind
 
 
-def build_device_program(specs: tuple[tuple[int, CellKind, int], ...],
+def build_device_program(specs: tuple[tuple[int, CellKind, int, int], ...],
                          nibble: bool = False):
     """The (unjitted) single-chip forward step for one width-signature.
 
@@ -103,33 +97,18 @@ def build_device_program(specs: tuple[tuple[int, CellKind, int], ...],
              when `nibble` — two 4-bit symbols per byte, unpacked on device
              through a 16-entry table back to ASCII so the parsers are
              identical), lengths i32[R, n_dense]
-    Output:  packed i32[K, R]: the first n_ok_words(n_dense) rows are
-             ok-bit words (bit j%31 of word j//31 = dense col j parsed
-             clean), then each column's value rows (_PACK_ROWS) — ONE
-             array so the latency-bound device→host link pays a single
-             fetch (a split ok output measured ~20% slower end to end).
-    """
+    Output:  uint32[n_words, R] bit-packed per ops/bitpack.build_layout —
+             each row's ok bits + components in the fewest words their
+             text-width-bounded magnitudes allow. ONE array, minimal
+             bytes: the device→host fetch link (latency-bound AND ~40MB/s)
+             is the binding resource of the whole decode pipeline.
 
-    ok_words_n = n_ok_words(len(specs))
+    specs: (col_index, kind, gather_width, bit_width) per dense column.
+    """
+    from .bitpack import parse_and_pack
 
     def fn(bmat, lengths):
-        lengths = lengths.astype(jnp.int32)
-        R = bmat.shape[0]
-        rows = []
-        ok_words = [jnp.zeros(R, dtype=jnp.int32) for _ in range(ok_words_n)]
-        w_off = 0
-        for j, (col_idx, kind, width) in enumerate(specs):
-            if nibble:
-                packed = bmat[:, w_off // 2 : (w_off + width) // 2]
-                b = parsers.unpack_nibbles(packed, width)
-            else:
-                b = bmat[:, w_off : w_off + width].astype(jnp.int32)
-            w_off += width
-            comp, ok = parsers.parse_column(kind, b, lengths[:, j])
-            rows += [comp[k] for k in parsers.COLUMN_COMPONENTS[kind]]
-            ok_words[j // 31] = ok_words[j // 31] \
-                | (ok.astype(jnp.int32) << (j % 31))
-        return jnp.stack(ok_words + rows, axis=0)
+        return parse_and_pack(bmat, lengths.astype(jnp.int32), specs, nibble)
 
     return fn
 
@@ -178,24 +157,33 @@ def _combine(kind: CellKind, rows: np.ndarray) -> np.ndarray:
 
 
 class _PendingDecode:
-    """Handle for an in-flight device decode; `result()` completes it."""
+    """Handle for an in-flight device decode; `result()` completes it.
+    The device→host copy of the packed result is started at construction
+    (`copy_to_host_async`), so the transfer rides the link while the host
+    stages and packs the next batches — `result()` mostly finds the bytes
+    already landed."""
 
-    __slots__ = ("_decoder", "_staged", "_widths", "_packed", "_bad_rows",
+    __slots__ = ("_decoder", "_staged", "_specs", "_packed", "_bad_rows",
                  "_done")
 
     def __init__(self, decoder: "DeviceDecoder", staged: StagedBatch,
-                 widths: tuple[int, ...], packed, bad_rows=None):
+                 specs: tuple, packed, bad_rows=None):
         self._decoder = decoder
         self._staged = staged
-        self._widths = widths
+        self._specs = specs
         self._packed = packed
         self._bad_rows = bad_rows
         self._done: ColumnarBatch | None = None
+        if packed is not None:
+            try:
+                packed.copy_to_host_async()
+            except AttributeError:
+                pass  # non-jax array (tests may inject numpy)
 
     def result(self) -> ColumnarBatch:
         if self._done is None:
             self._done = self._decoder._complete(
-                self._staged, self._widths, self._packed, self._bad_rows)
+                self._staged, self._specs, self._packed, self._bad_rows)
         return self._done
 
 
@@ -228,8 +216,8 @@ class DeviceDecoder:
             else:
                 self._object.append(_ColSpec(i, kind))
         if len(self._dense) > 62:
-            # 62 device columns (2 ok words) covers the C packer's 64-column
-            # bound; wider tables spill the tail to the host-object path
+            # 62 device columns covers the C packer's 64-column bound;
+            # wider tables spill the tail to the host-object path
             for spec in self._dense[62:]:
                 self._object.append(spec)
             self._dense = self._dense[:62]
@@ -243,6 +231,24 @@ class DeviceDecoder:
             need = max(staged.max_field_len(spec.index),
                        _MIN_WIDTH.get(spec.kind, 4))
             out.append(bucket_width(need, hi=MAX_FIELD_WIDTH))
+        return tuple(out)
+
+    def _specs(self, staged: StagedBatch,
+               widths: tuple[int, ...]) -> tuple:
+        """(col_index, kind, gather_width, bit_width) per dense column.
+        bit_width bounds the packed-output field sizes from the column's
+        ACTUAL max text length (bucketed to even, clamped at the kind's
+        layout-saturation width so jit signatures stay few) — tighter than
+        the gather width, and every bit saved is fetch bandwidth on the
+        device link."""
+        from .bitpack import saturation_width
+
+        out = []
+        for spec, w in zip(self._dense, widths):
+            bw = round_up_even(
+                min(max(staged.max_field_len(spec.index), 1), w,
+                    saturation_width(spec.kind)))
+            out.append((spec.index, spec.kind, w, bw))
         return tuple(out)
 
     def _can_nibble(self, widths: tuple[int, ...]) -> bool:
@@ -295,13 +301,12 @@ class DeviceDecoder:
             w_off += w
         return bmat, lengths, False, None
 
-    def _device_call(self, staged: StagedBatch, widths: tuple[int, ...]):
+    def _device_call(self, staged: StagedBatch, specs: tuple):
+        widths = tuple(w for _, _, w, _ in specs)
         bmat, lengths, nibble, bad_rows = self._pack_host(staged, widths)
-        key = (staged.row_capacity, widths, nibble)
+        key = (staged.row_capacity, specs, nibble)
         fn = self._fn_cache.get(key)
         if fn is None:
-            specs = tuple((s.index, s.kind, w)
-                          for s, w in zip(self._dense, widths))
             fn = _build_device_fn(specs, nibble, self.use_pallas)
             self._fn_cache[key] = fn
         try:
@@ -319,7 +324,7 @@ class DeviceDecoder:
                 exc_info=True)
             self.use_pallas = False
             self._fn_cache.clear()
-            return self._device_call(staged, widths)
+            return self._device_call(staged, specs)
 
     def _gather_string_arrow(self, staged: StagedBatch, spec: _ColSpec,
                              valid: np.ndarray):
@@ -436,8 +441,10 @@ class DeviceDecoder:
                     c.data[i] = value
                 c.validity[i] = value is not None
 
-    def _complete(self, staged: StagedBatch, widths: tuple[int, ...],
+    def _complete(self, staged: StagedBatch, specs: tuple,
                   packed, bad_rows=None) -> ColumnarBatch:
+        from .bitpack import layout_for_specs, unpack_host
+
         n = staged.n_rows
         cols = self.schema.replicated_columns
         valid_full = ~staged.nulls & ~staged.toast
@@ -453,12 +460,12 @@ class DeviceDecoder:
             # nibble pack flagged bytes outside the symbol alphabet
             fallback.update(np.flatnonzero(bad_rows[:n]).tolist())
         if packed_np is not None:
-            for spec, w in zip(self._dense, widths):
+            for spec, (_, _, w, _) in zip(self._dense, specs):
                 if staged.max_field_len(spec.index) > w:
                     too_big = staged.lengths[:n, spec.index] > w
                     fallback.update(np.flatnonzero(too_big).tolist())
 
-        row_off = n_ok_words(len(self._dense))  # leading rows = ok words
+        layout = layout_for_specs(specs) if packed_np is not None else None
         for j, spec in enumerate(self._dense):
             valid = valid_full[:n, spec.index].copy()
             toast_col = staged.toast[:n, spec.index]
@@ -466,14 +473,11 @@ class DeviceDecoder:
                 # small batch: host decode of every row via the oracle
                 data = np.zeros(n, dtype=dense_dtype(spec.kind))
             else:
-                k = _PACK_ROWS[spec.kind]
-                rows = packed_np[row_off : row_off + k]
-                row_off += k
-                ok = (packed_np[j // 31].astype(np.int32) >> (j % 31)) & 1
-                bad = (ok[:n] == 0) & valid
+                ok, comps = unpack_host(layout, packed_np, j, n)
+                bad = ~ok & valid
                 if bad.any():
                     fallback.update(np.flatnonzero(bad).tolist())
-                data = _combine(spec.kind, rows[:, :n]).copy()
+                data = _combine(spec.kind, comps)
             columns[spec.index] = Column(
                 cols[spec.index], data, valid,
                 toast_col if toast_col.any() else None)
@@ -512,12 +516,12 @@ class DeviceDecoder:
                 f"staged batch has {staged.n_cols} cols, schema expects "
                 f"{len(cols)}")
         if self._dense and staged.n_rows >= self.device_min_rows:
-            widths = self._widths(staged)
-            packed, bad_rows = self._device_call(staged, widths)
+            specs = self._specs(staged, self._widths(staged))
+            packed, bad_rows = self._device_call(staged, specs)
         else:
-            widths = ()
+            specs = ()
             packed, bad_rows = None, None
-        return _PendingDecode(self, staged, widths, packed, bad_rows)
+        return _PendingDecode(self, staged, specs, packed, bad_rows)
 
     def decode(self, staged: StagedBatch) -> ColumnarBatch:
         return self.decode_async(staged).result()

@@ -27,46 +27,24 @@ from . import parsers
 DEFAULT_BLOCK_ROWS = 4096
 
 
-def _parse_block(bmat, lengths, specs, nibble: bool):
-    """Shared parse body over one row block (identical math and OUTPUT
-    LAYOUT to the XLA program — parsers.parse_column and engine.n_ok_words
-    are the single sources of truth)."""
-    from .engine import n_ok_words
-
-    rows = []
-    ok_words = [jnp.zeros(bmat.shape[0], dtype=jnp.int32)
-                for _ in range(n_ok_words(len(specs)))]
-    w_off = 0
-    for j, (col_idx, kind, width) in enumerate(specs):
-        if nibble:
-            packed = bmat[:, w_off // 2 : (w_off + width) // 2]
-            b = parsers.unpack_nibbles(packed, width)
-        else:
-            b = bmat[:, w_off : w_off + width].astype(jnp.int32)
-        w_off += width
-        comp, ok = parsers.parse_column(kind, b, lengths[:, j])
-        rows += [comp[k] for k in parsers.COLUMN_COMPONENTS[kind]]
-        ok_words[j // 31] = ok_words[j // 31] \
-            | (ok.astype(jnp.int32) << (j % 31))
-    return jnp.stack(ok_words + rows, axis=0)
-
-
-def build_pallas_program(specs: tuple[tuple[int, CellKind, int], ...],
+def build_pallas_program(specs: tuple[tuple[int, CellKind, int, int], ...],
                          nibble: bool = False,
                          block_rows: int = DEFAULT_BLOCK_ROWS,
                          interpret: bool | None = None):
     """Same contract as engine.build_device_program, lowered via Pallas."""
-    from .engine import _PACK_ROWS, n_ok_words
+    from .bitpack import layout_for_specs
 
-    k_out = n_ok_words(len(specs)) + sum(_PACK_ROWS[kind]
-                                         for _, kind, _ in specs)
+    layout = layout_for_specs(specs)
+    k_out = layout.n_words
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     def kernel(bmat_ref, len_ref, out_ref):
+        from .bitpack import parse_and_pack
+
         bmat = bmat_ref[:, :]
         lengths = len_ref[:, :].astype(jnp.int32)
-        out_ref[:, :] = _parse_block(bmat, lengths, specs, nibble)
+        out_ref[:, :] = parse_and_pack(bmat, lengths, specs, nibble)
 
     def fn(bmat, lengths):
         R = bmat.shape[0]
@@ -81,7 +59,7 @@ def build_pallas_program(specs: tuple[tuple[int, CellKind, int], ...],
                 pl.BlockSpec((blk, lengths.shape[1]), lambda i: (i, 0)),
             ],
             out_specs=pl.BlockSpec((k_out, blk), lambda i: (0, i)),
-            out_shape=jax.ShapeDtypeStruct((k_out, R), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct((k_out, R), jnp.uint32),
             interpret=interpret,
         )(bmat, lengths)
 

@@ -206,7 +206,10 @@ def _parse_tz_at(bmat: jax.Array, lengths: jax.Array, p: jax.Array):
     end = p + 3 + jnp.where(has_min, 3, 0) + jnp.where(has_sec, 3, 0)
     off = hh * 3600 + mm * 60 + ss
     off = jnp.where(neg, -off, off)
-    return off, end, sign_ok & hh_ok & mm_ok & ss_ok
+    # PG never renders offsets beyond ±15:59:59; larger hh would overflow
+    # the packed-transport ms budget (bitpack._MS_TZ_ZZ_BITS) with ok=1,
+    # silently corrupting instead of falling back — bound it here
+    return off, end, sign_ok & hh_ok & mm_ok & ss_ok & (hh <= 15)
 
 
 def parse_timestamp(bmat: jax.Array, lengths: jax.Array, with_tz: bool):
@@ -309,8 +312,11 @@ def parse_float(bmat: jax.Array, lengths: jax.Array):
     sig = n_mant - lead_zero_run
     exp_adj = exp_val - frac_count
 
+    # n_mant ≤ 18: the two limbs hold 18 digits; a 19+-digit mantissa can
+    # still have ≤ 15 *significant* digits (trailing zeros / leading zeros
+    # straddling the limb boundary) and would silently truncate otherwise
     fast = (sig <= 15) & (jnp.abs(exp_adj) <= 22) & (n_mant >= 1) \
-        & (n_dots <= 1) & mant_valid & exp_valid
+        & (n_mant <= 18) & (n_dots <= 1) & mant_valid & exp_valid
     ok = fast | (special > 0)
     return neg, limb0, limb1, exp_adj, special, ok
 

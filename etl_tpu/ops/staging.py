@@ -66,6 +66,8 @@ class StagedBatch:
         default_factory=lambda: np.zeros(0, dtype=np.int64))
     # rows needing the exact CPU decoder (escapes, oversized fields)
     copy_escapes: bool = False  # True: field bytes may carry COPY escapes
+    _maxlens: np.ndarray | None = field(default=None, repr=False,
+                                        compare=False)
 
     @property
     def row_capacity(self) -> int:
@@ -85,7 +87,12 @@ class StagedBatch:
     def max_field_len(self, col: int) -> int:
         if self.n_rows == 0:
             return 0
-        return int(self.lengths[: self.n_rows, col].max())
+        if self._maxlens is None:
+            # one pass over all columns, cached: _widths/_specs/_complete
+            # each consult per-column maxima on the hot path
+            object.__setattr__(self, "_maxlens",
+                               self.lengths[: self.n_rows].max(axis=0))
+        return int(self._maxlens[col])
 
 
 def stage_tuples(tuples: Sequence[TupleData], n_cols: int) -> StagedBatch:

@@ -84,6 +84,7 @@ def build_sharded_decode_step(mesh: Mesh,
       max_lsn     uint32[B]  durability watermark per batch, pmax over 'sp'
     """
 
+    specs = tuple(s[:3] for s in specs)  # accept engine 4-tuple specs too
     dense_idx = np.asarray([i for i, _, _ in specs], dtype=np.int32)
 
     def step(data, offsets, lengths, valid, lsns):

@@ -20,14 +20,25 @@ from ...models.cell import (JSON_NULL, PgInterval, PgNumeric,
 from ...models.errors import ErrorKind, EtlError
 from ...models.pgtypes import CellKind, Oid, array_element, kind_for_oid
 
-# Postgres renders infinity dates/timestamps as literals; map them to the
-# extreme representable Python values (reference maps to chrono MIN/MAX).
-DATE_POS_INFINITY = dt.date.max
-DATE_NEG_INFINITY = dt.date.min
-TS_POS_INFINITY = dt.datetime.max
-TS_NEG_INFINITY = dt.datetime.min
-TSTZ_POS_INFINITY = dt.datetime.max.replace(tzinfo=dt.timezone.utc)
-TSTZ_NEG_INFINITY = dt.datetime.min.replace(tzinfo=dt.timezone.utc)
+# Postgres renders infinity dates/timestamps as literals; map them to
+# out-of-band sentinels carrying PG's own internal magnitudes (i32::MAX
+# days / i64::MAX µs — what the reference's chrono MIN/MAX serialize to).
+# Using datetime.max/min here would collide with the GENUINE extreme
+# values 9999-12-31 / 0001-01-01T00:00:00, silently dropping their tz
+# offsets (datetime.min+15:59:59 would equal the -infinity sentinel).
+DATE_POS_INFINITY = PgSpecialDate(2**31 - 1, "infinity")
+DATE_NEG_INFINITY = PgSpecialDate(-(2**31), "-infinity")
+TS_POS_INFINITY = PgSpecialTimestamp(2**63 - 1, "infinity")
+TS_NEG_INFINITY = PgSpecialTimestamp(-(2**63), "-infinity")
+TSTZ_POS_INFINITY = PgSpecialTimestamp(2**63 - 1, "infinity", tz_aware=True)
+TSTZ_NEG_INFINITY = PgSpecialTimestamp(-(2**63), "-infinity", tz_aware=True)
+
+# exact bounds of Python's datetime range in epoch microseconds
+_MIN_TS_US = -62_135_596_800_000_000  # 0001-01-01 00:00:00
+_MAX_TS_US = 253_402_300_799_999_999  # 9999-12-31 23:59:59.999999
+_EPOCH_NAIVE = dt.datetime(1970, 1, 1)
+_EPOCH_AWARE = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
+_US_TD = dt.timedelta(microseconds=1)
 
 
 def _invalid(kind: str, text: str, exc: Exception | None = None) -> EtlError:
@@ -166,6 +177,8 @@ def _split_tz(text: str) -> tuple[str, int]:
             secs = 0
             for p, mult in zip(parts, (3600, 60, 1)):
                 secs += int(p) * mult
+            if secs > 57599:  # PG bound: ±15:59:59
+                raise _invalid("tz offset", text)
             return body, sign * secs
         if c == ":" or c.isdigit() or c == ".":
             continue
@@ -213,13 +226,16 @@ def parse_timestamptz(text: str) -> "dt.datetime | PgSpecialTimestamp":
     try:
         body, off = _split_tz(t)
         naive = parse_timestamp(body + (" BC" if bc else ""))
-        if naive in (TS_POS_INFINITY, TS_NEG_INFINITY):
-            return naive.replace(tzinfo=dt.timezone.utc)
         if isinstance(naive, PgSpecialTimestamp):
             return PgSpecialTimestamp(naive.micros - off * 1_000_000, text,
                                       tz_aware=True)
-        aware = naive.replace(tzinfo=dt.timezone(dt.timedelta(seconds=off)))
-        return aware.astimezone(dt.timezone.utc)
+        # integer µs arithmetic, not astimezone(): an offset can push an
+        # edge value (0001-01-01+hh / 9999-12-31-hh) outside Python's
+        # datetime range — those become out-of-band specials, not errors
+        micros = (naive - _EPOCH_NAIVE) // _US_TD - off * 1_000_000
+        if _MIN_TS_US <= micros <= _MAX_TS_US:
+            return _EPOCH_AWARE + dt.timedelta(microseconds=micros)
+        return PgSpecialTimestamp(micros, text, tz_aware=True)
     except (ValueError, OverflowError) as e:
         raise _invalid("timestamptz", text, e)
 

@@ -1,0 +1,94 @@
+"""Profile the decode pipeline stage-by-stage on the real chip.
+
+Not part of the test suite — a builder tool for finding the structural
+bottleneck (upload vs compute vs fetch vs host work) behind bench.py.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import bench as B
+
+
+def timeit(fn, n=5):
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts), sorted(ts)[len(ts) // 2]
+
+
+def main():
+    import jax
+
+    payloads = B.build_workload(B.N_ROWS)
+    schema = B.make_schema()
+
+    from etl_tpu.ops import DeviceDecoder
+    from etl_tpu.ops.wal import concat_payloads, stage_wal_batch
+
+    buf, offs, lens = concat_payloads(payloads)
+    print("backend:", jax.default_backend())
+
+    # raw link speed: upload and fetch of a plain array
+    for mb in (4,):
+        a = np.random.randint(0, 255, size=(mb * 1024 * 1024,), dtype=np.uint8)
+        up_min, up_med = timeit(lambda: jax.device_put(a).block_until_ready())
+        d = jax.device_put(a)
+        fx_min, fx_med = timeit(lambda: np.asarray(d))
+        print(f"link {mb}MiB: upload min={up_min*1e3:.1f}ms med={up_med*1e3:.1f}ms"
+              f" ({mb/up_med:.1f}MB/s) fetch min={fx_min*1e3:.1f}ms "
+              f"med={fx_med*1e3:.1f}ms ({mb/fx_med:.1f}MB/s)")
+    # round-trip latency: tiny array
+    t = np.zeros(8, dtype=np.uint8)
+    lat_min, lat_med = timeit(lambda: np.asarray(jax.device_put(t)))
+    print(f"latency tiny roundtrip: min={lat_min*1e3:.1f}ms med={lat_med*1e3:.1f}ms")
+
+    decoder = DeviceDecoder(schema)
+
+    # stage = frame + group
+    st_min, st_med = timeit(lambda: stage_wal_batch(buf, offs, lens, 4))
+    wal = stage_wal_batch(buf, offs, lens, 4)
+    staged = wal.staged
+    widths = decoder._widths(staged)
+    print(f"stage_wal_batch: min={st_min*1e3:.1f}ms med={st_med*1e3:.1f}ms  widths={widths}")
+
+    # host pack
+    pk_min, pk_med = timeit(lambda: decoder._pack_host(staged, widths))
+    bmat, lengths, nibble, bad = decoder._pack_host(staged, widths)
+    print(f"pack_host: min={pk_min*1e3:.1f}ms med={pk_med*1e3:.1f}ms nibble={nibble} "
+          f"bmat={bmat.shape} {bmat.nbytes/1e6:.2f}MB lengths={lengths.nbytes/1e6:.2f}MB")
+
+    # device call (dispatch + wait)
+    packed, _ = decoder._device_call(staged, widths)
+    packed.block_until_ready()
+    def full_call():
+        p, _ = decoder._device_call(staged, widths)
+        p.block_until_ready()
+    dc_min, dc_med = timeit(full_call)
+    print(f"pack+dispatch+devicewait: min={dc_min*1e3:.1f}ms med={dc_med*1e3:.1f}ms "
+          f"out={packed.shape} {packed.size*4/1e6:.2f}MB")
+
+    # fetch
+    fx_min, fx_med = timeit(lambda: np.asarray(packed))
+    print(f"fetch packed: min={fx_min*1e3:.1f}ms med={fx_med*1e3:.1f}ms")
+
+    # complete (includes fetch + combine + object cols + arrow)
+    cm_min, cm_med = timeit(lambda: decoder._complete(staged, widths, packed))
+    print(f"complete: min={cm_min*1e3:.1f}ms med={cm_med*1e3:.1f}ms")
+
+    # full blocking decode
+    fd_min, fd_med = timeit(lambda: decoder.decode(stage_wal_batch(buf, offs, lens, 4).staged))
+    print(f"full decode (blocking): min={fd_min*1e3:.1f}ms med={fd_med*1e3:.1f}ms "
+          f"-> {B.N_ROWS/fd_med:.0f} rows/s blocking")
+
+    # pipelined, as bench does
+    tp = B.bench_tpu(payloads, schema, B.N_ROWS)
+    print(f"bench_tpu pipelined: {tp:.0f} rows/s")
+
+
+if __name__ == "__main__":
+    main()

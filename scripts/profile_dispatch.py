@@ -1,0 +1,95 @@
+"""Is dispatch async on the axon tunnel? Does a worker thread overlap?"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+import bench as B
+
+
+def main():
+    import jax
+
+    payloads = B.build_workload(B.N_ROWS)
+    schema = B.make_schema()
+    from etl_tpu.ops import DeviceDecoder
+    from etl_tpu.ops.wal import concat_payloads, stage_wal_batch
+
+    buf, offs, lens = concat_payloads(payloads)
+    decoder = DeviceDecoder(schema)
+    wal = stage_wal_batch(buf, offs, lens, 4)
+    staged = wal.staged
+    widths = decoder._widths(staged)
+    bmat, lengths, nibble, bad = decoder._pack_host(staged, widths)
+    key = (staged.row_capacity, widths, nibble)
+    decoder._device_call(staged, widths)[0].block_until_ready()  # warm
+    fn = decoder._fn_cache[key]
+
+    # dispatch-only vs blocked
+    for label in ("dispatch-only", "dispatch+block"):
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = fn(bmat, lengths)
+            if label == "dispatch+block":
+                out.block_until_ready()
+            ts.append(time.perf_counter() - t0)
+            out.block_until_ready()
+        print(f"{label}: min={min(ts)*1e3:.1f}ms med={sorted(ts)[2]*1e3:.1f}ms")
+
+    # two dispatches back-to-back then block both: does device pipeline?
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        a = fn(bmat, lengths)
+        b = fn(bmat, lengths)
+        a.block_until_ready(); b.block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    print(f"2x dispatch then block: med={sorted(ts)[2]*1e3:.1f}ms")
+
+    # worker-thread overlap: device call in thread while host packs
+    def host_work():
+        t0 = time.perf_counter()
+        stage_wal_batch(buf, offs, lens, 4)
+        decoder._pack_host(staged, widths)
+        return time.perf_counter() - t0
+
+    ts = []
+    for _ in range(5):
+        res = {}
+        def dev():
+            t0 = time.perf_counter()
+            fn(bmat, lengths).block_until_ready()
+            res["dev"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        th = threading.Thread(target=dev)
+        th.start()
+        hw = host_work()
+        th.join()
+        total = time.perf_counter() - t0
+        ts.append((total, hw, res["dev"]))
+    med = sorted(ts)[2]
+    print(f"thread overlap: total={med[0]*1e3:.1f}ms host={med[1]*1e3:.1f}ms dev={med[2]*1e3:.1f}ms")
+
+    # upload count probe: is lengths a separate transfer? time with lengths pre-placed
+    dl = jax.device_put(lengths)
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        fn(bmat, dl).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    print(f"dispatch+block, lengths pre-placed: med={sorted(ts)[2]*1e3:.1f}ms")
+
+    db = jax.device_put(bmat)
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        fn(db, dl).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    print(f"dispatch+block, all pre-placed: med={sorted(ts)[2]*1e3:.1f}ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Fetch-path experiments: how do we get device results back faster?"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, n=5):
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts), sorted(ts)[n // 2]
+
+
+def fresh(shape, dtype=jnp.int32):
+    """A fresh on-device array with no cached host copy."""
+    return jax.jit(lambda k: jax.random.randint(k, shape, 0, 100, dtype))(
+        jax.random.PRNGKey(int(time.time() * 1e6) % 2**31))
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, "memories:", [m.kind for m in dev.addressable_memories()])
+
+    R = 262_144
+    shape = (4, R)  # 4.19MB int32
+
+    # 1. plain fetch of fresh arrays
+    def plain():
+        a = fresh(shape)
+        a.block_until_ready()
+        t0 = time.perf_counter()
+        np.asarray(a)
+        return time.perf_counter() - t0
+    ts = sorted(plain() for _ in range(5))
+    print(f"plain fetch 4.19MB: med={ts[2]*1e3:.1f}ms ({4.19/ts[2]:.0f}MB/s)")
+
+    # 2. parallel chunk fetch via threads
+    ex = ThreadPoolExecutor(8)
+    for nchunks in (2, 4, 8):
+        def chunked():
+            a = fresh(shape)
+            a.block_until_ready()
+            rows = np.array_split(np.arange(shape[0] * R), nchunks)
+            flat = a.reshape(-1)
+            parts = [flat[r[0]:r[-1] + 1] for r in rows]
+            for p in parts:
+                p.block_until_ready()
+            t0 = time.perf_counter()
+            list(ex.map(np.asarray, parts))
+            return time.perf_counter() - t0
+        ts = sorted(chunked() for _ in range(5))
+        print(f"parallel fetch x{nchunks}: med={ts[2]*1e3:.1f}ms ({4.19/ts[2]:.0f}MB/s)")
+
+    # 3. pinned_host output sharding
+    try:
+        from jax.sharding import SingleDeviceSharding
+        host_shard = SingleDeviceSharding(dev, memory_kind="pinned_host")
+        f = jax.jit(lambda k: jax.random.randint(k, shape, 0, 100, jnp.int32),
+                    out_shardings=host_shard)
+        def pinned():
+            a = f(jax.random.PRNGKey(int(time.time() * 1e6) % 2**31))
+            a.block_until_ready()
+            t0 = time.perf_counter()
+            np.asarray(a)
+            return time.perf_counter() - t0
+        ts = sorted(pinned() for _ in range(5))
+        print(f"pinned_host out fetch: med={ts[2]*1e3:.1f}ms")
+        # and total including compute
+        def pinned_total():
+            t0 = time.perf_counter()
+            a = f(jax.random.PRNGKey(int(time.time() * 1e6) % 2**31))
+            np.asarray(a)
+            return time.perf_counter() - t0
+        ts = sorted(pinned_total() for _ in range(5))
+        print(f"pinned_host compute+fetch total: med={ts[2]*1e3:.1f}ms")
+    except Exception as e:
+        print("pinned_host failed:", repr(e))
+
+    # 4. device_put round trip for size scaling: latency vs bandwidth
+    for mb in (0.25, 1, 4, 16):
+        n = int(mb * 1024 * 1024 // 4)
+        def rt():
+            a = fresh((n,))
+            a.block_until_ready()
+            t0 = time.perf_counter()
+            np.asarray(a)
+            return time.perf_counter() - t0
+        ts = sorted(rt() for _ in range(5))
+        print(f"fetch {mb}MB: med={ts[2]*1e3:.1f}ms ({mb/ts[2]:.0f}MB/s)")
+
+    # 5. copy_to_host_async then asarray
+    def async_fetch():
+        a = fresh(shape)
+        a.block_until_ready()
+        t0 = time.perf_counter()
+        a.copy_to_host_async()
+        np.asarray(a)
+        return time.perf_counter() - t0
+    ts = sorted(async_fetch() for _ in range(5))
+    print(f"copy_to_host_async+asarray: med={ts[2]*1e3:.1f}ms")
+
+    # 6. does fetch overlap another fetch? two arrays, two threads
+    def dual():
+        a, b = fresh(shape), fresh(shape)
+        a.block_until_ready(); b.block_until_ready()
+        t0 = time.perf_counter()
+        f1 = ex.submit(np.asarray, a)
+        f2 = ex.submit(np.asarray, b)
+        f1.result(); f2.result()
+        return time.perf_counter() - t0
+    ts = sorted(dual() for _ in range(5))
+    print(f"2 arrays 2 threads (8.4MB): med={ts[2]*1e3:.1f}ms ({8.38/ts[2]:.0f}MB/s)")
+
+    ex.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Does copy_to_host_async overlap the transfer with host work?"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench as B
+
+
+def fresh(shape, dtype=jnp.int32):
+    return jax.jit(lambda k: jax.random.randint(k, shape, 0, 100, dtype))(
+        jax.random.PRNGKey(int(time.time() * 1e6) % 2**31))
+
+
+def main():
+    shape = (4, 262_144)
+
+    # host work ~150ms: stage a wal batch repeatedly
+    payloads = B.build_workload(B.N_ROWS)
+    from etl_tpu.ops.wal import concat_payloads, stage_wal_batch
+    buf, offs, lens = concat_payloads(payloads)
+
+    def host_work(reps):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            stage_wal_batch(buf, offs, lens, 4)
+        return time.perf_counter() - t0
+
+    host_work(1)  # warm
+
+    for reps in (0, 4, 10):
+        tot, fetch_ts, hw_ts = [], [], []
+        for _ in range(5):
+            a = fresh(shape)
+            a.block_until_ready()
+            t0 = time.perf_counter()
+            a.copy_to_host_async()
+            hw = host_work(reps)
+            t1 = time.perf_counter()
+            np.asarray(a)
+            t2 = time.perf_counter()
+            tot.append(t2 - t0); fetch_ts.append(t2 - t1); hw_ts.append(hw)
+        i = 2
+        print(f"reps={reps}: total={sorted(tot)[i]*1e3:.0f}ms "
+              f"host_work={sorted(hw_ts)[i]*1e3:.0f}ms "
+              f"final_asarray={sorted(fetch_ts)[i]*1e3:.0f}ms")
+
+    # overlap with another DISPATCH + device exec (does transfer overlap exec?)
+    f = jax.jit(lambda x: (x * 3 + 1).sum(axis=0))
+    big = fresh((64, 262_144))
+    f(big).block_until_ready()
+    tot = []
+    for _ in range(5):
+        a = fresh(shape)
+        a.block_until_ready()
+        t0 = time.perf_counter()
+        a.copy_to_host_async()
+        r = f(big)  # device busy
+        hw = host_work(4)
+        np.asarray(a)
+        r.block_until_ready()
+        tot.append(time.perf_counter() - t0)
+    print(f"fetch + exec + hostwork concurrent: med={sorted(tot)[2]*1e3:.0f}ms")
+
+
+if __name__ == "__main__":
+    main()

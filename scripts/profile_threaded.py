@@ -1,0 +1,95 @@
+"""Prototype: threaded decode pipeline vs current single-thread pipelining.
+
+Worker thread: pack + dispatch + block + fetch. Main thread: stage + complete.
+Fresh arrays every batch (no jax host-copy cache effects).
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+import bench as B
+
+
+def main():
+    payloads = B.build_workload(B.N_ROWS)
+    schema = B.make_schema()
+    from etl_tpu.ops import DeviceDecoder
+    from etl_tpu.ops.wal import concat_payloads, stage_wal_batch
+
+    buf, offs, lens = concat_payloads(payloads)
+    decoder = DeviceDecoder(schema)
+
+    def stage():
+        return stage_wal_batch(buf, offs, lens, 4)
+
+    # warm
+    decoder.decode(stage().staged)
+
+    # phase stamps on one fresh blocking decode
+    wal = stage()
+    t0 = time.perf_counter()
+    widths = decoder._widths(wal.staged)
+    t1 = time.perf_counter()
+    packed, bad = decoder._device_call(wal.staged, widths)  # pack+dispatch
+    t2 = time.perf_counter()
+    packed.block_until_ready()
+    t3 = time.perf_counter()
+    packed_np = np.asarray(packed)
+    t4 = time.perf_counter()
+    batch = decoder._complete(wal.staged, widths, packed)
+    t5 = time.perf_counter()
+    print(f"widths={1e3*(t1-t0):.1f}ms pack+dispatch={1e3*(t2-t1):.1f}ms "
+          f"block={1e3*(t3-t2):.1f}ms fetch={1e3*(t4-t3):.1f}ms "
+          f"complete={1e3*(t5-t4):.1f}ms")
+
+    n_batches = 10
+
+    # current-style single-thread pipelining
+    for trial in range(3):
+        t0 = time.perf_counter()
+        pending = []
+        for _ in range(n_batches):
+            wal = stage()
+            pending.append(decoder.decode_async(wal.staged))
+            if len(pending) >= 4:
+                assert pending.pop(0).result().num_rows == B.N_ROWS
+        for p in pending:
+            p.result()
+        dt = (time.perf_counter() - t0) / n_batches
+        print(f"single-thread pipelined: {B.N_ROWS/dt:.0f} rows/s ({dt*1e3:.0f}ms/batch)")
+
+    # threaded: worker does pack+dispatch+block+fetch
+    ex = ThreadPoolExecutor(1)
+
+    def device_work(staged):
+        widths = decoder._widths(staged)
+        packed, bad = decoder._device_call(staged, widths)
+        packed.block_until_ready()
+        return staged, widths, np.asarray(packed), bad
+
+    for trial in range(3):
+        t0 = time.perf_counter()
+        futs = []
+        done = 0
+        for _ in range(n_batches):
+            wal = stage()
+            futs.append(ex.submit(device_work, wal.staged))
+            if len(futs) >= 3:
+                staged, widths, packed_np, bad = futs.pop(0).result()
+                b = decoder._complete(staged, widths, packed_np, bad)
+                assert b.num_rows == B.N_ROWS
+                done += 1
+        for f in futs:
+            staged, widths, packed_np, bad = f.result()
+            decoder._complete(staged, widths, packed_np, bad)
+        dt = (time.perf_counter() - t0) / n_batches
+        print(f"threaded pipelined: {B.N_ROWS/dt:.0f} rows/s ({dt*1e3:.0f}ms/batch)")
+
+    ex.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -291,9 +291,23 @@ class TestBuckets:
         schema = make_schema([Oid.INT4])
         dec = DeviceDecoder(schema, device_min_rows=0)
         for n in (3, 100, 250):  # all inside the 256 bucket
-            staged = stage_tuples(tuples_from_texts([[str(i)] for i in range(n)]), 1)
+            # constant digit count: same (row-bucket, widths, bit-widths)
+            # signature across batch sizes must reuse one compiled program
+            staged = stage_tuples(
+                tuples_from_texts([[str(100 + i)] for i in range(n)]), 1)
             batch = dec.decode(staged)
-            assert list(batch.columns[0].data) == list(range(n))
+            assert list(batch.columns[0].data) == [100 + i for i in range(n)]
+        assert len(dec._fn_cache) == 1
+
+    def test_jit_cache_bit_width_buckets_are_even(self):
+        # value-width drift (1→2 digits) must NOT recompile: bit widths
+        # bucket to even character counts
+        schema = make_schema([Oid.INT4])
+        dec = DeviceDecoder(schema, device_min_rows=0)
+        for hi in (9, 99):
+            staged = stage_tuples(
+                tuples_from_texts([[str(hi)] for _ in range(8)]), 1)
+            assert dec.decode(staged).columns[0].data[0] == hi
         assert len(dec._fn_cache) == 1
 
     def test_oversized_field_falls_back(self):
@@ -391,3 +405,61 @@ class TestWideOkWords:
         assert isinstance(dev.columns[0].value(0), PgNumeric)
         assert isinstance(dev.columns[0].value(1), PgNumeric)
         assert_batches_equal(dev, cpu)
+
+
+class TestBitpackTransport:
+    """The packed uint32 transport (ops/bitpack.py) must roundtrip exactly
+    at every type's extremes and never corrupt silently (ok=1 implies the
+    value fits its bit budget)."""
+
+    def test_extreme_values_roundtrip(self):
+        dev, cpu = decode_both(
+            [Oid.INT2, Oid.INT4, Oid.INT8, Oid.FLOAT8],
+            [["-32768", "-2147483648", "-9223372036854775808", "-1.5e22"],
+             ["32767", "2147483647", "9223372036854775807", "1e-22"],
+             ["0", "0", "0", "-0"]])
+        assert_batches_equal(dev, cpu)
+
+    def test_long_mantissa_falls_back_not_truncates(self):
+        # 21-digit mantissa, 15 significant digits: the device limbs hold
+        # only 18 digits — must fall back to the CPU oracle, not silently
+        # drop the high digits (parse_float n_mant <= 18 guard)
+        dev, cpu = decode_both(
+            [Oid.FLOAT8],
+            [["123456789012345000000"], ["0.000000000000000012345"],
+             ["999999999999999000000000"], ["1.5"]])
+        assert_batches_equal(dev, cpu)
+
+    def test_oversized_tz_offset_falls_back(self):
+        # tz hh > 15 would overflow the 29-bit packed ms budget; the device
+        # must flag the row so the CPU oracle re-decodes it — surfacing a
+        # typed INVALID_DATA error (the oracle rejects ±24h+ offsets), not
+        # a silently bit-truncated timestamp
+        from etl_tpu.models.errors import EtlError
+
+        with pytest.raises(EtlError):
+            decode_both([Oid.TIMESTAMPTZ], [["2024-01-01 00:00:00+75"]])
+        dev, cpu = decode_both(
+            [Oid.TIMESTAMPTZ],
+            [["2024-01-01 00:00:00+09"],
+             ["2024-06-15 23:59:59.999999-15:59:59"]])
+        assert_batches_equal(dev, cpu)
+
+    def test_timestamptz_extreme_valid_offsets(self):
+        dev, cpu = decode_both(
+            [Oid.TIMESTAMPTZ],
+            [["0001-01-01 00:00:00+15:59:59"],
+             ["9999-12-31 23:59:59.999999-15:59:59"]])
+        assert_batches_equal(dev, cpu)
+
+    def test_layout_saturation_stops_recompiles(self):
+        from etl_tpu.ops.bitpack import layout_for_specs, saturation_width
+        from etl_tpu.models.pgtypes import CellKind
+
+        # widths past saturation must produce identical layouts
+        for kind in (CellKind.I32, CellKind.I64, CellKind.TIMESTAMPTZ,
+                     CellKind.DATE, CellKind.F64, CellKind.BOOL):
+            sat = saturation_width(kind)
+            a = layout_for_specs(((0, kind, 64, sat),))
+            b = layout_for_specs(((0, kind, 64, sat),))
+            assert a == b and a.n_words >= 1
