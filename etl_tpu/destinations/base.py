@@ -96,13 +96,31 @@ class _RowChange:
 def expand_batch_events(events: Iterable[Event]) -> list[Event]:
     """Expand DecodedBatchEvents into per-row Insert/Update/Delete events
     (helper for row-oriented destinations; columnar-native ones consume the
-    batch directly)."""
+    batch directly).
+
+    Emits events identical to the CPU codec path (codec/event.py): update
+    old tuples become TableRow ('O') or identity-masked PartialTableRow
+    ('K'), full old tuples back-fill TOAST-unchanged new values, and 'K'
+    deletes yield PartialTableRow — reference codec/event.rs:28-50."""
+    from ..models.cell import TOAST_UNCHANGED
+    from ..models.table_row import PartialTableRow
+
     out: list[Event] = []
     for e in events:
         if not isinstance(e, DecodedBatchEvent):
             out.append(e)
             continue
         rows = e.batch.to_rows()
+        old_batch = e.old_batch
+        old_rows_list = old_batch.to_rows() if old_batch is not None else []
+        old_by_row = {int(r): j for j, r in enumerate(e.old_rows)}
+        identity = e.schema.identity_mask
+        idx = e.schema.replicated_indices
+        present = [identity[idx[i]] for i in range(len(idx))]
+
+        def partial(row: TableRow) -> PartialTableRow:
+            return PartialTableRow(row.values, list(present))
+
         for i, row in enumerate(rows):
             ct = ChangeType(int(e.change_types[i]))
             commit = Lsn(int(e.commit_lsns[i]))
@@ -111,9 +129,25 @@ def expand_batch_events(events: Iterable[Event]) -> list[Event]:
                 out.append(InsertEvent(e.start_lsn, commit, ordinal,
                                        e.schema, row))
             elif ct is ChangeType.UPDATE:
+                old = None
+                j = old_by_row.get(i)
+                if j is not None:
+                    old_row = old_rows_list[j]
+                    if e.old_is_key[j]:
+                        old = partial(old_row)
+                    else:
+                        old = old_row
+                        # TOAST merge: unchanged columns take the full old
+                        # tuple's values (codec/event.py decode_update)
+                        values = row.values
+                        for k, v in enumerate(values):
+                            if v is TOAST_UNCHANGED:
+                                values[k] = old_row.values[k]
                 out.append(UpdateEvent(e.start_lsn, commit, ordinal,
-                                       e.schema, row))
+                                       e.schema, row, old))
             else:
+                old = partial(row) if e.delete_is_key is not None \
+                    and e.delete_is_key[i] else row
                 out.append(DeleteEvent(e.start_lsn, commit, ordinal,
-                                       e.schema, row))
+                                       e.schema, old))
     return out

@@ -44,6 +44,7 @@ from .base import Destination, WriteAck, expand_batch_events
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
                    DestinationRetryPolicy, TaskSet, change_type_label,
                    escaped_table_name, http_status_retryable,
+                   require_full_batch, require_full_row,
                    sequential_event_program, versioned_table_name,
                    with_retries)
 
@@ -264,6 +265,8 @@ class BigQueryDestination(Destination):
 
     def _row_json(self, schema: ReplicatedTableSchema, row: TableRow,
                   ct: ChangeType, seq: str) -> dict:
+        if ct is not ChangeType.DELETE:
+            require_full_row("bigquery", schema, row)
         doc = {c.name: encode_value(v, c.kind)
                for c, v in zip(schema.replicated_columns, row.values)}
         doc[CHANGE_TYPE_COLUMN] = change_type_label(ct)
@@ -273,6 +276,8 @@ class BigQueryDestination(Destination):
     def _rows_from_batch(self, schema: ReplicatedTableSchema,
                          batch: ColumnarBatch,
                          ev: DecodedBatchEvent | None) -> list[dict]:
+        require_full_batch("bigquery", schema, batch,
+                           ev.change_types if ev is not None else None)
         cols = schema.replicated_columns
         out = []
         for i in range(batch.num_rows):

@@ -40,7 +40,8 @@ from .base import expand_batch_events
 from .util import (CDC_DELETE, CDC_UPSERT, CHANGE_SEQUENCE_COLUMN,
                    CHANGE_TYPE_COLUMN, DestinationRetryPolicy,
                    change_type_label, escaped_table_name,
-                   http_status_retryable, sequential_event_program,
+                   http_status_retryable, require_full_batch,
+                   require_full_row, sequential_event_program,
                    with_retries)
 
 
@@ -255,6 +256,8 @@ class ClickHouseDestination(Destination):
         lines: list[bytes] = []
         for item in items:
             _, row, ct, ev = item
+            if ct is not ChangeType.DELETE:
+                require_full_row("clickhouse", schema, row)
             seq = ev.sequence_key.with_ordinal(0)
             fields = [render_value(v, c.kind) for v, c in
                       zip(row.values, schema.replicated_columns)]
@@ -270,6 +273,8 @@ class ClickHouseDestination(Destination):
     def _render_batch_tsv(self, schema: ReplicatedTableSchema,
                           batch: ColumnarBatch, *, change_type: str | None,
                           seqs: DecodedBatchEvent | None) -> bytes:
+        require_full_batch("clickhouse", schema, batch,
+                           seqs.change_types if seqs is not None else None)
         cols = schema.replicated_columns
         out = []
         for i in range(batch.num_rows):

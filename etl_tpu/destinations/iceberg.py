@@ -30,7 +30,8 @@ from .base import Destination, WriteAck, expand_batch_events
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
                    DestinationRetryPolicy, change_type_label,
                    escaped_table_name, http_status_retryable,
-                   sequential_event_program, with_retries)
+                   require_full_row, sequential_event_program,
+                   with_retries)
 from ..models.event import ChangeType
 
 _ICEBERG_TYPES: dict[CellKind, str] = {
@@ -170,6 +171,7 @@ class IcebergDestination(Destination):
                 rows.append(e.old_row)
                 types.append(change_type_label(ChangeType.DELETE))
             else:
+                require_full_row("iceberg", schema, e.row)
                 rows.append(e.row)
                 types.append(change_type_label(ChangeType.INSERT))
             seqs.append(e.sequence_key.with_ordinal(i))

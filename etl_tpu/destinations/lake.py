@@ -42,7 +42,8 @@ from ..models.schema import ReplicatedTableSchema, TableId
 from ..models.table_row import ColumnarBatch
 from .base import Destination, WriteAck, expand_batch_events
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN, CDC_DELETE,
-                   CDC_UPSERT, change_type_label, escaped_table_name,
+                   CDC_PATCH, CDC_UPSERT, PATCH_MISSING_COLUMN,
+                   _identity_values, change_type_label, escaped_table_name,
                    sequential_event_program)
 
 
@@ -140,6 +141,7 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
 
     async def write_table_rows(self, schema: ReplicatedTableSchema,
                                batch: ColumnarBatch) -> WriteAck:
+        await self._wait_maintenance_clear(schema.id)
         name, gen = self._ensure_table(schema)
         if batch.num_rows:
             rb = batch.to_arrow()
@@ -162,18 +164,45 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
 
     async def _write_cdc_file(self, schema: ReplicatedTableSchema,
                               evs: list) -> None:
+        from ..models.cell import TOAST_UNCHANGED
+
+        await self._wait_maintenance_clear(schema.id)
         name, gen = self._ensure_table(schema)
         row = self._table_row(schema.id)
         watermark = row[3] if row else ""
-        seqs, types, rows = [], [], []
+        seqs, types, rows, missing = [], [], [], []
         for i, e in enumerate(evs):
             seq = e.sequence_key.with_ordinal(i)
             seqs.append(seq)
             if isinstance(e, DeleteEvent):
                 types.append(CDC_DELETE)
                 rows.append(e.old_row)
+                missing.append(None)
             else:
-                types.append(CDC_UPSERT)
+                omitted = [c.name for c, v
+                           in zip(schema.replicated_columns, e.row.values)
+                           if v is TOAST_UNCHANGED]
+                if omitted and isinstance(e, UpdateEvent) \
+                        and e.old_row is not None \
+                        and _identity_values(schema, e.old_row) \
+                        != _identity_values(schema, e.row):
+                    # the old-identity row is deleted by the split program;
+                    # a patch keyed by the NEW identity has no stored row
+                    # to preserve columns from — unreconstructable
+                    raise EtlError(
+                        ErrorKind.SOURCE_REPLICA_IDENTITY,
+                        f"lake: identity-changing update for {schema.name} "
+                        f"omits TOASTed column(s) {omitted}; set REPLICA "
+                        f"IDENTITY FULL on the source table.")
+                if omitted:
+                    # unchanged-TOAST without an old image: column-wise
+                    # patch — stored values for the omitted columns are
+                    # preserved at collapse (ducklake/batches.rs Partial)
+                    types.append(CDC_PATCH)
+                    missing.append(json.dumps(omitted))
+                else:
+                    types.append(CDC_UPSERT)
+                    missing.append(None)
                 rows.append(e.row)
         max_seq = max(seqs)
         if watermark and max_seq <= watermark:
@@ -184,6 +213,9 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
                               pa.array(types, type=pa.string()))
         rb = rb.append_column(CHANGE_SEQUENCE_COLUMN,
                               pa.array(seqs, type=pa.string()))
+        if any(m is not None for m in missing):
+            rb = rb.append_column(PATCH_MISSING_COLUMN,
+                                  pa.array(missing, type=pa.string()))
         path = self._write_parquet(self.root / name, rb)
         self._record_file(schema.id, gen, path, "cdc", len(rows), max_seq)
         if self._cdc_file_count(schema.id, gen) >= self.config.compact_min_files:
@@ -225,23 +257,45 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
         if row is None:
             raise EtlError(ErrorKind.DESTINATION_FAILED,
                            f"unknown table {table_id}")
-        name, schema_json, gen, _ = row
-        schema = ReplicatedTableSchema.from_json(json.loads(schema_json))
-        key_cols = [c.name for c in schema.identity_columns()] or \
-            [c.name for c in schema.replicated_columns]
+        _, _, gen, _ = row
         files = self._catalog().execute(
             "SELECT path, kind FROM lake_files WHERE table_id = ? AND "
             "generation = ? ORDER BY id", (table_id, gen)).fetchall()
+        return self._collapse(row, files)
+
+    def _collapse(self, table_row, files: "list[tuple[str, str]]") -> pa.Table:
+        """Collapse an EXPLICIT (path, kind) file list — the caller passes
+        the lake_tables row and file set it observed (compact: under its
+        transaction) so the merge and the catalog swap agree on inputs."""
+        name, schema_json, gen, _ = table_row
+        schema = ReplicatedTableSchema.from_json(json.loads(schema_json))
+        key_cols = [c.name for c in schema.identity_columns()] or \
+            [c.name for c in schema.replicated_columns]
         live: dict[tuple, dict] = {}
         for path, kind in files:
             t = pq.read_table(path)
             for rec in t.to_pylist():
                 key = tuple(rec[k] for k in key_cols)
-                if kind == "cdc" and rec.get(CHANGE_TYPE_COLUMN) == CDC_DELETE:
+                ct = rec.get(CHANGE_TYPE_COLUMN) if kind == "cdc" else None
+                if ct == CDC_DELETE:
                     live.pop(key, None)
+                    continue
+                patch_missing = rec.get(PATCH_MISSING_COLUMN)
+                rec.pop(CHANGE_TYPE_COLUMN, None)
+                rec.pop(CHANGE_SEQUENCE_COLUMN, None)
+                rec.pop(PATCH_MISSING_COLUMN, None)
+                if ct == CDC_PATCH:
+                    # column-wise update: omitted columns keep stored values;
+                    # patch for an absent key is a no-op (reference SQL
+                    # UPDATE-with-predicate semantics)
+                    prev = live.get(key)
+                    if prev is None:
+                        continue
+                    omitted = set(json.loads(patch_missing or "[]"))
+                    for k, v in rec.items():
+                        if k not in omitted:
+                            prev[k] = v
                 else:
-                    rec.pop(CHANGE_TYPE_COLUMN, None)
-                    rec.pop(CHANGE_SEQUENCE_COLUMN, None)
                     live[key] = rec
         if not live:
             return pa.table({c.name: [] for c in schema.replicated_columns})
@@ -282,10 +336,51 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
         return [r[0] for r in self._catalog().execute(
             "SELECT table_id FROM lake_tables").fetchall()]
 
+    # writers give up on the maintenance flag after this long: a crashed
+    # external maintenance process (flag never cleared) must surface as a
+    # retryable error, not wedge the pipeline silently
+    MAINTENANCE_WAIT_TIMEOUT_S = 60.0
+
+    async def _wait_maintenance_clear(self, table_id: TableId) -> None:
+        """Writers block while external maintenance holds the table
+        (ADVICE r1: writers previously never checked the flag, so an
+        external compaction could race a live CDC commit)."""
+        import logging
+
+        db = self._catalog()
+        waited = 0.0
+        warned = False
+        while True:
+            busy = db.execute(
+                "SELECT in_progress FROM lake_maintenance WHERE "
+                "table_id = ?", (table_id,)).fetchone()
+            if not busy or not busy[0]:
+                return
+            if waited >= self.MAINTENANCE_WAIT_TIMEOUT_S:
+                raise EtlError(
+                    ErrorKind.DESTINATION_FAILED,
+                    f"lake: maintenance flag for table {table_id} held for "
+                    f">{self.MAINTENANCE_WAIT_TIMEOUT_S:.0f}s — external "
+                    f"maintenance crashed without clearing it? (UPDATE "
+                    f"lake_maintenance SET in_progress = 0 to recover)")
+            if waited >= 5.0 and not warned:
+                warned = True
+                logging.getLogger("etl_tpu.destinations").warning(
+                    "lake: writer waiting on maintenance flag for table %s",
+                    table_id)
+            await asyncio.sleep(0.05)
+            waited += 0.05
+
     async def compact(self, table_id: TableId) -> int:
         """Merge the current generation's files into one base file.
         Returns merged file count. Guarded by the catalog maintenance flag
-        (reference external_maintenance.rs coordination)."""
+        (reference external_maintenance.rs coordination).
+
+        The observe→merge→replace sequence runs inside ONE immediate
+        catalog transaction and deletes ONLY the observed file ids — a CDC
+        file committed concurrently (external maintenance binary vs a live
+        replicator) survives the swap instead of being dropped unmerged
+        (ADVICE r1 data-loss race)."""
         db = self._catalog()
         busy = db.execute("SELECT in_progress FROM lake_maintenance WHERE "
                           "table_id = ?", (table_id,)).fetchone()
@@ -296,28 +391,41 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
                    "in_progress = 1", (table_id,))
         db.commit()
         try:
-            row = self._table_row(table_id)
+            db.execute("BEGIN IMMEDIATE")
+            row = db.execute(
+                "SELECT name, schema_json, generation, max_seq FROM "
+                "lake_tables WHERE table_id = ?", (table_id,)).fetchone()
             if row is None:
+                db.execute("ROLLBACK")
                 return 0
             name, _, gen, max_seq = row
             files = db.execute(
-                "SELECT id, path FROM lake_files WHERE table_id = ? AND "
-                "generation = ?", (table_id, gen)).fetchall()
+                "SELECT id, path, kind FROM lake_files WHERE table_id = ? "
+                "AND generation = ? ORDER BY id", (table_id, gen)).fetchall()
             if len(files) < 2:
+                db.execute("ROLLBACK")
                 return 0
-            merged = self.read_current(table_id)
+            merged = self._collapse(row, [(p, k) for _, p, k in files])
             path = self.root / name / f"data-{uuid.uuid4().hex}.parquet"
             pq.write_table(merged, path)
-            db.execute("DELETE FROM lake_files WHERE table_id = ? AND "
-                       "generation = ?", (table_id, gen))
+            ids = [fid for fid, _, _ in files]
+            db.execute(
+                f"DELETE FROM lake_files WHERE id IN "
+                f"({','.join('?' * len(ids))})", ids)
             db.execute(
                 "INSERT INTO lake_files (table_id, generation, path, kind, "
                 "row_count, max_seq) VALUES (?, ?, ?, 'base', ?, ?)",
                 (table_id, gen, str(path), merged.num_rows, max_seq))
             db.commit()
-            for _id, p in files:
+            for _id, p, _k in files:
                 Path(p).unlink(missing_ok=True)
             return len(files)
+        except BaseException:
+            try:
+                db.execute("ROLLBACK")
+            except sqlite3.OperationalError:
+                pass
+            raise
         finally:
             db.execute("UPDATE lake_maintenance SET in_progress = 0 WHERE "
                        "table_id = ?", (table_id,))

@@ -33,7 +33,8 @@ from .bigquery import encode_value  # same JSON value encoding rules
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
                    DestinationRetryPolicy, change_type_label,
                    escaped_table_name, http_status_retryable,
-                   sequential_event_program, with_retries)
+                   require_full_row, sequential_event_program,
+                   with_retries)
 
 _SF_TYPES: dict[CellKind, str] = {
     CellKind.BOOL: "BOOLEAN", CellKind.I16: "NUMBER(5,0)",
@@ -215,6 +216,8 @@ class SnowflakeDestination(Destination):
             row = e.old_row if isinstance(e, DeleteEvent) else e.row
             ct = ChangeType.DELETE if isinstance(e, DeleteEvent) \
                 else ChangeType.INSERT
+            if ct is not ChangeType.DELETE:
+                require_full_row("snowflake", schema, row)
             doc = {c.name: encode_value(v, c.kind)
                    for c, v in zip(schema.replicated_columns, row.values)}
             doc[CHANGE_TYPE_COLUMN] = change_type_label(ct)

@@ -25,10 +25,57 @@ CHANGE_SEQUENCE_COLUMN = "_CHANGE_SEQUENCE_NUMBER"
 
 CDC_UPSERT = "UPSERT"
 CDC_DELETE = "DELETE"
+# column-wise partial update: only the columns NOT listed in the row's
+# `_PATCH_MISSING` metadata overwrite the stored row (the lake analogue of
+# reference ducklake/batches.rs UpdatedTableRow::Partial → SQL UPDATE)
+CDC_PATCH = "PATCH"
+PATCH_MISSING_COLUMN = "_PATCH_MISSING"
 
 
 def change_type_label(ct: ChangeType) -> str:
     return CDC_DELETE if ct is ChangeType.DELETE else CDC_UPSERT
+
+
+def require_full_row(destination: str, schema, row) -> None:
+    """Full-row UPSERT destinations cannot preserve omitted columns: an
+    update row still carrying TOAST_UNCHANGED values (source has default
+    replica identity and didn't ship the old image) must fail typed rather
+    than overwrite stored values with NULL (reference
+    bigquery/core.rs:1477-1495 bigquery_update_new_row; ADVICE r1 high).
+    Remedy: ALTER TABLE ... REPLICA IDENTITY FULL on the source."""
+    from ..models.cell import TOAST_UNCHANGED
+
+    if any(v is TOAST_UNCHANGED for v in row.values):
+        missing = [c.name for c, v in zip(schema.replicated_columns,
+                                          row.values)
+                   if v is TOAST_UNCHANGED]
+        raise EtlError(
+            ErrorKind.SOURCE_REPLICA_IDENTITY,
+            f"{destination}: update for {schema.name} omits TOASTed "
+            f"column(s) {missing} (unchanged-TOAST without an old image); "
+            f"full-row upsert would overwrite them with NULL. Set REPLICA "
+            f"IDENTITY FULL on the source table.")
+
+
+def require_full_batch(destination: str, schema, batch,
+                       change_types=None) -> None:
+    """Columnar-path variant of `require_full_row`: reject TOAST-unchanged
+    cells in non-DELETE rows of a ColumnarBatch."""
+    for c in batch.columns:
+        if c.toast_unchanged is None or not c.toast_unchanged.any():
+            continue
+        for i in range(batch.num_rows):
+            if not c.toast_unchanged[i]:
+                continue
+            if change_types is not None \
+                    and int(change_types[i]) == int(ChangeType.DELETE):
+                continue
+            raise EtlError(
+                ErrorKind.SOURCE_REPLICA_IDENTITY,
+                f"{destination}: update for {schema.name} omits TOASTed "
+                f"column {c.schema.name} (unchanged-TOAST without an old "
+                f"image); full-row upsert would overwrite it with NULL. "
+                f"Set REPLICA IDENTITY FULL on the source table.")
 
 
 def sequence_number(key: EventSequenceKey, ordinal: int) -> str:
@@ -113,11 +160,37 @@ class TaskSet:
         await self.join()
 
 
+def _identity_values(schema, row):
+    """Identity-column values of a row, in replicated order."""
+    idx = schema.replicated_indices
+    identity = schema.identity_mask
+    return tuple(v for i, v in enumerate(row.values) if identity[idx[i]])
+
+
+def split_key_changing_update(e):
+    """An UPDATE whose old image shows a different replica identity leaves
+    the old-identity row stale in upsert-keyed destinations. Emit
+    DELETE(old identity) + the update, mirroring reference
+    ducklake/batches.rs `Full → Delete{origin: update} + Upsert`
+    (ADVICE r1: key-changing updates leave duplicate rows in _current
+    views). Returns [events…] to apply in order."""
+    from ..models.event import DeleteEvent, UpdateEvent
+
+    if not isinstance(e, UpdateEvent) or e.old_row is None:
+        return [e]
+    if _identity_values(e.schema, e.old_row) == \
+            _identity_values(e.schema, e.row):
+        return [e]
+    return [DeleteEvent(e.start_lsn, e.commit_lsn, e.tx_ordinal, e.schema,
+                        e.old_row), e]
+
+
 def sequential_event_program(events):
     """Order-preserving destination program: yields ("rows", schema, [row
     events…]) runs and ("truncate", event) / ("schema_change", event)
     barriers, splitting runs so WAL order is preserved — rows preceding a
-    truncate in the batch must land before it executes.
+    truncate in the batch must land before it executes. Key-changing
+    updates expand to DELETE(old identity) + update.
 
     Accepts expanded per-row events (use expand_batch_events first)."""
     from ..models.event import (DeleteEvent, InsertEvent, SchemaChangeEvent,
@@ -125,7 +198,10 @@ def sequential_event_program(events):
 
     run_schema = None
     run: list = []
-    for e in events:
+    flat = (e for outer in events
+            for e in (split_key_changing_update(outer)
+                      if isinstance(outer, UpdateEvent) else (outer,)))
+    for e in flat:
         if isinstance(e, (InsertEvent, UpdateEvent, DeleteEvent)):
             if run_schema is not None and (run_schema.id != e.schema.id
                                            or run_schema != e.schema):

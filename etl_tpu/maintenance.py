@@ -20,7 +20,8 @@ from .destinations.lake import LakeConfig, LakeDestination
 
 async def run_maintenance(warehouse: str, *, vacuum: bool,
                           api_url: str | None, pipeline_id: int | None,
-                          tenant_id: str | None) -> dict:
+                          tenant_id: str | None,
+                          stop_timeout_s: float = 120.0) -> dict:
     paused = False
     session = None
     if api_url and pipeline_id is not None:
@@ -28,20 +29,37 @@ async def run_maintenance(warehouse: str, *, vacuum: bool,
 
         session = aiohttp.ClientSession(
             headers={"tenant_id": tenant_id or ""})
-        try:
-            resp = await session.post(
-                f"{api_url}/v1/pipelines/{pipeline_id}/stop")
-            paused = resp.status in (200, 202)
+    try:
+        if session is not None:
+            async with session.post(
+                    f"{api_url}/v1/pipelines/{pipeline_id}/stop") as resp:
+                paused = resp.status in (200, 202)
             if not paused:
                 # the operator asked for coordination; running maintenance
                 # against a live writer is exactly what they tried to avoid
                 raise RuntimeError(
                     f"could not pause pipeline {pipeline_id}: "
                     f"HTTP {resp.status} — aborting maintenance")
-        except BaseException:
-            await session.close()
-            raise
-    try:
+            # 202 means 'stopping': the orchestrator deletes the workload
+            # but the pod may still be draining — poll until the pipeline
+            # reports stopped so compaction never overlaps a live writer
+            # (ADVICE r1: pause coordination race). A timeout here still
+            # flows through the resume in the finally below — aborted
+            # maintenance must not leave replication down.
+            deadline = asyncio.get_event_loop().time() + stop_timeout_s
+            while True:
+                async with session.get(
+                        f"{api_url}/v1/pipelines/{pipeline_id}/status") as st:
+                    body = await st.json() if st.status == 200 else {}
+                if body.get("state") == "stopped":
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise RuntimeError(
+                        f"pipeline {pipeline_id} did not reach 'stopped' "
+                        f"within {stop_timeout_s}s "
+                        f"(state={body.get('state')!r}) — "
+                        f"aborting maintenance")
+                await asyncio.sleep(min(0.5, stop_timeout_s / 10))
         lake = LakeDestination(LakeConfig(warehouse))
         await lake.startup()
         table_ids = lake.table_ids()
@@ -58,14 +76,16 @@ async def run_maintenance(warehouse: str, *, vacuum: bool,
         if session is not None:
             try:
                 if paused:
-                    resp = await session.post(
-                        f"{api_url}/v1/pipelines/{pipeline_id}/start")
-                    if resp.status not in (200, 202):
-                        import logging
+                    async with session.post(
+                            f"{api_url}/v1/pipelines/{pipeline_id}/start") \
+                            as resp:
+                        if resp.status not in (200, 202):
+                            import logging
 
-                        logging.getLogger("etl_tpu.maintenance").error(
-                            "failed to resume pipeline %s: HTTP %s — "
-                            "resume it manually", pipeline_id, resp.status)
+                            logging.getLogger("etl_tpu.maintenance").error(
+                                "failed to resume pipeline %s: HTTP %s — "
+                                "resume it manually", pipeline_id,
+                                resp.status)
             except Exception as e:
                 import logging
 

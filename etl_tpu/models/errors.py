@@ -45,6 +45,7 @@ class ErrorKind(enum.Enum):
     COPY_FORMAT_INVALID = enum.auto()
 
     # --- schema class ---
+    SOURCE_REPLICA_IDENTITY = enum.auto()  # reference SourceReplicaIdentityError
     SCHEMA_NOT_FOUND = enum.auto()
     SCHEMA_MISMATCH = enum.auto()
     SCHEMA_CHANGE_UNSUPPORTED = enum.auto()
@@ -163,6 +164,7 @@ _MANUAL_KINDS = frozenset({
     ErrorKind.PUBLICATION_NOT_FOUND,
     ErrorKind.PUBLICATION_TABLE_MISSING,
     ErrorKind.MISSING_PRIMARY_KEY,
+    ErrorKind.SOURCE_REPLICA_IDENTITY,
     ErrorKind.SCHEMA_MISMATCH,
     ErrorKind.SCHEMA_CHANGE_UNSUPPORTED,
     ErrorKind.UNSUPPORTED_TYPE,

@@ -142,22 +142,73 @@ class SchemaChangeEvent:
     new_schema: ReplicatedTableSchema | None  # None = table dropped
 
 
-@dataclass(slots=True)
 class DecodedBatchEvent:
     """TPU-path event: a contiguous same-table run of changes decoded on
     device into columnar form. `change_types[i]` and `tx_ordinals[i]` /
-    `commit_lsns[i]` give each row its identity in the WAL order."""
+    `commit_lsns[i]` give each row its identity in the WAL order.
 
-    start_lsn: Lsn
-    commit_lsn: Lsn
-    schema: ReplicatedTableSchema
-    batch: ColumnarBatch
-    change_types: np.ndarray  # uint8[n] of ChangeType
-    commit_lsns: np.ndarray  # uint64[n]
-    tx_ordinals: np.ndarray  # uint64[n]
+    `batch` / `old_batch` resolve lazily: the assembler hands the event an
+    in-flight device decode (`pending`, an object with `.result()`), so the
+    device works and the result streams back to the host while the apply
+    loop keeps reading WAL — the decode completes inside the destination
+    write that consumes it (the software-pipelining analogue of the
+    reference's one-in-flight flush, apply.rs:1956-2023).
+
+    Old-tuple identity (reference codec/event.rs:28-50): `old_rows[j]` is
+    the row index whose update carried an old/key tuple (stored as row j of
+    `old_batch`); `old_is_key[j]` distinguishes 'K' key tuples from 'O'
+    full tuples. `delete_is_key[i]` is True when DELETE row i carried a 'K'
+    tuple (identity columns only) rather than a full 'O' old row.
+    """
+
+    __slots__ = ("start_lsn", "commit_lsn", "schema", "change_types",
+                 "commit_lsns", "tx_ordinals", "old_rows", "old_is_key",
+                 "delete_is_key", "_batch", "_pending", "_old_batch",
+                 "_old_pending")
+
+    def __init__(self, start_lsn: Lsn, commit_lsn: Lsn,
+                 schema: ReplicatedTableSchema, *,
+                 change_types: np.ndarray, commit_lsns: np.ndarray,
+                 tx_ordinals: np.ndarray,
+                 batch: ColumnarBatch | None = None, pending=None,
+                 old_batch: ColumnarBatch | None = None, old_pending=None,
+                 old_rows: np.ndarray | None = None,
+                 old_is_key: np.ndarray | None = None,
+                 delete_is_key: np.ndarray | None = None):
+        if batch is None and pending is None:
+            raise ValueError("DecodedBatchEvent needs batch or pending")
+        self.start_lsn = start_lsn
+        self.commit_lsn = commit_lsn
+        self.schema = schema
+        self.change_types = change_types
+        self.commit_lsns = commit_lsns
+        self.tx_ordinals = tx_ordinals
+        self.old_rows = old_rows if old_rows is not None \
+            else np.zeros(0, dtype=np.int64)
+        self.old_is_key = old_is_key if old_is_key is not None \
+            else np.zeros(0, dtype=np.bool_)
+        self.delete_is_key = delete_is_key
+        self._batch = batch
+        self._pending = pending
+        self._old_batch = old_batch
+        self._old_pending = old_pending
+
+    @property
+    def batch(self) -> ColumnarBatch:
+        if self._batch is None:
+            self._batch = self._pending.result()
+            self._pending = None
+        return self._batch
+
+    @property
+    def old_batch(self) -> ColumnarBatch | None:
+        if self._old_batch is None and self._old_pending is not None:
+            self._old_batch = self._old_pending.result()
+            self._old_pending = None
+        return self._old_batch
 
     def __len__(self) -> int:
-        return self.batch.num_rows
+        return len(self.change_types)
 
 
 Event = Union[
@@ -179,5 +230,9 @@ def event_size_hint(e: Event) -> int:
     if isinstance(e, DeleteEvent):
         return 64 + e.old_row.size_hint()
     if isinstance(e, DecodedBatchEvent):
-        return 64 + e.batch.size_hint() + e.change_types.nbytes + 16 * len(e)
+        # don't force a lazy in-flight decode just for accounting
+        base = 64 + e.change_types.nbytes + 16 * len(e)
+        if e._batch is not None:
+            base += e._batch.size_hint()
+        return base
     return 64

@@ -32,6 +32,7 @@ class WalBatch:
     old_staged: StagedBatch | None  # old/key tuples for U rows that sent one
     old_rows: np.ndarray  # int64[k] — row indices old_staged corresponds to
     old_is_key: np.ndarray  # bool[k] — True: 'K' key tuple, False: 'O' full
+    delete_is_key: np.ndarray  # bool[n] — DELETE row i carried a 'K' tuple
     non_row_indices: np.ndarray  # int64[] messages for host decode
     relids: np.ndarray  # int32[n] per-row relation oid
     bad_from: int  # -1, or first malformed message index (rest unframed)
@@ -128,10 +129,13 @@ def stage_wal_batch(buf: bytes | np.ndarray, msg_off: np.ndarray,
         old_rows = np.zeros(0, dtype=np.int64)
         old_is_key = np.zeros(0, dtype=np.bool_)
 
+    delete_is_key = is_d[row_idx] & (framed.old_kind[row_idx] == ord("K"))
+
     return WalBatch(
         staged=staged, change_types=change,
         msg_index=row_idx.astype(np.int64), old_staged=old_staged,
         old_rows=old_rows, old_is_key=old_is_key,
+        delete_is_key=delete_is_key,
         non_row_indices=non_row.astype(np.int64),
         relids=framed.relid[row_idx], bad_from=bad)
 

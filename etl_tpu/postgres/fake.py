@@ -84,6 +84,11 @@ class FakeDatabase:
         self.tables[schema.id] = t
         return t
 
+    def set_replica_identity(self, table_id: TableId, identity: str) -> None:
+        """'d' (default: PK) or 'f' (full) — ALTER TABLE ... REPLICA IDENTITY."""
+        assert identity in ("d", "f"), identity
+        self.tables[table_id].replica_identity = ord(identity)
+
     def create_publication(self, name: str, table_ids: list[TableId],
                            column_filters: dict[TableId, list[str]] | None = None,
                            row_filters: "dict[TableId, callable] | None" = None
@@ -205,17 +210,40 @@ class FakeTransaction:
             elif kind == "U":
                 _, tid, values, key = op
                 t = db.tables[tid]
-                key_vals = [None if v is None else v.encode() for v in key]
+                kcols = self._key_columns(t)
+                old_row = self._find_row(t, key)
+                enc = lambda vs: [None if v is None else v.encode()
+                                  for v in vs]
+                # PG semantics: identity-full sends the full old row ('O');
+                # default identity sends a key-only tuple ('K') ONLY when
+                # an identity column changed; otherwise no old tuple
+                old_values = key_values = None
+                if t.replica_identity == ord("f") and old_row is not None:
+                    old_values = enc(old_row)
+                elif old_row is not None and any(
+                        old_row[i] != values[i] for i in kcols):
+                    key_values = enc([old_row[i] if i in kcols else None
+                                      for i in range(len(old_row))])
                 body_entries.append((pgoutput.encode_update(
-                    tid, [None if v is None else v.encode() for v in values],
-                    key_values=key_vals), tid, list(values)))
+                    tid, enc(values), old_values=old_values,
+                    key_values=key_values), tid, list(values)))
                 self._apply_update(t, key, values)
             elif kind == "D":
                 _, tid, _, key = op
                 t = db.tables[tid]
+                kcols = self._key_columns(t)
+                old_row = self._find_row(t, key)
+                if t.replica_identity == ord("f") and old_row is not None:
+                    tup = old_row
+                    full = True
+                else:
+                    src = old_row if old_row is not None else key
+                    tup = [src[i] if i in kcols else None
+                           for i in range(len(src))]
+                    full = False
                 body_entries.append((pgoutput.encode_delete(
-                    tid, [None if v is None else v.encode() for v in key]),
-                    tid, list(key)))
+                    tid, [None if v is None else v.encode() for v in tup],
+                    full_old=full), tid, list(key)))
                 self._apply_delete(t, key)
             elif kind == "T":
                 _, tids, options, _ = op
@@ -243,6 +271,13 @@ class FakeTransaction:
     def _key_columns(self, t: FakeTable) -> list[int]:
         pk = [i for i, c in enumerate(t.schema.columns) if c.is_primary_key]
         return pk or list(range(len(t.schema.columns)))
+
+    def _find_row(self, t: FakeTable, key) -> list | None:
+        kcols = self._key_columns(t)
+        for row in t.rows:
+            if all(row[i] == key[i] for i in kcols):
+                return list(row)
+        return None
 
     def _apply_update(self, t: FakeTable, key, values) -> None:
         kcols = self._key_columns(t)

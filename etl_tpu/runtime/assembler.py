@@ -116,15 +116,20 @@ class EventAssembler:
         if wal.bad_from >= 0:
             raise EtlError(ErrorKind.WAL_DECODE_FAILED,
                            f"malformed row message at run index {wal.bad_from}")
-        batch = decoder.decode(wal.staged)
+        # async dispatch: the device decodes (and streams results back)
+        # while the apply loop keeps reading WAL; the DecodedBatchEvent
+        # resolves the batch lazily when the destination write consumes it
+        pending = decoder.decode_async(wal.staged)
+        old_pending = decoder.decode_async(wal.old_staged) \
+            if wal.old_staged is not None else None
         self._events.append(DecodedBatchEvent(
-            start_lsn=Lsn(r.start_lsns[0]),
-            commit_lsn=Lsn(r.commit_lsns[-1]),
-            schema=r.schema,
-            batch=batch,
+            Lsn(r.start_lsns[0]), Lsn(r.commit_lsns[-1]), r.schema,
+            pending=pending,
             change_types=wal.change_types,
             commit_lsns=np.asarray(r.commit_lsns, dtype=np.uint64),
             tx_ordinals=np.asarray(r.tx_ordinals, dtype=np.uint64),
+            old_pending=old_pending, old_rows=wal.old_rows,
+            old_is_key=wal.old_is_key, delete_is_key=wal.delete_is_key,
         ))
 
     def flush(self) -> list[Event]:

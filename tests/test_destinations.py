@@ -443,3 +443,98 @@ class TestWalOrderBarriers:
         from etl_tpu.destinations.bigquery import bq_field
         f = bq_field(schema_notnull.replicated_columns[1], {"id"})
         assert f["mode"] == "NULLABLE"
+
+
+class TestToastUnchanged:
+    """Unchanged-TOAST columns must never be flattened to NULL at a
+    destination (ADVICE r1 high; reference ducklake Partial updates,
+    bigquery_update_new_row error)."""
+
+    def _toast_update(self, i=0, lsn=0x200):
+        from etl_tpu.models.cell import TOAST_UNCHANGED
+
+        # id=1 updated, note TOASTed-unchanged (no old image)
+        return UpdateEvent(Lsn(lsn), Lsn(lsn), i, make_schema(),
+                           TableRow([1, TOAST_UNCHANGED, PgNumeric("5")]))
+
+    async def test_lake_patch_preserves_stored_value(self, tmp_path):
+        dest = LakeDestination(LakeConfig(warehouse_path=str(tmp_path)))
+        await dest.startup()
+        await dest.write_events([ins(0, [1, "big-toasted-note", PgNumeric("1")])])
+        await dest.write_events([self._toast_update()])
+        t = dest.read_current(TID)
+        recs = t.to_pylist()
+        assert len(recs) == 1
+        assert recs[0]["note"] == "big-toasted-note"  # NOT nulled
+        assert recs[0]["amount"] == "5"
+        await dest.shutdown()
+
+    async def test_lake_patch_survives_compaction(self, tmp_path):
+        dest = LakeDestination(LakeConfig(warehouse_path=str(tmp_path),
+                                          compact_min_files=100))
+        await dest.startup()
+        await dest.write_events([ins(0, [1, "keep-me", PgNumeric("1")])])
+        await dest.write_events([self._toast_update()])
+        merged = await dest.compact(TID)
+        assert merged >= 2
+        recs = dest.read_current(TID).to_pylist()
+        assert recs[0]["note"] == "keep-me"
+        await dest.shutdown()
+
+    async def test_bigquery_refuses_toast_upsert(self):
+        from etl_tpu.models.errors import ErrorKind, EtlError
+
+        srv = RecordingHttpServer()
+        await srv.start()
+        try:
+            dest = BigQueryDestination(BigQueryConfig(
+                project_id="p", dataset_id="d", base_url=srv.url()),
+                retry=RETRY_FAST)
+            await dest.startup()
+            with pytest.raises(EtlError) as ei:
+                ack = await dest.write_events([self._toast_update()])
+                await ack.wait_durable()
+            assert ei.value.kind is ErrorKind.SOURCE_REPLICA_IDENTITY
+            await dest.shutdown()
+        finally:
+            await srv.stop()
+
+    async def test_clickhouse_refuses_toast_upsert(self):
+        from etl_tpu.models.errors import ErrorKind, EtlError
+
+        srv = RecordingHttpServer()
+        await srv.start()
+        try:
+            dest = ClickHouseDestination(ClickHouseConfig(
+                url=srv.url(), database="db"), retry=RETRY_FAST)
+            await dest.startup()
+            with pytest.raises(EtlError) as ei:
+                await dest.write_events([self._toast_update()])
+            assert ei.value.kind is ErrorKind.SOURCE_REPLICA_IDENTITY
+            await dest.shutdown()
+        finally:
+            await srv.stop()
+
+
+class TestKeyChangingUpdate:
+    """An update that changes the replica identity must delete the
+    old-identity row (ADVICE r1: stale duplicates in _current views;
+    reference ducklake Full -> Delete{origin:update} + Upsert)."""
+
+    async def test_lake_no_stale_row(self, tmp_path):
+        from etl_tpu.models.table_row import PartialTableRow
+
+        dest = LakeDestination(LakeConfig(warehouse_path=str(tmp_path)))
+        await dest.startup()
+        await dest.write_events([ins(0, [1, "a", PgNumeric("1")]),
+                                 ins(1, [2, "b", PgNumeric("2")])])
+        # PK 1 -> 9 with a key-only old image
+        upd = UpdateEvent(Lsn(0x300), Lsn(0x300), 0, make_schema(),
+                          TableRow([9, "a2", PgNumeric("1")]),
+                          PartialTableRow([1, None, None],
+                                          [True, False, False]))
+        await dest.write_events([upd])
+        recs = {r["id"]: r for r in dest.read_current(TID).to_pylist()}
+        assert set(recs) == {9, 2}, "old-identity row 1 must be deleted"
+        assert recs[9]["note"] == "a2"
+        await dest.shutdown()

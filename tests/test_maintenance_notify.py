@@ -43,6 +43,11 @@ class TestMaintenance:
     async def test_pause_resume_via_api(self, tmp_path):
         server = RecordingHttpServer()
         await server.start()
+        # maintenance polls /status until the pipeline is fully stopped
+        # before touching the lake (pause-coordination race fix)
+        server.responders.append(
+            lambda r: (200, {"state": "stopped"})
+            if r.path.endswith("/status") else None)
         try:
             d = LakeDestination(LakeConfig(str(tmp_path)))
             await d.startup()
@@ -93,4 +98,25 @@ class TestWebhookNotifier:
             await n.close()
         finally:
             set_error_hook(lambda r: None)
+            await server.stop()
+
+
+class TestMaintenancePausePoll:
+    async def test_aborts_if_never_stopped(self, tmp_path):
+        """If the pipeline never reaches 'stopped', maintenance must abort
+        rather than compact under a live writer."""
+        server = RecordingHttpServer()
+        await server.start()
+        server.responders.append(
+            lambda r: (200, {"state": "stopping"})
+            if r.path.endswith("/status") else None)
+        try:
+            with pytest.raises(RuntimeError, match="did not reach 'stopped'"):
+                await run_maintenance(str(tmp_path), vacuum=False,
+                                      api_url=server.url(), pipeline_id=7,
+                                      tenant_id="acme", stop_timeout_s=0.3)
+            # the abort must still resume the (successfully paused)
+            # pipeline — otherwise replication stays down on timeout
+            assert server.paths()[-1] == "POST /v1/pipelines/7/start"
+        finally:
             await server.stop()
