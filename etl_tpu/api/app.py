@@ -334,9 +334,61 @@ def build_app(state: ApiState) -> web.Application:
                                retry_policy=st.retry_policy.value,
                                retry_attempts=st.retry_attempts)
                 out.append(doc)
-            return web.json_response({"tables": out})
+            slot_lag = await _try_slot_lag(row, tenant)
+            return web.json_response({"tables": out, "slot_lag": slot_lag})
         finally:
             await store.close()
+
+    _slot_lag_cache: dict[int, tuple[float, object]] = {}
+    _SLOT_LAG_TTL_S = 5.0
+
+    async def _try_slot_lag(pipeline_row, tenant: str):
+        """Source-side slot lag for the replication-status surface
+        (reference etl-postgres/src/lag.rs via routes/pipelines.rs).
+        Best-effort: an unreachable source yields null, not a 5xx.
+        Briefly cached per pipeline so a polling dashboard doesn't pay a
+        fresh connect+auth against the customer's database per request."""
+        import time as _time
+
+        from ..postgres.lag import query_slot_lag
+        from ..postgres.wire import PgWireConnection
+
+        pid = pipeline_row[0]
+        cached = _slot_lag_cache.get(pid)
+        if cached is not None and _time.monotonic() - cached[0] \
+                < _SLOT_LAG_TTL_S:
+            return cached[1]
+        src = state.fetch_owned("api_sources", pipeline_row[2], tenant)
+        if src is None:
+            return None
+        try:
+            cfg = state.cipher.decrypt(src[3])  # → dict
+            conn = PgWireConnection(
+                host=cfg.get("host", "localhost"),
+                port=int(cfg.get("port", 5432)),
+                database=cfg.get("database", "postgres"),
+                user=cfg.get("user", "postgres"),
+                password=cfg.get("password"),
+                application_name="etl_tpu_api", connect_timeout_s=3.0)
+            await conn.connect()
+            try:
+                metrics = await query_slot_lag(conn)
+            finally:
+                await conn.close()
+            result = [{
+                "slot_name": m.slot_name, "active": m.active,
+                "wal_status": m.wal_status,
+                "restart_lsn_lag_bytes": m.restart_lsn_lag_bytes,
+                "confirmed_flush_lag_bytes": m.confirmed_flush_lag_bytes,
+                "safe_wal_size_bytes": m.safe_wal_size_bytes,
+                "write_lag_ms": m.write_lag_ms,
+                "flush_lag_ms": m.flush_lag_ms,
+                "replay_lag_ms": m.replay_lag_ms,
+            } for m in metrics]
+        except Exception:
+            result = None
+        _slot_lag_cache[pid] = (_time.monotonic(), result)
+        return result
 
     async def rollback_tables(req: web.Request):
         """Repair op: reset errored tables to Init so they resync

@@ -130,6 +130,12 @@ class PipelineConfig:
         InvalidatedSlotBehavior.ERROR
     run_source_migrations: bool = True
     wal_sender_timeout_ms: int = 60_000
+    # background schema-version pruning cadence (reference hourly task,
+    # apply.rs:123,423-631); 0 disables
+    schema_cleanup_interval_s: float = 3600.0
+    # out-of-band lag sampler cadence (reference apply.rs:579-624 polling
+    # pg_current_wal_lsn on a lazy side connection); 0 disables
+    lag_sample_interval_s: float = 10.0
 
     def validate(self) -> None:
         _require(self.pipeline_id >= 0, "pipeline_id must be >= 0")

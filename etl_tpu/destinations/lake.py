@@ -64,6 +64,12 @@ class LakeDestination(Destination):
     async def startup(self) -> None:
         self.root.mkdir(parents=True, exist_ok=True)
         self._db = sqlite3.connect(self.root / "catalog.db")
+        # WAL keeps readers unblocked during commits; the generous busy
+        # timeout covers compact()'s observe→merge→swap transaction so a
+        # concurrent writer (external maintenance binary vs replicator)
+        # waits instead of failing with a raw 'database is locked'
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA busy_timeout=60000")
         self._db.executescript("""
 CREATE TABLE IF NOT EXISTS lake_tables (
     table_id BIGINT PRIMARY KEY,

@@ -46,6 +46,38 @@ class _WireReplicationStream(ReplicationStream):
                 return
             yield pgoutput.decode_replication_frame(payload)
 
+    def drain_buffered(self, max_n: int) -> list:
+        """Parse CopyData frames already sitting in the stream reader's
+        buffer without awaiting — under a WAL burst the socket delivers
+        many frames per event-loop wakeup and paying a select() per frame
+        caps CDC throughput (CPython StreamReader internals; degrades to
+        the awaited path when unavailable)."""
+        out: list = []
+        reader = getattr(self._conn, "_reader", None)
+        buf = getattr(reader, "_buffer", None)
+        if buf is None or self._closed:
+            return out
+        while len(out) < max_n and len(buf) >= 5:
+            length = int.from_bytes(buf[1:5], "big")
+            if len(buf) < 1 + length:
+                break
+            tag = buf[0:1]
+            payload = bytes(buf[5 : 1 + length])
+            del buf[: 1 + length]
+            if tag == b"d":
+                out.append(pgoutput.decode_replication_frame(payload))
+            elif tag == b"E":
+                from .wire import PgServerError, _parse_error_fields
+
+                getattr(reader, "_maybe_resume_transport", lambda: None)()
+                raise PgServerError(_parse_error_fields(payload))
+            elif tag == b"Z":
+                self._closed = True
+                break
+            # 'c'/'C' and other tags: skip, same as copy_both_read
+        getattr(reader, "_maybe_resume_transport", lambda: None)()
+        return out
+
     async def send_status_update(self, written: Lsn, flushed: Lsn,
                                  applied: Lsn,
                                  reply_requested: bool = False) -> None:

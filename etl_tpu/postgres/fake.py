@@ -307,33 +307,57 @@ class _FakeReplicationStream(ReplicationStream):
         _FakeReplicationStream._ids += 1
         self.id = _FakeReplicationStream._ids
         self._wal_index = 0
+        self._pub_tables = None
         db.active_streams.append(self)
 
     def __aiter__(self) -> AsyncIterator[pgoutput.ReplicationFrame]:
         return self._frames()
 
+    def _next_buffered(self) -> "pgoutput.XLogData | None":
+        """Next already-written WAL frame, or None when caught up."""
+        db = self.db
+        if self._pub_tables is None:
+            self._pub_tables = set(db.publications.get(self.publication, []))
+        while self._wal_index < len(db.wal):
+            lsn, payload, tid, row = db.wal[self._wal_index]
+            self._wal_index += 1
+            # START_REPLICATION is INCLUSIVE of the requested LSN: the
+            # next tx's BEGIN sits exactly at the prior commit's end
+            if lsn < self.pos_lsn:
+                continue
+            if not self._publication_allows(payload, self._pub_tables):
+                continue
+            if not db.row_filter_allows(self.publication, tid, row):
+                continue
+            return pgoutput.XLogData(
+                start_lsn=lsn, end_lsn=db.current_lsn,
+                clock_us=_now_us(), payload=payload)
+        return None
+
+    def drain_buffered(self, max_n: int) -> list:
+        """Bulk-read already-buffered frames without event-loop round
+        trips (the apply loop's per-frame asyncio overhead otherwise caps
+        CDC throughput)."""
+        out = []
+        if self._closed or self.slot.invalidated:
+            return out
+        while len(out) < max_n:
+            f = self._next_buffered()
+            if f is None:
+                break
+            out.append(f)
+        return out
+
     async def _frames(self):
         db = self.db
-        pub_tables = set(db.publications.get(self.publication, []))
         while not self._closed:
             if self.slot.invalidated:
                 raise EtlError(ErrorKind.SLOT_INVALIDATED,
                                f"slot {self.slot.name} invalidated")
-            # drain available WAL
-            while self._wal_index < len(db.wal):
-                lsn, payload, tid, row = db.wal[self._wal_index]
-                self._wal_index += 1
-                # START_REPLICATION is INCLUSIVE of the requested LSN: the
-                # next tx's BEGIN sits exactly at the prior commit's end
-                if lsn < self.pos_lsn:
-                    continue
-                if not self._publication_allows(payload, pub_tables):
-                    continue
-                if not db.row_filter_allows(self.publication, tid, row):
-                    continue
-                yield pgoutput.XLogData(
-                    start_lsn=lsn, end_lsn=db.current_lsn,
-                    clock_us=_now_us(), payload=payload)
+            frame = self._next_buffered()
+            if frame is not None:
+                yield frame
+                continue
             # wait for more WAL or emit keepalive on timeout
             try:
                 async with db._wal_cond:

@@ -46,6 +46,13 @@ class ReplicationStream(abc.ABC):
     @abc.abstractmethod
     def __aiter__(self) -> AsyncIterator[ReplicationFrame]: ...
 
+    def drain_buffered(self, max_n: int) -> list:
+        """Already-received frames, synchronously (no event-loop round
+        trip). Default: none — the apply loop then falls back to one
+        awaited frame per select. Implementations override this to lift
+        the per-frame asyncio overhead off the CDC hot path."""
+        return []
+
     @abc.abstractmethod
     async def send_status_update(self, written: Lsn, flushed: Lsn,
                                  applied: Lsn,

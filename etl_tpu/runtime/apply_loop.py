@@ -43,6 +43,7 @@ from ..postgres.codec import pgoutput
 from ..postgres.source import ReplicationStream
 from ..store.base import PipelineStore
 from ..destinations.base import Destination
+from ..telemetry.egress import record_egress
 from ..telemetry.metrics import (ETL_APPLY_LOOP_BATCHES_TOTAL,
                                  ETL_APPLY_LOOP_EVENTS_TOTAL,
                                  ETL_APPLY_LOOP_FLUSH_LAG_BYTES,
@@ -114,6 +115,7 @@ class _LoopState:
     received_lsn: Lsn = Lsn.ZERO
     server_end_lsn: Lsn = Lsn.ZERO  # latest end-of-WAL the server reported
     batch_commit_end: Lsn | None = None  # last commit boundary inside batch
+    last_status_flush_lsn: Lsn = Lsn.ZERO  # flush LSN last reported upstream
 
 
 class ApplyLoop:
@@ -121,7 +123,7 @@ class ApplyLoop:
                  stream: ReplicationStream, store: PipelineStore,
                  destination: Destination, table_cache: SharedTableCache,
                  config: PipelineConfig, shutdown: ShutdownSignal,
-                 start_lsn: Lsn):
+                 start_lsn: Lsn, monitor=None, budget=None):
         self.ctx = ctx
         self.stream = stream
         self.store = store
@@ -129,11 +131,17 @@ class ApplyLoop:
         self.cache = table_cache
         self.config = config
         self.shutdown = shutdown
+        self.monitor = monitor  # MemoryMonitor | None
+        self._lease = budget.register_stream() if budget is not None else None
         self.assembler = EventAssembler(config.batch.batch_engine)
-        self.state = _LoopState(durable_lsn=start_lsn, received_lsn=start_lsn)
+        self.state = _LoopState(durable_lsn=start_lsn, received_lsn=start_lsn,
+                                last_status_flush_lsn=start_lsn)
         self._in_flight: _InFlight | None = None
         self._batch_deadline: float | None = None
         self._ready_states: dict[TableId, bool] = {}
+        interval = config.schema_cleanup_interval_s
+        self._next_schema_cleanup = (time.monotonic() + interval) \
+            if interval > 0 and isinstance(ctx, ApplyContext) else None
 
     # -- ownership filter -----------------------------------------------------
 
@@ -174,12 +182,25 @@ class ApplyLoop:
         keepalive_s = self.config.keepalive_deadline_ms / 1000
         stream_iter = self.stream.__aiter__()
         msg_task: asyncio.Task | None = None
+        resume_task: asyncio.Task | None = None
         shutdown_task = asyncio.ensure_future(self.shutdown.wait())
         try:
             while True:
-                if msg_task is None:
+                # memory backpressure: under RSS pressure stop pulling WAL
+                # (the walsender buffers; standby feedback keeps flowing via
+                # the keepalive timeout) until the monitor's hysteresis
+                # resumes — reference BackpressureStream, stream.rs:45-122
+                paused = self.monitor is not None and self.monitor.pressure
+                if msg_task is None and not paused:
                     msg_task = asyncio.ensure_future(stream_iter.__anext__())
-                waits = {shutdown_task, msg_task}
+                waits = {shutdown_task}
+                if msg_task is not None:
+                    waits.add(msg_task)
+                if paused:
+                    if resume_task is None:
+                        resume_task = asyncio.ensure_future(
+                            self.monitor.wait_until_resumed())
+                    waits.add(resume_task)
                 if self._in_flight is not None:
                     waits.add(self._in_flight.task)
                 now = time.monotonic()
@@ -199,6 +220,8 @@ class ApplyLoop:
                 if shutdown_task in done:
                     await self._drain()
                     return ExitIntent.PAUSE
+                if resume_task is not None and resume_task in done:
+                    resume_task = None
                 # priority 2: flush result
                 if self._in_flight is not None \
                         and self._in_flight.task in done:
@@ -210,11 +233,11 @@ class ApplyLoop:
                 if self._batch_deadline is not None \
                         and time.monotonic() >= self._batch_deadline:
                     self._maybe_dispatch_flush(force=True)
-                # priority 4: message — then opportunistically drain frames
-                # that are already buffered: a full select per message costs
-                # ~1-2ms of asyncio machinery, which would cap CDC throughput
-                # at a few hundred events/s
-                if msg_task in done:
+                # priority 4: message — then bulk-drain frames that are
+                # already buffered: a full select per message costs tens of
+                # µs of asyncio machinery, which would cap CDC throughput
+                # at ~30k events/s
+                if msg_task is not None and msg_task in done:
                     exc = msg_task.exception()
                     if exc is not None:
                         raise exc
@@ -223,39 +246,39 @@ class ApplyLoop:
                     intent = await self._handle_frame(frame)
                     if intent is not None:
                         return intent
-                    for _ in range(4096):
-                        if self.shutdown.is_triggered or (
-                                self._in_flight is not None
-                                and self._in_flight.task.done()):
+                    while not (self.shutdown.is_triggered or (
+                            self._in_flight is not None
+                            and self._in_flight.task.done()) or (
+                            self.monitor is not None
+                            and self.monitor.pressure)):
+                        frames = self.stream.drain_buffered(4096)
+                        if not frames:
                             break
-                        msg_task = asyncio.ensure_future(
-                            stream_iter.__anext__())
-                        if not msg_task.done():
-                            await asyncio.sleep(0)  # one tick to resume it
-                        if not msg_task.done():
-                            break  # nothing buffered: back to the select
-                        exc = msg_task.exception()
-                        if exc is not None:
-                            raise exc
-                        frame = msg_task.result()
-                        msg_task = None
-                        intent = await self._handle_frame(frame)
-                        if intent is not None:
-                            return intent
+                        for frame in frames:
+                            intent = await self._handle_frame(frame)
+                            if intent is not None:
+                                return intent
                 elif not done:
                     # idle timeout: proactive keepalive + idle sync processing
                     await self._send_status_update()
                     if isinstance(self.ctx, ApplyContext):
                         await self._process_syncing_tables(
                             self.state.received_lsn)
+                if self._next_schema_cleanup is not None \
+                        and time.monotonic() >= self._next_schema_cleanup:
+                    self._next_schema_cleanup = time.monotonic() \
+                        + self.config.schema_cleanup_interval_s
+                    await self._run_schema_cleanup()
         finally:
-            for t in (msg_task, shutdown_task):
+            for t in (msg_task, shutdown_task, resume_task):
                 if t is not None and not t.done():
                     t.cancel()
                     try:
                         await t
                     except (asyncio.CancelledError, Exception):
                         pass
+            if self._lease is not None:
+                self._lease.release()
             await self.stream.close()
 
     # -- frame handling ---------------------------------------------------------
@@ -291,6 +314,27 @@ class ApplyLoop:
 
     async def _handle_message(self, start_lsn: Lsn, payload: bytes) -> None:
         st = self.state
+        # TPU-engine fast path for row messages: the batch engine needs
+        # only (kind, relid, raw payload) — the native framer re-parses the
+        # tuple data on the staging path, so a full host-side
+        # decode_logical_message here would parse every tuple twice and cap
+        # CDC throughput at the Python parse rate
+        if payload[:1] in (b"I", b"U", b"D") \
+                and self.config.batch.batch_engine is BatchEngine.TPU:
+            relid = int.from_bytes(payload[1:5], "big")
+            if not await self._table_owned(relid):
+                return
+            schema = self.cache.get(relid)
+            if schema is None:
+                raise EtlError(ErrorKind.SCHEMA_NOT_FOUND,
+                               f"no RELATION seen for table {relid}")
+            self.assembler.push_raw_row(payload, schema, start_lsn,
+                                        st.current_commit_lsn, st.tx_ordinal)
+            st.tx_ordinal += 1
+            if self.assembler.size_bytes and self._batch_deadline is None:
+                self._batch_deadline = time.monotonic() \
+                    + self.config.batch.max_fill_ms / 1000
+            return
         msg = pgoutput.decode_logical_message(payload)
         if isinstance(msg, pgoutput.BeginMessage):
             st.current_commit_lsn = msg.final_lsn
@@ -354,9 +398,17 @@ class ApplyLoop:
     def _maybe_dispatch_flush(self, force: bool = False) -> None:
         if self._in_flight is not None or len(self.assembler) == 0:
             return
-        if not force and self.assembler.size_bytes \
-                < self.config.batch.max_size_bytes:
+        # budget-aware threshold: under many active streams the per-stream
+        # share shrinks below the static cap (batch_budget.rs:72-96) —
+        # flushes happen mid-transaction with the commit LSN carried
+        # separately (apply.rs:1932-1945), so splitting huge transactions
+        # is safe for durability accounting
+        threshold = self.config.batch.max_size_bytes
+        if self._lease is not None:
+            threshold = min(threshold, self._lease.ideal_batch_bytes())
+        if not force and self.assembler.size_bytes < threshold:
             return
+        batch_bytes = self.assembler.size_bytes
         events = self.assembler.flush()
         commit_end = self.state.batch_commit_end
         self.state.batch_commit_end = None
@@ -365,6 +417,10 @@ class ApplyLoop:
         async def write() -> None:
             ack = await self.destination.write_events(events)
             await ack.wait_durable()
+            # billing/egress accounting rides durable acks (egress.rs:1-20)
+            record_egress(pipeline_id=self.config.pipeline_id,
+                          destination=type(self.destination).__name__,
+                          bytes_processed=batch_bytes, kind="streaming")
 
         registry.counter_inc(ETL_APPLY_LOOP_BATCHES_TOTAL)
         registry.counter_inc(ETL_APPLY_LOOP_EVENTS_TOTAL, len(events))
@@ -410,6 +466,20 @@ class ApplyLoop:
             except EtlError:
                 pass  # resume re-delivers from durable progress
 
+    async def _run_schema_cleanup(self) -> None:
+        """Prune schema versions no longer reachable by any decode: every
+        event at or below the durable LSN is flushed, so only the newest
+        version ≤ durable (plus anything newer) can still be consulted
+        (reference hourly cleanup task, apply.rs:123,423-631,1607)."""
+        from ..models.schema import SnapshotId
+
+        failpoints.fail_point(failpoints.ON_SCHEMA_CLEANUP)
+        if int(self.state.durable_lsn) == 0:
+            return
+        snapshot = SnapshotId(int(self.state.durable_lsn))
+        for tid in await self.store.get_table_ids_with_schemas():
+            await self.store.prune_schema_versions(tid, snapshot)
+
     async def _send_status_update(self) -> None:
         failpoints.fail_point(failpoints.ON_STATUS_UPDATE)
         registry.gauge_set(ETL_APPLY_LOOP_FLUSH_LAG_BYTES,
@@ -417,6 +487,7 @@ class ApplyLoop:
         registry.gauge_set(
             ETL_APPLY_LOOP_RECEIVED_LAG_BYTES,
             max(0, self.state.server_end_lsn - self.state.received_lsn))
+        self.state.last_status_flush_lsn = self.state.durable_lsn
         await self.stream.send_status_update(
             written=self.state.received_lsn,
             flushed=self.state.durable_lsn,

@@ -31,7 +31,7 @@ class ApplyWorker:
     def __init__(self, *, config: PipelineConfig, store: PipelineStore,
                  destination: Destination, source_factory,
                  pool: TableSyncWorkerPool, table_cache: SharedTableCache,
-                 shutdown: ShutdownSignal):
+                 shutdown: ShutdownSignal, monitor=None, budget=None):
         self.config = config
         self.store = store
         self.destination = destination
@@ -39,6 +39,8 @@ class ApplyWorker:
         self.pool = pool
         self.cache = table_cache
         self.shutdown = shutdown
+        self.monitor = monitor
+        self.budget = budget
         self.slot_name = apply_slot_name(config.pipeline_id)
         self._task: asyncio.Task | None = None
 
@@ -97,11 +99,63 @@ class ApplyWorker:
             loop = ApplyLoop(ctx=ctx, stream=stream, store=self.store,
                              destination=self.destination,
                              table_cache=self.cache, config=self.config,
-                             shutdown=self.shutdown, start_lsn=start_lsn)
-            intent = await loop.run()
+                             shutdown=self.shutdown, start_lsn=start_lsn,
+                             monitor=self.monitor, budget=self.budget)
+            sampler = asyncio.ensure_future(self._lag_sampler(loop)) \
+                if self.config.lag_sample_interval_s > 0 else None
+            try:
+                intent = await loop.run()
+            finally:
+                if sampler is not None:
+                    sampler.cancel()
+                    try:
+                        await sampler
+                    except asyncio.CancelledError:
+                        pass
             assert intent is ExitIntent.PAUSE
         finally:
             await source.close()
+
+    async def _lag_sampler(self, loop: ApplyLoop) -> None:
+        """Out-of-band lag gauges on a lazy side connection (reference
+        apply.rs:579-624 + observability.rs:46-50): polls the server's
+        current WAL position so end-to-end and effective-flush lag keep
+        updating even when the apply loop is busy or idle."""
+        from ..telemetry.metrics import (
+            ETL_APPLY_LOOP_EFFECTIVE_FLUSH_LAG_BYTES,
+            ETL_APPLY_LOOP_END_TO_END_LAG_BYTES, registry)
+
+        interval = self.config.lag_sample_interval_s
+        source: ReplicationSource | None = None
+        try:
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    if source is None:
+                        source = self.source_factory()
+                        await source.connect()
+                    wal = await source.get_current_wal_lsn()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # lag sampling must never take down the apply worker;
+                    # drop the connection and retry on the next tick
+                    if source is not None:
+                        try:
+                            await source.close()
+                        except Exception:
+                            pass
+                        source = None
+                    continue
+                registry.gauge_set(
+                    ETL_APPLY_LOOP_END_TO_END_LAG_BYTES,
+                    max(0, int(wal) - int(loop.state.durable_lsn)))
+                registry.gauge_set(
+                    ETL_APPLY_LOOP_EFFECTIVE_FLUSH_LAG_BYTES,
+                    max(0, int(wal) - int(loop.state.last_status_flush_lsn)))
+        finally:
+            if source is not None:
+                await source.close()
 
     async def _get_start_lsn(self, source: ReplicationSource) -> Lsn:
         """max(durable progress, slot confirmed_flush); create slot if

@@ -62,6 +62,23 @@ class EventAssembler:
         self._events.append(ev)
         self.size_bytes += size_hint
 
+    def push_raw_row(self, payload: bytes, schema: ReplicatedTableSchema,
+                     start_lsn: Lsn, commit_lsn: Lsn,
+                     tx_ordinal: int) -> None:
+        """TPU fast path: accumulate the raw row-message payload without
+        host-side tuple parsing (the framer parses it on the device staging
+        path). Callers guarantee payload[0] is I/U/D."""
+        if self._run is None or self._run.table_id != schema.id \
+                or self._run.schema is not schema:
+            self._seal_run()
+            self._run = _Run(table_id=schema.id, schema=schema)
+        r = self._run
+        r.payloads.append(payload)
+        r.start_lsns.append(int(start_lsn))
+        r.commit_lsns.append(int(commit_lsn))
+        r.tx_ordinals.append(tx_ordinal)
+        self.size_bytes += 64 + len(payload)
+
     def push_row_message(self, msg: pgoutput.LogicalReplicationMessage,
                          payload: bytes, schema: ReplicatedTableSchema,
                          start_lsn: Lsn, commit_lsn: Lsn,
@@ -83,16 +100,7 @@ class EventAssembler:
             self.size_bytes += 64 + len(payload)
             return
         # TPU path: defer decode, accumulate raw payloads
-        if self._run is None or self._run.table_id != schema.id \
-                or self._run.schema is not schema:
-            self._seal_run()
-            self._run = _Run(table_id=schema.id, schema=schema)
-        r = self._run
-        r.payloads.append(payload)
-        r.start_lsns.append(int(start_lsn))
-        r.commit_lsns.append(int(commit_lsn))
-        r.tx_ordinals.append(tx_ordinal)
-        self.size_bytes += 64 + len(payload)
+        self.push_raw_row(payload, schema, start_lsn, commit_lsn, tx_ordinal)
 
     # -- flush ----------------------------------------------------------------
 

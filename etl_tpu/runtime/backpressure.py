@@ -87,15 +87,25 @@ class MemoryMonitor:
             self._task = None
 
     def sample_once(self) -> bool:
-        """One sample + hysteresis update; returns current pressure."""
+        """One sample + hysteresis update; returns current pressure. The
+        monitor owns the backpressure metrics: it is the single hysteresis
+        authority, so one pressure episode counts once no matter how many
+        streams pause on it."""
+        from ..telemetry.metrics import (
+            ETL_MEMORY_BACKPRESSURE_ACTIVATIONS_TOTAL,
+            ETL_MEMORY_BACKPRESSURE_ACTIVE, registry)
+
         self.last_rss = self._rss_reader()
         ratio = self.last_rss / max(1, self.limit_bytes)
         if not self.pressure and ratio >= self.config.activate_ratio:
             self.pressure = True
             self._resumed.clear()
+            registry.counter_inc(ETL_MEMORY_BACKPRESSURE_ACTIVATIONS_TOTAL)
+            registry.gauge_set(ETL_MEMORY_BACKPRESSURE_ACTIVE, 1)
         elif self.pressure and ratio <= self.config.resume_ratio:
             self.pressure = False
             self._resumed.set()
+            registry.gauge_set(ETL_MEMORY_BACKPRESSURE_ACTIVE, 0)
         return self.pressure
 
     async def _run(self) -> None:

@@ -26,6 +26,7 @@ from ..ops.staging import stage_copy_chunk
 from ..postgres.codec.copy_text import parse_copy_row
 from ..postgres.source import ReplicationSource
 from ..destinations.base import Destination, WriteAck
+from ..telemetry.egress import record_egress
 from ..telemetry.metrics import ETL_TABLE_COPY_ROWS_TOTAL, registry
 from . import failpoints
 from .shutdown import ShutdownRequested, ShutdownSignal, or_shutdown
@@ -44,6 +45,7 @@ class CopyPartition:
 class CopyProgress:
     total_rows: int = 0
     partitions_done: int = 0
+    bytes_written: int = 0  # COPY text bytes since the last egress record
 
 
 def plan_copy_partitions(estimated_rows: int, heap_pages: int,
@@ -77,7 +79,8 @@ async def _copy_partition(source: ReplicationSource,
                           decoder: DeviceDecoder | None,
                           destination: Destination,
                           progress: CopyProgress,
-                          max_batch_bytes: int) -> None:
+                          max_batch_bytes: int, monitor=None,
+                          lease=None, pipeline_id: int = 0) -> None:
     rng = None if part.end_page is None and part.start_page == 0 \
         else (part.start_page, part.end_page if part.end_page is not None
               else 1 << 30)
@@ -102,6 +105,7 @@ async def _copy_partition(source: ReplicationSource,
         if not chunk:
             return
         failpoints.fail_point(failpoints.DURING_COPY)
+        progress.bytes_written += len(chunk)
         if decoder is not None:
             staged = stage_copy_chunk(chunk, len(oids))
             in_flight.append(decoder.decode_async(staged))
@@ -116,8 +120,16 @@ async def _copy_partition(source: ReplicationSource,
         registry.counter_inc(ETL_TABLE_COPY_ROWS_TOTAL, batch.num_rows)
 
     async for raw in stream:
+        if monitor is not None and monitor.pressure:
+            # stop pulling COPY data under memory pressure; the server-side
+            # cursor waits (reference TryBatchBackpressureStream pause)
+            await monitor.wait_until_resumed()
         pending += raw
-        if len(pending) >= max_batch_bytes:
+        # budget-aware chunking: the per-stream share shrinks when many
+        # partitions copy concurrently (batch_budget.rs:72-96)
+        threshold = max_batch_bytes if lease is None \
+            else min(max_batch_bytes, lease.ideal_batch_bytes())
+        if len(pending) >= threshold:
             cut = pending.rfind(b"\n") + 1
             await write_chunk(pending[:cut])
             pending = pending[cut:]
@@ -127,6 +139,12 @@ async def _copy_partition(source: ReplicationSource,
     # durability barrier for this partition (mod.rs:360-378)
     for ack in acks:
         await ack.wait_durable()
+    if progress.bytes_written:
+        record_egress(pipeline_id=pipeline_id,
+                      destination=type(destination).__name__,
+                      bytes_processed=progress.bytes_written,
+                      kind="table_copy")
+        progress.bytes_written = 0
     progress.partitions_done += 1
 
 
@@ -134,7 +152,8 @@ async def parallel_table_copy(*, source_factory, primary_source,
                               schema: ReplicatedTableSchema,
                               snapshot_id: str, config: PipelineConfig,
                               destination: Destination,
-                              shutdown: ShutdownSignal) -> CopyProgress:
+                              shutdown: ShutdownSignal, monitor=None,
+                              budget=None) -> CopyProgress:
     """Copy one table through N snapshot-sharing connections."""
     est_rows, heap_pages = await primary_source.estimate_table_stats(schema.id)
     parts = plan_copy_partitions(est_rows, heap_pages, config)
@@ -150,6 +169,7 @@ async def parallel_table_copy(*, source_factory, primary_source,
         src = primary_source if use_primary else source_factory()
         if not use_primary:
             await src.connect()
+        lease = budget.register_stream() if budget is not None else None
         try:
             while True:
                 try:
@@ -159,8 +179,11 @@ async def parallel_table_copy(*, source_factory, primary_source,
                 await or_shutdown(shutdown, _copy_partition(
                     src, schema, snapshot_id, config.publication_name, part,
                     decoder, destination, progress,
-                    config.batch.max_size_bytes))
+                    config.batch.max_size_bytes, monitor=monitor,
+                    lease=lease, pipeline_id=config.pipeline_id))
         finally:
+            if lease is not None:
+                lease.release()
             if not use_primary:
                 await src.close()
 

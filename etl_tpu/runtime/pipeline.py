@@ -19,6 +19,7 @@ from ..postgres.source import ReplicationSource
 from ..store.base import PipelineStore
 from ..destinations.base import Destination
 from .apply_worker import ApplyWorker
+from .backpressure import BatchBudgetController, MemoryMonitor
 from .shutdown import ShutdownSignal
 from .state import TableState
 from .table_cache import SharedTableCache
@@ -42,6 +43,8 @@ class Pipeline:
         self.pool: TableSyncWorkerPool | None = None
         self.apply_worker: ApplyWorker | None = None
         self._apply_task: asyncio.Task | None = None
+        self.memory_monitor: MemoryMonitor | None = None
+        self.batch_budget: BatchBudgetController | None = None
 
     async def start(self) -> None:
         source = self.source_factory()
@@ -51,17 +54,27 @@ class Pipeline:
         finally:
             await source.close()
         await self.destination.startup()
+        # memory defense (reference pipeline.rs:168 MemoryMonitor::new +
+        # batch_budget.rs): the monitor pauses WAL/COPY intake under RSS
+        # pressure; the budget controller sizes batches by the active
+        # stream count so concurrent copies don't multiply peak memory
+        self.memory_monitor = MemoryMonitor(self.config.backpressure)
+        self.memory_monitor.start()
+        self.batch_budget = BatchBudgetController(
+            self.config.backpressure, self.config.batch.max_size_bytes)
         self.pool = TableSyncWorkerPool(
             config=self.config, store=self.store,
             destination=self.destination,
             source_factory=self.source_factory,
-            table_cache=self.table_cache, shutdown=self.shutdown_signal)
+            table_cache=self.table_cache, shutdown=self.shutdown_signal,
+            monitor=self.memory_monitor, budget=self.batch_budget)
         await self.pool.refresh_states()
         self.apply_worker = ApplyWorker(
             config=self.config, store=self.store,
             destination=self.destination,
             source_factory=self.source_factory, pool=self.pool,
-            table_cache=self.table_cache, shutdown=self.shutdown_signal)
+            table_cache=self.table_cache, shutdown=self.shutdown_signal,
+            monitor=self.memory_monitor, budget=self.batch_budget)
         self._apply_task = self.apply_worker.spawn()
 
     async def _initialize_table_states(self,
@@ -92,6 +105,8 @@ class Pipeline:
             self.shutdown_signal.trigger()
             if self.pool is not None:
                 await self.pool.wait_all()
+            if self.memory_monitor is not None:
+                await self.memory_monitor.stop()
             await self.destination.shutdown()
 
     async def shutdown(self) -> None:

@@ -97,6 +97,11 @@ class SchemaStore(abc.ABC):
     async def get_schema_versions(self, table_id: TableId) -> list[SnapshotId]: ...
 
     @abc.abstractmethod
+    async def get_table_ids_with_schemas(self) -> "list[TableId]":
+        """Tables that have at least one stored schema version (the
+        cleanup task's iteration set)."""
+
+    @abc.abstractmethod
     async def prune_schema_versions(self, table_id: TableId,
                                     older_than: SnapshotId) -> int:
         """Drop versions strictly older than the newest one ≤ `older_than`

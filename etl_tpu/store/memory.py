@@ -98,6 +98,9 @@ class MemoryStore(PipelineStore):
     async def get_schema_versions(self, table_id: TableId) -> list[SnapshotId]:
         return [s for s, _ in self._schemas.get(table_id, [])]
 
+    async def get_table_ids_with_schemas(self) -> list[TableId]:
+        return [tid for tid, v in self._schemas.items() if v]
+
     async def prune_schema_versions(self, table_id: TableId,
                                     older_than: SnapshotId) -> int:
         versions = self._schemas.get(table_id)

@@ -274,3 +274,90 @@ class TestReviewRegressions:
                                         headers=H)).status == 204
         finally:
             await client.close()
+
+
+class TestSlotLagSurface:
+    async def test_replication_status_includes_slot_lag(self, tmp_path):
+        """replication-status surfaces source-side slot lag when the
+        source is reachable (reference lag.rs via routes/pipelines.rs) and
+        degrades to null when it isn't."""
+        from etl_tpu.runtime.state import TableState
+        from etl_tpu.store.sql import SqliteStore
+        from etl_tpu.testing.fake_pg_server import FakePgServer
+        from etl_tpu.postgres.fake import FakeDatabase
+        from etl_tpu.models import ColumnSchema, Oid, TableName, TableSchema
+
+        db = FakeDatabase()
+        db.create_table(TableSchema(
+            16384, TableName("public", "t"),
+            (ColumnSchema("id", Oid.INT4, nullable=False,
+                          primary_key_ordinal=1),)))
+        db.create_publication("pub", [16384])
+        from etl_tpu.postgres.fake import FakeSource
+        await FakeSource(db).create_slot("supabase_etl_apply_7")
+        server = FakePgServer(db)
+        await server.start()
+
+        store_path = str(tmp_path / "pipe.db")
+        client, _ = await make_client(tmp_path)
+        try:
+            await client.post("/v1/tenants", json={"id": "acme", "name": "A"})
+            src = await (await client.post(
+                "/v1/sources", headers=H,
+                json={"name": "s", "config": {
+                    "host": "127.0.0.1", "port": server.port,
+                    "database": "postgres", "user": "etl"}})).json()
+            dst = await (await client.post(
+                "/v1/destinations", headers=H,
+                json={"name": "d", "config": {"type": "memory"}})).json()
+            resp = await client.post(
+                "/v1/pipelines", headers=H,
+                json={"source_id": src["id"], "destination_id": dst["id"],
+                      "publication_name": "pub", "store_path": store_path})
+            pid = (await resp.json())["id"]
+            store = SqliteStore(store_path, pid)
+            await store.connect()
+            await store.update_table_state(16384, TableState.ready())
+            await store.close()
+
+            doc = await (await client.get(
+                f"/v1/pipelines/{pid}/replication-status",
+                headers=H)).json()
+            assert doc["slot_lag"], doc
+            slot = doc["slot_lag"][0]
+            assert slot["slot_name"].startswith("supabase_etl_")
+            assert "confirmed_flush_lag_bytes" in slot
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_slot_lag_null_when_source_unreachable(self, tmp_path):
+        from etl_tpu.runtime.state import TableState
+        from etl_tpu.store.sql import SqliteStore
+
+        store_path = str(tmp_path / "pipe.db")
+        client, _ = await make_client(tmp_path)
+        try:
+            await client.post("/v1/tenants", json={"id": "acme", "name": "A"})
+            src = await (await client.post(
+                "/v1/sources", headers=H,
+                json={"name": "s", "config": {
+                    "host": "127.0.0.1", "port": 1}})).json()
+            dst = await (await client.post(
+                "/v1/destinations", headers=H,
+                json={"name": "d", "config": {"type": "memory"}})).json()
+            resp = await client.post(
+                "/v1/pipelines", headers=H,
+                json={"source_id": src["id"], "destination_id": dst["id"],
+                      "publication_name": "pub", "store_path": store_path})
+            pid = (await resp.json())["id"]
+            store = SqliteStore(store_path, pid)
+            await store.connect()
+            await store.update_table_state(1, TableState.ready())
+            await store.close()
+            doc = await (await client.get(
+                f"/v1/pipelines/{pid}/replication-status",
+                headers=H)).json()
+            assert doc["slot_lag"] is None
+        finally:
+            await client.close()
