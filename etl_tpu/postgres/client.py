@@ -31,6 +31,29 @@ def _quote_literal(s: str) -> str:
     return "'" + s.replace("'", "''") + "'"
 
 
+def wire_connection_from_config(config: PgConnectionConfig, *,
+                                application_name: str,
+                                replication: bool = False
+                                ) -> PgWireConnection:
+    """THE connection builder shared by the replication client and the
+    PostgresStore: TLS context from config.tls, secret-wrapper password
+    unwrapping via .expose() — divergence here means the store and the
+    client authenticate differently against the same config."""
+    ssl_context = None
+    if config.tls.enabled:
+        ssl_context = ssl_mod.create_default_context()
+        if config.tls.trusted_root_certs:
+            ssl_context.load_verify_locations(
+                cadata=config.tls.trusted_root_certs)
+    password = config.password
+    expose = getattr(password, "expose", None)
+    return PgWireConnection(
+        host=config.host, port=config.port, database=config.name,
+        user=config.username, password=expose() if expose else password,
+        application_name=application_name, replication=replication,
+        ssl_context=ssl_context, connect_timeout_s=config.connect_timeout_s)
+
+
 class _WireReplicationStream(ReplicationStream):
     def __init__(self, conn: PgWireConnection):
         self._conn = conn
@@ -118,25 +141,10 @@ class PgReplicationClient(ReplicationSource):
         self._conn: PgWireConnection | None = None
         self.server_version: int = 0  # e.g. 150004
 
-    def _ssl_context(self) -> ssl_mod.SSLContext | None:
-        if not self.config.tls.enabled:
-            return None
-        ctx = ssl_mod.create_default_context()
-        if self.config.tls.trusted_root_certs:
-            ctx.load_verify_locations(
-                cadata=self.config.tls.trusted_root_certs)
-        return ctx
-
     def _new_conn(self, replication: bool) -> PgWireConnection:
-        password = self.config.password
-        expose = getattr(password, "expose", None)
-        return PgWireConnection(
-            host=self.config.host, port=self.config.port,
-            database=self.config.name, user=self.config.username,
-            password=expose() if expose else password,
-            application_name=self.application_name,
-            replication=replication, ssl_context=self._ssl_context(),
-            connect_timeout_s=self.config.connect_timeout_s)
+        return wire_connection_from_config(
+            self.config, application_name=self.application_name,
+            replication=replication)
 
     @property
     def conn(self) -> PgWireConnection:
@@ -233,6 +241,29 @@ class PgReplicationClient(ReplicationSource):
         r = await self.conn.query("SELECT pg_current_wal_lsn()")
         return Lsn(r.rows[0][0])
 
+    # -- source migrations (reference postgres/migrations.rs:102-122) --------
+
+    async def is_in_recovery(self) -> bool:
+        r = await self.conn.query("SELECT pg_is_in_recovery()")
+        return r.rows[0][0] == "t"
+
+    async def applied_source_migrations(self) -> list[str]:
+        from .wire import PgServerError
+
+        try:
+            r = await self.conn.query(
+                "SELECT name FROM etl.source_migrations ORDER BY name")
+        except PgServerError:
+            return []  # schema not installed yet
+        return [row[0] for row in r.rows]
+
+    async def apply_source_migration(self, name: str, sql: str) -> None:
+        await self.conn.query(sql)
+        safe = name.replace("'", "''")
+        await self.conn.query(
+            "INSERT INTO etl.source_migrations (name) VALUES "
+            f"('{safe}') ON CONFLICT (name) DO NOTHING")
+
     # -- slots ------------------------------------------------------------------
 
     async def get_slot(self, name: str) -> SlotInfo | None:
@@ -271,20 +302,34 @@ class PgReplicationClient(ReplicationSource):
 
     async def copy_table_stream(self, table_id: TableId, publication: str,
                                 snapshot_id: str,
-                                ctid_range: "tuple[int, int] | None" = None
+                                ctid_range: "tuple[int, int] | None" = None,
+                                publication_table_id: "TableId | None" = None
                                 ) -> CopyStream:
         """COPY in a REPEATABLE READ transaction pinned to the exported
         snapshot; fresh connection per stream (copy workers fork children,
-        reference copy.rs:346-363)."""
+        reference copy.rs:346-363). `publication_table_id` names the
+        PUBLISHED relation when it differs from the physical one — a leaf
+        partition under publish_via_partition_root inherits the root's
+        column list and row filter (pg_publication_tables lists only the
+        root)."""
         conn = self._new_conn(replication=False)
         await conn.connect()
         try:
-            schema = await self._table_and_columns(conn, table_id, publication)
-            cols = ", ".join(f'"{c}"' for c in schema[1])
-            where = ""
+            qualified, names, rowfilter = await self._table_and_columns(
+                conn, table_id, publication,
+                publication_table_id=publication_table_id)
+            cols = ", ".join(f'"{c}"' for c in names)
+            conds = []
             if ctid_range is not None:
                 lo, hi = ctid_range
-                where = f" WHERE ctid >= '({lo},0)' AND ctid < '({hi},0)'"
+                conds.append(f"ctid >= '({lo},0)' AND ctid < '({hi},0)'")
+            if rowfilter:
+                # PG15 publication row filter: the snapshot COPY must apply
+                # the same predicate the walsender applies to CDC, or the
+                # initial copy includes rows the publication excludes
+                # (reference transaction.rs:868)
+                conds.append(f"({rowfilter})")
+            where = f" WHERE {' AND '.join(conds)}" if conds else ""
             await conn.query(
                 "BEGIN ISOLATION LEVEL REPEATABLE READ READ ONLY")
             if snapshot_id:
@@ -293,12 +338,14 @@ class PgReplicationClient(ReplicationSource):
         except BaseException:
             await conn.close()  # don't leak the socket / open transaction
             raise
-        sql = f"COPY (SELECT {cols} FROM {schema[0]}{where}) TO STDOUT"
+        sql = f"COPY (SELECT {cols} FROM {qualified}{where}) TO STDOUT"
         return _WireCopyStream(conn, sql)
 
     async def _table_and_columns(self, conn: PgWireConnection,
                                  table_id: TableId,
-                                 publication: str) -> tuple[str, list[str]]:
+                                 publication: str, *,
+                                 publication_table_id: "TableId | None" = None
+                                 ) -> tuple[str, list[str], "str | None"]:
         r = await conn.query(
             "SELECT n.nspname, c.relname FROM pg_class c "
             "JOIN pg_namespace n ON n.oid = c.relnamespace "
@@ -307,13 +354,16 @@ class PgReplicationClient(ReplicationSource):
             raise EtlError(ErrorKind.PUBLICATION_TABLE_MISSING,
                            f"table {table_id}")
         qualified = TableName(r.rows[0][0], r.rows[0][1]).quoted()
+        pub_oid = int(publication_table_id
+                      if publication_table_id is not None else table_id)
         filt = await conn.query(
-            "SELECT pt.attnames FROM pg_publication_tables pt "
+            "SELECT pt.attnames, pt.rowfilter FROM pg_publication_tables pt "
             "JOIN pg_namespace ns ON ns.nspname = pt.schemaname "
             "JOIN pg_class pc ON pc.relnamespace = ns.oid "
             "AND pc.relname = pt.tablename "
             f"WHERE pt.pubname = {_quote_literal(publication)} "
-            f"AND pc.oid = {int(table_id)}")
+            f"AND pc.oid = {pub_oid}")
+        rowfilter = filt.rows[0][1] if filt.rows and len(filt.rows[0]) > 1             else None
         if filt.rows and filt.rows[0][0]:
             names = _parse_name_array(filt.rows[0][0])
         else:
@@ -322,7 +372,7 @@ class PgReplicationClient(ReplicationSource):
                 f"{int(table_id)} AND a.attnum > 0 AND NOT a.attisdropped "
                 "ORDER BY a.attnum")
             names = [row[0] for row in cols.rows]
-        return qualified, names
+        return qualified, names, rowfilter
 
     async def estimate_table_stats(self, table_id: TableId) -> tuple[int, int]:
         r = await self.conn.query(
@@ -332,6 +382,18 @@ class PgReplicationClient(ReplicationSource):
         if not r.rows:
             return 0, 1
         return int(r.rows[0][0]), int(r.rows[0][1])
+
+    async def get_partition_leaves(
+            self, table_id: TableId) -> list[tuple[TableId, int, int]]:
+        """Leaf partitions with stats for per-leaf copy planning
+        (reference transaction.rs:808-825)."""
+        r = await self.conn.query(
+            "SELECT c.oid, GREATEST(c.reltuples::bigint, 0), "
+            "GREATEST(c.relpages::bigint, 1) "
+            f"FROM pg_partition_tree({int(table_id)}) pt "
+            "JOIN pg_class c ON c.oid = pt.relid "
+            "WHERE pt.isleaf AND pt.level > 0 ORDER BY c.oid")
+        return [(int(a), int(b), int(c)) for a, b, c in r.rows]
 
     async def start_replication(self, slot_name: str, publication: str,
                                 start_lsn: Lsn) -> ReplicationStream:

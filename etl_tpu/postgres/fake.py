@@ -40,6 +40,8 @@ class FakeTable:
     schema: TableSchema
     rows: list[list[str | None]] = field(default_factory=list)  # text-format
     replica_identity: int = ord("d")
+    partition_parent: "TableId | None" = None  # leaf → its partitioned root
+    partition_leaves: "list[TableId]" = field(default_factory=list)
 
 
 @dataclass
@@ -61,8 +63,11 @@ class FakeDatabase:
         # publication column filters: (publication, table) -> column names
         self.column_filters: dict[tuple[str, TableId], list[str]] = {}
         # PG15 row filters: (publication, table) -> predicate over the
-        # row's text values (the walsender-side WHERE clause analogue)
+        # row's text values (the walsender-side WHERE clause analogue);
+        # row_filter_sql carries the textual predicate surfaced through
+        # pg_publication_tables.rowfilter for the wire client's COPY
         self.row_filters: dict[tuple[str, TableId], "callable"] = {}
+        self.row_filter_sql: dict[tuple[str, TableId], str] = {}
         # (start_lsn, payload, table_id|None, row_texts|None) — the row
         # metadata lets streams evaluate publication row filters the way
         # the walsender evaluates WHERE clauses at send time
@@ -75,6 +80,9 @@ class FakeDatabase:
         self.active_streams: list["_FakeReplicationStream"] = []
         self._snapshot_seq = 0
         self._relation_sent: set[tuple[int, int]] = set()  # (stream id, table)
+        self.is_standby = False  # read replica: pg_is_in_recovery() = true
+        self.applied_migrations: list[str] = []
+        self.ddl_trigger_installed = False
 
     # -- test-facing setup ----------------------------------------------------
 
@@ -83,6 +91,35 @@ class FakeDatabase:
         t = FakeTable(schema=schema, rows=list(rows or []))
         self.tables[schema.id] = t
         return t
+
+    def create_partitioned_table(
+            self, parent: TableSchema,
+            leaves: "dict[TableId, tuple[str, list[list[str | None]]]]"
+    ) -> FakeTable:
+        """Partitioned root + its leaf partitions. `leaves` maps
+        leaf_id → (leaf_name, rows); leaves share the parent's columns.
+        Publications list the ROOT (publish_via_partition_root): the
+        walsender maps leaf row changes to the root relid."""
+        p = FakeTable(schema=parent, rows=[])
+        p.partition_leaves = list(leaves)
+        self.tables[parent.id] = p
+        for leaf_id, (leaf_name, rows) in leaves.items():
+            leaf = FakeTable(schema=TableSchema(
+                leaf_id, type(parent.name)(parent.name.schema, leaf_name),
+                parent.columns), rows=list(rows))
+            leaf.partition_parent = parent.id
+            self.tables[leaf_id] = leaf
+        return p
+
+    def wal_relid(self, table_id: TableId) -> TableId:
+        """publish_via_partition_root mapping: a leaf's WAL changes are
+        attributed to the published root."""
+        t = self.tables.get(table_id)
+        if t is not None and t.partition_parent is not None:
+            parent = t.partition_parent
+            if any(parent in tids for tids in self.publications.values()):
+                return parent
+        return table_id
 
     def set_replica_identity(self, table_id: TableId, identity: str) -> None:
         """'d' (default: PK) or 'f' (full) — ALTER TABLE ... REPLICA IDENTITY."""
@@ -97,7 +134,12 @@ class FakeDatabase:
         for tid, cols in (column_filters or {}).items():
             self.column_filters[(name, tid)] = cols
         for tid, pred in (row_filters or {}).items():
-            self.row_filters[(name, tid)] = pred
+            if isinstance(pred, tuple):
+                sql_text, fn = pred
+                self.row_filter_sql[(name, tid)] = sql_text
+                self.row_filters[(name, tid)] = fn
+            else:
+                self.row_filters[(name, tid)] = pred
 
     def next_lsn(self, advance: int = 8) -> Lsn:
         self._lsn += advance
@@ -178,34 +220,52 @@ class FakeTransaction:
     def logical_message(self, prefix: str, content: bytes) -> None:
         self._ops.append(("M", prefix, content, None))
 
+    def alter_table(self, table_id: TableId, new_schema: TableSchema) -> None:
+        """ALTER TABLE: applies the new schema; if the source migrations
+        installed the DDL event trigger AND the table is published, the
+        trigger emits a supabase_etl_ddl logical message transactionally
+        (reference migrations/source/...schema_change_messages.up.sql)."""
+        self._ops.append(("A", table_id, new_schema, None))
+
     async def commit(self) -> Lsn:
         db = self.db
         ts = _now_us()
-        # Relation messages for tables used (PG sends per-connection; putting
-        # them in the WAL makes replays self-describing, which the apply
-        # loop tolerates — repeated RELATION is idempotent)
-        used: list[TableId] = []
-        for op in self._ops:
-            if op[0] in ("I", "U", "D") and op[1] not in used:
-                used.append(op[1])
         begin_at = db.current_lsn + 8
 
-        entries: list[bytes] = []
-        for tid in used:
+        # Relation messages are emitted lazily before a table's first row
+        # op, with the schema CURRENT AT THAT POINT — an ALTER earlier in
+        # the transaction must be reflected, exactly like the walsender's
+        # per-connection relation cache invalidation. (PG sends per-
+        # connection; putting them in the WAL makes replays self-
+        # describing, which the apply loop tolerates — repeated RELATION
+        # is idempotent.)
+        relation_sent: set[TableId] = set()
+        body_entries: list[bytes] = []
+
+        def emit_relation(tid: TableId) -> None:
             t = db.tables[tid]
             cols = [((1 if c.is_primary_key else 0), c.name, c.type_oid,
                      c.modifier) for c in t.schema.columns]
-            entries.append(pgoutput.encode_relation(
+            body_entries.append((pgoutput.encode_relation(
                 tid, t.schema.name.schema, t.schema.name.name, cols,
-                replica_identity=t.replica_identity))
-        body_entries: list[bytes] = []
+                replica_identity=t.replica_identity), None, None))
+            relation_sent.add(tid)
+
         for op in self._ops:
             kind = op[0]
+            if kind in ("I", "U", "D"):
+                # publish_via_partition_root: leaf changes carry the root's
+                # relid (and the root's RELATION message) in the WAL
+                target = db.wal_relid(op[1])
+                if target not in relation_sent:
+                    emit_relation(target)
             if kind == "I":
                 _, tid, values, _ = op
+                target = db.wal_relid(tid)
                 body_entries.append((pgoutput.encode_insert(
-                    tid, [None if v is None else v.encode() for v in values]),
-                    tid, list(values)))
+                    target,
+                    [None if v is None else v.encode() for v in values]),
+                    target, list(values)))
                 db.tables[tid].rows.append(list(values))
             elif kind == "U":
                 _, tid, values, key = op
@@ -224,9 +284,10 @@ class FakeTransaction:
                         old_row[i] != values[i] for i in kcols):
                     key_values = enc([old_row[i] if i in kcols else None
                                       for i in range(len(old_row))])
+                target = db.wal_relid(tid)
                 body_entries.append((pgoutput.encode_update(
-                    tid, enc(values), old_values=old_values,
-                    key_values=key_values), tid, list(values)))
+                    target, enc(values), old_values=old_values,
+                    key_values=key_values), target, list(values)))
                 self._apply_update(t, key, values)
             elif kind == "D":
                 _, tid, _, key = op
@@ -241,9 +302,10 @@ class FakeTransaction:
                     tup = [src[i] if i in kcols else None
                            for i in range(len(src))]
                     full = False
+                target = db.wal_relid(tid)
                 body_entries.append((pgoutput.encode_delete(
-                    tid, [None if v is None else v.encode() for v in tup],
-                    full_old=full), tid, list(key)))
+                    target, [None if v is None else v.encode() for v in tup],
+                    full_old=full), target, list(key)))
                 self._apply_delete(t, key)
             elif kind == "T":
                 _, tids, options, _ = op
@@ -251,17 +313,29 @@ class FakeTransaction:
                     list(tids), options), None, None))
                 for tid in tids:
                     db.tables[tid].rows.clear()
+            elif kind == "A":
+                _, tid, new_schema, _ = op
+                db.tables[tid].schema = new_schema
+                relation_sent.discard(tid)
+                published = any(tid in tids
+                                for tids in db.publications.values())
+                if db.ddl_trigger_installed and published:
+                    from .codec.event import (DDL_MESSAGE_PREFIX,
+                                              encode_schema_change)
+
+                    body_entries.append((pgoutput.encode_logical_message(
+                        DDL_MESSAGE_PREFIX,
+                        encode_schema_change(tid, new_schema),
+                        lsn=int(db.current_lsn)), None, None))
             elif kind == "M":
                 _, prefix, content, _ = op
                 body_entries.append((pgoutput.encode_logical_message(
                     prefix, content, lsn=int(db.current_lsn)), None, None))
 
-        n_entries = len(entries) + len(body_entries) + 2  # + begin + commit
+        n_entries = len(body_entries) + 2  # + begin + commit
         commit_lsn = Lsn(int(begin_at) + 8 * (n_entries - 1))
         await db.append_wal(pgoutput.encode_begin(int(commit_lsn), ts,
                                                   self.xid))
-        for e in entries:
-            await db.append_wal(e)
         for payload, tid, row in body_entries:
             await db.append_wal(payload, table_id=tid, row=row)
         end_lsn = await db.append_wal(
@@ -459,6 +533,31 @@ class FakeSource(ReplicationSource):
     async def get_current_wal_lsn(self) -> Lsn:
         return self.db.current_lsn
 
+    async def is_in_recovery(self) -> bool:
+        return self.db.is_standby
+
+    async def get_partition_leaves(
+            self, table_id: TableId) -> list[tuple[TableId, int, int]]:
+        t = self.db.tables.get(table_id)
+        if t is None or not t.partition_leaves:
+            return []
+        out = []
+        for leaf_id in t.partition_leaves:
+            leaf = self.db.tables[leaf_id]
+            n = len(leaf.rows)
+            out.append((leaf_id, n, max(1, n // 64)))
+        return out
+
+    async def applied_source_migrations(self) -> list[str]:
+        return list(self.db.applied_migrations)
+
+    async def apply_source_migration(self, name: str, sql: str) -> None:
+        # the fake models the migration's EFFECT: the DDL event trigger is
+        # installed, so ALTER TABLE through FakeTransaction emits the
+        # supabase_etl_ddl logical message (the installed path)
+        self.db.ddl_trigger_installed = True
+        self.db.applied_migrations.append(name)
+
     async def get_slot(self, name: str) -> SlotInfo | None:
         s = self.db.slots.get(name)
         if s is None:
@@ -481,20 +580,23 @@ class FakeSource(ReplicationSource):
 
     async def copy_table_stream(self, table_id: TableId, publication: str,
                                 snapshot_id: str,
-                                ctid_range: "tuple[int, int] | None" = None
+                                ctid_range: "tuple[int, int] | None" = None,
+                                publication_table_id: "TableId | None" = None
                                 ) -> CopyStream:
         snap = self.db.snapshots.get(snapshot_id)
         if snap is None:
             raise EtlError(ErrorKind.SNAPSHOT_EXPORT_FAILED, snapshot_id)
         rows = snap.get(table_id, [])
-        pred = self.db.row_filters.get((publication, table_id))
+        # a leaf partition inherits the published root's row/column filters
+        pub_tid = self.db.wal_relid(table_id)
+        pred = self.db.row_filters.get((publication, pub_tid))
         if pred is not None:
             rows = [r for r in rows if pred(r)]
         if ctid_range is not None:
             # fake pages: 64 rows per heap page
             lo, hi = ctid_range
             rows = rows[lo * 64 : hi * 64]
-        filt = self.db.column_filters.get((publication, table_id))
+        filt = self.db.column_filters.get((publication, pub_tid))
         if filt:
             schema = self.db.tables[table_id].schema
             idx = [schema.column_index(c) for c in filt]

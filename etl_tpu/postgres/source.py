@@ -97,6 +97,21 @@ class ReplicationSource(abc.ABC):
     @abc.abstractmethod
     async def get_current_wal_lsn(self) -> Lsn: ...
 
+    # -- source migrations (reference postgres/migrations.rs) ---------------
+
+    @abc.abstractmethod
+    async def is_in_recovery(self) -> bool:
+        """True on a standby/read replica (pg_is_in_recovery())."""
+
+    @abc.abstractmethod
+    async def applied_source_migrations(self) -> "list[str]":
+        """Names recorded in etl.source_migrations ([] if absent)."""
+
+    @abc.abstractmethod
+    async def apply_source_migration(self, name: str, sql: str) -> None:
+        """Run one migration script and record its name."""
+
+
     # -- slots ---------------------------------------------------------------
 
     @abc.abstractmethod
@@ -117,14 +132,26 @@ class ReplicationSource(abc.ABC):
     @abc.abstractmethod
     async def copy_table_stream(self, table_id: TableId, publication: str,
                                 snapshot_id: str,
-                                ctid_range: "tuple[int, int] | None" = None
+                                ctid_range: "tuple[int, int] | None" = None,
+                                publication_table_id: "TableId | None" = None
                                 ) -> CopyStream:
         """COPY text stream of the table as of the snapshot; optional CTID
-        page range for partitioned parallel copy (transaction.rs:780,868)."""
+        page range for partitioned parallel copy (transaction.rs:780,868).
+        `publication_table_id`: the published relation when it differs from
+        the physical one (leaf partitions under
+        publish_via_partition_root inherit the root's filters)."""
 
     @abc.abstractmethod
     async def estimate_table_stats(self, table_id: TableId) -> tuple[int, int]:
         """(estimated_rows, heap_pages) from pg_class for copy planning."""
+
+    async def get_partition_leaves(
+            self, table_id: TableId) -> "list[tuple[TableId, int, int]]":
+        """Leaf partitions of a partitioned table as (leaf_id, est_rows,
+        heap_pages); empty for regular tables. Copy planning weights CTID
+        ranges per leaf (reference transaction.rs:808-825,
+        copy.rs:457-547)."""
+        return []
 
     @abc.abstractmethod
     async def start_replication(self, slot_name: str, publication: str,

@@ -19,7 +19,7 @@ from dataclasses import dataclass
 
 from ..config.pipeline import BatchEngine, PipelineConfig
 from ..models.errors import ErrorKind, EtlError
-from ..models.schema import ReplicatedTableSchema
+from ..models.schema import ReplicatedTableSchema, TableId
 from ..models.table_row import ColumnarBatch
 from ..ops.engine import DeviceDecoder
 from ..ops.staging import stage_copy_chunk
@@ -34,11 +34,14 @@ from .shutdown import ShutdownRequested, ShutdownSignal, or_shutdown
 
 @dataclass(frozen=True)
 class CopyPartition:
-    """A CTID page range [start_page, end_page); end None = to table end."""
+    """A CTID page range [start_page, end_page); end None = to table end.
+    `relation_id` is the physical relation to COPY (a leaf partition when
+    the published table is partitioned; None = the table itself)."""
 
     start_page: int
     end_page: int | None
     estimated_rows: int
+    relation_id: "TableId | None" = None
 
 
 @dataclass
@@ -84,8 +87,13 @@ async def _copy_partition(source: ReplicationSource,
     rng = None if part.end_page is None and part.start_page == 0 \
         else (part.start_page, part.end_page if part.end_page is not None
               else 1 << 30)
-    stream = await source.copy_table_stream(
-        schema.id, publication, snapshot_id, ctid_range=rng)
+    if part.relation_id is not None and part.relation_id != schema.id:
+        stream = await source.copy_table_stream(
+            part.relation_id, publication, snapshot_id, ctid_range=rng,
+            publication_table_id=schema.id)
+    else:
+        stream = await source.copy_table_stream(
+            schema.id, publication, snapshot_id, ctid_range=rng)
     oids = [c.type_oid for c in schema.replicated_columns]
     pending = b""
     acks: list[WriteAck] = []
@@ -155,8 +163,21 @@ async def parallel_table_copy(*, source_factory, primary_source,
                               shutdown: ShutdownSignal, monitor=None,
                               budget=None) -> CopyProgress:
     """Copy one table through N snapshot-sharing connections."""
-    est_rows, heap_pages = await primary_source.estimate_table_stats(schema.id)
-    parts = plan_copy_partitions(est_rows, heap_pages, config)
+    leaves = await primary_source.get_partition_leaves(schema.id)
+    if leaves:
+        # partitioned root: plan per leaf, weighted by each leaf's stats
+        # (reference copy.rs:457-547); CTID ranges are per physical
+        # relation, so page math never spans leaves
+        parts = []
+        for leaf_id, est_rows, heap_pages in leaves:
+            for p in plan_copy_partitions(est_rows, heap_pages, config):
+                parts.append(CopyPartition(p.start_page, p.end_page,
+                                           p.estimated_rows, leaf_id))
+        parts.sort(key=lambda p: -p.estimated_rows)
+    else:
+        est_rows, heap_pages = \
+            await primary_source.estimate_table_stats(schema.id)
+        parts = plan_copy_partitions(est_rows, heap_pages, config)
     n_conns = min(config.table_sync_copy.max_connections, len(parts))
     decoder = DeviceDecoder(schema) \
         if config.batch.batch_engine is BatchEngine.TPU else None

@@ -50,6 +50,13 @@ class Pipeline:
         source = self.source_factory()
         await source.connect()
         try:
+            if self.config.run_source_migrations:
+                # installs the supabase_etl_ddl event trigger so schema
+                # changes flow through the WAL (pipeline.rs:153-164);
+                # no-op on standbys and when already applied
+                from ..postgres.migrations import run_source_migrations
+
+                await run_source_migrations(source)
             await self._initialize_table_states(source)
         finally:
             await source.close()

@@ -3,3 +3,4 @@
 from .base import (DestinationTableMetadata, PipelineStore, SchemaStore,
                    StateStore)
 from .memory import MemoryStore, NotifyingStore
+from .sql import PostgresStore, SqliteStore

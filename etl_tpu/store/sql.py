@@ -1,8 +1,9 @@
 """Durable SQL store: the reference `etl` schema on sqlite or Postgres.
 
-Reference parity: `PostgresStore` (crates/etl/src/store/both/postgres.rs)
-against the `etl` schema (migrations/postgres_store/20250827000000_base.up.sql
-+ 20260511090000_replication_progress.up.sql):
+Reference parity: `PostgresStore` (crates/etl/src/store/both/postgres.rs,
+829 LoC) against the `etl` schema
+(migrations/postgres_store/20250827000000_base.up.sql +
+20260511090000_replication_progress.up.sql):
 
   - `replication_state`: per-table state rows with a prev-pointer history
     chain and a partial unique `is_current` index;
@@ -14,14 +15,18 @@ Cache-first reads like the reference (postgres.rs): all lookups hit an
 in-memory cache warmed at `connect()`; writes go through to the database
 synchronously.
 
-Dialects: "sqlite" (file-backed, fully functional in this environment) and
-"postgres" (same statements with $n placeholders, executed over a DB-API
-compatible runner — e.g. the wire client adapter). Statement generation is
-shared so the Postgres path cannot drift from the tested sqlite path.
+Dialects share ONE statement set (`_SqlStoreBase`), so the Postgres path
+cannot drift from the sqlite path:
+  - `SqliteStore`: file-backed, `?` placeholders, synchronous sqlite3;
+  - `PostgresStore`: executes the same statements over the from-scratch
+    wire client (`postgres/wire.py`) via the simple-query protocol with
+    client-side literal binding — no driver dependency, same connection
+    stack the replication client uses.
 """
 
 from __future__ import annotations
 
+import abc
 import json
 import sqlite3
 from dataclasses import dataclass
@@ -36,7 +41,7 @@ from .base import DestinationTableMetadata, PipelineStore, ProgressKey
 MIGRATIONS: list[tuple[str, str]] = [
     ("20250827000000_base", """
 CREATE TABLE IF NOT EXISTS etl_replication_state (
-    id INTEGER PRIMARY KEY {autoinc},
+    id {bigserial} PRIMARY KEY,
     pipeline_id BIGINT NOT NULL,
     table_id BIGINT NOT NULL,
     state TEXT NOT NULL,
@@ -71,65 +76,62 @@ CREATE TABLE IF NOT EXISTS etl_replication_progress (
 ]
 
 
-class SqliteStore(PipelineStore):
-    """File-backed store. `connect()` runs migrations and warms caches."""
+def _opt_int(v) -> int | None:
+    return None if v is None else int(v)
 
-    def __init__(self, path: str | Path, pipeline_id: int):
-        self.path = str(path)
+
+class _SqlStoreBase(PipelineStore, abc.ABC):
+    """Shared statements + caches; subclasses provide execution."""
+
+    def __init__(self, pipeline_id: int):
         self.pipeline_id = pipeline_id
-        self._db: sqlite3.Connection | None = None
         # cache-first reads (reference postgres.rs cache strategy)
         self._states: dict[TableId, TableState] = {}
         self._schemas: dict[TableId, list[tuple[SnapshotId, ReplicatedTableSchema]]] = {}
         self._progress: dict[ProgressKey, Lsn] = {}
         self._meta: dict[TableId, DestinationTableMetadata] = {}
 
+    # -- execution seam ------------------------------------------------------
+
+    @abc.abstractmethod
+    async def _run(self, sql: str, params: tuple = ()) -> list[tuple]:
+        """Execute one statement (auto-committed); return rows."""
+
+    @abc.abstractmethod
+    async def _txn(self, statements: list[tuple[str, tuple]]) -> None:
+        """Execute several statements atomically."""
+
     # -- lifecycle -----------------------------------------------------------
 
-    async def connect(self) -> None:
-        self._db = sqlite3.connect(self.path)
-        self._db.execute("PRAGMA journal_mode=WAL")
-        self._db.execute("PRAGMA synchronous=NORMAL")
+    async def _migrate_and_warm(self, bigserial: str) -> None:
         for _name, ddl in MIGRATIONS:
-            self._db.executescript(ddl.format(autoinc="AUTOINCREMENT"))
-        self._db.commit()
-        self._load_caches()
+            for stmt in ddl.format(bigserial=bigserial).split(";"):
+                if stmt.strip():
+                    await self._run(stmt)
+        await self._load_caches()
 
-    def _load_caches(self) -> None:
-        db = self._conn()
+    async def _load_caches(self) -> None:
         pid = self.pipeline_id
-        self._states = {}
-        for tid, raw in db.execute(
+        self._states = {
+            int(tid): TableState.from_json(raw) for tid, raw in await self._run(
                 "SELECT table_id, state FROM etl_replication_state "
-                "WHERE pipeline_id = ? AND is_current = 1", (pid,)):
-            self._states[tid] = TableState.from_json(raw)
+                "WHERE pipeline_id = ? AND is_current = 1", (pid,))}
         self._schemas = {}
-        for tid, sid, raw in db.execute(
+        for tid, sid, raw in await self._run(
                 "SELECT table_id, snapshot_id, schema_json FROM "
                 "etl_table_schemas WHERE pipeline_id = ? "
                 "ORDER BY snapshot_id", (pid,)):
-            self._schemas.setdefault(tid, []).append(
-                (sid, ReplicatedTableSchema.from_json(json.loads(raw))))
+            self._schemas.setdefault(int(tid), []).append(
+                (int(sid), ReplicatedTableSchema.from_json(json.loads(raw))))
         self._progress = {
-            key: Lsn(lsn) for key, lsn in db.execute(
+            key: Lsn(int(lsn)) for key, lsn in await self._run(
                 "SELECT progress_key, lsn FROM etl_replication_progress "
                 "WHERE pipeline_id = ?", (pid,))}
         self._meta = {
-            tid: DestinationTableMetadata(tid, name, gen)
-            for tid, name, gen in db.execute(
+            int(tid): DestinationTableMetadata(int(tid), name, int(gen))
+            for tid, name, gen in await self._run(
                 "SELECT table_id, destination_table_name, generation "
                 "FROM etl_table_mappings WHERE pipeline_id = ?", (pid,))}
-
-    def _conn(self) -> sqlite3.Connection:
-        if self._db is None:
-            raise EtlError(ErrorKind.STATE_STORE_FAILED,
-                           "store not connected")
-        return self._db
-
-    async def close(self) -> None:
-        if self._db is not None:
-            self._db.close()
-            self._db = None
 
     # -- StateStore ----------------------------------------------------------
 
@@ -144,30 +146,27 @@ class SqliteStore(PipelineStore):
         if not state.is_persistent:
             raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
                            f"{state.type.value} is memory-only, not storable")
-        db = self._conn()
         pid = self.pipeline_id
         # prev-pointer history chain (reference base.up.sql semantics)
-        cur = db.execute(
+        cur = await self._run(
             "SELECT id FROM etl_replication_state WHERE pipeline_id = ? "
-            "AND table_id = ? AND is_current = 1",
-            (pid, table_id)).fetchone()
-        prev_id = cur[0] if cur else None
-        db.execute("UPDATE etl_replication_state SET is_current = 0 "
-                   "WHERE pipeline_id = ? AND table_id = ? "
-                   "AND is_current = 1", (pid, table_id))
-        db.execute(
-            "INSERT INTO etl_replication_state "
-            "(pipeline_id, table_id, state, prev, is_current) "
-            "VALUES (?, ?, ?, ?, 1)",
-            (pid, table_id, state.to_json(), prev_id))
-        db.commit()
+            "AND table_id = ? AND is_current = 1", (pid, table_id))
+        prev_id = _opt_int(cur[0][0]) if cur else None
+        await self._txn([
+            ("UPDATE etl_replication_state SET is_current = 0 "
+             "WHERE pipeline_id = ? AND table_id = ? AND is_current = 1",
+             (pid, table_id)),
+            ("INSERT INTO etl_replication_state "
+             "(pipeline_id, table_id, state, prev, is_current) "
+             "VALUES (?, ?, ?, ?, 1)",
+             (pid, table_id, state.to_json(), prev_id)),
+        ])
         self._states[table_id] = state
 
     async def delete_table_state(self, table_id: TableId) -> None:
-        db = self._conn()
-        db.execute("DELETE FROM etl_replication_state WHERE pipeline_id = ? "
-                   "AND table_id = ?", (self.pipeline_id, table_id))
-        db.commit()
+        await self._run(
+            "DELETE FROM etl_replication_state WHERE pipeline_id = ? "
+            "AND table_id = ?", (self.pipeline_id, table_id))
         self._states.pop(table_id, None)
 
     async def get_durable_progress(self, key: ProgressKey) -> Lsn | None:
@@ -178,24 +177,21 @@ class SqliteStore(PipelineStore):
         cur = self._progress.get(key)
         if cur is not None and lsn < cur:
             return False
-        db = self._conn()
-        db.execute(
+        await self._run(
             "INSERT INTO etl_replication_progress "
             "(pipeline_id, progress_key, lsn) VALUES (?, ?, ?) "
             "ON CONFLICT (pipeline_id, progress_key) DO UPDATE SET "
             "lsn = excluded.lsn WHERE excluded.lsn >= "
             "etl_replication_progress.lsn",
             (self.pipeline_id, key, int(lsn)))
-        db.commit()
         self._progress[key] = lsn
         return True
 
     async def delete_durable_progress(self, key: ProgressKey) -> None:
-        db = self._conn()
-        db.execute("DELETE FROM etl_replication_progress WHERE "
-                   "pipeline_id = ? AND progress_key = ?",
-                   (self.pipeline_id, key))
-        db.commit()
+        await self._run(
+            "DELETE FROM etl_replication_progress WHERE "
+            "pipeline_id = ? AND progress_key = ?",
+            (self.pipeline_id, key))
         self._progress.pop(key, None)
 
     async def get_destination_metadata(
@@ -204,8 +200,7 @@ class SqliteStore(PipelineStore):
 
     async def update_destination_metadata(
             self, meta: DestinationTableMetadata) -> None:
-        db = self._conn()
-        db.execute(
+        await self._run(
             "INSERT INTO etl_table_mappings "
             "(pipeline_id, table_id, destination_table_name, generation) "
             "VALUES (?, ?, ?, ?) ON CONFLICT (pipeline_id, table_id) "
@@ -213,22 +208,19 @@ class SqliteStore(PipelineStore):
             "destination_table_name, generation = excluded.generation",
             (self.pipeline_id, meta.table_id, meta.destination_table_name,
              meta.generation))
-        db.commit()
         self._meta[meta.table_id] = meta
 
     async def delete_destination_metadata(self, table_id: TableId) -> None:
-        db = self._conn()
-        db.execute("DELETE FROM etl_table_mappings WHERE pipeline_id = ? "
-                   "AND table_id = ?", (self.pipeline_id, table_id))
-        db.commit()
+        await self._run(
+            "DELETE FROM etl_table_mappings WHERE pipeline_id = ? "
+            "AND table_id = ?", (self.pipeline_id, table_id))
         self._meta.pop(table_id, None)
 
     # -- SchemaStore ---------------------------------------------------------
 
     async def store_table_schema(self, schema: ReplicatedTableSchema,
                                  snapshot_id: SnapshotId) -> None:
-        db = self._conn()
-        db.execute(
+        await self._run(
             "INSERT INTO etl_table_schemas "
             "(pipeline_id, table_id, snapshot_id, schema_json) "
             "VALUES (?, ?, ?, ?) ON CONFLICT "
@@ -236,7 +228,6 @@ class SqliteStore(PipelineStore):
             "schema_json = excluded.schema_json",
             (self.pipeline_id, schema.id, snapshot_id,
              json.dumps(schema.to_json())))
-        db.commit()
         versions = self._schemas.setdefault(schema.id, [])
         versions[:] = [(s, v) for s, v in versions if s != snapshot_id]
         versions.append((snapshot_id, schema))
@@ -276,29 +267,158 @@ class SqliteStore(PipelineStore):
                 keep_from = i
         removed_ids = [s for s, _ in versions[:keep_from]]
         if removed_ids:
-            db = self._conn()
-            db.executemany(
-                "DELETE FROM etl_table_schemas WHERE pipeline_id = ? AND "
-                "table_id = ? AND snapshot_id = ?",
-                [(self.pipeline_id, table_id, s) for s in removed_ids])
-            db.commit()
+            await self._txn([
+                ("DELETE FROM etl_table_schemas WHERE pipeline_id = ? AND "
+                 "table_id = ? AND snapshot_id = ?",
+                 (self.pipeline_id, table_id, s)) for s in removed_ids])
         versions[:] = versions[keep_from:]
         return len(removed_ids)
 
     async def delete_table_schemas(self, table_id: TableId) -> None:
-        db = self._conn()
-        db.execute("DELETE FROM etl_table_schemas WHERE pipeline_id = ? "
-                   "AND table_id = ?", (self.pipeline_id, table_id))
-        db.commit()
+        await self._run(
+            "DELETE FROM etl_table_schemas WHERE pipeline_id = ? "
+            "AND table_id = ?", (self.pipeline_id, table_id))
         self._schemas.pop(table_id, None)
 
     # -- history inspection (reference prev-pointer chain) --------------------
 
     async def state_history(self, table_id: TableId) -> list[TableState]:
         """Oldest→newest chain of states for a table."""
-        db = self._conn()
-        rows = db.execute(
+        rows = await self._run(
             "SELECT state FROM etl_replication_state WHERE pipeline_id = ? "
-            "AND table_id = ? ORDER BY id", (self.pipeline_id, table_id)
-        ).fetchall()
+            "AND table_id = ? ORDER BY id", (self.pipeline_id, table_id))
         return [TableState.from_json(r[0]) for r in rows]
+
+
+class SqliteStore(_SqlStoreBase):
+    """File-backed store. `connect()` runs migrations and warms caches."""
+
+    def __init__(self, path: str | Path, pipeline_id: int):
+        super().__init__(pipeline_id)
+        self.path = str(path)
+        self._db: sqlite3.Connection | None = None
+
+    async def connect(self) -> None:
+        self._db = sqlite3.connect(self.path)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        await self._migrate_and_warm(bigserial="INTEGER")
+        self._db.commit()
+
+    def _conn(self) -> sqlite3.Connection:
+        if self._db is None:
+            raise EtlError(ErrorKind.STATE_STORE_FAILED,
+                           "store not connected")
+        return self._db
+
+    async def _run(self, sql: str, params: tuple = ()) -> list[tuple]:
+        db = self._conn()
+        rows = db.execute(sql, params).fetchall()
+        db.commit()
+        return rows
+
+    async def _txn(self, statements: list[tuple[str, tuple]]) -> None:
+        db = self._conn()
+        try:
+            for sql, params in statements:
+                db.execute(sql, params)
+            db.commit()
+        except BaseException:
+            db.rollback()
+            raise
+
+    async def close(self) -> None:
+        if self._db is not None:
+            self._db.close()
+            self._db = None
+
+
+def _pg_literal(v) -> str:
+    """Client-side literal binding for the simple-query protocol. Values
+    in the store schema are ints, keys, state/schema JSON text, or NULL;
+    strings quote by doubling '' (standard_conforming_strings, the PG
+    default since 9.1, keeps backslashes literal)."""
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, int):
+        return str(v)
+    s = str(v)
+    if "\x00" in s:
+        raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
+                       "NUL byte in store value")
+    return "'" + s.replace("'", "''") + "'"
+
+
+def bind_literals(sql: str, params: tuple) -> str:
+    """Substitute `?` placeholders with quoted literals, skipping quoted
+    string segments in the statement itself."""
+    out = []
+    it = iter(params)
+    in_str = False
+    for ch in sql:
+        if ch == "'":
+            in_str = not in_str
+            out.append(ch)
+        elif ch == "?" and not in_str:
+            out.append(_pg_literal(next(it)))
+        else:
+            out.append(ch)
+    rest = list(it)
+    if rest:
+        raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
+                       f"{len(rest)} unbound parameters for: {sql[:80]}")
+    return "".join(out)
+
+
+class PostgresStore(_SqlStoreBase):
+    """The reference PostgresStore over the from-scratch wire client.
+
+    Reference: crates/etl/src/store/both/postgres.rs + the
+    migrations/postgres_store SQL. Executes the shared statement set via
+    the simple-query protocol (one implicit transaction per statement;
+    multi-statement atomicity via explicit BEGIN/COMMIT)."""
+
+    def __init__(self, connection_config, pipeline_id: int):
+        """connection_config: PgConnectionConfig (host/port/name/username/
+        password/TLS) — the same config object the replication client
+        uses."""
+        super().__init__(pipeline_id)
+        self._config = connection_config
+        self._conn = None
+
+    async def connect(self) -> None:
+        from ..postgres.client import wire_connection_from_config
+
+        self._conn = wire_connection_from_config(
+            self._config,
+            application_name=f"etl_tpu_store_{self.pipeline_id}")
+        await self._conn.connect()
+        await self._migrate_and_warm(
+            bigserial="BIGINT GENERATED BY DEFAULT AS IDENTITY")
+
+    async def _run(self, sql: str, params: tuple = ()) -> list[tuple]:
+        if self._conn is None:
+            raise EtlError(ErrorKind.STATE_STORE_FAILED,
+                           "store not connected")
+        result = await self._conn.query(bind_literals(sql, params))
+        return [tuple(r) for r in result.rows]
+
+    async def _txn(self, statements: list[tuple[str, tuple]]) -> None:
+        await self._run("BEGIN")
+        try:
+            for sql, params in statements:
+                await self._run(sql, params)
+        except BaseException:
+            try:
+                await self._run("ROLLBACK")
+            except Exception:
+                pass
+            raise
+        await self._run("COMMIT")
+
+    async def close(self) -> None:
+        if self._conn is not None:
+            await self._conn.close()
+            self._conn = None

@@ -22,6 +22,7 @@ import hashlib
 import hmac
 import os
 import re
+import sqlite3
 import struct
 import time
 from dataclasses import dataclass
@@ -231,9 +232,82 @@ class FakePgServer:
             w.write(READY)
         await w.drain()
 
+    def _try_store_sql(self, sess: _Session, norm: str, sql: str) -> bool:
+        """Execute `etl` store-schema statements (PostgresStore over the
+        wire) against an embedded per-database sqlite — the statements are
+        the store's shared dialect, so sqlite semantics match; only the
+        identity-column DDL spelling differs."""
+        w = sess.writer
+        first = norm.split(" ", 1)[0].upper() if norm else ""
+        is_txn = first in ("BEGIN", "COMMIT", "ROLLBACK") and " " not in norm
+        store_tables = ("etl_replication_state", "etl_table_schemas",
+                        "etl_table_mappings", "etl_replication_progress")
+        if not is_txn and not any(t in norm for t in store_tables):
+            return False
+        if first not in ("CREATE", "INSERT", "UPDATE", "DELETE", "SELECT",
+                         "BEGIN", "COMMIT", "ROLLBACK"):
+            return False
+        db = self.db
+        store = getattr(db, "_store_sql_db", None)
+        if store is None:
+            store = sqlite3.connect(":memory:", check_same_thread=False)
+            store.isolation_level = None  # explicit BEGIN/COMMIT pass through
+            db._store_sql_db = store
+        stmt = sql.replace("BIGINT GENERATED BY DEFAULT AS IDENTITY",
+                           "INTEGER")
+        try:
+            cur = store.execute(stmt)
+        except sqlite3.Error as e:
+            w.write(_error("42601", f"store sql: {e}"))
+            w.write(READY)
+            return True
+        if cur.description is not None:
+            names = [d[0] for d in cur.description]
+            rows = [[None if v is None else str(v) for v in r]
+                    for r in cur.fetchall()]
+            self._send_rows(w, names, rows)
+        else:
+            tag = {"INSERT": "INSERT 0 1", "UPDATE": f"UPDATE {cur.rowcount}",
+                   "DELETE": f"DELETE {cur.rowcount}"}.get(first, first)
+            w.write(_command_complete(tag))
+            w.write(READY)
+        return True
+
     async def _try_handle(self, sess: _Session, norm: str, sql: str) -> bool:
         w = sess.writer
         db = self.db
+
+        if self._try_store_sql(sess, norm, sql):
+            return True
+
+        if norm == "SELECT pg_is_in_recovery()":
+            self._send_rows(w, ["pg_is_in_recovery"],
+                            [["t" if db.is_standby else "f"]])
+            return True
+        if norm.startswith("SELECT name FROM etl.source_migrations"):
+            if not db.applied_migrations:
+                w.write(_error("42P01",
+                               'relation "etl.source_migrations" does not '
+                               "exist"))
+                w.write(READY)
+                return True
+            self._send_rows(w, ["name"],
+                            [[n] for n in sorted(db.applied_migrations)])
+            return True
+        if norm.startswith("CREATE SCHEMA IF NOT EXISTS etl"):
+            # the source migration script: model its effect (event trigger
+            # installed) the same way FakeSource does
+            db.ddl_trigger_installed = True
+            w.write(_command_complete("CREATE SCHEMA"))
+            w.write(READY)
+            return True
+        if norm.startswith("INSERT INTO etl.source_migrations"):
+            m2 = re.search(r"VALUES \('([^']+)'\)", norm)
+            if m2 and m2.group(1) not in db.applied_migrations:
+                db.applied_migrations.append(m2.group(1))
+            w.write(_command_complete("INSERT 0 1"))
+            w.write(READY)
+            return True
 
         m = re.match(r"SELECT 1 FROM pg_publication WHERE pubname = '([^']*)'",
                      norm)
@@ -288,13 +362,16 @@ class FakePgServer:
                                 "attnotnull", "ord", "default"], rows)
             return True
 
-        if "SELECT pt.attnames FROM pg_publication_tables" in norm:
+        if "SELECT pt.attnames" in norm \
+                and "FROM pg_publication_tables" in norm:
             pub = re.search(r"pt\.pubname = '([^']*)'", norm).group(1)
             tid = int(re.search(r"pc\.oid = (\d+)", norm).group(1))
             filt = db.column_filters.get((pub, tid))
-            rows = [["{" + ",".join(filt) + "}"]] if filt else [[None]] \
-                if tid in db.publications.get(pub, []) else []
-            self._send_rows(w, ["attnames"], rows)
+            attnames = "{" + ",".join(filt) + "}" if filt else None
+            rowfilter = db.row_filter_sql.get((pub, tid))
+            published = tid in db.publications.get(pub, [])
+            rows = [[attnames, rowfilter]] if published else []
+            self._send_rows(w, ["attnames", "rowfilter"], rows)
             return True
 
         if "FROM pg_replication_slots s" in norm and "LEFT JOIN" in norm:
@@ -372,10 +449,22 @@ class FakePgServer:
             return True
 
         m = re.match(r"COPY \(SELECT (.+) FROM \"([^\"]+)\"\.\"([^\"]+)\""
-                     r"(?: WHERE ctid >= '\((\d+),0\)' AND ctid < "
-                     r"'\((\d+),0\)')?\) TO STDOUT", norm)
+                     r"(?: WHERE (?:ctid >= '\((\d+),0\)' AND ctid < "
+                     r"'\((\d+),0\)')?(?: ?(?:AND )?\((.+)\))?)?"
+                     r"\) TO STDOUT", norm)
         if m:
             await self._copy_out(sess, m)
+            return True
+
+        m = re.search(r"FROM pg_partition_tree\((\d+)\) pt", norm)
+        if m:
+            t = db.tables.get(int(m.group(1)))
+            rows = []
+            for leaf_id in (t.partition_leaves if t else []):
+                leaf = db.tables[leaf_id]
+                n = len(leaf.rows)
+                rows.append([str(leaf_id), str(n), str(max(1, n // 64))])
+            self._send_rows(w, ["oid", "greatest", "greatest"], rows)
             return True
 
         m = re.search(r"FROM pg_class WHERE oid = (\d+)", norm)
@@ -419,11 +508,25 @@ class FakePgServer:
         snap = db.snapshots.get(sess.snapshot_id or "", None)
         rows = snap.get(table.schema.id, []) if snap is not None \
             else table.rows
-        # apply every publication row filter defined for this table (the
-        # fake has no session publication context on COPY; tests use one)
-        for (pub, tid), pred in db.row_filters.items():
-            if tid == table.schema.id:
-                rows = [r for r in rows if pred(r)]
+        # apply a row filter ONLY when the COPY SQL carried its predicate
+        # (the walsender applies filters at send time; the snapshot COPY
+        # must spell them out — a client that forgets gets unfiltered rows
+        # here, so the regression is visible to tests)
+        rowfilter_text = m.group(6)
+        if rowfilter_text:
+            pred = next(
+                (fn for (pub, tid), sql_text in db.row_filter_sql.items()
+                 if " ".join(sql_text.split()).lower()
+                 == " ".join(rowfilter_text.split()).lower()
+                 and (fn := db.row_filters.get((pub, tid))) is not None),
+                None)
+            if pred is None:
+                w.write(_error("42601",
+                               f"fake server: unknown row filter "
+                               f"{rowfilter_text!r}"))
+                w.write(READY)
+                return
+            rows = [r for r in rows if pred(r)]
         if lo is not None:
             rows = rows[lo * 64 : hi * 64]
         wanted = [c.strip().strip('"') for c in col_sql.split(",")]

@@ -1,17 +1,20 @@
-"""Durable SQL store tests: reference `etl` schema semantics on sqlite,
-including cross-process-style restart persistence (reference
-postgres_store.rs integration suite)."""
+"""Durable SQL store tests: reference `etl` schema semantics on BOTH
+dialects — sqlite (file-backed) and Postgres (over the from-scratch wire
+client against the socket-level fake server) — including
+cross-process-style restart persistence (reference postgres_store.rs
+integration suite)."""
 
 import asyncio
 
 import pytest
 
+from etl_tpu.config import PgConnectionConfig
 from etl_tpu.models import (ColumnSchema, Lsn, Oid, ReplicatedTableSchema,
                             RetryKind, TableName, TableSchema)
 from etl_tpu.models.errors import EtlError
 from etl_tpu.runtime.state import TableState, TableStateType
 from etl_tpu.store.base import DestinationTableMetadata
-from etl_tpu.store.sql import SqliteStore
+from etl_tpu.store.sql import PostgresStore, SqliteStore, bind_literals
 
 
 def schema(tid=5):
@@ -21,103 +24,176 @@ def schema(tid=5):
          ColumnSchema("b", Oid.TEXT))))
 
 
-class TestSqliteStore:
-    async def test_states_persist_across_restart(self, tmp_path):
-        path = tmp_path / "store.db"
-        s1 = SqliteStore(path, pipeline_id=1)
-        await s1.connect()
-        await s1.update_table_state(5, TableState.init())
-        await s1.update_table_state(5, TableState.data_sync())
-        await s1.update_table_state(
-            5, TableState.errored("x", retry_policy=RetryKind.MANUAL,
-                                  retry_attempts=2))
-        await s1.close()
+class StoreEnv:
+    """Builds stores of one dialect sharing backing storage, so a second
+    `make()` models a process restart."""
 
-        s2 = SqliteStore(path, pipeline_id=1)
-        await s2.connect()
-        st = await s2.get_table_state(5)
-        assert st.type is TableStateType.ERRORED
-        assert st.retry_policy is RetryKind.MANUAL
-        assert st.retry_attempts == 2
-        # prev-pointer history chain preserved oldest→newest
-        hist = await s2.state_history(5)
-        assert [h.type for h in hist] == [
-            TableStateType.INIT, TableStateType.DATA_SYNC,
-            TableStateType.ERRORED]
-        await s2.close()
+    def __init__(self, dialect: str, tmp_path):
+        self.dialect = dialect
+        self.tmp_path = tmp_path
+        self._server = None
+        self._stores = []
 
-    async def test_pipeline_isolation(self, tmp_path):
-        path = tmp_path / "store.db"
-        a = SqliteStore(path, 1)
-        b = SqliteStore(path, 2)
-        await a.connect()
-        await b.connect()
-        await a.update_table_state(5, TableState.ready())
-        assert await b.get_table_state(5) is None
-        await a.close()
-        await b.close()
+    async def make(self, pipeline_id: int = 1):
+        if self.dialect == "sqlite":
+            s = SqliteStore(self.tmp_path / "store.db", pipeline_id)
+        else:
+            if self._server is None:
+                from etl_tpu.postgres.fake import FakeDatabase
+                from etl_tpu.testing.fake_pg_server import FakePgServer
 
-    async def test_memory_only_rejected(self, tmp_path):
-        s = SqliteStore(tmp_path / "s.db", 1)
+                self._server = FakePgServer(FakeDatabase())
+                await self._server.start()
+            s = PostgresStore(
+                PgConnectionConfig(host="127.0.0.1",
+                                   port=self._server.port,
+                                   name="postgres", username="etl"),
+                pipeline_id)
         await s.connect()
+        self._stores.append(s)
+        return s
+
+    async def cleanup(self):
+        for s in self._stores:
+            try:
+                await s.close()
+            except Exception:
+                pass
+        if self._server is not None:
+            await self._server.stop()
+
+
+DIALECTS = ["sqlite", "postgres"]
+
+
+@pytest.mark.parametrize("dialect", DIALECTS)
+class TestSqlStoreDialects:
+    async def test_states_persist_across_restart(self, dialect, tmp_path):
+        env = StoreEnv(dialect, tmp_path)
+        try:
+            s1 = await env.make()
+            await s1.update_table_state(5, TableState.init())
+            await s1.update_table_state(5, TableState.data_sync())
+            await s1.update_table_state(
+                5, TableState.errored("x", retry_policy=RetryKind.MANUAL,
+                                      retry_attempts=2))
+            await s1.close()
+
+            s2 = await env.make()
+            st = await s2.get_table_state(5)
+            assert st.type is TableStateType.ERRORED
+            assert st.retry_policy is RetryKind.MANUAL
+            assert st.retry_attempts == 2
+            # prev-pointer history chain preserved oldest→newest
+            hist = await s2.state_history(5)
+            assert [h.type for h in hist] == [
+                TableStateType.INIT, TableStateType.DATA_SYNC,
+                TableStateType.ERRORED]
+        finally:
+            await env.cleanup()
+
+    async def test_pipeline_isolation(self, dialect, tmp_path):
+        env = StoreEnv(dialect, tmp_path)
+        try:
+            a = await env.make(1)
+            b = await env.make(2)
+            await a.update_table_state(5, TableState.ready())
+            assert await b.get_table_state(5) is None
+        finally:
+            await env.cleanup()
+
+    async def test_memory_only_rejected(self, dialect, tmp_path):
+        env = StoreEnv(dialect, tmp_path)
+        try:
+            s = await env.make()
+            with pytest.raises(EtlError):
+                await s.update_table_state(1, TableState.sync_wait(Lsn(1)))
+        finally:
+            await env.cleanup()
+
+    async def test_progress_monotonic_and_durable(self, dialect, tmp_path):
+        env = StoreEnv(dialect, tmp_path)
+        try:
+            s = await env.make()
+            assert await s.update_durable_progress("slot_a", Lsn(100))
+            assert not await s.update_durable_progress("slot_a", Lsn(50))
+            await s.close()
+            s2 = await env.make()
+            assert await s2.get_durable_progress("slot_a") == Lsn(100)
+            # regression attempt after reload also rejected
+            assert not await s2.update_durable_progress("slot_a", Lsn(99))
+            await s2.delete_durable_progress("slot_a")
+            assert await s2.get_durable_progress("slot_a") is None
+        finally:
+            await env.cleanup()
+
+    async def test_schema_versions_durable(self, dialect, tmp_path):
+        env = StoreEnv(dialect, tmp_path)
+        try:
+            s = await env.make()
+            r1 = schema()
+            await s.store_table_schema(r1, 0)
+            cols2 = r1.table_schema.columns + (ColumnSchema("c", Oid.BOOL),)
+            r2 = ReplicatedTableSchema.with_all_columns(
+                TableSchema(5, r1.name, cols2))
+            await s.store_table_schema(r2, 500)
+            await s.close()
+
+            s2 = await env.make()
+            assert (await s2.get_table_schema(5, at_snapshot=100)) == r1
+            assert (await s2.get_table_schema(5)) == r2
+            assert await s2.get_schema_versions(5) == [0, 500]
+            assert await s2.prune_schema_versions(5, 600) == 1
+            assert await s2.get_schema_versions(5) == [500]
+            await s2.close()
+            # prune is durable too
+            s3 = await env.make()
+            assert await s3.get_schema_versions(5) == [500]
+        finally:
+            await env.cleanup()
+
+    async def test_destination_metadata(self, dialect, tmp_path):
+        env = StoreEnv(dialect, tmp_path)
+        try:
+            s = await env.make()
+            await s.update_destination_metadata(
+                DestinationTableMetadata(5, "public_t", generation=2))
+            await s.close()
+            s2 = await env.make()
+            m = await s2.get_destination_metadata(5)
+            assert m.destination_table_name == "public_t" \
+                and m.generation == 2
+        finally:
+            await env.cleanup()
+
+    async def test_state_json_with_quotes_roundtrips(self, dialect, tmp_path):
+        """Client-side literal binding must survive quotes in error text
+        (the Postgres dialect quotes by doubling)."""
+        env = StoreEnv(dialect, tmp_path)
+        try:
+            s = await env.make()
+            msg = "it's a 'quoted' failure; DROP TABLE x; --"
+            await s.update_table_state(7, TableState.errored(
+                msg, retry_policy=RetryKind.MANUAL, retry_attempts=1))
+            await s.close()
+            s2 = await env.make()
+            st = await s2.get_table_state(7)
+            assert st.reason == msg
+        finally:
+            await env.cleanup()
+
+
+class TestBindLiterals:
+    def test_binding(self):
+        assert bind_literals("SELECT ? , ?", (1, None)) == \
+            "SELECT 1 , NULL"
+        assert bind_literals("a = ?", ("o'brien",)) == "a = 'o''brien'"
+        # '?' inside a quoted segment is not a placeholder
+        assert bind_literals("SELECT '?' , ?", (5,)) == "SELECT '?' , 5"
+
+    def test_unbound_raises(self):
         with pytest.raises(EtlError):
-            await s.update_table_state(1, TableState.sync_wait(Lsn(1)))
-        await s.close()
-
-    async def test_progress_monotonic_and_durable(self, tmp_path):
-        path = tmp_path / "store.db"
-        s = SqliteStore(path, 1)
-        await s.connect()
-        assert await s.update_durable_progress("slot_a", Lsn(100))
-        assert not await s.update_durable_progress("slot_a", Lsn(50))
-        await s.close()
-        s2 = SqliteStore(path, 1)
-        await s2.connect()
-        assert await s2.get_durable_progress("slot_a") == Lsn(100)
-        # regression attempt after reload also rejected
-        assert not await s2.update_durable_progress("slot_a", Lsn(99))
-        await s2.delete_durable_progress("slot_a")
-        assert await s2.get_durable_progress("slot_a") is None
-        await s2.close()
-
-    async def test_schema_versions_durable(self, tmp_path):
-        path = tmp_path / "store.db"
-        s = SqliteStore(path, 1)
-        await s.connect()
-        r1 = schema()
-        await s.store_table_schema(r1, 0)
-        cols2 = r1.table_schema.columns + (ColumnSchema("c", Oid.BOOL),)
-        r2 = ReplicatedTableSchema.with_all_columns(
-            TableSchema(5, r1.name, cols2))
-        await s.store_table_schema(r2, 500)
-        await s.close()
-
-        s2 = SqliteStore(path, 1)
-        await s2.connect()
-        assert (await s2.get_table_schema(5, at_snapshot=100)) == r1
-        assert (await s2.get_table_schema(5)) == r2
-        assert await s2.get_schema_versions(5) == [0, 500]
-        assert await s2.prune_schema_versions(5, 600) == 1
-        assert await s2.get_schema_versions(5) == [500]
-        await s2.close()
-        # prune is durable too
-        s3 = SqliteStore(path, 1)
-        await s3.connect()
-        assert await s3.get_schema_versions(5) == [500]
-        await s3.close()
-
-    async def test_destination_metadata(self, tmp_path):
-        path = tmp_path / "store.db"
-        s = SqliteStore(path, 1)
-        await s.connect()
-        await s.update_destination_metadata(
-            DestinationTableMetadata(5, "public_t", generation=2))
-        await s.close()
-        s2 = SqliteStore(path, 1)
-        await s2.connect()
-        m = await s2.get_destination_metadata(5)
-        assert m.destination_table_name == "public_t" and m.generation == 2
-        await s2.close()
+            bind_literals("SELECT ?", (1, 2))
 
 
 class TestPipelineWithSqliteStore:

@@ -201,3 +201,49 @@ class TestPipelineOverWire:
             await p2.shutdown_and_wait()
         finally:
             await server.stop()
+
+
+class TestWirePartitionsAndFilters:
+    async def test_partition_leaves_over_wire(self):
+        from tests.test_pipeline_e2e import (PART_L1, PART_L2, PART_ROOT,
+                                             make_partitioned_db)
+
+        db = make_partitioned_db()
+        server = await start_server(db)
+        try:
+            c = client_for(server)
+            await c.connect()
+            leaves = await c.get_partition_leaves(PART_ROOT)
+            assert [l[0] for l in leaves] == [PART_L1, PART_L2]
+            assert leaves[0][1] == 150 and leaves[1][1] == 70
+            assert await c.get_partition_leaves(PART_L1) == []
+            await c.close()
+        finally:
+            await server.stop()
+
+    async def test_copy_sql_includes_row_filter(self):
+        """The wire COPY must carry the publication rowfilter predicate
+        (transaction.rs:868) — the fake surfaces it via
+        pg_publication_tables.rowfilter and filters server-side."""
+        db = make_db()
+        db.create_publication(
+            "pub", [ACCOUNTS],
+            row_filters={ACCOUNTS: ("balance >= 0",
+                                    lambda r: r[2] is not None
+                                    and int(r[2]) >= 0)})
+        server = await start_server(db)
+        try:
+            c = client_for(server)
+            await c.connect()
+            created = await c.create_slot("supabase_etl_table_sync_1_16384")
+            stream = await c.copy_table_stream(ACCOUNTS, "pub",
+                                               created.snapshot_id)
+            data = b""
+            async for chunk in stream:
+                data += chunk
+            lines = [l for l in data.split(b"\n") if l]
+            ids = {l.split(b"\t")[0] for l in lines}
+            assert ids == {b"1", b"3"}  # bob (-5) filtered at COPY
+            await c.close()
+        finally:
+            await server.stop()
