@@ -113,7 +113,20 @@ def build_device_program(specs: tuple[tuple[int, CellKind, int, int], ...],
     return fn
 
 
-def _build_device_fn(specs, nibble: bool = False, use_pallas: bool = False):
+def _build_device_fn(specs, nibble: bool = False, use_pallas: bool = False,
+                     mesh=None):
+    if mesh is not None:
+        # multi-chip: rows sharded over the 'sp' axis, the SAME program —
+        # decode is elementwise over rows, so XLA partitions it with no
+        # cross-device collectives on the forward path; the bit-packed
+        # output keeps its row shards until the host fetch gathers them
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rows_sharded = NamedSharding(mesh, P("sp", None))
+        out_sharded = NamedSharding(mesh, P(None, "sp"))
+        return jax.jit(build_device_program(specs, nibble),
+                       in_shardings=(rows_sharded, rows_sharded),
+                       out_shardings=out_sharded)
     if use_pallas:
         from .pallas_kernel import build_pallas_program
 
@@ -196,13 +209,27 @@ class DeviceDecoder:
     # partitions go to the device
     DEVICE_MIN_ROWS = 8192
 
+    # below this row count a multi-device mesh buys nothing (per-shard
+    # work too small vs dispatch overhead); batches at/above it shard rows
+    # across 'sp' (SURVEY §7: data-parallel decode across ragged batches)
+    MESH_MIN_ROWS = 65_536
+
     def __init__(self, schema: ReplicatedTableSchema, *,
                  numeric_mode: str = "text", use_pallas: bool = False,
-                 device_min_rows: int | None = None):
+                 device_min_rows: int | None = None,
+                 mesh: "object | str | None" = "auto",
+                 mesh_min_rows: int | None = None):
         self.schema = schema
         self.use_pallas = use_pallas
         self.device_min_rows = self.DEVICE_MIN_ROWS \
             if device_min_rows is None else device_min_rows
+        if mesh == "auto":
+            from ..parallel.mesh import default_decode_mesh
+
+            mesh = default_decode_mesh()
+        self.mesh = mesh  # jax.sharding.Mesh | None
+        self.mesh_min_rows = self.MESH_MIN_ROWS \
+            if mesh_min_rows is None else mesh_min_rows
         cols = schema.replicated_columns
         self._numeric_mode = numeric_mode
         self._dense: list[_ColSpec] = []
@@ -301,13 +328,20 @@ class DeviceDecoder:
             w_off += w
         return bmat, lengths, False, None
 
+    def _use_mesh(self, row_capacity: int) -> bool:
+        return (self.mesh is not None
+                and row_capacity >= self.mesh_min_rows
+                and row_capacity % self.mesh.size == 0)
+
     def _device_call(self, staged: StagedBatch, specs: tuple):
         widths = tuple(w for _, _, w, _ in specs)
         bmat, lengths, nibble, bad_rows = self._pack_host(staged, widths)
-        key = (staged.row_capacity, specs, nibble)
+        use_mesh = self._use_mesh(staged.row_capacity)
+        key = (staged.row_capacity, specs, nibble, use_mesh)
         fn = self._fn_cache.get(key)
         if fn is None:
-            fn = _build_device_fn(specs, nibble, self.use_pallas)
+            fn = _build_device_fn(specs, nibble, self.use_pallas,
+                                  mesh=self.mesh if use_mesh else None)
             self._fn_cache[key] = fn
         try:
             return fn(bmat, lengths), bad_rows  # async dispatch

@@ -18,16 +18,35 @@ change (jax.sharding.Mesh spanning hosts).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-from ..models.pgtypes import CellKind
-from ..ops import parsers
+
+
+def decode_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh | None:
+    """1D row-sharding mesh over all devices for the PRODUCTION decoder
+    (DeviceDecoder(mesh=…)): decode is embarrassingly parallel over rows,
+    so a single 'sp' axis covers it; None on single-device hosts."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < 2:
+        return None
+    return Mesh(np.asarray(devices), axis_names=("sp",))
+
+
+_DEFAULT_MESH: "list[Mesh | None] | None" = None
+
+
+def default_decode_mesh() -> Mesh | None:
+    """Cached decode_mesh over jax.devices() — what DeviceDecoder uses when
+    constructed with mesh='auto'."""
+    global _DEFAULT_MESH
+    if _DEFAULT_MESH is None:
+        _DEFAULT_MESH = [decode_mesh()]
+    return _DEFAULT_MESH[0]
 
 
 def make_mesh(devices: Sequence[jax.Device] | None = None,
@@ -48,74 +67,3 @@ def make_mesh(devices: Sequence[jax.Device] | None = None,
     return Mesh(arr, axis_names=("dp", "sp"))
 
 
-def _parse_columns(data, offsets, lengths, specs):
-    """Shared per-shard decode body: offsets/lengths are [B, R, C] local
-    shards; returns per-column component dict (parsers.parse_column order)
-    + ok matrix [B, R, n_dense]."""
-    B, R, C = offsets.shape
-    out = {}
-    oks = []
-    for col_idx, kind, width in specs:
-        off = offsets[:, :, col_idx].reshape(B * R)
-        ln = lengths[:, :, col_idx].reshape(B * R)
-        b = parsers.gather_fields(data, off, ln, width)
-        comp, ok = parsers.parse_column(kind, b, ln)
-        out[col_idx] = {k: v.reshape(B, R) for k, v in comp.items()}
-        oks.append(ok.reshape(B, R))
-    ok_mat = jnp.stack(oks, axis=-1) if oks else \
-        jnp.ones((B, R, 0), dtype=bool)
-    return out, ok_mat
-
-
-def build_sharded_decode_step(mesh: Mesh,
-                              specs: tuple[tuple[int, CellKind, int], ...]):
-    """The multi-chip decode step: batches sharded over 'dp', rows over 'sp'.
-
-    Inputs (global shapes):
-      data      uint8[cap]      replicated byte buffer
-      offsets   int32[B, R, C]  sharded P('dp', 'sp')
-      lengths   int32[B, R, C]  sharded P('dp', 'sp')
-      valid     bool[B, R, C]   sharded P('dp', 'sp')
-      lsns      uint32[B, R]    per-row start-LSN low word, P('dp', 'sp')
-
-    Outputs:
-      components  per-column dicts, each [B, R] sharded P('dp', 'sp')
-      n_bad       int32[B]   rows needing CPU fallback, psum over 'sp'
-      max_lsn     uint32[B]  durability watermark per batch, pmax over 'sp'
-    """
-
-    specs = tuple(s[:3] for s in specs)  # accept engine 4-tuple specs too
-    dense_idx = np.asarray([i for i, _, _ in specs], dtype=np.int32)
-
-    def step(data, offsets, lengths, valid, lsns):
-        comps, ok_mat = _parse_columns(data, offsets, lengths, specs)
-        valid_dense = valid[:, :, dense_idx]  # align with ok_mat columns
-        row_bad = (~ok_mat & valid_dense).any(axis=-1)  # [B, R] local
-        n_bad = jax.lax.psum(row_bad.sum(axis=1, dtype=jnp.int32), "sp")
-        max_lsn = jax.lax.pmax(lsns.max(axis=1), "sp")
-        return comps, n_bad, max_lsn
-
-    kwargs = dict(
-        mesh=mesh,
-        in_specs=(P(), P("dp", "sp", None), P("dp", "sp", None),
-                  P("dp", "sp", None), P("dp", "sp")),
-        out_specs=({i: {k: P("dp", "sp") for k in parsers.COLUMN_COMPONENTS[kind]}
-                    for i, kind, _ in specs},
-                   P("dp"), P("dp")))
-    try:
-        from jax import shard_map  # jax >= 0.7: replication-check kwarg
-        sharded = shard_map(step, check_vma=False, **kwargs)
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
-        sharded = shard_map(step, check_rep=False, **kwargs)
-    return jax.jit(sharded)
-
-
-def shard_staged_inputs(mesh: Mesh, data, offsets, lengths, valid, lsns):
-    """Place host arrays onto the mesh with the step's shardings."""
-    rep = NamedSharding(mesh, P())
-    rc = NamedSharding(mesh, P("dp", "sp", None))
-    rl = NamedSharding(mesh, P("dp", "sp"))
-    return (jax.device_put(data, rep), jax.device_put(offsets, rc),
-            jax.device_put(lengths, rc), jax.device_put(valid, rc),
-            jax.device_put(lsns, rl))

@@ -253,16 +253,20 @@ class PgReplicationClient(ReplicationSource):
         try:
             r = await self.conn.query(
                 "SELECT name FROM etl.source_migrations ORDER BY name")
-        except PgServerError:
-            return []  # schema not installed yet
+        except PgServerError as e:
+            # only 'relation/schema does not exist' means not-installed;
+            # permission or transient errors must NOT trigger a re-run of
+            # the migration script (it would fail or double-apply)
+            if e.fields.get("C") in ("42P01", "3F000"):
+                return []
+            raise
         return [row[0] for row in r.rows]
 
     async def apply_source_migration(self, name: str, sql: str) -> None:
         await self.conn.query(sql)
-        safe = name.replace("'", "''")
         await self.conn.query(
             "INSERT INTO etl.source_migrations (name) VALUES "
-            f"('{safe}') ON CONFLICT (name) DO NOTHING")
+            f"({_quote_literal(name)}) ON CONFLICT (name) DO NOTHING")
 
     # -- slots ------------------------------------------------------------------
 

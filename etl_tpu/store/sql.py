@@ -384,9 +384,17 @@ class PostgresStore(_SqlStoreBase):
         """connection_config: PgConnectionConfig (host/port/name/username/
         password/TLS) — the same config object the replication client
         uses."""
+        import asyncio
+
         super().__init__(pipeline_id)
         self._config = connection_config
         self._conn = None
+        # ONE wire connection serves every store caller (apply loop +
+        # N table-sync workers); simple-query protocol frames must not
+        # interleave, and _txn's BEGIN..COMMIT must not admit foreign
+        # statements — serialize everything through this lock
+        self._lock = asyncio.Lock()
+        self._in_txn = False
 
     async def connect(self) -> None:
         from ..postgres.client import wire_connection_from_config
@@ -398,25 +406,37 @@ class PostgresStore(_SqlStoreBase):
         await self._migrate_and_warm(
             bigserial="BIGINT GENERATED BY DEFAULT AS IDENTITY")
 
-    async def _run(self, sql: str, params: tuple = ()) -> list[tuple]:
+    async def _run_unlocked(self, sql: str,
+                            params: tuple = ()) -> list[tuple]:
         if self._conn is None:
             raise EtlError(ErrorKind.STATE_STORE_FAILED,
                            "store not connected")
         result = await self._conn.query(bind_literals(sql, params))
         return [tuple(r) for r in result.rows]
 
+    async def _run(self, sql: str, params: tuple = ()) -> list[tuple]:
+        if self._in_txn:  # already serialized by the enclosing _txn
+            return await self._run_unlocked(sql, params)
+        async with self._lock:
+            return await self._run_unlocked(sql, params)
+
     async def _txn(self, statements: list[tuple[str, tuple]]) -> None:
-        await self._run("BEGIN")
-        try:
-            for sql, params in statements:
-                await self._run(sql, params)
-        except BaseException:
+        async with self._lock:
+            self._in_txn = True
             try:
-                await self._run("ROLLBACK")
-            except Exception:
-                pass
-            raise
-        await self._run("COMMIT")
+                await self._run_unlocked("BEGIN")
+                try:
+                    for sql, params in statements:
+                        await self._run_unlocked(sql, params)
+                except BaseException:
+                    try:
+                        await self._run_unlocked("ROLLBACK")
+                    except Exception:
+                        pass
+                    raise
+                await self._run_unlocked("COMMIT")
+            finally:
+                self._in_txn = False
 
     async def close(self) -> None:
         if self._conn is not None:
