@@ -63,9 +63,14 @@ def _json_error(status: int, message: str) -> web.HTTPException:
 
 class ApiState:
     def __init__(self, db_path: str, cipher: ConfigCipher,
-                 orchestrator: Orchestrator):
+                 orchestrator: Orchestrator, api_key: str | None = None):
         self.cipher = cipher
         self.orchestrator = orchestrator
+        # deployment API key (reference etl-api authentication module):
+        # when set, every /v1 route requires `Authorization: Bearer <key>`
+        # BEFORE tenant routing — the tenant header alone is an assertion,
+        # not an authentication
+        self.api_key = api_key
         self.db = sqlite3.connect(db_path)
         self.db.executescript("""
 CREATE TABLE IF NOT EXISTS api_tenants (
@@ -76,6 +81,10 @@ CREATE TABLE IF NOT EXISTS api_sources (
 CREATE TABLE IF NOT EXISTS api_destinations (
     id INTEGER PRIMARY KEY AUTOINCREMENT, tenant_id TEXT NOT NULL,
     name TEXT NOT NULL, config_enc TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS api_images (
+    id INTEGER PRIMARY KEY AUTOINCREMENT, tenant_id TEXT NOT NULL,
+    name TEXT NOT NULL, is_default INTEGER NOT NULL DEFAULT 0,
+    UNIQUE (tenant_id, name));
 CREATE TABLE IF NOT EXISTS api_pipelines (
     id INTEGER PRIMARY KEY AUTOINCREMENT, tenant_id TEXT NOT NULL,
     source_id INTEGER NOT NULL, destination_id INTEGER NOT NULL,
@@ -91,6 +100,12 @@ CREATE TABLE IF NOT EXISTS api_pipelines (
             f"SELECT * FROM {table} WHERE id = ? AND tenant_id = ?",
             (row_id, tenant)).fetchone()
         return row
+
+    def default_image(self, tenant: str) -> "str | None":
+        row = self.db.execute(
+            "SELECT name FROM api_images WHERE tenant_id = ? AND "
+            "is_default = 1", (tenant,)).fetchone()
+        return row[0] if row else None
 
     def pipeline_config(self, row) -> dict:
         """Assemble the full replicator config for a pipeline row."""
@@ -112,8 +127,55 @@ CREATE TABLE IF NOT EXISTS api_pipelines (
         return doc
 
 
+_SECRET_KEY_HINTS = ("password", "secret", "token", "key", "credential")
+
+
+MASKED = "********"
+
+
+def redact_config(doc):
+    """Decrypted configs never leave the API verbatim: ANY value under a
+    secret-looking key is masked, whatever its type (ADVICE r1: GET
+    previously echoed decrypted source/destination credentials)."""
+    if isinstance(doc, dict):
+        return {k: (MASKED if any(h in k.lower()
+                                  for h in _SECRET_KEY_HINTS)
+                    else redact_config(v))
+                for k, v in doc.items()}
+    if isinstance(doc, list):
+        return [redact_config(v) for v in doc]
+    return doc
+
+
+def unmask_config(new, stored):
+    """Read-modify-write support: a client that PUTs back a GET response
+    carries the mask sentinel — restore the stored value there instead of
+    encrypting the literal '********' as the credential."""
+    if new == MASKED:
+        return stored
+    if isinstance(new, dict) and isinstance(stored, dict):
+        return {k: unmask_config(v, stored.get(k)) for k, v in new.items()}
+    if isinstance(new, list) and isinstance(stored, list):
+        return [unmask_config(v, s) for v, s in zip(new, stored)] \
+            + new[len(stored):]
+    return new
+
+
 def build_app(state: ApiState) -> web.Application:
-    app = web.Application()
+    @web.middleware
+    async def auth_middleware(request: web.Request, handler):
+        if state.api_key is not None \
+                and request.path.startswith("/v1"):
+            import hmac as _hmac
+
+            header = request.headers.get("Authorization", "")
+            if not _hmac.compare_digest(header,
+                                        f"Bearer {state.api_key}"):
+                return web.json_response({"error": "unauthorized"},
+                                         status=401)
+        return await handler(request)
+
+    app = web.Application(middlewares=[auth_middleware])
     r = app.router
 
     # -- health / metrics / openapi --------------------------------------------
@@ -184,7 +246,7 @@ def build_app(state: ApiState) -> web.Application:
                 raise _json_error(404, "not found")
             return web.json_response({
                 "id": row[0], "name": row[2],
-                "config": state.cipher.decrypt(row[3])})
+                "config": redact_config(state.cipher.decrypt(row[3]))})
 
         async def update(req: web.Request):
             tenant = _require_tenant(req)
@@ -194,6 +256,9 @@ def build_app(state: ApiState) -> web.Application:
             doc = await _json_body(req)
             config = doc.get("config")
             name = doc.get("name", row[2])
+            if config is not None:
+                config = unmask_config(config,
+                                       state.cipher.decrypt(row[3]))
             enc = state.cipher.encrypt(config) if config is not None else row[3]
             state.db.execute(
                 f"UPDATE {table} SET name = ?, config_enc = ? WHERE id = ?",
@@ -226,6 +291,67 @@ def build_app(state: ApiState) -> web.Application:
 
     make_config_routes("api_sources", "/v1/sources")
     make_config_routes("api_destinations", "/v1/destinations")
+
+    # -- images (replicator container images; reference etl-api images CRUD)
+
+    async def create_image(req: web.Request):
+        tenant = _require_tenant(req)
+        doc = await _json_body(req)
+        name = doc.get("name")
+        if not name:
+            raise _json_error(400, "name required")
+        try:
+            cur = state.db.execute(
+                "INSERT INTO api_images (tenant_id, name, is_default) "
+                "VALUES (?, ?, ?)",
+                (tenant, name, 1 if doc.get("default") else 0))
+        except sqlite3.IntegrityError:
+            raise _json_error(409, f"image {name} exists")
+        if doc.get("default"):
+            state.db.execute("UPDATE api_images SET is_default = 0 "
+                             "WHERE tenant_id = ? AND id <> ?",
+                             (tenant, cur.lastrowid))
+        state.db.commit()
+        return web.json_response(
+            {"id": cur.lastrowid, "name": name,
+             "default": bool(doc.get("default"))}, status=201)
+
+    async def list_images(req: web.Request):
+        tenant = _require_tenant(req)
+        rows = state.db.execute(
+            "SELECT id, name, is_default FROM api_images WHERE "
+            "tenant_id = ?", (tenant,)).fetchall()
+        return web.json_response([
+            {"id": i, "name": n, "default": bool(d)} for i, n, d in rows])
+
+    async def set_default_image(req: web.Request):
+        tenant = _require_tenant(req)
+        iid = _path_id(req)
+        row = state.db.execute(
+            "SELECT id FROM api_images WHERE id = ? AND tenant_id = ?",
+            (iid, tenant)).fetchone()
+        if row is None:
+            raise _json_error(404, "image not found")
+        state.db.execute("UPDATE api_images SET is_default = 0 WHERE "
+                         "tenant_id = ?", (tenant,))
+        state.db.execute("UPDATE api_images SET is_default = 1 "
+                         "WHERE id = ?", (iid,))
+        state.db.commit()
+        return web.json_response({"id": iid, "default": True})
+
+    async def delete_image(req: web.Request):
+        tenant = _require_tenant(req)
+        iid = _path_id(req)
+        state.db.execute(
+            "DELETE FROM api_images WHERE id = ? AND tenant_id = ?",
+            (iid, tenant))
+        state.db.commit()
+        return web.json_response({}, status=204)
+
+    r.add_post("/v1/images", create_image)
+    r.add_get("/v1/images", list_images)
+    r.add_post("/v1/images/{id}/set-default", set_default_image)
+    r.add_delete("/v1/images/{id}", delete_image)
 
     # -- pipelines ----------------------------------------------------------------
 
@@ -288,7 +414,8 @@ def build_app(state: ApiState) -> web.Application:
         row = _pipeline_row(req, tenant)
         config = state.pipeline_config(row)
         await state.orchestrator.start_pipeline(ReplicatorSpec(
-            pipeline_id=row[0], tenant_id=tenant, config=config))
+            pipeline_id=row[0], tenant_id=tenant, config=config,
+            image=state.default_image(tenant)))
         return web.json_response({"status": "starting"}, status=202)
 
     async def stop_pipeline(req: web.Request):
@@ -302,7 +429,8 @@ def build_app(state: ApiState) -> web.Application:
         row = _pipeline_row(req, tenant)
         config = state.pipeline_config(row)
         await state.orchestrator.restart_pipeline(ReplicatorSpec(
-            pipeline_id=row[0], tenant_id=tenant, config=config))
+            pipeline_id=row[0], tenant_id=tenant, config=config,
+            image=state.default_image(tenant)))
         return web.json_response({"status": "restarting"}, status=202)
 
     async def pipeline_status(req: web.Request):
@@ -400,6 +528,8 @@ def build_app(state: ApiState) -> web.Application:
             raise _json_error(404, "pipeline has no durable store")
         doc = await _json_body(req)
         table_ids = doc.get("table_ids")
+        from ..postgres.slots import table_sync_slot_name
+
         store = SqliteStore(store_path, row[0])
         await store.connect()
         try:
@@ -409,9 +539,24 @@ def build_app(state: ApiState) -> web.Application:
             rolled = []
             for tid in targets:
                 if table_ids is not None or states[tid].is_errored:
+                    prior = states[tid]
                     await store.reset_table(tid)
-                    rolled.append(tid)
-            return web.json_response({"rolled_back": sorted(rolled)})
+                    # a stale sync-slot progress row would fence the fresh
+                    # copy's catchup below its real position
+                    await store.delete_durable_progress(
+                        table_sync_slot_name(row[0], tid))
+                    rolled.append({
+                        "table_id": tid,
+                        "previous_state": prior.type.value,
+                        "previous_reason": prior.reason
+                        if prior.is_errored else None,
+                    })
+            unknown = [] if table_ids is None else \
+                [t for t in table_ids if t not in states]
+            return web.json_response({
+                "rolled_back": sorted(r["table_id"] for r in rolled),
+                "tables": sorted(rolled, key=lambda r: r["table_id"]),
+                "unknown_table_ids": sorted(unknown)})
         finally:
             await store.close()
 
@@ -429,21 +574,169 @@ def build_app(state: ApiState) -> web.Application:
 
 
 OPENAPI_DOC = {
-    "openapi": "3.0.0",
-    "info": {"title": "etl_tpu control plane", "version": "0.1.0"},
-    "paths": {
-        "/v1/tenants": {"post": {}, "get": {}},
-        "/v1/sources": {"post": {}, "get": {}},
-        "/v1/sources/{id}": {"get": {}, "put": {}, "delete": {}},
-        "/v1/destinations": {"post": {}, "get": {}},
-        "/v1/destinations/{id}": {"get": {}, "put": {}, "delete": {}},
-        "/v1/pipelines": {"post": {}, "get": {}},
-        "/v1/pipelines/{id}": {"get": {}, "delete": {}},
-        "/v1/pipelines/{id}/start": {"post": {}},
-        "/v1/pipelines/{id}/stop": {"post": {}},
-        "/v1/pipelines/{id}/restart": {"post": {}},
-        "/v1/pipelines/{id}/status": {"get": {}},
-        "/v1/pipelines/{id}/replication-status": {"get": {}},
-        "/v1/pipelines/{id}/rollback-tables": {"post": {}},
+    "openapi": "3.0.3",
+    "info": {
+        "title": "etl_tpu control plane",
+        "version": "0.2.0",
+        "description": (
+            "Multi-tenant control plane for replication pipelines: "
+            "sources/destinations with encrypted configs, pipeline "
+            "lifecycle via the orchestrator seam, replicator images, "
+            "and repair operations."),
     },
+    "components": {
+        "securitySchemes": {
+            "bearer": {"type": "http", "scheme": "bearer"},
+            "tenant": {"type": "apiKey", "in": "header",
+                       "name": "tenant_id"},
+        },
+        "schemas": {
+            "Error": {"type": "object",
+                      "properties": {"error": {"type": "string"}}},
+            "Tenant": {"type": "object",
+                       "properties": {"id": {"type": "string"},
+                                      "name": {"type": "string"}},
+                       "required": ["id", "name"]},
+            "ConfigResource": {
+                "type": "object",
+                "properties": {"id": {"type": "integer"},
+                               "name": {"type": "string"},
+                               "config": {"type": "object"}},
+                "description": "GET responses mask secret-looking config "
+                               "values."},
+            "Image": {"type": "object",
+                      "properties": {"id": {"type": "integer"},
+                                     "name": {"type": "string"},
+                                     "default": {"type": "boolean"}}},
+            "Pipeline": {
+                "type": "object",
+                "properties": {"id": {"type": "integer"},
+                               "source_id": {"type": "integer"},
+                               "destination_id": {"type": "integer"},
+                               "publication_name": {"type": "string"},
+                               "config": {"type": "object"},
+                               "store_path": {"type": "string"}},
+                "required": ["source_id", "destination_id",
+                             "publication_name"]},
+            "PipelineStatus": {
+                "type": "object",
+                "properties": {"pipeline_id": {"type": "integer"},
+                               "state": {"type": "string",
+                                         "enum": ["stopped", "starting",
+                                                  "running", "failed"]},
+                               "detail": {"type": "string"}}},
+            "ReplicationStatus": {
+                "type": "object",
+                "properties": {
+                    "tables": {"type": "array", "items": {
+                        "type": "object",
+                        "properties": {
+                            "table_id": {"type": "integer"},
+                            "state": {"type": "string"},
+                            "lsn": {"type": "string"},
+                            "reason": {"type": "string"},
+                            "retry_policy": {"type": "string"},
+                            "retry_attempts": {"type": "integer"}}}},
+                    "slot_lag": {"type": "array", "nullable": True,
+                                 "items": {"type": "object"}}}},
+            "RollbackRequest": {
+                "type": "object",
+                "properties": {"table_ids": {
+                    "type": "array", "items": {"type": "integer"},
+                    "description": "omit to roll back every errored "
+                                   "table"}}},
+            "RollbackResponse": {
+                "type": "object",
+                "properties": {
+                    "rolled_back": {"type": "array",
+                                    "items": {"type": "integer"}},
+                    "tables": {"type": "array", "items": {"type": "object"}},
+                    "unknown_table_ids": {"type": "array",
+                                          "items": {"type": "integer"}}}},
+        },
+    },
+    "security": [{"bearer": [], "tenant": []}],
+}
+
+
+def _op(summary, *, body=None, resp=None, params=None):
+    doc = {"summary": summary, "responses": {
+        "default": {"description": "response", "content": {
+            "application/json": {"schema": resp or {"type": "object"}}}}}}
+    if body is not None:
+        doc["requestBody"] = {"content": {"application/json": {
+            "schema": body}}}
+    if params:
+        doc["parameters"] = params
+    return doc
+
+
+_ID_PARAM = [{"name": "id", "in": "path", "required": True,
+              "schema": {"type": "integer"}}]
+
+
+def _ref(name):
+    return {"$ref": f"#/components/schemas/{name}"}
+
+
+OPENAPI_DOC["paths"] = {
+    "/health": {"get": _op("liveness probe")},
+    "/metrics": {"get": _op("Prometheus metrics (text exposition)")},
+    "/v1/tenants": {
+        "post": _op("create tenant", body=_ref("Tenant"),
+                    resp=_ref("Tenant")),
+        "get": _op("list tenants")},
+    "/v1/sources": {
+        "post": _op("create source (config encrypted at rest)",
+                    body=_ref("ConfigResource")),
+        "get": _op("list this tenant's sources")},
+    "/v1/sources/{id}": {
+        "get": _op("get source (secrets masked)", params=_ID_PARAM,
+                   resp=_ref("ConfigResource")),
+        "put": _op("update source", params=_ID_PARAM),
+        "delete": _op("delete source (409 while referenced)",
+                      params=_ID_PARAM)},
+    "/v1/destinations": {
+        "post": _op("create destination (config encrypted at rest)",
+                    body=_ref("ConfigResource")),
+        "get": _op("list this tenant's destinations")},
+    "/v1/destinations/{id}": {
+        "get": _op("get destination (secrets masked)", params=_ID_PARAM,
+                   resp=_ref("ConfigResource")),
+        "put": _op("update destination", params=_ID_PARAM),
+        "delete": _op("delete destination (409 while referenced)",
+                      params=_ID_PARAM)},
+    "/v1/images": {
+        "post": _op("register replicator image", body=_ref("Image"),
+                    resp=_ref("Image")),
+        "get": _op("list replicator images")},
+    "/v1/images/{id}": {
+        "delete": _op("delete image", params=_ID_PARAM)},
+    "/v1/images/{id}/set-default": {
+        "post": _op("make this the image new pipelines deploy with",
+                    params=_ID_PARAM)},
+    "/v1/pipelines": {
+        "post": _op("create pipeline", body=_ref("Pipeline")),
+        "get": _op("list this tenant's pipelines")},
+    "/v1/pipelines/{id}": {
+        "get": _op("get pipeline", params=_ID_PARAM, resp=_ref("Pipeline")),
+        "delete": _op("stop and delete pipeline", params=_ID_PARAM)},
+    "/v1/pipelines/{id}/start": {
+        "post": _op("deploy the replicator (202: starting)",
+                    params=_ID_PARAM)},
+    "/v1/pipelines/{id}/stop": {
+        "post": _op("tear down the replicator (202: stopping)",
+                    params=_ID_PARAM)},
+    "/v1/pipelines/{id}/restart": {
+        "post": _op("stop then start", params=_ID_PARAM)},
+    "/v1/pipelines/{id}/status": {
+        "get": _op("orchestrator state", params=_ID_PARAM,
+                   resp=_ref("PipelineStatus"))},
+    "/v1/pipelines/{id}/replication-status": {
+        "get": _op("table states from the durable store + source slot lag",
+                   params=_ID_PARAM, resp=_ref("ReplicationStatus"))},
+    "/v1/pipelines/{id}/rollback-tables": {
+        "post": _op("reset errored (or listed) tables for resync",
+                    params=_ID_PARAM, body=_ref("RollbackRequest"),
+                    resp=_ref("RollbackResponse"))},
 }

@@ -33,6 +33,7 @@ class ReplicatorSpec:
     pipeline_id: int
     tenant_id: str
     config: dict  # full replicator config document (plaintext)
+    image: "str | None" = None  # container image override (images CRUD)
 
 
 @dataclass
@@ -117,7 +118,8 @@ class K8sOrchestrator(Orchestrator):
                     "template": {
                         "metadata": {"labels": {"app": name}},
                         "spec": {"containers": [{
-                            "name": "replicator", "image": self.image,
+                            "name": "replicator",
+                            "image": spec.image or self.image,
                             "args": ["--config-dir", "/etc/etl"],
                             "volumeMounts": [{"name": "config",
                                               "mountPath": "/etc/etl"}],

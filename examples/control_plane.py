@@ -18,13 +18,18 @@ from etl_tpu.api.orchestrator import LocalOrchestrator
 
 
 async def main() -> None:
+    import os
+    import secrets
+
     work = tempfile.mkdtemp(prefix="etl-api-")
+    api_key = os.environ.get("ETL_API_KEY") or secrets.token_urlsafe(24)
     state = ApiState(f"{work}/api.db", ConfigCipher(EncryptionKey.generate()),
-                     LocalOrchestrator(work))
+                     LocalOrchestrator(work), api_key=api_key)
     runner = web.AppRunner(build_app(state))
     await runner.setup()
     await web.TCPSite(runner, "127.0.0.1", 8080).start()
     print("control plane on http://127.0.0.1:8080 (see /openapi.json)")
+    print(f"Authorization: Bearer {api_key}")
     await asyncio.Event().wait()
 
 
