@@ -91,6 +91,15 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
     table_id BIGINT PRIMARY KEY,
     in_progress INTEGER NOT NULL DEFAULT 0
 );
+CREATE TABLE IF NOT EXISTS lake_maintenance_history (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    table_id BIGINT NOT NULL,
+    operation TEXT NOT NULL,        -- 'compact' | 'vacuum'
+    started_at TEXT NOT NULL,
+    finished_at TEXT,
+    files_affected BIGINT NOT NULL DEFAULT 0,
+    outcome TEXT NOT NULL DEFAULT 'running'  -- running|ok|skipped|failed
+);
 """)
         self._db.commit()
 
@@ -323,6 +332,9 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
                    "VALUES (?, 1) ON CONFLICT (table_id) DO UPDATE SET "
                    "in_progress = 1", (table_id,))
         db.commit()
+        hid = self._history_start(table_id, "vacuum")
+        outcome = "failed"
+        n = 0
         try:
             rows = db.execute(
                 "SELECT f.id, f.path FROM lake_files f JOIN lake_tables t "
@@ -332,11 +344,14 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
                 Path(path).unlink(missing_ok=True)
                 db.execute("DELETE FROM lake_files WHERE id = ?", (fid,))
             db.commit()
-            return len(rows)
+            n = len(rows)
+            outcome = "ok" if n else "skipped"
+            return n
         finally:
             db.execute("UPDATE lake_maintenance SET in_progress = 0 WHERE "
                        "table_id = ?", (table_id,))
             db.commit()
+            self._history_finish(hid, outcome, n)
 
     def table_ids(self) -> "list[TableId]":
         return [r[0] for r in self._catalog().execute(
@@ -346,6 +361,43 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
     # external maintenance process (flag never cleared) must surface as a
     # retryable error, not wedge the pipeline silently
     MAINTENANCE_WAIT_TIMEOUT_S = 60.0
+
+    def _history_start(self, table_id: TableId, op: str) -> int:
+        import datetime as _dt
+
+        db = self._catalog()
+        cur = db.execute(
+            "INSERT INTO lake_maintenance_history "
+            "(table_id, operation, started_at) VALUES (?, ?, ?)",
+            (table_id, op, _dt.datetime.now(_dt.timezone.utc).isoformat()))
+        db.commit()
+        return cur.lastrowid
+
+    def _history_finish(self, hid: int, outcome: str, files: int) -> None:
+        import datetime as _dt
+
+        db = self._catalog()
+        db.execute(
+            "UPDATE lake_maintenance_history SET finished_at = ?, "
+            "outcome = ?, files_affected = ? WHERE id = ?",
+            (_dt.datetime.now(_dt.timezone.utc).isoformat(), outcome,
+             files, hid))
+        db.commit()
+
+    def maintenance_history(self, table_id: "TableId | None" = None,
+                            limit: int = 50) -> list[dict]:
+        """Recent maintenance operations, newest first (reference
+        etl-maintenance operation history)."""
+        db = self._catalog()
+        where = "WHERE table_id = ?" if table_id is not None else ""
+        params = (table_id, limit) if table_id is not None else (limit,)
+        rows = db.execute(
+            f"SELECT table_id, operation, started_at, finished_at, "
+            f"files_affected, outcome FROM lake_maintenance_history "
+            f"{where} ORDER BY id DESC LIMIT ?", params).fetchall()
+        return [{"table_id": t, "operation": op, "started_at": s0,
+                 "finished_at": f, "files_affected": n, "outcome": o}
+                for t, op, s0, f, n, o in rows]
 
     async def _wait_maintenance_clear(self, table_id: TableId) -> None:
         """Writers block while external maintenance holds the table
@@ -396,6 +448,9 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
                    "VALUES (?, 1) ON CONFLICT (table_id) DO UPDATE SET "
                    "in_progress = 1", (table_id,))
         db.commit()
+        hid = self._history_start(table_id, "compact")
+        n_files = 0
+        outcome = "skipped"
         try:
             db.execute("BEGIN IMMEDIATE")
             row = db.execute(
@@ -425,8 +480,11 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
             db.commit()
             for _id, p, _k in files:
                 Path(p).unlink(missing_ok=True)
-            return len(files)
+            n_files = len(files)
+            outcome = "ok"
+            return n_files
         except BaseException:
+            outcome = "failed"
             try:
                 db.execute("ROLLBACK")
             except sqlite3.OperationalError:
@@ -436,3 +494,4 @@ CREATE TABLE IF NOT EXISTS lake_maintenance (
             db.execute("UPDATE lake_maintenance SET in_progress = 0 WHERE "
                        "table_id = ?", (table_id,))
             db.commit()
+            self._history_finish(hid, outcome, n_files)

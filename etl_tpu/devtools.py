@@ -1,0 +1,200 @@
+"""Dev automation: `python -m etl_tpu.devtools <command>`.
+
+The xtask analogue (reference crates/xtask: docker Postgres clusters,
+chaos injection, pg-fill-table, benchmark orchestration) for an
+environment with no docker/k8s: the cluster is the socket-level fake
+server, and chaos is driven through its connection-severing hooks.
+
+Commands:
+  serve-source   start a fake PG server with N generated rows (the
+                 pg-fill-table + `cargo x postgres start` analogue);
+                 prints the port and streams CDC traffic if requested
+  chaos          run a pipeline over real TCP against the fake server
+                 while repeatedly severing every replication stream
+                 (NetworkChaos partition analogue), then verify exactly-
+                 once delivery to the destination
+  fuzz           seeded parser fuzzing (etl_tpu.testing.fuzz)
+  bench-compare  diff two benchmark JSON reports (etl_tpu.benchmarks)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def _make_filled_db(n_rows: int, n_tables: int = 1):
+    from .models import ColumnSchema, Oid, TableName, TableSchema
+    from .postgres.fake import FakeDatabase
+
+    db = FakeDatabase()
+    tids = []
+    for t in range(n_tables):
+        tid = 20000 + t
+        db.create_table(TableSchema(
+            tid, TableName("public", f"filled_{t}"),
+            (ColumnSchema("id", Oid.INT8, nullable=False,
+                          primary_key_ordinal=1),
+             ColumnSchema("bucket", Oid.INT4),
+             ColumnSchema("payload", Oid.TEXT))),
+            rows=[[str(i + 1), str(i % 97), f"payload-{t}-{i}" + "x" * 40]
+                  for i in range(n_rows)])
+        tids.append(tid)
+    db.create_publication("pub", tids)
+    return db, tids
+
+
+async def serve_source(args) -> int:
+    from .testing.fake_pg_server import FakePgServer
+
+    db, tids = _make_filled_db(args.rows, args.tables)
+    server = FakePgServer(db)
+    await server.start()
+    print(json.dumps({"port": server.port, "publication": "pub",
+                      "tables": tids, "rows_per_table": args.rows}))
+    if args.cdc_rate > 0:
+        i = args.rows
+        while True:
+            tx = db.transaction()
+            for _ in range(min(args.cdc_rate, 500)):
+                i += 1
+                tx.insert(tids[i % len(tids)],
+                          [str(i + 1), str(i % 97), f"cdc-{i}"])
+            await tx.commit()
+            await asyncio.sleep(1.0)
+    await asyncio.Event().wait()
+    return 0
+
+
+async def chaos(args) -> int:
+    """Partition chaos: sever every live replication stream every
+    `--interval` seconds while CDC flows; at the end, assert the
+    destination saw every row exactly once (at-least-once + idempotent
+    delivery must collapse to exactly-once in the memory destination's
+    event log given slot/progress resume)."""
+    from .config import BatchConfig, BatchEngine, PgConnectionConfig, PipelineConfig
+    from .destinations import MemoryDestination
+    from .models import InsertEvent
+    from .postgres.client import PgReplicationClient
+    from .runtime import Pipeline, TableStateType
+    from .store import NotifyingStore
+    from .testing.fake_pg_server import FakePgServer
+
+    db, tids = _make_filled_db(args.rows)
+    tid = tids[0]
+    server = FakePgServer(db)
+    await server.start()
+    cfg = PgConnectionConfig(host="127.0.0.1", port=server.port,
+                             name="postgres", username="etl")
+    store = NotifyingStore()
+    dest = MemoryDestination()
+    pipeline = Pipeline(
+        config=PipelineConfig(
+            pipeline_id=1, publication_name="pub", pg_connection=cfg,
+            batch=BatchConfig(max_fill_ms=40,
+                              batch_engine=BatchEngine(args.engine)),
+            apply_retry=__import__(
+                "etl_tpu.config", fromlist=["RetryConfig"]).RetryConfig(
+                max_attempts=100, initial_delay_ms=50, max_delay_ms=200)),
+        store=store, destination=dest,
+        source_factory=lambda: PgReplicationClient(cfg))
+    await pipeline.start()
+    await asyncio.wait_for(store.notify_on(tid, TableStateType.READY), 60)
+
+    n_cdc = 0
+    severs = 0
+    deadline = asyncio.get_event_loop().time() + args.seconds
+    while asyncio.get_event_loop().time() < deadline:
+        tx = db.transaction()
+        for _ in range(50):
+            n_cdc += 1
+            tx.insert(tid, [str(10**6 + n_cdc), "0", f"chaos-{n_cdc}"])
+        await tx.commit()
+        await asyncio.sleep(args.interval / 2)
+        await db.sever_streams()  # the NetworkChaos partition
+        severs += 1
+        await asyncio.sleep(args.interval / 2)
+
+    def delivered():
+        return {e.row.values[0] for e in dest.events
+                if isinstance(e, InsertEvent)}
+
+    expected = {10**6 + i for i in range(1, n_cdc + 1)}
+    for _ in range(600):
+        if delivered() >= expected:
+            break
+        await asyncio.sleep(0.1)
+    got = delivered()
+    missing = expected - got
+    await pipeline.shutdown_and_wait()
+    await server.stop()
+    dup_count = sum(
+        1 for e in dest.events if isinstance(e, InsertEvent)) - len(got)
+    report = {"severs": severs, "cdc_rows": n_cdc,
+              "delivered": len(got & expected), "missing": sorted(missing),
+              "duplicate_events": dup_count,
+              "copied_rows": len(dest.table_rows[tid])}
+    print(json.dumps(report))
+    if missing or report["copied_rows"] != args.rows:
+        print("CHAOS FAILED", file=sys.stderr)
+        return 1
+    print("chaos OK: no loss across stream partitions", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="etl_tpu.devtools")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("serve-source",
+                        help="fake PG server with generated data")
+    sp.add_argument("--rows", type=int, default=10_000)
+    sp.add_argument("--tables", type=int, default=1)
+    sp.add_argument("--cdc-rate", type=int, default=0,
+                    help="rows/second of continuous CDC traffic")
+
+    cp = sub.add_parser("chaos", help="stream-partition chaos scenario")
+    cp.add_argument("--rows", type=int, default=2_000)
+    cp.add_argument("--seconds", type=float, default=10.0)
+    cp.add_argument("--interval", type=float, default=1.0)
+    cp.add_argument("--engine", default="tpu", choices=["tpu", "cpu"])
+
+    fp = sub.add_parser("fuzz", help="seeded parser fuzzing")
+    fp.add_argument("--target", default=None)
+    fp.add_argument("--seconds", type=float, default=10.0)
+    fp.add_argument("--seed", type=int, default=None)
+
+    bp = sub.add_parser("bench-compare", help="diff two bench reports")
+    bp.add_argument("a")
+    bp.add_argument("b")
+    bp.add_argument("--fail-pct", type=float, default=None)
+
+    args = p.parse_args(argv)
+    if args.cmd == "serve-source":
+        return asyncio.run(serve_source(args))
+    if args.cmd == "chaos":
+        return asyncio.run(chaos(args))
+    if args.cmd == "fuzz":
+        from .testing.fuzz import main as fuzz_main
+
+        fuzz_args = []
+        if args.target:
+            fuzz_args += ["--target", args.target]
+        fuzz_args += ["--seconds", str(args.seconds)]
+        if args.seed is not None:
+            fuzz_args += ["--seed", str(args.seed)]
+        return fuzz_main(fuzz_args)
+    if args.cmd == "bench-compare":
+        from .benchmarks.compare import main as cmp_main
+
+        cmp_args = [args.a, args.b]
+        if args.fail_pct is not None:
+            cmp_args += ["--fail-pct", str(args.fail_pct)]
+        return cmp_main(cmp_args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,13 @@ from .destinations.lake import LakeConfig, LakeDestination
 async def run_maintenance(warehouse: str, *, vacuum: bool,
                           api_url: str | None, pipeline_id: int | None,
                           tenant_id: str | None,
-                          stop_timeout_s: float = 120.0) -> dict:
+                          stop_timeout_s: float = 120.0,
+                          min_cdc_files: int = 2) -> dict:
+    """Operation policy (reference etl-maintenance operation policies): a
+    table is compacted only when its current generation holds at least
+    `min_cdc_files` CDC files — churning small tables is pure write
+    amplification. Every operation lands in the catalog's
+    lake_maintenance_history for the --history surface."""
     paused = False
     session = None
     if api_url and pipeline_id is not None:
@@ -65,13 +71,22 @@ async def run_maintenance(warehouse: str, *, vacuum: bool,
         table_ids = lake.table_ids()
         compacted = 0
         vacuumed = 0
+        skipped_by_policy = 0
         for tid in table_ids:
-            compacted += await lake.compact(tid)
+            row = lake._table_row(tid)
+            n_cdc = lake._cdc_file_count(tid, row[2]) if row else 0
+            if n_cdc >= min_cdc_files:
+                compacted += await lake.compact(tid)
+            else:
+                skipped_by_policy += 1
             if vacuum:
                 vacuumed += await lake.vacuum(tid)
+        history = lake.maintenance_history(limit=20)
         await lake.shutdown()
         return {"tables": len(table_ids), "compacted_files": compacted,
-                "vacuumed_files": vacuumed, "paused_pipeline": paused}
+                "vacuumed_files": vacuumed,
+                "skipped_by_policy": skipped_by_policy,
+                "paused_pipeline": paused, "history": history}
     finally:
         if session is not None:
             try:
@@ -106,11 +121,27 @@ def main(argv=None) -> int:
                         "around maintenance")
     p.add_argument("--pipeline-id", type=int, default=None)
     p.add_argument("--tenant-id", default=None)
+    p.add_argument("--min-cdc-files", type=int, default=2,
+                   help="compact a table only when it has >= this many "
+                        "CDC files (operation policy)")
+    p.add_argument("--history", action="store_true",
+                   help="print maintenance history and exit (no ops)")
     args = p.parse_args(argv)
+    if args.history:
+        async def show() -> dict:
+            lake = LakeDestination(LakeConfig(args.warehouse))
+            await lake.startup()
+            h = lake.maintenance_history(limit=100)
+            await lake.shutdown()
+            return {"history": h}
+
+        print(json.dumps(asyncio.run(show())))
+        return 0
     try:
         out = asyncio.run(run_maintenance(
             args.warehouse, vacuum=args.vacuum, api_url=args.api_url,
-            pipeline_id=args.pipeline_id, tenant_id=args.tenant_id))
+            pipeline_id=args.pipeline_id, tenant_id=args.tenant_id,
+            min_cdc_files=args.min_cdc_files))
     except Exception as e:
         print(json.dumps({"error": f"{type(e).__name__}: {e}"}),
               file=sys.stderr)

@@ -96,19 +96,26 @@ def parse_bytea(text: str) -> bytes:
             return bytes.fromhex(text[2:])
         except ValueError as e:
             raise _invalid("bytea", text, e)
-    # legacy escape format
+    # legacy escape format: printable bytes verbatim, \\ for backslash,
+    # \nnn octal (digits 0-7, value ≤ 255) — anything else is corrupt
     out = bytearray()
     i, n = 0, len(text)
     while i < n:
         c = text[i]
         if c != "\\":
+            if ord(c) > 255:
+                raise _invalid("bytea", text)
             out.append(ord(c))
             i += 1
         elif i + 1 < n and text[i + 1] == "\\":
             out.append(0x5C)
             i += 2
-        elif i + 3 < n and text[i + 1 : i + 4].isdigit():
-            out.append(int(text[i + 1 : i + 4], 8))
+        elif i + 3 < n and all(d in "01234567"
+                               for d in text[i + 1 : i + 4]):
+            v = int(text[i + 1 : i + 4], 8)
+            if v > 255:
+                raise _invalid("bytea", text)
+            out.append(v)
             i += 4
         else:
             raise _invalid("bytea", text)
@@ -141,7 +148,7 @@ def parse_date(text: str) -> "dt.date | PgSpecialDate":
             year = 1 - year
             return PgSpecialDate(days_from_civil(year, month, day), text)
         return dt.date(year, month, day)
-    except (ValueError, AttributeError) as e:
+    except (ValueError, AttributeError, OverflowError) as e:
         raise _invalid("date", text, e)
 
 
@@ -162,7 +169,7 @@ def parse_time(text: str) -> dt.time:
             # Postgres allows 24:00:00; clamp to max representable
             return dt.time(23, 59, 59, 999999)
         return dt.time(h, m, s, us)
-    except ValueError as e:
+    except (ValueError, OverflowError) as e:
         raise _invalid("time", text, e)
 
 
@@ -211,7 +218,7 @@ def parse_timestamp(text: str) -> "dt.datetime | PgSpecialTimestamp":
                 + tm.microsecond
             return PgSpecialTimestamp(d.days * 86_400_000_000 + tod, text)
         return dt.datetime.combine(d, tm)
-    except (ValueError, EtlError) as e:
+    except (ValueError, OverflowError, EtlError) as e:
         if isinstance(e, EtlError) and "date" not in str(e) and "time" not in str(e):
             raise
         raise _invalid("timestamp", text, e)

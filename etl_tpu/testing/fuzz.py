@@ -1,0 +1,243 @@
+"""Fuzz targets for the codec parsers + native framer.
+
+Reference parity: cargo-fuzz targets `parse_copy_row`, `parse_text_cell`,
+`numeric_text_roundtrip`, `parse_bytea_hex_string`
+(fuzz/fuzz_targets/ + src/fuzzing.rs). No coverage-guided fuzzer exists in
+this environment, so this is a seeded random byte fuzzer with structured
+mutations (truncate/splice/bitflip over valid corpora), a wall-clock
+budget, and crash seeds printed for replay — the same contract the
+reference's fuzz entry points enforce:
+
+  THE PARSERS MUST NEVER CRASH UNCONTROLLED. Any input either parses or
+  raises a typed EtlError; the native framer must flag malformed frames
+  (bad_from) or raise EtlError, never segfault or throw bare exceptions.
+
+Run ad hoc:  python -m etl_tpu.testing.fuzz --seconds 30 [--seed N]
+CI-sized runs live in tests/test_fuzz.py.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from ..models.errors import EtlError
+from ..models.pgtypes import Oid
+
+# every OID the text parser dispatches on — fuzz coverage must include
+# each branch
+_OIDS = [Oid.BOOL, Oid.INT2, Oid.INT4, Oid.INT8, Oid.FLOAT4, Oid.FLOAT8,
+         Oid.NUMERIC, Oid.TEXT, Oid.VARCHAR, Oid.BPCHAR, Oid.DATE, Oid.TIME,
+         Oid.TIMETZ, Oid.TIMESTAMP, Oid.TIMESTAMPTZ, Oid.UUID, Oid.JSON,
+         Oid.JSONB, Oid.BYTEA, Oid.INTERVAL]
+
+_SEED_TEXTS = [
+    "0", "-1", "12345678901234567890123456789", "+5", "-", "--", "1e309",
+    "1.5", "-0.0", "NaN", "Infinity", "-Infinity", "nan", "1e", "e1", ".",
+    "2024-02-29", "0001-01-01", "9999-12-31", "0044-03-15 BC", "infinity",
+    "-infinity", "24:00:00", "23:59:60", "12:00:00.1234567",
+    "2024-05-01 12:34:56.789+02", "2024-05-01 12:34:56-15:59:59",
+    "a0eebc99-9c0b-4ef8-bb6d-6bb9bd380a11", "{}", "[1,2]", "null",
+    '{"k": "v"}', "\\xdeadbeef", "\\x", "\\xg", "1 year 2 mons",
+    "t", "f", "true", "", " ", "\t", "\\N", "\\", "{1,2,3}", "{NULL}",
+    '{"a","b"}', "0.000000000000000012345", "9" * 40,
+]
+
+_MUT_CHARS = "0123456789-+.:eE aftTxX{}\\\"',N\x00\x7fé"
+
+
+def _mutate(rng: random.Random, s: str) -> str:
+    ops = rng.randint(1, 3)
+    out = s
+    for _ in range(ops):
+        c = rng.random()
+        if c < 0.25 and out:
+            i = rng.randrange(len(out))
+            out = out[:i] + rng.choice(_MUT_CHARS) + out[i + 1:]
+        elif c < 0.5:
+            i = rng.randrange(len(out) + 1)
+            out = out[:i] + rng.choice(_MUT_CHARS) + out[i:]
+        elif c < 0.7 and out:
+            i = rng.randrange(len(out))
+            out = out[:i] + out[i + 1:]
+        elif c < 0.85 and out:
+            i, j = sorted((rng.randrange(len(out) + 1),
+                           rng.randrange(len(out) + 1)))
+            other = rng.choice(_SEED_TEXTS)
+            out = out[:i] + other + out[j:]
+        else:
+            out = out * rng.randint(1, 3)
+    return out[:4096]
+
+
+class FuzzFailure(AssertionError):
+    def __init__(self, target: str, seed: int, case: int, detail: str):
+        super().__init__(
+            f"fuzz target {target} failed at seed={seed} case={case}: "
+            f"{detail}\nreplay: python -m etl_tpu.testing.fuzz "
+            f"--target {target} --seed {seed}")
+
+
+def fuzz_parse_text_cell(rng: random.Random, _ignored=None) -> None:
+    from ..postgres.codec.text import parse_cell_text
+
+    text = _mutate(rng, rng.choice(_SEED_TEXTS))
+    oid = rng.choice(_OIDS)
+    try:
+        parse_cell_text(text, oid)
+    except EtlError:
+        pass  # typed rejection is the contract
+
+
+def fuzz_parse_copy_row(rng: random.Random, _ignored=None) -> None:
+    from ..postgres.codec.copy_text import parse_copy_row
+
+    n_cols = rng.randint(1, 6)
+    oids = [rng.choice(_OIDS) for _ in range(n_cols)]
+    fields = [_mutate(rng, rng.choice(_SEED_TEXTS))
+              for _ in range(rng.randint(0, n_cols + 1))]
+    line = "\t".join(fields).encode("utf-8", "surrogatepass")[:2048]
+    try:
+        parse_copy_row(line, oids)
+    except (EtlError, UnicodeDecodeError):
+        pass
+
+
+def fuzz_numeric_roundtrip(rng: random.Random, _ignored=None) -> None:
+    """Valid numeric text must survive parse → pg_text exactly (the
+    reference numeric_text_roundtrip target); arbitrary text must parse or
+    fail typed."""
+    from ..models.cell import PgNumeric
+    from ..postgres.codec.text import parse_cell_text
+
+    digits = rng.randint(1, 35)
+    scale = rng.randint(0, digits)
+    n = rng.randint(0, 10**digits - 1)
+    s = str(n).rjust(scale + 1, "0")
+    text = (("-" if rng.random() < 0.5 else "")
+            + (s[:-scale] + "." + s[-scale:] if scale else s))
+    v = parse_cell_text(text, Oid.NUMERIC)
+    assert isinstance(v, PgNumeric)
+    assert v.pg_text() == text, (v.pg_text(), text)
+    # and the mutated form must never crash untyped
+    try:
+        parse_cell_text(_mutate(rng, text), Oid.NUMERIC)
+    except EtlError:
+        pass
+
+
+def fuzz_bytea_hex(rng: random.Random, _ignored=None) -> None:
+    from ..postgres.codec.text import parse_cell_text
+
+    body = "".join(rng.choice("0123456789abcdefABCDEFxg \\")
+                   for _ in range(rng.randint(0, 64)))
+    for text in (f"\\x{body}", body):
+        try:
+            parse_cell_text(text, Oid.BYTEA)
+        except EtlError:
+            pass
+
+
+def fuzz_framer(rng: random.Random, _ignored=None) -> None:
+    """Random bytes through the native pgoutput framer: it must return a
+    FramedBatch with bad_from set, or raise EtlError — never crash the
+    process or return out-of-bounds offsets."""
+    import numpy as np
+
+    from ..native import frame_pgoutput
+    from ..postgres.codec import pgoutput
+
+    msgs = []
+    for _ in range(rng.randint(1, 8)):
+        c = rng.random()
+        if c < 0.4:  # valid insert, possibly corrupted below
+            msgs.append(pgoutput.encode_insert(
+                rng.randrange(1, 1 << 31),
+                [str(rng.randrange(1000)).encode()
+                 for _ in range(rng.randint(0, 4))]))
+        elif c < 0.6:
+            msgs.append(pgoutput.encode_begin(rng.randrange(1 << 40),
+                                              rng.randrange(1 << 50), 7))
+        else:
+            msgs.append(bytes(rng.randrange(256)
+                              for _ in range(rng.randint(0, 64))))
+    if msgs and rng.random() < 0.5:  # corrupt one
+        i = rng.randrange(len(msgs))
+        b = bytearray(msgs[i])
+        if b:
+            b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+        msgs[i] = bytes(b)
+    buf = b"".join(msgs)
+    lens = np.array([len(m) for m in msgs], dtype=np.int32)
+    offs = np.zeros(len(msgs), dtype=np.int64)
+    np.cumsum(lens[:-1], out=offs[1:])
+    n_cols = rng.randint(1, 8)
+    try:
+        framed, bad = frame_pgoutput(buf, offs, lens, n_cols)
+    except EtlError:
+        return
+    upto = framed.n_msgs if bad < 0 else bad
+    # offsets/lengths within bounds for every framed field
+    total = len(buf)
+    for arr_off, arr_len in ((framed.new_off[:upto], framed.new_len[:upto]),
+                             (framed.old_off[:upto], framed.old_len[:upto])):
+        ends = arr_off.astype(np.int64) + arr_len
+        assert (arr_off >= 0).all() and (ends <= total).all(), \
+            "framer emitted out-of-bounds field"
+
+
+TARGETS = {
+    "parse_text_cell": fuzz_parse_text_cell,
+    "parse_copy_row": fuzz_parse_copy_row,
+    "numeric_roundtrip": fuzz_numeric_roundtrip,
+    "bytea_hex": fuzz_bytea_hex,
+    "framer": fuzz_framer,
+}
+
+
+def run_target(name: str, *, seconds: float = 2.0, seed: int | None = None,
+               min_cases: int = 200) -> int:
+    """Run one target under a wall-clock budget; returns cases executed.
+    Raises FuzzFailure with the replay seed on any contract violation."""
+    fn = TARGETS[name]
+    base_seed = seed if seed is not None else random.randrange(1 << 30)
+    deadline = time.monotonic() + seconds
+    case = 0
+    while case < min_cases or time.monotonic() < deadline:
+        case_seed = base_seed + case
+        rng = random.Random(case_seed)
+        try:
+            fn(rng)
+        except AssertionError as e:
+            raise FuzzFailure(name, base_seed, case, str(e))
+        except EtlError:
+            pass
+        except Exception as e:  # untyped escape = contract violation
+            raise FuzzFailure(name, base_seed, case,
+                              f"untyped {type(e).__name__}: {e}")
+        case += 1
+        if case >= min_cases and time.monotonic() >= deadline:
+            break
+    return case
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="etl_tpu.testing.fuzz")
+    p.add_argument("--target", choices=sorted(TARGETS), default=None)
+    p.add_argument("--seconds", type=float, default=10.0)
+    p.add_argument("--seed", type=int, default=None)
+    args = p.parse_args(argv)
+    names = [args.target] if args.target else sorted(TARGETS)
+    for name in names:
+        n = run_target(name, seconds=args.seconds / len(names),
+                       seed=args.seed)
+        print(f"{name}: {n} cases OK")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

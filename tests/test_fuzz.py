@@ -1,0 +1,13 @@
+"""CI-sized fuzz runs over the codec parsers and native framer
+(reference cargo-fuzz targets, SURVEY §4.5). Failures print a replay
+seed."""
+
+import pytest
+
+from etl_tpu.testing.fuzz import TARGETS, run_target
+
+
+@pytest.mark.parametrize("target", sorted(TARGETS))
+def test_fuzz_target(target):
+    n = run_target(target, seconds=1.5, min_cases=300)
+    assert n >= 300

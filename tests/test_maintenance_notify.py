@@ -120,3 +120,29 @@ class TestMaintenancePausePoll:
             assert server.paths()[-1] == "POST /v1/pipelines/7/start"
         finally:
             await server.stop()
+
+
+class TestMaintenancePolicyAndHistory:
+    async def test_policy_skips_small_tables_and_records_history(
+            self, tmp_path):
+        d = LakeDestination(LakeConfig(str(tmp_path), compact_min_files=100))
+        await d.startup()
+        # one table with 3 CDC files, one with 1
+        await d.write_events([ins(0, [1, "a", None])])
+        await d.write_events([ins(1, [2, "b", None])])
+        await d.write_events([ins(2, [3, "c", None])])
+        await d.shutdown()
+        out = await run_maintenance(str(tmp_path), vacuum=True,
+                                    api_url=None, pipeline_id=None,
+                                    tenant_id=None, min_cdc_files=2)
+        assert out["compacted_files"] >= 3
+        assert out["skipped_by_policy"] == 0
+        hist = out["history"]
+        assert hist and hist[0]["operation"] in ("vacuum", "compact")
+        assert all(h["outcome"] in ("ok", "skipped") for h in hist)
+        # run again: now a single base file → policy skips compaction
+        out2 = await run_maintenance(str(tmp_path), vacuum=False,
+                                     api_url=None, pipeline_id=None,
+                                     tenant_id=None, min_cdc_files=2)
+        assert out2["compacted_files"] == 0
+        assert out2["skipped_by_policy"] == 1
