@@ -328,11 +328,11 @@ CREATE TABLE IF NOT EXISTS lake_maintenance_history (
                           "table_id = ?", (table_id,)).fetchone()
         if busy and busy[0]:
             return 0
+        hid = self._history_start(table_id, "vacuum")
         db.execute("INSERT INTO lake_maintenance (table_id, in_progress) "
                    "VALUES (?, 1) ON CONFLICT (table_id) DO UPDATE SET "
                    "in_progress = 1", (table_id,))
         db.commit()
-        hid = self._history_start(table_id, "vacuum")
         outcome = "failed"
         n = 0
         try:
@@ -383,6 +383,20 @@ CREATE TABLE IF NOT EXISTS lake_maintenance_history (
             (_dt.datetime.now(_dt.timezone.utc).isoformat(), outcome,
              files, hid))
         db.commit()
+
+    def current_cdc_file_count(self, table_id: TableId) -> int:
+        """CDC files in the table's CURRENT generation — the compaction
+        policy input (stable public surface; callers must not index
+        catalog rows)."""
+        row = self._table_row(table_id)
+        if row is None:
+            return 0
+        return self._cdc_file_count(table_id, row[2])
+
+    def record_maintenance_skip(self, table_id: TableId, op: str) -> None:
+        """Audit row for a policy decision that never invoked the op."""
+        self._history_finish(self._history_start(table_id, op),
+                             "skipped", 0)
 
     def maintenance_history(self, table_id: "TableId | None" = None,
                             limit: int = 50) -> list[dict]:
@@ -444,11 +458,11 @@ CREATE TABLE IF NOT EXISTS lake_maintenance_history (
                           "table_id = ?", (table_id,)).fetchone()
         if busy and busy[0]:
             return 0
+        hid = self._history_start(table_id, "compact")
         db.execute("INSERT INTO lake_maintenance (table_id, in_progress) "
                    "VALUES (?, 1) ON CONFLICT (table_id) DO UPDATE SET "
                    "in_progress = 1", (table_id,))
         db.commit()
-        hid = self._history_start(table_id, "compact")
         n_files = 0
         outcome = "skipped"
         try:

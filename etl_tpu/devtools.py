@@ -57,12 +57,15 @@ async def serve_source(args) -> int:
     if args.cdc_rate > 0:
         i = args.rows
         while True:
-            tx = db.transaction()
-            for _ in range(min(args.cdc_rate, 500)):
-                i += 1
-                tx.insert(tids[i % len(tids)],
-                          [str(i + 1), str(i % 97), f"cdc-{i}"])
-            await tx.commit()
+            remaining = args.cdc_rate  # full requested rows/second
+            while remaining > 0:
+                tx = db.transaction()
+                for _ in range(min(remaining, 500)):
+                    i += 1
+                    tx.insert(tids[i % len(tids)],
+                              [str(i + 1), str(i % 97), f"cdc-{i}"])
+                remaining -= 500
+                await tx.commit()
             await asyncio.sleep(1.0)
     await asyncio.Event().wait()
     return 0
@@ -74,7 +77,8 @@ async def chaos(args) -> int:
     destination saw every row exactly once (at-least-once + idempotent
     delivery must collapse to exactly-once in the memory destination's
     event log given slot/progress resume)."""
-    from .config import BatchConfig, BatchEngine, PgConnectionConfig, PipelineConfig
+    from .config import (BatchConfig, BatchEngine, PgConnectionConfig,
+                         PipelineConfig, RetryConfig)
     from .destinations import MemoryDestination
     from .models import InsertEvent
     from .postgres.client import PgReplicationClient
@@ -95,9 +99,8 @@ async def chaos(args) -> int:
             pipeline_id=1, publication_name="pub", pg_connection=cfg,
             batch=BatchConfig(max_fill_ms=40,
                               batch_engine=BatchEngine(args.engine)),
-            apply_retry=__import__(
-                "etl_tpu.config", fromlist=["RetryConfig"]).RetryConfig(
-                max_attempts=100, initial_delay_ms=50, max_delay_ms=200)),
+            apply_retry=RetryConfig(max_attempts=100, initial_delay_ms=50,
+                                    max_delay_ms=200)),
         store=store, destination=dest,
         source_factory=lambda: PgReplicationClient(cfg))
     await pipeline.start()
@@ -137,7 +140,7 @@ async def chaos(args) -> int:
               "duplicate_events": dup_count,
               "copied_rows": len(dest.table_rows[tid])}
     print(json.dumps(report))
-    if missing or report["copied_rows"] != args.rows:
+    if missing or dup_count > 0 or report["copied_rows"] != args.rows:
         print("CHAOS FAILED", file=sys.stderr)
         return 1
     print("chaos OK: no loss across stream partitions", file=sys.stderr)

@@ -73,12 +73,11 @@ async def run_maintenance(warehouse: str, *, vacuum: bool,
         vacuumed = 0
         skipped_by_policy = 0
         for tid in table_ids:
-            row = lake._table_row(tid)
-            n_cdc = lake._cdc_file_count(tid, row[2]) if row else 0
-            if n_cdc >= min_cdc_files:
+            if lake.current_cdc_file_count(tid) >= min_cdc_files:
                 compacted += await lake.compact(tid)
             else:
                 skipped_by_policy += 1
+                lake.record_maintenance_skip(tid, "compact")
             if vacuum:
                 vacuumed += await lake.vacuum(tid)
         history = lake.maintenance_history(limit=20)
