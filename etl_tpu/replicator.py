@@ -7,7 +7,7 @@ reference exposes through etl-telemetry.
 
 Extra config keys consumed here (beyond PipelineConfig):
   destination: {type: memory|clickhouse|bigquery|lake|iceberg|snowflake, …}
-  store:       {type: memory|sqlite, path: …}
+  store:       {type: memory|sqlite|postgres, path: …, connection: …}
   metrics_port: 0 disables the endpoint
 """
 
@@ -28,7 +28,7 @@ from .models.errors import EtlError
 from .postgres.client import PgReplicationClient
 from .runtime.pipeline import Pipeline
 from .store.memory import MemoryStore
-from .store.sql import SqliteStore
+from .store.sql import PostgresStore, SqliteStore
 from .telemetry.metrics import registry
 from .telemetry.tracing import init_tracing
 
@@ -80,8 +80,20 @@ async def run_replicator(config_dir: str,
                 config.pipeline_id, config.publication_name,
                 config.batch.batch_engine.value)
 
-    if store_doc.get("type") == "sqlite":
+    store_type = store_doc.get("type", "memory")
+    if store_type == "sqlite":
         store = SqliteStore(store_doc["path"], config.pipeline_id)
+        await store.connect()
+    elif store_type == "postgres":
+        # durable state lives in a Postgres `etl` schema over the same
+        # wire stack as replication (reference store/both/postgres.rs);
+        # defaults to the SOURCE connection, overridable per-field
+        store_conn = config.pg_connection
+        if store_doc.get("connection"):
+            from .config.pipeline import PgConnectionConfig
+
+            store_conn = PgConnectionConfig(**store_doc["connection"])
+        store = PostgresStore(store_conn, config.pipeline_id)
         await store.connect()
     else:
         store = MemoryStore()
