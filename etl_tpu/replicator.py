@@ -56,6 +56,33 @@ async def serve_metrics(port: int) -> web.AppRunner | None:
     return runner
 
 
+def store_connection_from_doc(base, overrides_doc):
+    """store.connection overrides merge ONTO the source connection
+    (per-field); secrets/tls convert through the loader; unknown keys are
+    typed CONFIG_INVALID errors."""
+    if not overrides_doc:
+        return base
+    import dataclasses
+
+    from .config.load import Secret, _build
+    from .config.pipeline import PgConnectionConfig, TlsConfig
+    from .models.errors import ErrorKind, EtlError
+
+    overrides = dict(overrides_doc)
+    known = {f.name for f in dataclasses.fields(PgConnectionConfig)}
+    unknown = set(overrides) - known
+    if unknown:
+        raise EtlError(ErrorKind.CONFIG_INVALID,
+                       f"store.connection: unknown keys {sorted(unknown)}")
+    if overrides.get("password") is not None:
+        overrides["password"] = Secret(overrides["password"])
+    if "tls" in overrides:
+        overrides["tls"] = _build(TlsConfig, overrides["tls"])
+    merged = dataclasses.replace(base, **overrides)
+    merged.validate()
+    return merged
+
+
 async def run_replicator(config_dir: str,
                          environment: Environment | None = None) -> None:
     doc = load_config_dict(config_dir, environment)
@@ -88,11 +115,8 @@ async def run_replicator(config_dir: str,
         # durable state lives in a Postgres `etl` schema over the same
         # wire stack as replication (reference store/both/postgres.rs);
         # defaults to the SOURCE connection, overridable per-field
-        store_conn = config.pg_connection
-        if store_doc.get("connection"):
-            from .config.pipeline import PgConnectionConfig
-
-            store_conn = PgConnectionConfig(**store_doc["connection"])
+        store_conn = store_connection_from_doc(
+            config.pg_connection, store_doc.get("connection"))
         store = PostgresStore(store_conn, config.pipeline_id)
         await store.connect()
     else:

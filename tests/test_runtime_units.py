@@ -150,3 +150,33 @@ class TestMemoryStoreContracts:
         removed = await store.prune_schema_versions(5, 25)
         assert removed == 1
         assert await store.get_schema_versions(5) == [20]
+
+
+class TestReplicatorStoreConfig:
+    def test_postgres_store_connection_overrides_merge(self):
+        """store.connection overrides merge ONTO the source connection
+        (per-field), convert secrets/tls through the loader, and reject
+        unknown keys — review r2 findings on the raw-constructor path."""
+        import asyncio
+        import dataclasses
+
+        from etl_tpu.config.load import Secret
+        from etl_tpu.config.pipeline import PgConnectionConfig
+
+        from etl_tpu.replicator import store_connection_from_doc as merge
+
+        base = PgConnectionConfig(host="src-db", port=6000, name="app",
+                                  username="etl", password=Secret("pw"))
+        merged = merge(base, {"name": "etl_state"})
+        assert merged.host == "src-db" and merged.port == 6000
+        assert merged.name == "etl_state"
+        assert merged.password == "pw"  # inherited, still wrapped
+        merged2 = merge(base, {"password": "other",
+                               "tls": {"enabled": True}})
+        assert isinstance(merged2.password, Secret)
+        assert merged2.tls.enabled is True  # typed, not a dict
+
+        from etl_tpu.models.errors import EtlError
+        import pytest as _pytest
+        with _pytest.raises(EtlError):
+            merge(base, {"host": "x", "bogus_key": 1})
