@@ -463,3 +463,80 @@ class TestBitpackTransport:
             a = layout_for_specs(((0, kind, 64, sat),))
             b = layout_for_specs(((0, kind, 64, sat),))
             assert a == b and a.n_words >= 1
+
+
+class TestWalOldTuplesAtScale:
+    def test_large_batch_old_tuple_mapping(self):
+        """Device-scale WAL batch with mixed I/U/D and old/key tuples:
+        stage_wal_batch must map old tuples to row positions and mark
+        delete kinds exactly; the decoded old batch must match the CPU
+        oracle (VERDICT r1 item 2 at the device path, not just e2e)."""
+        import numpy as np
+
+        from etl_tpu.ops import DeviceDecoder
+        from etl_tpu.ops.wal import concat_payloads, stage_wal_batch
+        from etl_tpu.postgres.codec import pgoutput
+
+        schema = make_schema([Oid.INT4, Oid.TEXT])
+        payloads = []
+        kinds = []  # (change, has_old, old_is_key) per row
+        r = random.Random(5)
+        for i in range(9000):
+            c = r.random()
+            if c < 0.5:
+                payloads.append(pgoutput.encode_insert(
+                    1, [str(i).encode(), f"v{i}".encode()]))
+                kinds.append(("I", False, False))
+            elif c < 0.7:  # update with key tuple (PK change)
+                payloads.append(pgoutput.encode_update(
+                    1, [str(i).encode(), f"n{i}".encode()],
+                    key_values=[str(i - 1).encode(), None]))
+                kinds.append(("U", True, True))
+            elif c < 0.8:  # update with full old tuple
+                payloads.append(pgoutput.encode_update(
+                    1, [str(i).encode(), f"n{i}".encode()],
+                    old_values=[str(i - 1).encode(), f"o{i}".encode()]))
+                kinds.append(("U", True, False))
+            elif c < 0.9:  # plain update
+                payloads.append(pgoutput.encode_update(
+                    1, [str(i).encode(), f"n{i}".encode()]))
+                kinds.append(("U", False, False))
+            else:  # delete, alternating K/O
+                full = i % 2 == 0
+                payloads.append(pgoutput.encode_delete(
+                    1, [str(i).encode(), f"d{i}".encode() if full else None],
+                    full_old=full))
+                kinds.append(("D", False, full))
+        buf, offs, lens = concat_payloads(payloads)
+        wal = stage_wal_batch(buf, offs, lens, 2)
+        assert wal.bad_from < 0
+        n = len(kinds)
+        assert wal.staged.n_rows == n
+
+        # delete_is_key marks exactly the 'K' deletes
+        expect_dk = np.array([k == "D" and not key_or_full
+                              for k, _, key_or_full in kinds])
+        np.testing.assert_array_equal(wal.delete_is_key, expect_dk)
+
+        # old_rows maps exactly the updates that carried a tuple
+        expect_old = [i for i, (k, has_old, _) in enumerate(kinds)
+                      if k == "U" and has_old]
+        np.testing.assert_array_equal(wal.old_rows, expect_old)
+        expect_is_key = np.array(
+            [kinds[i][2] for i in expect_old])
+        np.testing.assert_array_equal(wal.old_is_key, expect_is_key)
+
+        # decode BOTH batches on the device path; values line up by row
+        dec = DeviceDecoder(schema, device_min_rows=0)
+        main = dec.decode(wal.staged)
+        old = dec.decode(wal.old_staged)
+        for j, i in enumerate(expect_old):
+            assert old.columns[0].data[j] == i - 1
+            if not wal.old_is_key[j]:
+                assert old.columns[1].value(j) == f"o{i}"
+            else:
+                assert not old.columns[1].validity[j]
+        # main batch: deletes carry the old/key tuple as the row
+        for i, (k, _, full) in enumerate(kinds):
+            if k == "D":
+                assert main.columns[0].data[i] == i
