@@ -477,8 +477,11 @@ class DeviceDecoder:
 
     def _complete(self, staged: StagedBatch, specs: tuple,
                   packed, bad_rows=None) -> ColumnarBatch:
+        import time as _time
+
         from .bitpack import layout_for_specs, unpack_host
 
+        _t0 = _time.perf_counter()
         n = staged.n_rows
         cols = self.schema.replicated_columns
         valid_full = ~staged.nulls & ~staged.toast
@@ -533,10 +536,22 @@ class DeviceDecoder:
                 toast_col if toast_col.any() else None,
                 lazy_text_oid=lazy_oid)
 
+        from ..telemetry.metrics import (
+            ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL,
+            ETL_DEVICE_DECODE_ROWS_TOTAL, ETL_DEVICE_DECODE_SECONDS,
+            registry)
+
+        registry.counter_inc(ETL_DEVICE_DECODE_ROWS_TOTAL, n)
         if fallback:
             rows_arr = np.asarray(sorted(r for r in fallback if r < n),
                                   dtype=np.int64)
             self._cpu_fixup(staged, rows_arr, columns)
+            registry.counter_inc(ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL,
+                                 len(rows_arr))
+        # completion time (fetch wait + unpack + combines + object cols);
+        # dispatch/transfer overlap is deliberately excluded
+        registry.histogram_observe(ETL_DEVICE_DECODE_SECONDS,
+                                   _time.perf_counter() - _t0)
         return ColumnarBatch(self.schema, columns)
 
     # -- public -------------------------------------------------------------

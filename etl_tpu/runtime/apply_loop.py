@@ -47,7 +47,9 @@ from ..telemetry.egress import record_egress
 from ..telemetry.metrics import (ETL_APPLY_LOOP_BATCHES_TOTAL,
                                  ETL_APPLY_LOOP_EVENTS_TOTAL,
                                  ETL_APPLY_LOOP_FLUSH_LAG_BYTES,
-                                 ETL_APPLY_LOOP_RECEIVED_LAG_BYTES, registry)
+                                 ETL_APPLY_LOOP_RECEIVED_LAG_BYTES,
+                                 ETL_TRANSACTION_SIZE_BYTES,
+                                 ETL_TRANSACTIONS_TOTAL, registry)
 from . import failpoints
 from .assembler import EventAssembler
 from .shutdown import ShutdownSignal
@@ -116,6 +118,7 @@ class _LoopState:
     server_end_lsn: Lsn = Lsn.ZERO  # latest end-of-WAL the server reported
     batch_commit_end: Lsn | None = None  # last commit boundary inside batch
     last_status_flush_lsn: Lsn = Lsn.ZERO  # flush LSN last reported upstream
+    tx_bytes: int = 0  # payload bytes since the current BEGIN
 
 
 class ApplyLoop:
@@ -331,6 +334,7 @@ class ApplyLoop:
             self.assembler.push_raw_row(payload, schema, start_lsn,
                                         st.current_commit_lsn, st.tx_ordinal)
             st.tx_ordinal += 1
+            st.tx_bytes += len(payload)
             if self.assembler.size_bytes and self._batch_deadline is None:
                 self._batch_deadline = time.monotonic() \
                     + self.config.batch.max_fill_ms / 1000
@@ -339,12 +343,18 @@ class ApplyLoop:
         if isinstance(msg, pgoutput.BeginMessage):
             st.current_commit_lsn = msg.final_lsn
             st.tx_ordinal = 0
+            st.tx_bytes = 0
             self.assembler.push_control(event_codec.decode_begin(msg, start_lsn))
         elif isinstance(msg, pgoutput.CommitMessage):
             ev = event_codec.decode_commit(msg, start_lsn)
             self.assembler.push_control(ev)
             st.last_commit_end_lsn = ev.end_lsn
             st.batch_commit_end = ev.end_lsn
+            registry.counter_inc(ETL_TRANSACTIONS_TOTAL)
+            # owned-row payload bytes only (tx_bytes definition) — control
+            # messages don't count toward transaction size
+            registry.histogram_observe(ETL_TRANSACTION_SIZE_BYTES,
+                                       st.tx_bytes)
             # a commit closes the unit destinations can make durable;
             # size check happens in _maybe_dispatch_flush
         elif isinstance(msg, pgoutput.RelationMessage):
@@ -367,6 +377,7 @@ class ApplyLoop:
                 msg, payload, schema, start_lsn, st.current_commit_lsn,
                 st.tx_ordinal)
             st.tx_ordinal += 1
+            st.tx_bytes += len(payload)
         elif isinstance(msg, pgoutput.TruncateMessage):
             schemas = []
             for rid in msg.relation_ids:

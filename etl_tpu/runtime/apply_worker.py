@@ -162,6 +162,10 @@ class ApplyWorker:
         missing; invalidation policy (worker.rs:366-527)."""
         slot = await source.get_slot(self.slot_name)
         if slot is not None and slot.invalidated:
+            from ..telemetry.metrics import (ETL_SLOT_INVALIDATIONS_TOTAL,
+                                             registry)
+
+            registry.counter_inc(ETL_SLOT_INVALIDATIONS_TOTAL)
             behavior = self.config.invalidated_slot_behavior
             if behavior is InvalidatedSlotBehavior.ERROR:
                 raise EtlError(

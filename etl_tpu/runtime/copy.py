@@ -27,7 +27,9 @@ from ..postgres.codec.copy_text import parse_copy_row
 from ..postgres.source import ReplicationSource
 from ..destinations.base import Destination, WriteAck
 from ..telemetry.egress import record_egress
-from ..telemetry.metrics import ETL_TABLE_COPY_ROWS_TOTAL, registry
+from ..telemetry.metrics import (ETL_TABLE_COPY_BYTES_TOTAL,
+                                 ETL_TABLE_COPY_DURATION_SECONDS,
+                                 ETL_TABLE_COPY_ROWS_TOTAL, registry)
 from . import failpoints
 from .shutdown import ShutdownRequested, ShutdownSignal, or_shutdown
 
@@ -114,6 +116,7 @@ async def _copy_partition(source: ReplicationSource,
             return
         failpoints.fail_point(failpoints.DURING_COPY)
         progress.bytes_written += len(chunk)
+        registry.counter_inc(ETL_TABLE_COPY_BYTES_TOTAL, len(chunk))
         if decoder is not None:
             staged = stage_copy_chunk(chunk, len(oids))
             in_flight.append(decoder.decode_async(staged))
@@ -208,6 +211,9 @@ async def parallel_table_copy(*, source_factory, primary_source,
             if not use_primary:
                 await src.close()
 
+    import time as _time
+
+    _t0 = _time.perf_counter()
     tasks = [asyncio.ensure_future(worker(i == 0)) for i in range(n_conns)]
     results = await asyncio.gather(*tasks, return_exceptions=True)
     errors = [r for r in results if isinstance(r, BaseException)]
@@ -218,4 +224,8 @@ async def parallel_table_copy(*, source_factory, primary_source,
         first = errors[0]
         raise first if isinstance(first, EtlError) else EtlError(
             ErrorKind.SOURCE_IO, f"copy failed: {first!r}")
+    # completed copies only: failed/aborted attempts would skew the
+    # duration distribution low
+    registry.histogram_observe(ETL_TABLE_COPY_DURATION_SECONDS,
+                               _time.perf_counter() - _t0)
     return progress

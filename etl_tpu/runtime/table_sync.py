@@ -89,17 +89,31 @@ class TableSyncWorkerPool:
         self._syncing = {
             tid for tid, st in self._states_cache.items()
             if st.type is not TableStateType.READY and not st.is_errored}
+        self._update_table_gauges()
 
     def _cache_state(self, tid: TableId, st: TableState | None) -> None:
         if st is None:
             self._states_cache.pop(tid, None)
             self._syncing.discard(tid)
-            return
-        self._states_cache[tid] = st
-        if st.type is TableStateType.READY or st.is_errored:
-            self._syncing.discard(tid)
         else:
-            self._syncing.add(tid)
+            self._states_cache[tid] = st
+            if st.type is TableStateType.READY or st.is_errored:
+                self._syncing.discard(tid)
+            else:
+                self._syncing.add(tid)
+        self._update_table_gauges()
+
+    def _update_table_gauges(self) -> None:
+        from ..telemetry.metrics import (ETL_TABLES_ERRORED,
+                                         ETL_TABLES_READY, ETL_TABLES_TOTAL,
+                                         registry)
+
+        states = self._states_cache
+        registry.gauge_set(ETL_TABLES_TOTAL, len(states))
+        registry.gauge_set(ETL_TABLES_READY, sum(
+            1 for s in states.values() if s.type is TableStateType.READY))
+        registry.gauge_set(ETL_TABLES_ERRORED, sum(
+            1 for s in states.values() if s.is_errored))
 
     def table_state(self, tid: TableId) -> TableState | None:
         return self._merged_state(tid)
@@ -347,6 +361,16 @@ class TableSyncWorker:
             destination_table_name=str(schema.name)))
         # 5. copy, then record FinishedCopy
         await self._copy_table(source, schema, created.snapshot_id)
+        try:
+            from ..telemetry.metrics import (
+                ETL_TABLE_COPY_END_TO_END_LAG_BYTES, registry)
+
+            wal_now = await source.get_current_wal_lsn()
+            registry.gauge_set(
+                ETL_TABLE_COPY_END_TO_END_LAG_BYTES,
+                max(0, int(wal_now) - int(created.consistent_point)))
+        except EtlError:
+            pass  # lag reporting must never fail a copy
         await store.update_table_state(self.tid, TableState.finished_copy())
         failpoints.fail_point(failpoints.AFTER_FINISHED_COPY)
         return created.consistent_point, schema

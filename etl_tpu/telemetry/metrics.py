@@ -51,6 +51,13 @@ LABEL_DESTINATION = "destination"
 _HISTOGRAM_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
                       30.0, 60.0)
 
+# byte-scale series use byte-scale buckets (the default set is seconds)
+_BYTE_BUCKETS = (1 << 10, 16 << 10, 64 << 10, 256 << 10, 1 << 20, 4 << 20,
+                 16 << 20, 64 << 20, 256 << 20, 1 << 30)
+_BUCKETS_BY_NAME = {
+    "etl_transaction_size_bytes": _BYTE_BUCKETS,
+}
+
 LabelSet = tuple[tuple[str, str], ...]
 
 
@@ -60,10 +67,14 @@ def _labels(labels: dict[str, str] | None) -> LabelSet:
 
 @dataclass
 class _Histogram:
-    buckets: list[int] = field(
-        default_factory=lambda: [0] * (len(_HISTOGRAM_BUCKETS) + 1))
+    bounds: tuple = _HISTOGRAM_BUCKETS
+    buckets: list[int] = None  # type: ignore[assignment]
     total: float = 0.0
     count: int = 0
+
+    def __post_init__(self):
+        if self.buckets is None:
+            self.buckets = [0] * (len(self.bounds) + 1)
 
 
 class MetricsRegistry:
@@ -90,10 +101,12 @@ class MetricsRegistry:
                           labels: dict[str, str] | None = None) -> None:
         key = _labels(labels)
         with self._lock:
-            h = self._histograms[name].setdefault(key, _Histogram())
+            h = self._histograms[name].setdefault(
+                key, _Histogram(bounds=_BUCKETS_BY_NAME.get(
+                    name, _HISTOGRAM_BUCKETS)))
             h.total += value
             h.count += 1
-            for i, b in enumerate(_HISTOGRAM_BUCKETS):
+            for i, b in enumerate(h.bounds):
                 if value <= b:
                     h.buckets[i] += 1
                     return
@@ -130,7 +143,7 @@ class MetricsRegistry:
                 out.append(f"# TYPE {name} histogram")
                 for key, h in sorted(self._histograms[name].items()):
                     cum = 0
-                    for i, b in enumerate(_HISTOGRAM_BUCKETS):
+                    for i, b in enumerate(h.bounds):
                         cum += h.buckets[i]
                         out.append(
                             f"{name}_bucket"
