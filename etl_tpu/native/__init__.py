@@ -226,7 +226,7 @@ def _frame_py(data: np.ndarray, msg_off: np.ndarray, msg_len: np.ndarray,
 def pack_bmat(data, offsets, lengths, col_idx, widths, bmat, lens_out) -> bool:
     """C fast path for the device byte-matrix pack; False if unavailable."""
     lib = _load()
-    if lib is None or len(col_idx) > 64:
+    if lib is None or len(col_idx) > 256:
         return False
     p = _ptr
     R, C = offsets.shape
@@ -255,7 +255,7 @@ def pack_bmat_nibble(data, offsets, lengths, col_idx, widths, bmat,
                      lens_out, bad_rows) -> bool:
     """C nibble pack (two symbols/byte); False if unavailable."""
     lib = _load()
-    if lib is None or len(col_idx) > 64:
+    if lib is None or len(col_idx) > 256:
         return False
     p = _ptr
     R, C = offsets.shape

@@ -180,9 +180,9 @@ void etl_pack_bmat(const uint8_t *data, int64_t data_len,
                    const int32_t *widths, int32_t n_dense, uint8_t *bmat,
                    int32_t total_w, uint8_t *lens_out) {
     /* per-column output offsets */
-    int32_t w_off[64];
+    int32_t w_off[256];
     int32_t acc = 0;
-    for (int32_t j = 0; j < n_dense && j < 64; j++) {
+    for (int32_t j = 0; j < n_dense && j < 256; j++) {
         w_off[j] = acc;
         acc += widths[j];
     }
@@ -256,9 +256,9 @@ void etl_pack_bmat_nibble(const uint8_t *data, int64_t data_len,
         code_of[':'] = 13; code_of[' '] = 14;
         init = 1;
     }
-    int32_t w_off[64];
+    int32_t w_off[256];
     int32_t acc = 0;
-    for (int32_t j = 0; j < n_dense && j < 64; j++) {
+    for (int32_t j = 0; j < n_dense && j < 256; j++) {
         w_off[j] = acc;
         acc += widths[j] / 2;
     }

@@ -242,12 +242,14 @@ class DeviceDecoder:
                 self._dense.append(_ColSpec(i, kind))
             else:
                 self._object.append(_ColSpec(i, kind))
-        if len(self._dense) > 62:
-            # 62 device columns covers the C packer's 64-column bound;
-            # wider tables spill the tail to the host-object path
-            for spec in self._dense[62:]:
+        if len(self._dense) > 250:
+            # the C packer handles 256 columns; beyond 250 dense device
+            # columns the tail spills to the host-object path (the byte
+            # matrix for such tables is bounded by the batch size budget,
+            # not the column count)
+            for spec in self._dense[250:]:
                 self._object.append(spec)
-            self._dense = self._dense[:62]
+            self._dense = self._dense[:250]
         self._fn_cache: dict[tuple, Callable] = {}
 
     # -- internals ----------------------------------------------------------

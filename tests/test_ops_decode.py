@@ -540,3 +540,30 @@ class TestWalOldTuplesAtScale:
         for i, (k, _, full) in enumerate(kinds):
             if k == "D":
                 assert main.columns[0].data[i] == i
+
+
+class TestVeryWideTables:
+    def test_100_dense_columns_stay_on_device(self):
+        """Wide tables: all 100 int columns decode as DEVICE columns (the
+        previous 62-column cap spilled the tail to per-row host objects)."""
+        oids = [Oid.INT8 if i % 2 else Oid.INT4 for i in range(100)]
+        schema = make_schema(oids)
+        dec = DeviceDecoder(schema, device_min_rows=0)
+        assert len(dec._dense) == 100, "wide dense columns spilled"
+        rows = [[str((i * 97 + c) % 10**6) for c in range(100)]
+                for i in range(300)]
+        dev, cpu = decode_both(oids, rows)
+        assert_batches_equal(dev, cpu)
+
+    def test_260_dense_columns_spill_tail_only(self):
+        oids = [Oid.INT4] * 260
+        schema = make_schema(oids)
+        dec = DeviceDecoder(schema)
+        assert len(dec._dense) == 250
+        assert len(dec._object) == 10
+        # small batch routes to the oracle (no 260-col program compile);
+        # spilled columns must still come back correct
+        staged = stage_tuples(tuples_from_texts(
+            [[str(i + c) for c in range(260)] for i in range(5)]), 260)
+        batch = dec.decode(staged)
+        assert batch.columns[259].value(2) == 261
