@@ -35,6 +35,18 @@ def _now_us() -> int:
     return int(time.time() * 1_000_000)
 
 
+class _ToastUnchanged:
+    """Sentinel for an UNCHANGED TOASTED column in FakeTransaction.update:
+    the walsender omits such values ('u' tuple kind) when the old image
+    isn't being sent — the storage keeps the real value."""
+
+    def __repr__(self) -> str:
+        return "FAKE_TOAST_UNCHANGED_VALUE"
+
+
+TOAST_UNCHANGED_VALUE = _ToastUnchanged()
+
+
 @dataclass
 class FakeTable:
     schema: TableSchema
@@ -272,8 +284,17 @@ class FakeTransaction:
                 t = db.tables[tid]
                 kcols = self._key_columns(t)
                 old_row = self._find_row(t, key)
-                enc = lambda vs: [None if v is None else v.encode()
-                                  for v in vs]
+
+                def enc(vs):
+                    return [None if v is None
+                            or isinstance(v, _ToastUnchanged)
+                            else v.encode() for v in vs]
+
+                def kinds_of(vs):
+                    return [pgoutput.TUPLE_UNCHANGED_TOAST
+                            if isinstance(v, _ToastUnchanged)
+                            else pgoutput.TUPLE_NULL if v is None
+                            else pgoutput.TUPLE_TEXT for v in vs]
                 # PG semantics: identity-full sends the full old row ('O');
                 # default identity sends a key-only tuple ('K') ONLY when
                 # an identity column changed; otherwise no old tuple
@@ -285,9 +306,16 @@ class FakeTransaction:
                     key_values = enc([old_row[i] if i in kcols else None
                                       for i in range(len(old_row))])
                 target = db.wal_relid(tid)
+                # row filters evaluate against REAL tuple values (the
+                # walsender resolves TOAST from storage before filtering)
+                resolved = [old_row[i]
+                            if isinstance(v, _ToastUnchanged)
+                            and old_row is not None else v
+                            for i, v in enumerate(values)]
                 body_entries.append((pgoutput.encode_update(
                     target, enc(values), old_values=old_values,
-                    key_values=key_values), target, list(values)))
+                    key_values=key_values,
+                    new_kinds=kinds_of(values)), target, resolved))
                 self._apply_update(t, key, values)
             elif kind == "D":
                 _, tid, _, key = op
@@ -357,7 +385,10 @@ class FakeTransaction:
         kcols = self._key_columns(t)
         for row in t.rows:
             if all(row[i] == key[i] for i in kcols):
-                row[:] = list(values)
+                # unchanged-TOAST cells keep their stored value, exactly
+                # like Postgres storage
+                row[:] = [row[i] if isinstance(v, _ToastUnchanged) else v
+                          for i, v in enumerate(values)]
                 return
 
     def _apply_delete(self, t: FakeTable, key) -> None:
