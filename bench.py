@@ -120,6 +120,36 @@ def bench_tpu(payloads, schema, n_rows):
     return n_rows / sorted(times)[len(times) // 2]
 
 
+def _probe_devices(mode: str, timeout_s: float = 300.0):
+    """Initialize the backend with a watchdog: a dead accelerator tunnel
+    hangs jax.devices() indefinitely — fail loud and fast (single JSON
+    diagnostic on stdout, the bench output contract) instead."""
+    import threading
+
+    result: list = []
+    failure: list = []
+
+    def init():
+        try:
+            import jax
+
+            result.append(jax.devices())
+        except BaseException as e:  # report the real root cause
+            failure.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=init, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not result:
+        detail = failure[0] if failure else (
+            f"did not initialize within {timeout_s:.0f}s "
+            f"(accelerator tunnel down?)")
+        print(json.dumps({"mode": mode,
+                          "error": f"device backend unavailable: {detail}"}))
+        sys.exit(3)
+    return result[0]
+
+
 def main():
     import argparse
 
@@ -131,6 +161,8 @@ def main():
                                  "wide_row"])
     parser.add_argument("--engine", default="tpu", choices=["tpu", "cpu"])
     args = parser.parse_args()
+    if args.mode == "decode" or args.engine == "tpu":
+        _probe_devices(args.mode)  # cpu-engine runs need no device
     if args.mode != "decode":
         import asyncio
 

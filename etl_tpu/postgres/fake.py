@@ -308,10 +308,10 @@ class FakeTransaction:
                 target = db.wal_relid(tid)
                 # row filters evaluate against REAL tuple values (the
                 # walsender resolves TOAST from storage before filtering)
-                resolved = [old_row[i]
-                            if isinstance(v, _ToastUnchanged)
-                            and old_row is not None else v
-                            for i, v in enumerate(values)]
+                resolved = [
+                    (old_row[i] if old_row is not None else None)
+                    if isinstance(v, _ToastUnchanged) else v
+                    for i, v in enumerate(values)]
                 body_entries.append((pgoutput.encode_update(
                     target, enc(values), old_values=old_values,
                     key_values=key_values,
