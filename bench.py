@@ -161,8 +161,10 @@ def main():
                                  "wide_row"])
     parser.add_argument("--engine", default="tpu", choices=["tpu", "cpu"])
     args = parser.parse_args()
-    if args.mode == "decode" or args.engine == "tpu":
-        _probe_devices(args.mode)  # cpu-engine runs need no device
+    # decode and wide_row always run the device engine; pipeline modes
+    # only need a device when the batch engine is tpu
+    if args.mode in ("decode", "wide_row") or args.engine == "tpu":
+        _probe_devices(args.mode)
     if args.mode != "decode":
         import asyncio
 
