@@ -255,10 +255,10 @@ class PgWireConnection:
 
     # -- simple query --------------------------------------------------------
 
-    async def query(self, sql: str) -> QueryResult:
-        """Simple-query protocol; returns text-format rows."""
-        self._send(b"Q", sql.encode() + b"\x00")
-        await self._flush()
+    async def _read_query_response(self) -> QueryResult:
+        """Collect RowDescription/DataRows/CommandComplete until
+        ReadyForQuery; a captured ErrorResponse raises at the sync point
+        (shared by the simple and extended query paths)."""
         desc: RowDescription | None = None
         rows: list[list[str | None]] = []
         tag = ""
@@ -279,7 +279,40 @@ class PgWireConnection:
                 if error is not None:
                     raise error
                 return QueryResult(desc, rows, tag)
-            # N (notice), S (parameter) ignored
+            # N (notice), S (parameter), 1/2/n/s acks: ignored
+
+    async def query(self, sql: str) -> QueryResult:
+        """Simple-query protocol; returns text-format rows."""
+        self._send(b"Q", sql.encode() + b"\x00")
+        await self._flush()
+        return await self._read_query_response()
+
+    # -- extended query ------------------------------------------------------
+
+    async def query_params(self, sql: str,
+                           params: "tuple | list" = ()) -> QueryResult:
+        """Extended-protocol query with SERVER-side parameter binding
+        ($1..$n placeholders): unnamed Parse → Bind (text-format params)
+        → Describe → Execute → Sync. Removes any client-side quoting from
+        the security/correctness path."""
+        body = _cstr("") + _cstr(sql) + struct.pack(">h", 0)
+        self._send(b"P", body)
+        bind = bytearray(_cstr("") + _cstr(""))
+        bind += struct.pack(">h", 0)  # all params text-format
+        bind += struct.pack(">h", len(params))
+        for v in params:
+            if v is None:
+                bind += struct.pack(">i", -1)
+            else:
+                b = str(v).encode()
+                bind += struct.pack(">i", len(b)) + b
+        bind += struct.pack(">h", 0)  # all results text-format
+        self._send(b"B", bytes(bind))
+        self._send(b"D", b"P" + _cstr(""))
+        self._send(b"E", _cstr("") + struct.pack(">i", 0))
+        self._send(b"S", b"")
+        await self._flush()
+        return await self._read_query_response()
 
     async def copy_out(self, sql: str) -> AsyncIterator[bytes]:
         """COPY ... TO STDOUT: yields raw CopyData payloads."""
@@ -354,6 +387,10 @@ class PgWireConnection:
                 pass
             self._writer = None
             self._reader = None
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
 
 
 def _parse_error_fields(payload: bytes) -> dict[str, str]:

@@ -19,9 +19,9 @@ Dialects share ONE statement set (`_SqlStoreBase`), so the Postgres path
 cannot drift from the sqlite path:
   - `SqliteStore`: file-backed, `?` placeholders, synchronous sqlite3;
   - `PostgresStore`: executes the same statements over the from-scratch
-    wire client (`postgres/wire.py`) via the simple-query protocol with
-    client-side literal binding — no driver dependency, same connection
-    stack the replication client uses.
+    wire client (`postgres/wire.py`) via the EXTENDED protocol
+    (Parse/Bind/Execute, server-side parameter binding) — no driver
+    dependency, same connection stack the replication client uses.
 """
 
 from __future__ import annotations
@@ -333,22 +333,30 @@ class SqliteStore(_SqlStoreBase):
             self._db = None
 
 
-def _pg_literal(v) -> str:
-    """Client-side literal binding for the simple-query protocol. Values
-    in the store schema are ints, keys, state/schema JSON text, or NULL;
-    strings quote by doubling '' (standard_conforming_strings, the PG
-    default since 9.1, keeps backslashes literal)."""
-    if v is None:
-        return "NULL"
-    if isinstance(v, bool):
-        return "TRUE" if v else "FALSE"
-    if isinstance(v, int):
-        return str(v)
-    s = str(v)
-    if "\x00" in s:
+import functools
+
+
+@functools.lru_cache(maxsize=256)
+def to_dollar_params(sql: str, n_params: int) -> str:
+    """Rewrite `?` placeholders (outside quoted segments) to `$1..$n` for
+    the extended protocol. Cached: the statement set is a small fixed
+    collection and the rewrite depends only on (sql, n_params)."""
+    out = []
+    n = 0
+    in_str = False
+    for ch in sql:
+        if ch == "'":
+            in_str = not in_str
+            out.append(ch)
+        elif ch == "?" and not in_str:
+            n += 1
+            out.append(f"${n}")
+        else:
+            out.append(ch)
+    if n != n_params:
         raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
-                       "NUL byte in store value")
-    return "'" + s.replace("'", "''") + "'"
+                       f"{n} placeholders for {n_params} params: {sql[:80]}")
+    return "".join(out)
 
 
 def bind_literals(sql: str, params: tuple) -> str:
@@ -411,7 +419,25 @@ class PostgresStore(_SqlStoreBase):
         if self._conn is None:
             raise EtlError(ErrorKind.STATE_STORE_FAILED,
                            "store not connected")
-        result = await self._conn.query(bind_literals(sql, params))
+        if not params:
+            result = await self._conn.query(sql)
+        else:
+            # extended protocol: SERVER-side binding — no client-side
+            # quoting on the correctness/security path
+            texts = []
+            for v in params:
+                if v is None:
+                    texts.append(None)
+                    continue
+                t = str(v)
+                if "\x00" in t:
+                    # real PG rejects NUL in text; fail typed and early
+                    # (the sqlite-backed fake would silently accept it)
+                    raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
+                                   "NUL byte in store value")
+                texts.append(t)
+            result = await self._conn.query_params(
+                to_dollar_params(sql, len(params)), texts)
         return [tuple(r) for r in result.rows]
 
     async def _run(self, sql: str, params: tuple = ()) -> list[tuple]:

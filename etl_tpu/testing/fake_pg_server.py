@@ -80,6 +80,9 @@ class _Session:
     replication: bool = False
     user: str = ""
     snapshot_id: str | None = None  # pinned via SET TRANSACTION SNAPSHOT
+    # extended-protocol state (unnamed statement/portal only)
+    ext_sql: str | None = None
+    ext_params: "list[str | None]" = None  # type: ignore[assignment]
 
 
 class FakePgServer:
@@ -130,6 +133,8 @@ class FakePgServer:
                 if tag == b"Q":
                     sql = payload.rstrip(b"\x00").decode()
                     await self._dispatch(sess, sql)
+                elif tag in (b"P", b"B", b"D", b"E", b"H", b"S"):
+                    await self._extended(sess, tag, payload)
                 # CopyData outside CopyBoth: ignore
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 BrokenPipeError):
@@ -213,6 +218,60 @@ class FakePgServer:
         final = f"v={base64.b64encode(verifier).decode()}"
         w.write(_msg(b"R", struct.pack(">i", 12) + final.encode()))
         return True
+
+    # -- extended protocol (unnamed statement/portal) ---------------------------
+
+    async def _extended(self, sess: _Session, tag: bytes,
+                        payload: bytes) -> None:
+        """Parse/Bind/Describe/Execute/Sync: the server binds parameters
+        SERVER-side; execution happens at Sync by substituting quoted
+        literals into the parsed statement and reusing the simple-query
+        dispatch (the real server plans instead — same observable
+        behavior for the statement shapes the framework issues)."""
+        w = sess.writer
+        if tag == b"P":
+            zero = payload.index(b"\x00")
+            rest = payload[zero + 1:]
+            sess.ext_sql = rest[: rest.index(b"\x00")].decode()
+            sess.ext_params = []
+        elif tag == b"B":
+            pos = payload.index(b"\x00") + 1  # portal name
+            pos = payload.index(b"\x00", pos) + 1  # statement name
+            (n_fmt,) = struct.unpack_from(">h", payload, pos)
+            pos += 2 + 2 * n_fmt
+            (n_params,) = struct.unpack_from(">h", payload, pos)
+            pos += 2
+            params: list[str | None] = []
+            for _ in range(n_params):
+                (ln,) = struct.unpack_from(">i", payload, pos)
+                pos += 4
+                if ln < 0:
+                    params.append(None)
+                else:
+                    params.append(payload[pos : pos + ln].decode())
+                    pos += ln
+            sess.ext_params = params
+        elif tag == b"S":
+            if sess.ext_sql is None:
+                w.write(READY)
+                await w.drain()
+                return
+            params = sess.ext_params or []
+
+            def lit(m: re.Match) -> str:
+                v = params[int(m.group(1)) - 1]
+                return "NULL" if v is None \
+                    else "'" + v.replace("'", "''") + "'"
+
+            # ONE pass over the original statement: bound values containing
+            # "$n" text must never be re-substituted
+            sql = re.sub(r"\$(\d+)", lit, sess.ext_sql)
+            w.write(_msg(b"1"))  # ParseComplete
+            w.write(_msg(b"2"))  # BindComplete
+            sess.ext_sql = None
+            sess.ext_params = None
+            await self._dispatch(sess, sql)  # rows + tag + ReadyForQuery
+        # D (describe) / E (execute) / H (flush): folded into Sync
 
     # -- SQL dispatch ------------------------------------------------------------
 

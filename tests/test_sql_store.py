@@ -14,7 +14,7 @@ from etl_tpu.models import (ColumnSchema, Lsn, Oid, ReplicatedTableSchema,
 from etl_tpu.models.errors import EtlError
 from etl_tpu.runtime.state import TableState, TableStateType
 from etl_tpu.store.base import DestinationTableMetadata
-from etl_tpu.store.sql import PostgresStore, SqliteStore, bind_literals
+from etl_tpu.store.sql import PostgresStore, SqliteStore
 
 
 def schema(tid=5):
@@ -183,19 +183,6 @@ class TestSqlStoreDialects:
             await env.cleanup()
 
 
-class TestBindLiterals:
-    def test_binding(self):
-        assert bind_literals("SELECT ? , ?", (1, None)) == \
-            "SELECT 1 , NULL"
-        assert bind_literals("a = ?", ("o'brien",)) == "a = 'o''brien'"
-        # '?' inside a quoted segment is not a placeholder
-        assert bind_literals("SELECT '?' , ?", (5,)) == "SELECT '?' , 5"
-
-    def test_unbound_raises(self):
-        with pytest.raises(EtlError):
-            bind_literals("SELECT ?", (1, 2))
-
-
 class TestPipelineWithSqliteStore:
     async def test_e2e_with_durable_store(self, tmp_path):
         """Pipeline restart with a durable store: states and progress come
@@ -243,3 +230,31 @@ class TestPipelineWithSqliteStore:
         n80 = sum(1 for e in dest.events
                   if getattr(e, "row", None) and e.row.values[0] == 80)
         assert n80 == 1
+
+
+class TestExtendedProtocol:
+    def test_dollar_conversion(self):
+        from etl_tpu.store.sql import to_dollar_params
+
+        assert to_dollar_params("a = ? AND b = ?", 2) == "a = $1 AND b = $2"
+        assert to_dollar_params("SELECT '?' , ?", 1) == "SELECT '?' , $1"
+        with pytest.raises(EtlError):
+            to_dollar_params("a = ?", 2)
+
+    async def test_hostile_params_are_data_not_sql(self, tmp_path):
+        """Server-side binding: a value full of quote/comment/statement
+        syntax round-trips verbatim on the postgres dialect."""
+        env = StoreEnv("postgres", tmp_path)
+        try:
+            s = await env.make()
+            evil = "x'; DROP TABLE etl_replication_state; --\n$1 ' OR '1'='1"
+            await s.update_table_state(5, TableState.errored(
+                evil, retry_policy=RetryKind.MANUAL, retry_attempts=1))
+            await s.close()
+            s2 = await env.make()
+            st = await s2.get_table_state(5)
+            assert st.reason == evil
+            # the table the injection tried to drop still answers
+            assert (await s2.state_history(5))[-1].reason == evil
+        finally:
+            await env.cleanup()
