@@ -21,6 +21,7 @@ from .destinations.lake import LakeConfig, LakeDestination
 async def run_maintenance(warehouse: str, *, vacuum: bool,
                           api_url: str | None, pipeline_id: int | None,
                           tenant_id: str | None,
+                          api_key: str | None = None,
                           stop_timeout_s: float = 120.0,
                           min_cdc_files: int = 2) -> dict:
     """Operation policy (reference etl-maintenance operation policies): a
@@ -33,8 +34,13 @@ async def run_maintenance(warehouse: str, *, vacuum: bool,
     if api_url and pipeline_id is not None:
         import aiohttp
 
-        session = aiohttp.ClientSession(
-            headers={"tenant_id": tenant_id or ""})
+        headers = {"tenant_id": tenant_id or ""}
+        if api_key:
+            # the control plane's bearer-auth middleware rejects
+            # unauthenticated /v1 calls with 401 — coordination against a
+            # secured API needs the key on every pause/status/resume call
+            headers["Authorization"] = f"Bearer {api_key}"
+        session = aiohttp.ClientSession(headers=headers)
     try:
         if session is not None:
             async with session.post(
@@ -120,6 +126,9 @@ def main(argv=None) -> int:
                         "around maintenance")
     p.add_argument("--pipeline-id", type=int, default=None)
     p.add_argument("--tenant-id", default=None)
+    p.add_argument("--api-key", default=None,
+                   help="bearer token for a secured control plane "
+                        "(falls back to $ETL_API_KEY)")
     p.add_argument("--min-cdc-files", type=int, default=2,
                    help="compact a table only when it has >= this many "
                         "CDC files (operation policy)")
@@ -137,9 +146,12 @@ def main(argv=None) -> int:
         print(json.dumps(asyncio.run(show())))
         return 0
     try:
+        import os
+
         out = asyncio.run(run_maintenance(
             args.warehouse, vacuum=args.vacuum, api_url=args.api_url,
             pipeline_id=args.pipeline_id, tenant_id=args.tenant_id,
+            api_key=args.api_key or os.environ.get("ETL_API_KEY"),
             min_cdc_files=args.min_cdc_files))
     except Exception as e:
         print(json.dumps({"error": f"{type(e).__name__}: {e}"}),

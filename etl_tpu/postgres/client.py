@@ -58,12 +58,16 @@ class _WireReplicationStream(ReplicationStream):
     def __init__(self, conn: PgWireConnection):
         self._conn = conn
         self._closed = False
+        self._pending_error: Exception | None = None
 
     def __aiter__(self) -> AsyncIterator[pgoutput.ReplicationFrame]:
         return self._frames()
 
     async def _frames(self):
         while not self._closed:
+            if self._pending_error is not None:
+                err, self._pending_error = self._pending_error, None
+                raise err
             payload = await self._conn.copy_both_read()
             if payload is None:
                 return
@@ -76,6 +80,9 @@ class _WireReplicationStream(ReplicationStream):
         caps CDC throughput (CPython StreamReader internals; degrades to
         the awaited path when unavailable)."""
         out: list = []
+        if self._pending_error is not None:
+            err, self._pending_error = self._pending_error, None
+            raise err
         reader = getattr(self._conn, "_reader", None)
         buf = getattr(reader, "_buffer", None)
         if buf is None or self._closed:
@@ -90,10 +97,16 @@ class _WireReplicationStream(ReplicationStream):
             if tag == b"d":
                 out.append(pgoutput.decode_replication_frame(payload))
             elif tag == b"E":
+                # do NOT raise here: frames already parsed in this pass
+                # were deleted from the reader buffer and would be lost,
+                # forcing a restart-from-durable re-delivery. Hand the
+                # caller what it has; the stored error surfaces on the
+                # next drain/iteration.
                 from .wire import PgServerError, _parse_error_fields
 
-                getattr(reader, "_maybe_resume_transport", lambda: None)()
-                raise PgServerError(_parse_error_fields(payload))
+                self._pending_error = PgServerError(
+                    _parse_error_fields(payload))
+                break
             elif tag == b"Z":
                 self._closed = True
                 break

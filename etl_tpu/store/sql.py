@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import abc
 import json
+import re
 import sqlite3
 from dataclasses import dataclass
 from pathlib import Path
@@ -336,6 +337,29 @@ class SqliteStore(_SqlStoreBase):
 import functools
 
 
+# The four store tables, flat (sqlite) spelling. The Postgres dialect
+# maps EXACTLY these into the `etl` schema; the fake server reverses the
+# same list — one source of truth, no drift.
+STORE_TABLE_NAMES = ("etl_replication_state", "etl_table_schemas",
+                     "etl_table_mappings", "etl_replication_progress")
+
+_QUALIFY_RE = re.compile(r"\b(" + "|".join(STORE_TABLE_NAMES) + r")\b")
+
+
+@functools.lru_cache(maxsize=256)
+def qualify_etl_schema(sql: str) -> str:
+    """Move the flat `etl_*` table names into the `etl` schema for the
+    Postgres dialect — the reference's postgres_store migrations create
+    `etl.replication_state` etc. in a dedicated schema, and with the
+    default store.connection (the SOURCE database) flat names would land
+    in the customer's public schema. The sqlite dialect keeps flat names
+    (sqlite has no schemas). Word-bounded and restricted to the table
+    list: index names like etl_replication_state_current (which CREATE
+    INDEX cannot schema-qualify) and unrelated etl_-prefixed identifiers
+    pass through untouched."""
+    return _QUALIFY_RE.sub(lambda m: "etl." + m.group(1)[4:], sql)
+
+
 @functools.lru_cache(maxsize=256)
 def to_dollar_params(sql: str, n_params: int) -> str:
     """Rewrite `?` placeholders (outside quoted segments) to `$1..$n` for
@@ -356,27 +380,6 @@ def to_dollar_params(sql: str, n_params: int) -> str:
     if n != n_params:
         raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
                        f"{n} placeholders for {n_params} params: {sql[:80]}")
-    return "".join(out)
-
-
-def bind_literals(sql: str, params: tuple) -> str:
-    """Substitute `?` placeholders with quoted literals, skipping quoted
-    string segments in the statement itself."""
-    out = []
-    it = iter(params)
-    in_str = False
-    for ch in sql:
-        if ch == "'":
-            in_str = not in_str
-            out.append(ch)
-        elif ch == "?" and not in_str:
-            out.append(_pg_literal(next(it)))
-        else:
-            out.append(ch)
-    rest = list(it)
-    if rest:
-        raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
-                       f"{len(rest)} unbound parameters for: {sql[:80]}")
     return "".join(out)
 
 
@@ -402,7 +405,6 @@ class PostgresStore(_SqlStoreBase):
         # interleave, and _txn's BEGIN..COMMIT must not admit foreign
         # statements — serialize everything through this lock
         self._lock = asyncio.Lock()
-        self._in_txn = False
 
     async def connect(self) -> None:
         from ..postgres.client import wire_connection_from_config
@@ -411,6 +413,22 @@ class PostgresStore(_SqlStoreBase):
             self._config,
             application_name=f"etl_tpu_store_{self.pipeline_id}")
         await self._conn.connect()
+        # the store tables live in a dedicated `etl` schema (reference
+        # migrations/postgres_store layout), never the customer's default
+        # schema — create it before the table migrations run
+        await self._conn.query("CREATE SCHEMA IF NOT EXISTS etl")
+        # one-time legacy migration: pre-r3 versions created the flat
+        # etl_* tables in the connection's default creation schema; move
+        # them (indexes follow) AND strip the etl_ prefix so they land at
+        # the exact names the qualified statements use — otherwise durable
+        # replication state would silently restart from empty. Unqualified
+        # source name: resolves via the same search_path the old CREATE
+        # TABLE used; both steps are no-ops once migrated.
+        for t in STORE_TABLE_NAMES:
+            await self._conn.query(
+                f"ALTER TABLE IF EXISTS {t} SET SCHEMA etl")
+            await self._conn.query(
+                f"ALTER TABLE IF EXISTS etl.{t} RENAME TO {t[4:]}")
         await self._migrate_and_warm(
             bigserial="BIGINT GENERATED BY DEFAULT AS IDENTITY")
 
@@ -419,6 +437,7 @@ class PostgresStore(_SqlStoreBase):
         if self._conn is None:
             raise EtlError(ErrorKind.STATE_STORE_FAILED,
                            "store not connected")
+        sql = qualify_etl_schema(sql)
         if not params:
             result = await self._conn.query(sql)
         else:
@@ -441,28 +460,26 @@ class PostgresStore(_SqlStoreBase):
         return [tuple(r) for r in result.rows]
 
     async def _run(self, sql: str, params: tuple = ()) -> list[tuple]:
-        if self._in_txn:  # already serialized by the enclosing _txn
-            return await self._run_unlocked(sql, params)
+        # ALWAYS take the lock: a concurrent caller during another task's
+        # _txn must queue behind the whole BEGIN..COMMIT, never share the
+        # wire connection mid-transaction (its statement would join the
+        # foreign transaction and vanish on rollback)
         async with self._lock:
             return await self._run_unlocked(sql, params)
 
     async def _txn(self, statements: list[tuple[str, tuple]]) -> None:
         async with self._lock:
-            self._in_txn = True
+            await self._run_unlocked("BEGIN")
             try:
-                await self._run_unlocked("BEGIN")
+                for sql, params in statements:
+                    await self._run_unlocked(sql, params)
+            except BaseException:
                 try:
-                    for sql, params in statements:
-                        await self._run_unlocked(sql, params)
-                except BaseException:
-                    try:
-                        await self._run_unlocked("ROLLBACK")
-                    except Exception:
-                        pass
-                    raise
-                await self._run_unlocked("COMMIT")
-            finally:
-                self._in_txn = False
+                    await self._run_unlocked("ROLLBACK")
+                except Exception:
+                    pass
+                raise
+            await self._run_unlocked("COMMIT")
 
     async def close(self) -> None:
         if self._conn is not None:

@@ -296,13 +296,38 @@ class FakePgServer:
         wire) against an embedded per-database sqlite — the statements are
         the store's shared dialect, so sqlite semantics match; only the
         identity-column DDL spelling differs."""
+        from ..store.sql import STORE_TABLE_NAMES
+
         w = sess.writer
+        # the Postgres dialect schema-qualifies into `etl.` (reference
+        # postgres_store layout); the embedded sqlite keeps flat names —
+        # reverse the SAME table list the store qualifies, no drift.
+        # Quote-aware: bound parameters arrive substituted as quoted
+        # literals and must NEVER be rewritten (real Postgres binds
+        # server-side and would not touch them).
+        def unqualify(s: str) -> str:
+            parts = s.split("'")
+            for i in range(0, len(parts), 2):  # even = outside quotes
+                for t in STORE_TABLE_NAMES:
+                    parts[i] = parts[i].replace(f"etl.{t[4:]}", t)
+            return "'".join(parts)
+
+        norm = unqualify(norm)
+        sql = unqualify(sql)
         first = norm.split(" ", 1)[0].upper() if norm else ""
         is_txn = first in ("BEGIN", "COMMIT", "ROLLBACK") and " " not in norm
-        store_tables = ("etl_replication_state", "etl_table_schemas",
-                        "etl_table_mappings", "etl_replication_progress")
-        if not is_txn and not any(t in norm for t in store_tables):
+        if not is_txn and not any(t in norm for t in STORE_TABLE_NAMES):
             return False
+        if first == "ALTER" and ("SET SCHEMA etl" in norm
+                                 or "RENAME TO" in norm):
+            # the store's one-time legacy migration (SET SCHEMA + RENAME).
+            # In the embedded sqlite's flat namespace the legacy and
+            # migrated spellings coincide, so both steps are no-ops that
+            # preserve seeded rows — the legacy-upgrade test pre-seeds
+            # flat tables and asserts the store still reads them.
+            w.write(_command_complete("ALTER TABLE"))
+            w.write(READY)
+            return True
         if first not in ("CREATE", "INSERT", "UPDATE", "DELETE", "SELECT",
                          "BEGIN", "COMMIT", "ROLLBACK"):
             return False
@@ -354,9 +379,12 @@ class FakePgServer:
                             [[n] for n in sorted(db.applied_migrations)])
             return True
         if norm.startswith("CREATE SCHEMA IF NOT EXISTS etl"):
-            # the source migration script: model its effect (event trigger
-            # installed) the same way FakeSource does
-            db.ddl_trigger_installed = True
+            # the source-migration SCRIPT (schema + functions + event
+            # triggers, one multi-statement query) installs the trigger;
+            # a bare CREATE SCHEMA (e.g. PostgresStore creating its own
+            # schema) must NOT set the flag
+            if "CREATE EVENT TRIGGER" in sql:
+                db.ddl_trigger_installed = True
             w.write(_command_complete("CREATE SCHEMA"))
             w.write(READY)
             return True

@@ -62,6 +62,29 @@ class TestMaintenance:
         finally:
             await server.stop()
 
+    async def test_api_key_sent_as_bearer(self, tmp_path):
+        """A secured control plane rejects unauthenticated /v1 calls with
+        401; coordination must carry the bearer token on every call
+        (ADVICE r2)."""
+        server = RecordingHttpServer()
+        await server.start()
+        server.responders.append(
+            lambda r: (200, {"state": "stopped"})
+            if r.path.endswith("/status") else None)
+        try:
+            d = LakeDestination(LakeConfig(str(tmp_path)))
+            await d.startup()
+            await d.write_table_rows(make_schema(), batch([[1, "a", None]]))
+            await d.shutdown()
+            await run_maintenance(str(tmp_path), vacuum=False,
+                                  api_url=server.url(), pipeline_id=7,
+                                  tenant_id="acme", api_key="sekrit")
+            assert server.requests
+            for req in server.requests:
+                assert req.headers.get("Authorization") == "Bearer sekrit"
+        finally:
+            await server.stop()
+
 
 class TestWebhookNotifier:
     async def test_error_posts_webhook(self):

@@ -258,3 +258,85 @@ class TestExtendedProtocol:
             assert (await s2.state_history(5))[-1].reason == evil
         finally:
             await env.cleanup()
+
+
+class TestLegacySchemaUpgrade:
+    async def test_flat_table_state_survives_upgrade(self):
+        """Pre-r3 deployments stored durable state in flat etl_* tables in
+        the default schema; connect() must migrate it into the etl schema
+        (SET SCHEMA + RENAME) rather than restart replication from empty.
+        The fake models the DDL as no-ops in its flat sqlite namespace, so
+        seeding legacy tables and reading them back through the qualified
+        statement set pins the upgrade contract end to end."""
+        import sqlite3
+
+        from etl_tpu.models.lsn import Lsn
+        from etl_tpu.postgres.fake import FakeDatabase
+        from etl_tpu.testing.fake_pg_server import FakePgServer
+
+        db = FakeDatabase()
+        legacy = sqlite3.connect(":memory:", check_same_thread=False)
+        legacy.isolation_level = None
+        legacy.executescript("""
+CREATE TABLE etl_replication_state (
+    id INTEGER PRIMARY KEY, pipeline_id BIGINT NOT NULL,
+    table_id BIGINT NOT NULL, state TEXT NOT NULL, prev BIGINT,
+    is_current INTEGER NOT NULL DEFAULT 1);
+CREATE UNIQUE INDEX etl_replication_state_current
+    ON etl_replication_state (pipeline_id, table_id) WHERE is_current = 1;
+CREATE TABLE etl_replication_progress (
+    pipeline_id BIGINT NOT NULL, progress_key TEXT NOT NULL,
+    lsn BIGINT NOT NULL, PRIMARY KEY (pipeline_id, progress_key));
+INSERT INTO etl_replication_state
+    (pipeline_id, table_id, state, prev, is_current)
+    VALUES (1, 777, '{"state": "ready"}', NULL, 1);
+INSERT INTO etl_replication_progress VALUES (1, 'apply', 4096);
+""")
+        db._store_sql_db = legacy
+        server = FakePgServer(db)
+        await server.start()
+        try:
+            s = PostgresStore(
+                PgConnectionConfig(host="127.0.0.1", port=server.port,
+                                   name="postgres", username="etl"), 1)
+            await s.connect()
+            st = await s.get_table_state(777)
+            assert st is not None and st.type.value == "ready"
+            assert await s.get_durable_progress("apply") == Lsn(4096)
+            await s.close()
+        finally:
+            await server.stop()
+
+
+class TestQualifiedNameInBoundValue:
+    async def test_literal_containing_qualified_table_name_roundtrips(self):
+        """A bound value that happens to contain 'etl.replication_state'
+        text (e.g. an error reason quoting a relation) must round-trip
+        byte-identical — real Postgres binds server-side and would never
+        rewrite it; the fake's flat-name mapping must be quote-aware."""
+        from etl_tpu.models.table_state import TableState
+        from etl_tpu.postgres.fake import FakeDatabase
+        from etl_tpu.testing.fake_pg_server import FakePgServer
+
+        db = FakeDatabase()
+        server = FakePgServer(db)
+        await server.start()
+        try:
+            s = PostgresStore(
+                PgConnectionConfig(host="127.0.0.1", port=server.port,
+                                   name="postgres", username="etl"), 1)
+            await s.connect()
+            reason = 'relation "etl.replication_state" does not exist'
+            await s.update_table_state(5, TableState.errored(reason))
+            # restart: the read-back must come from the database, not the
+            # in-memory cache
+            s2 = PostgresStore(
+                PgConnectionConfig(host="127.0.0.1", port=server.port,
+                                   name="postgres", username="etl"), 1)
+            await s2.connect()
+            st = await s2.get_table_state(5)
+            assert st is not None and st.reason == reason
+            await s.close()
+            await s2.close()
+        finally:
+            await server.stop()

@@ -247,3 +247,50 @@ class TestWirePartitionsAndFilters:
             await c.close()
         finally:
             await server.stop()
+
+
+class TestDrainBufferedErrorFrame:
+    """An 'E' frame mid-drain must not discard the frames parsed (and
+    already deleted from the reader buffer) earlier in the same pass —
+    they would only come back via restart-from-durable re-delivery
+    (ADVICE r2)."""
+
+    def test_frames_before_error_survive(self):
+        from etl_tpu.postgres.client import _WireReplicationStream
+        from etl_tpu.postgres.codec.pgoutput import (
+            PrimaryKeepalive, encode_primary_keepalive)
+        from etl_tpu.postgres.wire import PgServerError
+
+        def copy_data(payload: bytes) -> bytes:
+            return b"d" + (4 + len(payload)).to_bytes(4, "big") + payload
+
+        def error_frame(message: str) -> bytes:
+            fields = b"SERROR\x00C57P01\x00M" + message.encode() + b"\x00\x00"
+            return b"E" + (4 + len(fields)).to_bytes(4, "big") + fields
+
+        buf = bytearray(
+            copy_data(encode_primary_keepalive(0x100, 1_000_000))
+            + copy_data(encode_primary_keepalive(0x200, 2_000_000))
+            + error_frame("terminating connection")
+            + copy_data(encode_primary_keepalive(0x300, 3_000_000)))
+
+        stream = _WireReplicationStream.__new__(_WireReplicationStream)
+
+        class _Reader:
+            _buffer = buf
+
+        class _Conn:
+            _reader = _Reader()
+
+        stream._conn = _Conn()
+        stream._closed = False
+        stream._pending_error = None
+
+        out = stream.drain_buffered(10)
+        assert [f.end_lsn for f in out] == [0x100, 0x200]
+        assert all(isinstance(f, PrimaryKeepalive) for f in out)
+        # the error surfaces on the NEXT drain, not mid-pass
+        with pytest.raises(PgServerError, match="terminating"):
+            stream.drain_buffered(10)
+        # after raising once the stream drains normally again
+        assert [f.end_lsn for f in stream.drain_buffered(10)] == [0x300]
