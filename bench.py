@@ -80,7 +80,7 @@ def bench_cpu(payloads, schema, n_rows):
     return 1.0 / per_row  # records/sec
 
 
-def bench_tpu(payloads, schema, n_rows):
+def bench_tpu(payloads, schema, n_rows, use_pallas: bool = False):
     """Sustained pipelined throughput: stage batch N+1 and complete batch
     N-1 while batch N is in flight on the device — the same software
     pipelining the apply loop uses (one in-flight write, apply.rs:1956)."""
@@ -88,7 +88,7 @@ def bench_tpu(payloads, schema, n_rows):
     from etl_tpu.ops.wal import concat_payloads, stage_wal_batch
 
     buf, offs, lens = concat_payloads(payloads)
-    decoder = DeviceDecoder(schema)
+    decoder = DeviceDecoder(schema, use_pallas=use_pallas)
 
     def stage():
         return stage_wal_batch(buf, offs, lens, 4)
@@ -117,15 +117,46 @@ def bench_tpu(payloads, schema, n_rows):
     # MEDIAN of iterations: the number a sustained pipeline actually
     # delivers (the CPU baseline still uses its FASTEST sample — the
     # comparison is conservative in the baseline's favor)
-    return n_rows / sorted(times)[len(times) // 2]
+    return n_rows / sorted(times)[len(times) // 2], decoder
 
 
-def _probe_devices(mode: str, timeout_s: float = 300.0):
-    """Initialize the backend with a watchdog: a dead accelerator tunnel
-    hangs jax.devices() indefinitely — fail loud and fast (single JSON
-    diagnostic on stdout, the bench output contract) instead."""
+def _probe_devices(mode: str, attempts: int = 3, timeout_s: float = 150.0):
+    """Initialize the backend with retries: a dead accelerator tunnel hangs
+    jax.devices() indefinitely, and a hung in-process init can never be
+    retried — so each probe runs in a FRESH subprocess. The tunnel flaps
+    (round 2 died to this), so probe up to `attempts` times with backoff
+    before giving up with the single-JSON diagnostic the driver records."""
+    import subprocess
     import threading
+    import time as _t
 
+    last = ""
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import os, jax; "
+                 "jax.config.update('jax_platforms', 'cpu') "
+                 "if os.environ.get('JAX_PLATFORMS') == 'cpu' else None; "
+                 "jax.devices()"],
+                timeout=timeout_s, capture_output=True, text=True)
+            if proc.returncode == 0:
+                break
+            last = (proc.stderr or proc.stdout).strip()[-300:]
+        except subprocess.TimeoutExpired:
+            last = (f"probe did not initialize within {timeout_s:.0f}s "
+                    f"(accelerator tunnel down?)")
+        if attempt + 1 < attempts:
+            _t.sleep(20.0 * (attempt + 1))
+    else:
+        print(json.dumps({
+            "mode": mode,
+            "error": ("device backend unavailable after "
+                      f"{attempts} probes: {last}")}))
+        sys.exit(3)
+
+    # a probe subprocess saw the device — init in-process, watchdogged in
+    # case the tunnel dropped in between
     result: list = []
     failure: list = []
 
@@ -139,11 +170,11 @@ def _probe_devices(mode: str, timeout_s: float = 300.0):
 
     t = threading.Thread(target=init, daemon=True)
     t.start()
-    t.join(timeout_s)
+    t.join(timeout_s * 2)
     if not result:
         detail = failure[0] if failure else (
-            f"did not initialize within {timeout_s:.0f}s "
-            f"(accelerator tunnel down?)")
+            f"did not initialize within {timeout_s * 2:.0f}s "
+            f"(accelerator tunnel dropped after a successful probe)")
         print(json.dumps({"mode": mode,
                           "error": f"device backend unavailable: {detail}"}))
         sys.exit(3)
@@ -152,15 +183,26 @@ def _probe_devices(mode: str, timeout_s: float = 300.0):
 
 def main():
     import argparse
+    import os
 
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon TPU plugin overrides JAX_PLATFORMS at import time; the
+        # config knob wins (same dance as tests/conftest.py) — lets the
+        # bench smoke-run off-TPU without touching the tunnel
+        jax.config.update("jax_platforms", "cpu")
 
     parser = argparse.ArgumentParser(prog="bench.py")
     parser.add_argument("--mode", default="decode",
                         choices=["decode", "table_copy", "table_streaming",
                                  "wide_row"])
-    parser.add_argument("--engine", default="tpu", choices=["tpu", "cpu"])
+    parser.add_argument("--engine", default="tpu",
+                        choices=["tpu", "cpu", "pallas"])
     args = parser.parse_args()
+    if args.engine == "pallas" and args.mode != "wide_row":
+        parser.error("--engine pallas applies to wide_row only "
+                     "(decode mode always measures both engines)")
     # decode and wide_row always run the device engine; pipeline modes
     # only need a device when the batch engine is tpu
     if args.mode in ("decode", "wide_row") or args.engine == "tpu":
@@ -175,20 +217,42 @@ def main():
         elif args.mode == "table_streaming":
             out = asyncio.run(harness.run_table_streaming(engine=args.engine))
         else:
-            out = harness.run_wide_row()
+            out = harness.run_wide_row(
+                engine="pallas" if args.engine == "pallas" else "xla")
         print(json.dumps(out))
         return
 
     payloads = build_workload(N_ROWS)
     schema = make_schema()
     cpu_rps = bench_cpu(payloads, schema, N_ROWS)
-    tpu_rps = bench_tpu(payloads, schema, N_ROWS)
+    xla_rps, _ = bench_tpu(payloads, schema, N_ROWS)
+    # measure the pallas kernel too (VERDICT r2 #8: decide with data);
+    # if Mosaic rejects it on this libtpu the decoder falls back to XLA
+    # mid-run — detect that and report honestly rather than double-count.
+    # Off-TPU the kernel runs in interpret mode (correctness only, ~1000×
+    # slower) — not a perf measurement, skip it.
+    if jax.default_backend() == "tpu":
+        pallas_rps, pdec = bench_tpu(payloads, schema, N_ROWS,
+                                     use_pallas=True)
+        pallas_ok = pdec.use_pallas
+    else:
+        pallas_rps, pallas_ok = 0.0, False
+    if pallas_ok and pallas_rps > xla_rps:
+        best, engine = pallas_rps, "pallas"
+    else:
+        best, engine = xla_rps, "xla"
     result = {
         "metric": "wal_records_per_sec_decoded",
-        "value": round(tpu_rps),
+        "value": round(best),
         "unit": "records/s",
-        "vs_baseline": round(tpu_rps / cpu_rps, 2),
+        "vs_baseline": round(best / cpu_rps, 2),
         "cpu_baseline_records_per_sec": round(cpu_rps),
+        "engine": engine,
+        "xla_records_per_sec": round(xla_rps),
+        "pallas_records_per_sec": round(pallas_rps) if pallas_ok else None,
+        "pallas_status": "ok" if pallas_ok else (
+            "compile_fallback" if jax.default_backend() == "tpu"
+            else "not_measured"),
         "backend": jax.default_backend(),
         "workload": f"pgbench insert CDC, {N_ROWS} rows/batch",
     }

@@ -246,7 +246,8 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
 # ---------------------------------------------------------------------------
 
 
-def run_wide_row(n_rows: int = 16_384, n_iters: int = 5) -> dict:
+def run_wide_row(n_rows: int = 16_384, n_iters: int = 5,
+                 engine: str = "xla") -> dict:
     import random
 
     from ..models import (ColumnSchema, Oid, ReplicatedTableSchema,
@@ -295,7 +296,7 @@ def run_wide_row(n_rows: int = 16_384, n_iters: int = 5) -> dict:
             [TUPLE_NULL if v is None else TUPLE_TEXT for v in vals], vals))
 
     staged = stage_tuples(tuples, 100)
-    dec = DeviceDecoder(schema)
+    dec = DeviceDecoder(schema, use_pallas=(engine == "pallas"))
     dec.decode(staged)  # warmup
     times = []
     for _ in range(n_iters):
@@ -303,6 +304,10 @@ def run_wide_row(n_rows: int = 16_384, n_iters: int = 5) -> dict:
         dec.decode(staged)
         times.append(time.perf_counter() - t0)
     rps = n_rows / _median(times)
+    # a failed pallas compile silently falls back to XLA mid-warmup —
+    # report the engine that actually ran
+    ran = "pallas" if dec.use_pallas and engine == "pallas" else "xla"
     return {"mode": "wide_row", "rows": n_rows, "columns": 100,
+            "engine": ran,
             "rows_per_second": round(rps),
             "cells_per_second": round(rps * 100)}

@@ -6,8 +6,12 @@ parses a row block entirely in VMEM, streaming bmat blocks in and packed
 result blocks out without materializing any [R, W] intermediate in HBM —
 and (b) serve as the template for fusing more of the pipeline (validity
 masks, filtering) as column counts grow. `DeviceDecoder(use_pallas=True)`
-selects it; the bench compares both and the default stays whichever
-measures faster on the target chip.
+selects it; `bench.py --mode decode` measures BOTH engines every run and
+reports both numbers. XLA stays the production default: current libtpu's
+Mosaic rejects some byte-wise lowerings, and when the kernel fails to
+compile the decoder logs and falls back to the XLA program permanently
+for that instance (engine._device_call), so pallas can only win the
+bench headline when it genuinely compiles and measures faster.
 
 Falls back to interpret mode off-TPU so the differential tests cover the
 same code path on CPU.

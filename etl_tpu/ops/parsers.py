@@ -68,7 +68,9 @@ def _digit_limbs(bmat: jax.Array, lengths: jax.Array, start: jax.Array,
     in_range = (pos >= start[:, None]) & (pos < lengths[:, None])
     d = bmat - D0
     is_digit = (d >= 0) & (d <= 9)
-    all_digits = jnp.where(in_range, is_digit, True).all(axis=1)
+    # NOT a bool-armed where: Mosaic (pallas) lowers bool selects via an
+    # i8→i1 truncation it rejects; pure i1 logical ops lower everywhere
+    all_digits = ~(in_range & ~is_digit).any(axis=1)
     r = lengths[:, None] - 1 - pos  # digit position from the right
     weight = pow10(r % 9)
     dd = jnp.where(in_range & is_digit, d, 0)
@@ -163,7 +165,7 @@ def _parse_hms_at(bmat: jax.Array, lengths: jax.Array, base: int):
     scale = pow10(jnp.clip(5 - k, 0, 8))
     us = jnp.where(frac_digit & (k < run[:, None]), d * scale, 0) \
         .sum(axis=1, dtype=jnp.int32)
-    frac_ok = jnp.where(has_dot, run >= 1, True)
+    frac_ok = ~has_dot | (run >= 1)  # no bool-armed select (Mosaic)
     end = base + 8 + jnp.where(has_dot, 1 + run, 0)
     sec = (hh * 60 + mm) * 60 + ss
     # hh == 24 ("24:00:00") exists in PG but needs the CPU clamp path
@@ -196,13 +198,11 @@ def _parse_tz_at(bmat: jax.Array, lengths: jax.Array, p: jax.Array):
     has_min = (lengths > p + 3) & (at(p + 3) == COLON)
     m1, m2 = at(p + 4) - D0, at(p + 5) - D0
     mm = jnp.where(has_min, m1 * 10 + m2, 0)
-    mm_ok = jnp.where(has_min, (m1 >= 0) & (m1 <= 9) & (m2 >= 0) & (m2 <= 9),
-                      True)
+    mm_ok = ~has_min | ((m1 >= 0) & (m1 <= 9) & (m2 >= 0) & (m2 <= 9))
     has_sec = has_min & (lengths > p + 6) & (at(p + 6) == COLON)
     s1, s2 = at(p + 7) - D0, at(p + 8) - D0
     ss = jnp.where(has_sec, s1 * 10 + s2, 0)
-    ss_ok = jnp.where(has_sec, (s1 >= 0) & (s1 <= 9) & (s2 >= 0) & (s2 <= 9),
-                      True)
+    ss_ok = ~has_sec | ((s1 >= 0) & (s1 <= 9) & (s2 >= 0) & (s2 <= 9))
     end = p + 3 + jnp.where(has_min, 3, 0) + jnp.where(has_sec, 3, 0)
     off = hh * 3600 + mm * 60 + ss
     off = jnp.where(neg, -off, off)
@@ -270,7 +270,7 @@ def parse_float(bmat: jax.Array, lengths: jax.Array):
     is_digit = (d >= 0) & (d <= 9)
     mant_sel = (pos >= start[:, None]) & (pos < e_pos[:, None]) \
         & ~is_dot
-    mant_valid = jnp.where(mant_sel, is_digit, True).all(axis=1)
+    mant_valid = ~(mant_sel & ~is_digit).any(axis=1)
     n_mant = mant_sel.sum(axis=1).astype(jnp.int32)
     # digit position from the right within the mantissa (dot removed):
     # digits after the dot keep index; digits before shift by frac count
@@ -296,8 +296,8 @@ def parse_float(bmat: jax.Array, lengths: jax.Array):
     exp_sign = has_e & (exp_neg | (at(exp_start) == PLUS))
     exp_d_start = exp_start + exp_sign.astype(jnp.int32)
     exp_sel = (pos >= exp_d_start[:, None]) & in_len
-    exp_valid = jnp.where(exp_sel, is_digit, True).all(axis=1) \
-        & jnp.where(has_e, lengths > exp_d_start, True)
+    exp_valid = ~(exp_sel & ~is_digit).any(axis=1) \
+        & (~has_e | (lengths > exp_d_start))
     re = lengths[:, None] - 1 - pos
     eweight = pow10(re % 9)
     exp_val = jnp.where(exp_sel & is_digit & (re // 9 == 0), d * eweight, 0) \
@@ -348,12 +348,12 @@ def _int_range_ok(kind, neg, l0, l1, l2, ndigits):
     if kind is CellKind.I16:
         ok = (ndigits <= 5) & (l1 == 0) & (l2 == 0)
         v = l0  # ≤ 99999, no wrap
-        return ok & jnp.where(neg, v <= 32768, v <= 32767)
+        return ok & ((neg & (v <= 32768)) | (~neg & (v <= 32767)))
     if kind is CellKind.I32:
         ok = (ndigits <= 10) & (l2 == 0)
         in_range = (l1 < 2) | ((l1 == 2)
-                               & jnp.where(neg, l0 <= 147_483_648,
-                                           l0 <= 147_483_647))
+                               & ((neg & (l0 <= 147_483_648))
+                                  | (~neg & (l0 <= 147_483_647))))
         return ok & in_range
     if kind is CellKind.U32:
         ok = (ndigits <= 10) & (l2 == 0) & ~neg

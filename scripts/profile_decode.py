@@ -86,7 +86,7 @@ def main():
           f"-> {B.N_ROWS/fd_med:.0f} rows/s blocking")
 
     # pipelined, as bench does
-    tp = B.bench_tpu(payloads, schema, B.N_ROWS)
+    tp, _ = B.bench_tpu(payloads, schema, B.N_ROWS)
     print(f"bench_tpu pipelined: {tp:.0f} rows/s")
 
 
