@@ -68,6 +68,25 @@ _MIN_WIDTH = {
 }
 MAX_FIELD_WIDTH = 2048  # beyond this a field goes to CPU fallback
 
+# fixed gather widths for the HOST-backend program: wide enough for every
+# in-range text of the kind (longer → CPU fallback, same as the device
+# oversize rule), so the jit signature is data-INDEPENDENT — one compile
+# per (schema, row bucket) instead of one per drifting width signature.
+# Host memory traffic is cheap; only the device link makes widths precious.
+_HOST_WIDTH = {
+    CellKind.BOOL: 4,
+    CellKind.I16: 8,          # "-32768"
+    CellKind.I32: 12,         # "-2147483648"
+    CellKind.U32: 12,
+    CellKind.I64: 20,         # "-9223372036854775808"
+    CellKind.F32: 32,         # "-1.7976931348623157e+308" is 24
+    CellKind.F64: 32,
+    CellKind.DATE: 16,
+    CellKind.TIME: 16,        # "HH:MM:SS.ffffff"
+    CellKind.TIMESTAMP: 32,   # date + space + time = 26
+    CellKind.TIMESTAMPTZ: 36, # + "+15:59:59"
+}
+
 def round_up_even(n: int) -> int:
     return (n + 1) & ~1
 
@@ -200,6 +219,22 @@ class _PendingDecode:
         return self._done
 
 
+_HOST_CPU_DEVICE: list = []  # lazy singleton: [device] | [None]
+
+
+def _host_cpu_device():
+    """The host CPU backend's device, or None when unavailable. Present
+    even when the default backend is a TPU — XLA's CPU client is built in,
+    so the SAME decode program can execute host-side for batches too small
+    to amortize the accelerator round trip."""
+    if not _HOST_CPU_DEVICE:
+        try:
+            _HOST_CPU_DEVICE.append(jax.local_devices(backend="cpu")[0])
+        except Exception:
+            _HOST_CPU_DEVICE.append(None)
+    return _HOST_CPU_DEVICE[0]
+
+
 class DeviceDecoder:
     """Schema-bound batch decoder. jit caches are per-instance, keyed by
     (row_capacity, width-signature)."""
@@ -209,6 +244,13 @@ class DeviceDecoder:
     # partitions go to the device
     DEVICE_MIN_ROWS = 8192
 
+    # CDC flush runs (hundreds of rows between commit barriers) are far
+    # below DEVICE_MIN_ROWS; at/above this row count they run the SAME
+    # XLA decode program on the host CPU backend — one vectorized dispatch
+    # instead of a per-row Python oracle pass (~100× on the streaming hot
+    # path). Below it, dispatch overhead loses to the oracle.
+    HOST_MIN_ROWS = 64
+
     # below this row count a multi-device mesh buys nothing (per-shard
     # work too small vs dispatch overhead); batches at/above it shard rows
     # across 'sp' (SURVEY §7: data-parallel decode across ragged batches)
@@ -217,12 +259,15 @@ class DeviceDecoder:
     def __init__(self, schema: ReplicatedTableSchema, *,
                  numeric_mode: str = "text", use_pallas: bool = False,
                  device_min_rows: int | None = None,
+                 host_min_rows: int | None = None,
                  mesh: "object | str | None" = "auto",
                  mesh_min_rows: int | None = None):
         self.schema = schema
         self.use_pallas = use_pallas
         self.device_min_rows = self.DEVICE_MIN_ROWS \
             if device_min_rows is None else device_min_rows
+        self.host_min_rows = self.HOST_MIN_ROWS \
+            if host_min_rows is None else host_min_rows
         if mesh == "auto":
             from ..parallel.mesh import default_decode_mesh
 
@@ -251,6 +296,7 @@ class DeviceDecoder:
                 self._object.append(spec)
             self._dense = self._dense[:250]
         self._fn_cache: dict[tuple, Callable] = {}
+        self._host_specs_cache: tuple | None = None
 
     # -- internals ----------------------------------------------------------
 
@@ -280,22 +326,41 @@ class DeviceDecoder:
             out.append((spec.index, spec.kind, w, bw))
         return tuple(out)
 
+    def _host_specs(self) -> tuple:
+        """Data-independent specs for the host-CPU program (fixed gather
+        widths per kind, bit widths at saturation): the signature never
+        drifts with field lengths, so each (schema, row bucket) compiles
+        exactly once."""
+        if self._host_specs_cache is None:
+            from .bitpack import saturation_width
+
+            out = []
+            for spec in self._dense:
+                w = _HOST_WIDTH[spec.kind]
+                bw = round_up_even(min(w, saturation_width(spec.kind)))
+                out.append((spec.index, spec.kind, w, bw))
+            self._host_specs_cache = tuple(out)
+        return self._host_specs_cache
+
     def _can_nibble(self, widths: tuple[int, ...]) -> bool:
         return (all(s.kind in _NIBBLE_KINDS for s in self._dense)
                 and all(w % 2 == 0 and w <= 255 for w in widths)
                 and len(self._dense) > 0)
 
-    def _pack_host(self, staged: StagedBatch, widths: tuple[int, ...]):
+    def _pack_host(self, staged: StagedBatch, widths: tuple[int, ...],
+                   allow_nibble: bool = True):
         """Gather all dense fields into one byte matrix: nibble-packed C
         fast path (halves the upload) when the column mix allows, raw C
         pass otherwise, numpy as the last resort. Returns
-        (bmat, lengths, nibble, bad_rows)."""
+        (bmat, lengths, nibble, bad_rows). The host-backend path packs raw
+        (allow_nibble=False): there is no upload to halve, and skipping the
+        nibble probe avoids a second compiled program per schema."""
         from ..native import pack_bmat, pack_bmat_nibble
 
         R = staged.row_capacity
         total_w = sum(widths)
         ldtype = np.uint8 if max(widths, default=0) <= 255 else np.int32
-        if ldtype is np.uint8 and self._can_nibble(widths):
+        if allow_nibble and ldtype is np.uint8 and self._can_nibble(widths):
             bmat = np.empty((R, total_w // 2), dtype=np.uint8)
             lengths = np.empty((R, len(self._dense)), dtype=np.uint8)
             bad = np.empty(R, dtype=np.uint8)
@@ -335,20 +400,34 @@ class DeviceDecoder:
                 and row_capacity >= self.mesh_min_rows
                 and row_capacity % self.mesh.size == 0)
 
-    def _device_call(self, staged: StagedBatch, specs: tuple):
+    def _device_call(self, staged: StagedBatch, specs: tuple,
+                     host: bool = False):
         widths = tuple(w for _, _, w, _ in specs)
-        bmat, lengths, nibble, bad_rows = self._pack_host(staged, widths)
-        use_mesh = self._use_mesh(staged.row_capacity)
-        key = (staged.row_capacity, specs, nibble, use_mesh)
+        bmat, lengths, nibble, bad_rows = self._pack_host(
+            staged, widths, allow_nibble=not host)
+        if host:
+            # committed CPU placement: jit compiles/executes this call on
+            # the host CPU backend — same program, no accelerator round
+            # trip (pallas is TPU-lowered, so host always takes the XLA
+            # build; jit caches per input placement)
+            dev = _host_cpu_device()
+            bmat = jax.device_put(bmat, dev)
+            lengths = jax.device_put(lengths, dev)
+        use_mesh = not host and self._use_mesh(staged.row_capacity)
+        key = (staged.row_capacity, specs, nibble, use_mesh, host)
         fn = self._fn_cache.get(key)
         if fn is None:
-            fn = _build_device_fn(specs, nibble, self.use_pallas,
+            fn = _build_device_fn(specs, nibble,
+                                  self.use_pallas and not host,
                                   mesh=self.mesh if use_mesh else None)
             self._fn_cache[key] = fn
         try:
             return fn(bmat, lengths), bad_rows  # async dispatch
         except Exception:
-            if not self.use_pallas:
+            # host calls never run pallas — an error there is real, not a
+            # Mosaic rejection; misrouting it would disable pallas AND send
+            # the small batch on the accelerator round trip
+            if host or not self.use_pallas:
                 raise
             # Mosaic rejects some byte-wise lowerings on current libtpu
             # (interleave reshape, narrow truncations) — fall back to the
@@ -569,6 +648,10 @@ class DeviceDecoder:
         if self._dense and staged.n_rows >= self.device_min_rows:
             specs = self._specs(staged, self._widths(staged))
             packed, bad_rows = self._device_call(staged, specs)
+        elif self._dense and staged.n_rows >= self.host_min_rows \
+                and _host_cpu_device() is not None:
+            specs = self._host_specs()
+            packed, bad_rows = self._device_call(staged, specs, host=True)
         else:
             specs = ()
             packed, bad_rows = None, None

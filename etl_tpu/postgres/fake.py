@@ -170,6 +170,23 @@ class FakeDatabase:
             self._wal_cond.notify_all()
         return lsn
 
+    async def append_wal_many(
+            self, entries: "list[tuple[bytes, TableId | None, list | None]]"
+    ) -> Lsn:
+        """Append a transaction's entries with ONE reader wakeup — the
+        per-entry condition-variable round trip otherwise dominates
+        high-rate producers (each entry still advances the LSN by 8,
+        identical to sequential append_wal calls)."""
+        wal = self.wal
+        lsn = self._lsn
+        for payload, tid, row in entries:
+            lsn += 8
+            wal.append((Lsn(lsn), payload, tid, row))
+        self._lsn = lsn
+        async with self._wal_cond:
+            self._wal_cond.notify_all()
+        return Lsn(lsn)
+
     def row_filter_allows(self, publication: str, table_id: TableId | None,
                           row: "list[str | None] | None") -> bool:
         if table_id is None or row is None:
@@ -219,6 +236,14 @@ class FakeTransaction:
     def insert(self, table_id: TableId, values: list[str | None]) -> None:
         self._ops.append(("I", table_id, values, None))
 
+    def insert_preencoded(self, table_id: TableId, payload: bytes,
+                          values: "list[str | None] | None" = None) -> None:
+        """Insert whose pgoutput payload the caller already encoded (bench
+        producers encode off the clock so the measured window holds only
+        walsender framing + the pipeline). `values` feeds row filters and
+        table state; None skips both (fine when neither is in play)."""
+        self._ops.append(("P", table_id, payload, values))
+
     def update(self, table_id: TableId, key: list[str | None],
                new_values: list[str | None]) -> None:
         self._ops.append(("U", table_id, new_values, key))
@@ -265,13 +290,20 @@ class FakeTransaction:
 
         for op in self._ops:
             kind = op[0]
-            if kind in ("I", "U", "D"):
+            if kind in ("I", "U", "D", "P"):
                 # publish_via_partition_root: leaf changes carry the root's
                 # relid (and the root's RELATION message) in the WAL
                 target = db.wal_relid(op[1])
                 if target not in relation_sent:
                     emit_relation(target)
-            if kind == "I":
+            if kind == "P":
+                _, tid, payload, values = op
+                target = db.wal_relid(tid)
+                body_entries.append(
+                    (payload, target if values is not None else None, values))
+                if values is not None:
+                    db.tables[tid].rows.append(list(values))
+            elif kind == "I":
                 _, tid, values, _ = op
                 target = db.wal_relid(tid)
                 body_entries.append((pgoutput.encode_insert(
@@ -362,12 +394,13 @@ class FakeTransaction:
 
         n_entries = len(body_entries) + 2  # + begin + commit
         commit_lsn = Lsn(int(begin_at) + 8 * (n_entries - 1))
-        await db.append_wal(pgoutput.encode_begin(int(commit_lsn), ts,
-                                                  self.xid))
-        for payload, tid, row in body_entries:
-            await db.append_wal(payload, table_id=tid, row=row)
-        end_lsn = await db.append_wal(
-            pgoutput.encode_commit(int(commit_lsn), int(commit_lsn) + 8, ts))
+        entries = [(pgoutput.encode_begin(int(commit_lsn), ts, self.xid),
+                    None, None)]
+        entries.extend(body_entries)
+        entries.append((pgoutput.encode_commit(int(commit_lsn),
+                                               int(commit_lsn) + 8, ts),
+                        None, None))
+        await db.append_wal_many(entries)
         return commit_lsn
 
     def _key_columns(self, t: FakeTable) -> list[int]:
@@ -418,7 +451,8 @@ class _FakeReplicationStream(ReplicationStream):
     def __aiter__(self) -> AsyncIterator[pgoutput.ReplicationFrame]:
         return self._frames()
 
-    def _next_buffered(self) -> "pgoutput.XLogData | None":
+    def _next_buffered(self, clock_us: int | None = None
+                       ) -> "pgoutput.XLogData | None":
         """Next already-written WAL frame, or None when caught up."""
         db = self.db
         if self._pub_tables is None:
@@ -436,18 +470,20 @@ class _FakeReplicationStream(ReplicationStream):
                 continue
             return pgoutput.XLogData(
                 start_lsn=lsn, end_lsn=db.current_lsn,
-                clock_us=_now_us(), payload=payload)
+                clock_us=clock_us if clock_us is not None else _now_us(),
+                payload=payload)
         return None
 
     def drain_buffered(self, max_n: int) -> list:
         """Bulk-read already-buffered frames without event-loop round
         trips (the apply loop's per-frame asyncio overhead otherwise caps
-        CDC throughput)."""
+        CDC throughput). One clock read serves the whole window."""
         out = []
         if self._closed or self.slot.invalidated:
             return out
+        clock = _now_us()
         while len(out) < max_n:
-            f = self._next_buffered()
+            f = self._next_buffered(clock)
             if f is None:
                 break
             out.append(f)

@@ -257,10 +257,9 @@ class ApplyLoop:
                         frames = self.stream.drain_buffered(4096)
                         if not frames:
                             break
-                        for frame in frames:
-                            intent = await self._handle_frame(frame)
-                            if intent is not None:
-                                return intent
+                        intent = await self._handle_frames(frames)
+                        if intent is not None:
+                            return intent
                 elif not done:
                     # idle timeout: proactive keepalive + idle sync processing
                     await self._send_status_update()
@@ -285,6 +284,71 @@ class ApplyLoop:
             await self.stream.close()
 
     # -- frame handling ---------------------------------------------------------
+
+    async def _handle_frames(self, frames: list) -> ExitIntent | None:
+        """Bulk path for a drained frame window. Contiguous spans of row
+        messages for one table — the overwhelming majority of CDC traffic —
+        append into the assembler with per-SPAN bookkeeping (ownership
+        check, LSN watermarks, flush check) instead of per-frame Python;
+        control and keepalive frames take the per-frame slow path, which
+        doubles as the barrier bounding every span (so ownership and
+        current_commit_lsn are constants within one). This is what lifts
+        end-to-end CDC from the tens of µs/event the per-frame machinery
+        costs (reference loop: apply.rs:1280-1336 runs it in compiled Rust;
+        here the span batching amortizes it instead)."""
+        st = self.state
+        tpu = self.config.batch.batch_engine is BatchEngine.TPU
+        xlog = pgoutput.XLogData
+        row_tags = (b"I", b"U", b"D")
+        i, n = 0, len(frames)
+        while i < n:
+            frame = frames[i]
+            if not (tpu and type(frame) is xlog
+                    and frame.payload[:1] in row_tags):
+                intent = await self._handle_frame(frame)
+                if intent is not None:
+                    return intent
+                i += 1
+                continue
+            relid = int.from_bytes(frame.payload[1:5], "big")
+            j = i + 1
+            payloads = [frame.payload]
+            lsns = [int(frame.start_lsn)]
+            last = frame
+            # span cap: the batch-budget check runs per span, so an
+            # unbounded span could blow far past max_size_bytes inside one
+            # giant transaction (the split-at-budget e2e pins this)
+            cap = i + 512
+            while j < n and j < cap:
+                f = frames[j]
+                if type(f) is not xlog:
+                    break
+                p = f.payload
+                if p[:1] not in row_tags \
+                        or int.from_bytes(p[1:5], "big") != relid:
+                    break
+                payloads.append(p)
+                lsns.append(int(f.start_lsn))
+                last = f
+                j += 1
+            st.server_end_lsn = max(st.server_end_lsn, last.end_lsn)
+            st.received_lsn = max(st.received_lsn, last.start_lsn)
+            if await self._table_owned(relid):
+                schema = self.cache.get(relid)
+                if schema is None:
+                    raise EtlError(ErrorKind.SCHEMA_NOT_FOUND,
+                                   f"no RELATION seen for table {relid}")
+                nbytes = self.assembler.push_raw_rows(
+                    payloads, schema, lsns, int(st.current_commit_lsn),
+                    st.tx_ordinal)
+                st.tx_ordinal += len(payloads)
+                st.tx_bytes += nbytes
+                if self._batch_deadline is None:
+                    self._batch_deadline = time.monotonic() \
+                        + self.config.batch.max_fill_ms / 1000
+                self._maybe_dispatch_flush()
+            i = j
+        return None
 
     async def _handle_frame(self, frame) -> ExitIntent | None:
         if isinstance(frame, pgoutput.PrimaryKeepalive):

@@ -79,6 +79,27 @@ class EventAssembler:
         r.tx_ordinals.append(tx_ordinal)
         self.size_bytes += 64 + len(payload)
 
+    def push_raw_rows(self, payloads: list[bytes],
+                      schema: ReplicatedTableSchema, start_lsns: list[int],
+                      commit_lsn: int, tx_ordinal0: int) -> int:
+        """Bulk form of push_raw_row for a contiguous same-table span (the
+        apply loop's drained-window fast path): one call per span, list
+        extends instead of per-row pushes. Returns the span's payload
+        bytes (the caller's tx_bytes accounting needs the same sum)."""
+        if self._run is None or self._run.table_id != schema.id \
+                or self._run.schema is not schema:
+            self._seal_run()
+            self._run = _Run(table_id=schema.id, schema=schema)
+        r = self._run
+        k = len(payloads)
+        r.payloads.extend(payloads)
+        r.start_lsns.extend(start_lsns)
+        r.commit_lsns.extend([commit_lsn] * k)
+        r.tx_ordinals.extend(range(tx_ordinal0, tx_ordinal0 + k))
+        nbytes = sum(map(len, payloads))
+        self.size_bytes += 64 * k + nbytes
+        return nbytes
+
     def push_row_message(self, msg: pgoutput.LogicalReplicationMessage,
                          payload: bytes, schema: ReplicatedTableSchema,
                          start_lsn: Lsn, commit_lsn: Lsn,

@@ -21,7 +21,13 @@ class SharedTableCache:
         return self._schemas.get(table_id)
 
     def set(self, schema: ReplicatedTableSchema) -> None:
-        self._schemas[schema.id] = schema
+        # identity-preserving on equal schemas: the walsender re-sends
+        # RELATION per transaction; keeping the existing object lets
+        # downstream `is` checks (assembler decoder reuse — and with it the
+        # per-schema jit cache) survive the re-sends
+        prev = self._schemas.get(schema.id)
+        if prev is None or prev != schema:
+            self._schemas[schema.id] = schema
 
     def remove(self, table_id: TableId) -> None:
         self._schemas.pop(table_id, None)

@@ -567,3 +567,81 @@ class TestVeryWideTables:
             [[str(i + c) for c in range(260)] for i in range(5)]), 260)
         batch = dec.decode(staged)
         assert batch.columns[259].value(2) == 261
+
+
+class TestHostVectorPath:
+    """CDC-sized batches (host_min_rows ≤ n < device_min_rows) run the SAME
+    XLA program on the host CPU backend with a data-INDEPENDENT signature
+    (engine._HOST_WIDTH fixed gather widths) — one compile per schema, no
+    per-row oracle pass. Differential against the oracle, plus the
+    signature-stability property the streaming throughput depends on."""
+
+    OIDS = [Oid.INT8, Oid.INT4, Oid.FLOAT8, Oid.DATE, Oid.TIMESTAMPTZ,
+            Oid.TEXT]
+
+    def _rows(self, n, start=0):
+        out = []
+        for i in range(start, start + n):
+            out.append([str((i * 7919) % 2**62 - 2**61), str(i % 97),
+                        f"{i}.25", "2024-05-01",
+                        "2024-05-01 12:34:56.789+05:30", f"note-{i}"])
+        return out
+
+    def test_host_path_matches_oracle(self):
+        schema = make_schema(self.OIDS)
+        dec = DeviceDecoder(schema)  # production thresholds
+        rows = self._rows(500)
+        staged = stage_tuples(tuples_from_texts(rows), len(self.OIDS))
+        assert staged.n_rows >= dec.host_min_rows < dec.device_min_rows
+        batch = dec.decode(staged)
+        # routing proof: the host program ran (a jit fn was cached with
+        # host=True) — not the per-row oracle
+        assert any(key[-1] for key in dec._fn_cache), "host path not taken"
+        from etl_tpu.postgres.codec.text import parse_cell_text
+        cpu_rows = [TableRow([None if v is None else parse_cell_text(v, oid)
+                              for v, oid in zip(r, self.OIDS)])
+                    for r in rows]
+        assert_batches_equal(batch, ColumnarBatch.from_rows(schema, cpu_rows))
+
+    def test_signature_stable_across_field_lengths(self):
+        """Two batches with different max field lengths must NOT compile two
+        programs — drifting widths once recompiled per transaction and
+        collapsed streaming throughput 60×."""
+        schema = make_schema(self.OIDS)
+        dec = DeviceDecoder(schema)
+        short = [["1", "2", "3.5", "2024-01-02",
+                  "2024-01-02 03:04:05+00", "a"]] * 100
+        long = [["-9223372036854775808", "-2147483648",
+                 "-1.7976931348623157e+308", "2024-12-31",
+                 "2024-12-31 23:59:59.999999+15:59:59", "b" * 300]] * 100
+        dec.decode(stage_tuples(tuples_from_texts(short), len(self.OIDS)))
+        n_after_first = len(dec._fn_cache)
+        dec.decode(stage_tuples(tuples_from_texts(long), len(self.OIDS)))
+        assert len(dec._fn_cache) == n_after_first == 1
+
+    def test_oversize_fields_fall_back_correctly(self):
+        """Fields wider than the fixed host gather width (BC dates, huge
+        numerics-as-float) take the oracle fallback row-wise, exactly."""
+        oids = [Oid.INT8, Oid.DATE]
+        rows = [[str(i), "2024-05-01"] for i in range(120)]
+        rows[7] = [str(2**62), "0044-03-15 BC"]  # BC: oracle-only form
+        schema = make_schema(oids)
+        dec = DeviceDecoder(schema)
+        batch = dec.decode(stage_tuples(tuples_from_texts(rows), 2))
+        from etl_tpu.models.table_row import _to_dense
+        from etl_tpu.models.pgtypes import CellKind
+        from etl_tpu.postgres.codec.text import parse_cell_text
+        # BC date: exact DAYS via the oracle fallback (text repr normalizes)
+        assert batch.columns[1].data[7] == _to_dense(
+            CellKind.DATE, parse_cell_text("0044-03-15 BC", Oid.DATE))
+        assert batch.columns[0].value(7) == 2**62
+        assert batch.columns[0].value(119) == 119
+
+    def test_below_host_min_uses_oracle(self):
+        schema = make_schema(self.OIDS)
+        dec = DeviceDecoder(schema)
+        rows = self._rows(dec.host_min_rows - 1)
+        batch = dec.decode(stage_tuples(tuples_from_texts(rows),
+                                        len(self.OIDS)))
+        assert not dec._fn_cache  # oracle path: nothing compiled
+        assert batch.columns[1].value(3) == 3 % 97

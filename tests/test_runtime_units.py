@@ -180,3 +180,125 @@ class TestReplicatorStoreConfig:
         import pytest as _pytest
         with _pytest.raises(EtlError):
             merge(base, {"host": "x", "bogus_key": 1})
+
+
+class TestAssemblerBulkPush:
+    """push_raw_rows (the drained-window span path) must be byte-equivalent
+    to N push_raw_row calls — same runs, ordinals, size accounting."""
+
+    def _schema(self):
+        from etl_tpu.models import ReplicatedTableSchema, TableName, TableSchema
+        return ReplicatedTableSchema.with_all_columns(TableSchema(
+            7, TableName("public", "t"),
+            (ColumnSchema("id", Oid.INT4, nullable=False,
+                          primary_key_ordinal=1),)))
+
+    def test_bulk_equals_single(self):
+        from etl_tpu.config.pipeline import BatchEngine
+        from etl_tpu.postgres.codec import pgoutput
+        from etl_tpu.runtime.assembler import EventAssembler
+
+        schema = self._schema()
+        payloads = [pgoutput.encode_insert(7, [str(i).encode()])
+                    for i in range(10)]
+        a1 = EventAssembler(BatchEngine.TPU)
+        for i, p in enumerate(payloads):
+            a1.push_raw_row(p, schema, Lsn(100 + i), Lsn(500), i)
+        a2 = EventAssembler(BatchEngine.TPU)
+        nbytes = a2.push_raw_rows(payloads, schema,
+                                  [100 + i for i in range(10)], 500, 0)
+        assert nbytes == sum(len(p) for p in payloads)
+        assert a1.size_bytes == a2.size_bytes
+        r1, r2 = a1._run, a2._run
+        assert r1.payloads == r2.payloads
+        assert r1.start_lsns == r2.start_lsns
+        assert r1.commit_lsns == r2.commit_lsns
+        assert list(r1.tx_ordinals) == list(r2.tx_ordinals)
+
+    def test_bulk_seals_on_schema_change(self):
+        from etl_tpu.config.pipeline import BatchEngine
+        from etl_tpu.models import (ReplicatedTableSchema, TableName,
+                                    TableSchema)
+        from etl_tpu.postgres.codec import pgoutput
+        from etl_tpu.runtime.assembler import EventAssembler
+
+        s1 = self._schema()
+        s2 = ReplicatedTableSchema.with_all_columns(TableSchema(
+            8, TableName("public", "u"),
+            (ColumnSchema("id", Oid.INT4, nullable=False,
+                          primary_key_ordinal=1),)))
+        a = EventAssembler(BatchEngine.TPU)
+        a.push_raw_rows([pgoutput.encode_insert(7, [b"1"])], s1, [1], 10, 0)
+        a.push_raw_rows([pgoutput.encode_insert(8, [b"2"])], s2, [2], 10, 1)
+        events = a.flush()
+        assert len(events) == 2  # two sealed DecodedBatchEvents
+
+
+class TestIdentityPreservingTableCache:
+    def test_equal_schema_keeps_object(self):
+        from etl_tpu.models import (ReplicatedTableSchema, TableName,
+                                    TableSchema)
+        from etl_tpu.runtime.table_cache import SharedTableCache
+
+        def make():
+            return ReplicatedTableSchema.with_all_columns(TableSchema(
+                7, TableName("public", "t"),
+                (ColumnSchema("id", Oid.INT4, nullable=False,
+                              primary_key_ordinal=1),)))
+
+        cache = SharedTableCache()
+        a = make()
+        cache.set(a)
+        cache.set(make())  # equal but not identical (RELATION re-send)
+        assert cache.get(7) is a, \
+            "equal re-set must preserve identity (decoder/jit reuse)"
+        changed = ReplicatedTableSchema.with_all_columns(TableSchema(
+            7, TableName("public", "t"),
+            (ColumnSchema("id", Oid.INT8, nullable=False,
+                          primary_key_ordinal=1),)))
+        cache.set(changed)
+        assert cache.get(7) is changed  # real change replaces
+
+
+class TestPreencodedInserts:
+    def test_wal_identical_to_plain_insert(self):
+        import asyncio as _a
+
+        from etl_tpu.models import TableName, TableSchema
+        from etl_tpu.postgres.codec import pgoutput
+        from etl_tpu.postgres.fake import FakeDatabase
+
+        def mk_db():
+            db = FakeDatabase()
+            db.create_table(TableSchema(
+                16384, TableName("public", "t"),
+                (ColumnSchema("id", Oid.INT4, nullable=False,
+                              primary_key_ordinal=1),)))
+            db.create_publication("pub", [16384])
+            return db
+
+        async def run():
+            db1, db2 = mk_db(), mk_db()
+            tx = db1.transaction(xid=9)
+            for i in range(3):
+                tx.insert(16384, [str(i)])
+            lsn1 = await tx.commit()
+            tx = db2.transaction(xid=9)
+            for i in range(3):
+                tx.insert_preencoded(
+                    16384, pgoutput.encode_insert(16384, [str(i).encode()]),
+                    [str(i)])
+            lsn2 = await tx.commit()
+            assert int(lsn1) == int(lsn2)
+            assert [int(lsn) for lsn, *_ in db1.wal] \
+                == [int(lsn) for lsn, *_ in db2.wal]
+            for (l1, p1, t1, r1), (l2, p2, t2, r2) in zip(db1.wal, db2.wal):
+                if p1[:1] in (b"I", b"R"):
+                    assert p1 == p2
+                    assert t1 == t2 and r1 == r2
+                else:  # BEGIN/COMMIT embed wall-clock timestamps
+                    assert p1[:1] == p2[:1]
+            # table state advanced identically
+            assert db1.tables[16384].rows == db2.tables[16384].rows
+
+        asyncio.run(run())
