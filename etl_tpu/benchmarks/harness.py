@@ -204,6 +204,9 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
     await asyncio.wait_for(wait_warmup(), timeout=120)
     arrivals.clear()
     commit_times.clear()
+    # baseline BEFORE production starts: measured rows deliver concurrently
+    # with the producer loop, so a later capture would double-count them
+    base_delivered = dest.rows_delivered
 
     # payload encode happens OFF the clock: the reference bench's producer
     # is a separate Postgres server, not a Python encoder stealing the
@@ -224,8 +227,6 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
         lsn = await tx.commit()
         commit_times[int(lsn)] = time.perf_counter()
     t_prod1 = time.perf_counter()
-
-    base_delivered = dest.rows_delivered
 
     def delivered():
         return dest.rows_delivered - base_delivered

@@ -9,15 +9,23 @@ Reference parity: crates/etl-destinations/src/bigquery/ (6.6k LoC):
     (core.rs:956-978);
   - truncate → versioned successor tables `table`, `table_1`, … with a
     stable view over the latest generation (core.rs:55-106);
-  - local retry of transient append errors (client.rs:58-68,317-450);
+  - appends speak the REAL Storage Write wire format (bq_proto): an
+    AppendRowsRequest proto carrying a self-describing DescriptorProto
+    and per-row serialized proto messages, posted as
+    `application/x-protobuf` against the table's `_default` stream —
+    gRPC framing is the only transport difference from the reference
+    (no gRPC stack in this environment; payload bytes are identical);
+  - bounded LOCAL retry of Storage Write schema-propagation and
+    NOT-FOUND-while-table-exists errors with exponential equal-jitter
+    backoff (client.rs:58-68,551-650,1224-1285), on top of the transport
+    retry policy for HTTP-level transient failures;
   - background TaskSet with the ack resolving to Durable when the append
     lands (core.rs:1371-1388) — `write_events` returns an *Accepted* ack
     immediately, letting the apply loop build the next batch while the
     upload is in flight.
 
-Transport: a JSON/REST adapter with a pluggable base URL (tests run a fake
-server). Production deployments swap the transport for the gRPC Storage
-Write API; everything above `_append_rows`/`_api` is transport-agnostic.
+Table/dataset DDL stays on the REST v2 JSON surface, which is what the
+reference's client library uses for DDL as well.
 """
 
 from __future__ import annotations
@@ -40,6 +48,7 @@ from ..models.event import (ChangeType, DecodedBatchEvent, DeleteEvent,
 from ..models.pgtypes import CellKind
 from ..models.schema import (ReplicatedTableSchema, SchemaDiff, TableId)
 from ..models.table_row import ColumnarBatch, TableRow
+from . import bq_proto
 from .base import Destination, WriteAck, expand_batch_events
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
                    DestinationRetryPolicy, TaskSet, change_type_label,
@@ -53,9 +62,14 @@ from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
 class BigQueryConfig:
     project_id: str
     dataset_id: str
-    base_url: str  # REST endpoint (fake server in tests)
+    base_url: str  # endpoint root (emulator/fake in tests)
     auth_token: str = ""
     max_concurrent_appends: int = 4
+    # Storage Write local-retry window (reference client.rs:58-70: schema
+    # updates propagate to append streams "on the order of minutes")
+    storage_write_retry_timeout_s: float = 600.0
+    storage_write_retry_delay_s: float = 1.0
+    storage_write_max_retry_delay_s: float = 30.0
 
 
 _BQ_TYPES: dict[CellKind, str] = {
@@ -199,7 +213,7 @@ class BigQueryDestination(Destination):
         table = await self._ensure_table(schema)
         rows = self._rows_from_batch(schema, batch, None)
         ack, fut = WriteAck.accepted()
-        self._tasks.spawn(self._append_and_resolve(table, rows, fut))
+        self._tasks.spawn(self._append_and_resolve(table, schema, rows, fut))
         return ack
 
     async def write_events(self, events: Sequence[Event]) -> WriteAck:
@@ -223,15 +237,15 @@ class BigQueryDestination(Destination):
                         rows = []
                         for e in evs:
                             if isinstance(e, DeleteEvent):
-                                rows.append(self._row_json(
+                                rows.append(self._row_tuple(
                                     schema, e.old_row, ChangeType.DELETE,
                                     e.sequence_key.with_ordinal(ordinal)))
                             else:
-                                rows.append(self._row_json(
+                                rows.append(self._row_tuple(
                                     schema, e.row, ChangeType.INSERT,
                                     e.sequence_key.with_ordinal(ordinal)))
                             ordinal += 1
-                        await self._append_rows(table, rows)
+                        await self._append_rows(table, schema, rows)
                     elif op[0] == "truncate":
                         for sch in op[1].schemas:
                             await self.truncate_table(sch.id)
@@ -246,53 +260,204 @@ class BigQueryDestination(Destination):
         self._tasks.spawn(execute())
         return ack
 
-    async def _append_and_resolve(self, table: str, rows: list[dict],
+    async def _append_and_resolve(self, table: str,
+                                  schema: ReplicatedTableSchema,
+                                  rows: list[tuple],
                                   fut: asyncio.Future) -> None:
         try:
-            await self._append_rows(table, rows)
+            await self._append_rows(table, schema, rows)
             if not fut.done():
                 fut.set_result(None)
         except BaseException as e:
             if not fut.done():
                 fut.set_exception(e)
 
-    async def _append_rows(self, table: str, rows: list[dict]) -> None:
-        assert self._append_sem is not None
-        async with self._append_sem:
-            await self._api(
-                "POST", f"{self._dataset_path()}/tables/{table}/appendRows",
-                {"rows": rows})
+    def _write_stream(self, table: str) -> str:
+        return (f"projects/{self.config.project_id}/datasets/"
+                f"{self.config.dataset_id}/tables/{table}/streams/_default")
 
-    def _row_json(self, schema: ReplicatedTableSchema, row: TableRow,
-                  ct: ChangeType, seq: str) -> dict:
+    async def _post_append_proto(self, table: str, body: bytes) -> bytes:
+        """POST the serialized AppendRowsRequest; transport-level transient
+        failures retry under the destination policy (the gRPC library's
+        internal retries in the reference); Storage Write STATUS errors come
+        back inside the response proto and are classified by the caller."""
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        headers = {"Content-Type": "application/x-protobuf"}
+        if self.config.auth_token:
+            headers["Authorization"] = f"Bearer {self.config.auth_token}"
+        path = (f"{self._dataset_path()}/tables/{table}"
+                "/streams/_default:appendRows")
+
+        async def attempt() -> bytes:
+            async with self._session.post(
+                    f"{self.config.base_url}{path}", data=body,
+                    headers=headers) as resp:
+                payload = await resp.read()
+                if resp.status >= 400:
+                    raise EtlError(
+                        ErrorKind.DESTINATION_THROTTLED
+                        if http_status_retryable(resp.status)
+                        else ErrorKind.DESTINATION_FAILED,
+                        f"bigquery {resp.status} {path}: "
+                        f"{payload[:200]!r}")
+                return payload
+
+        def retryable(e: BaseException) -> bool:
+            if isinstance(e, EtlError):
+                return e.kind is ErrorKind.DESTINATION_THROTTLED
+            return isinstance(e, (aiohttp.ClientError, OSError))
+
+        return await with_retries(attempt, self.retry, retryable)
+
+    async def _table_exists(self, table: str) -> bool:
+        """GET the table resource (the probe behind NOT_FOUND retry
+        classification, client.rs:600-615). Transient probe failures retry
+        under the destination policy — a flaky probe must not demote a
+        retryable NOT_FOUND into a permanent failure."""
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        headers = {}
+        if self.config.auth_token:
+            headers["Authorization"] = f"Bearer {self.config.auth_token}"
+
+        async def attempt() -> bool:
+            async with self._session.get(
+                    f"{self.config.base_url}{self._dataset_path()}"
+                    f"/tables/{table}", headers=headers) as resp:
+                await resp.read()
+                if resp.status == 200:
+                    return True
+                if resp.status == 404:
+                    return False
+                raise EtlError(
+                    ErrorKind.DESTINATION_THROTTLED
+                    if http_status_retryable(resp.status)
+                    else ErrorKind.DESTINATION_FAILED,
+                    f"bigquery table probe {resp.status} for {table}")
+
+        def retryable(e: BaseException) -> bool:
+            if isinstance(e, EtlError):
+                return e.kind is ErrorKind.DESTINATION_THROTTLED
+            return isinstance(e, (aiohttp.ClientError, OSError))
+
+        return await with_retries(attempt, self.retry, retryable)
+
+    def _retryable_storage_write_detail(self, status) -> str | None:
+        """Schema-propagation classification (client.rs:557-579): structured
+        SCHEMA_MISMATCH_EXTRA_FIELDS in the status details, or the
+        documented message forms when no structured code is present."""
+        if status.code != bq_proto.GRPC_INVALID_ARGUMENT:
+            return None
+        if bq_proto.STORAGE_ERROR_SCHEMA_MISMATCH_EXTRA_FIELDS \
+                in status.storage_error_codes:
+            return status.message or "schema mismatch (structured)"
+        msg = status.message.lower()
+        if ("missing in the proto message" in msg
+                or "extra proto fields" in msg
+                or "schema_mismatch_extra_field" in msg):
+            return status.message
+        return None
+
+    async def _append_rows(self, table: str,
+                           schema: ReplicatedTableSchema,
+                           rows: list[tuple]) -> None:
+        """Proto-encode and append, absorbing locally retryable Storage
+        Write errors (schema propagation; NOT_FOUND while the table exists)
+        within a bounded window — exponential backoff with equal jitter
+        (client.rs:197-216,1224-1285). Row-level errors are permanent."""
+        import random
+        import time as _time
+
+        assert self._append_sem is not None
+        cfg = self.config
+        descriptor = bq_proto.row_descriptor(schema)
+        encoded = [bq_proto.encode_row(schema, values, ct, seq)
+                   for values, ct, seq in rows]
+        stream = self._write_stream(table)
+        started = _time.monotonic()
+        delay = cfg.storage_write_retry_delay_s
+        attempt = 0
+        while True:
+            attempt += 1
+            trace = (f"etl_tpu_{table}_{attempt}_"
+                     f"{random.randrange(2**32)}")
+            body = bq_proto.append_rows_request(
+                stream, descriptor, encoded, trace)
+            # concurrency slot held only for the POST itself — a
+            # propagation backoff (minutes) must not starve other tables'
+            # appends of their slots
+            async with self._append_sem:
+                payload = await self._post_append_proto(table, body)
+            resp = bq_proto.decode_append_rows_response(payload)
+            if resp.row_errors:
+                # permanent: bad data / schema mismatch per row
+                # (client.rs:222-244); row values are NOT echoed
+                first = resp.row_errors[0]
+                raise EtlError(
+                    ErrorKind.DESTINATION_FAILED,
+                    f"bigquery rejected {len(resp.row_errors)} row(s); "
+                    f"first: row {first.index} code {first.code}")
+            status = resp.error
+            if status is None or status.code == bq_proto.GRPC_OK:
+                return
+            detail = self._retryable_storage_write_detail(status)
+            if detail is None \
+                    and status.code == bq_proto.GRPC_NOT_FOUND \
+                    and await self._table_exists(table):
+                # stale default-stream routing after delete/recreate
+                detail = status.message or "storage write NOT_FOUND"
+            if detail is None:
+                raise self._status_to_error(status)
+            elapsed = _time.monotonic() - started
+            remaining = cfg.storage_write_retry_timeout_s - elapsed
+            if remaining <= 0:
+                raise EtlError(
+                    ErrorKind.DESTINATION_THROTTLED,
+                    "bigquery storage write retry timed out after "
+                    f"{cfg.storage_write_retry_timeout_s:.0f}s: {detail}")
+            # equal jitter: [delay/2, delay], capped by the window
+            sleep_s = min(delay / 2 + random.random() * (delay / 2),
+                          remaining)
+            await asyncio.sleep(sleep_s)
+            delay = min(delay * 2, cfg.storage_write_max_retry_delay_s)
+
+    @staticmethod
+    def _status_to_error(status) -> EtlError:
+        """gRPC code → error kind (client.rs:416-470): transient server
+        conditions map to the retryable kind so the worker-level timed
+        retry policy takes over; precondition/auth failures are final."""
+        transient = {bq_proto.GRPC_UNAVAILABLE, bq_proto.GRPC_INTERNAL,
+                     bq_proto.GRPC_ABORTED, bq_proto.GRPC_CANCELLED,
+                     bq_proto.GRPC_DEADLINE_EXCEEDED,
+                     bq_proto.GRPC_RESOURCE_EXHAUSTED}
+        kind = ErrorKind.DESTINATION_THROTTLED \
+            if status.code in transient else ErrorKind.DESTINATION_FAILED
+        return EtlError(kind, f"bigquery storage write error "
+                              f"(grpc code {status.code}): {status.message}")
+
+    def _row_tuple(self, schema: ReplicatedTableSchema, row: TableRow,
+                   ct: ChangeType, seq: str) -> tuple:
         if ct is not ChangeType.DELETE:
             require_full_row("bigquery", schema, row)
-        doc = {c.name: encode_value(v, c.kind)
-               for c, v in zip(schema.replicated_columns, row.values)}
-        doc[CHANGE_TYPE_COLUMN] = change_type_label(ct)
-        doc[CHANGE_SEQUENCE_COLUMN] = seq
-        return doc
+        return (list(row.values), change_type_label(ct), seq)
 
     def _rows_from_batch(self, schema: ReplicatedTableSchema,
                          batch: ColumnarBatch,
-                         ev: DecodedBatchEvent | None) -> list[dict]:
+                         ev: DecodedBatchEvent | None) -> list[tuple]:
         require_full_batch("bigquery", schema, batch,
                            ev.change_types if ev is not None else None)
-        cols = schema.replicated_columns
         out = []
         for i in range(batch.num_rows):
-            doc = {c.schema.name: encode_value(c.value(i), c.schema.kind)
-                   for c in batch.columns}
+            values = [c.value(i) for c in batch.columns]
             if ev is not None:
-                doc[CHANGE_TYPE_COLUMN] = change_type_label(
-                    ChangeType(int(ev.change_types[i])))
-                doc[CHANGE_SEQUENCE_COLUMN] = (
-                    f"{int(ev.commit_lsns[i]):016x}/"
-                    f"{int(ev.tx_ordinals[i]):016x}/{i:016x}")
+                ct = change_type_label(ChangeType(int(ev.change_types[i])))
+                seq = (f"{int(ev.commit_lsns[i]):016x}/"
+                       f"{int(ev.tx_ordinals[i]):016x}/{i:016x}")
             else:
-                doc[CHANGE_TYPE_COLUMN] = "UPSERT"
-                doc[CHANGE_SEQUENCE_COLUMN] = f"{0:016x}/{0:016x}/{i:016x}"
-            out.append(doc)
+                ct = "UPSERT"
+                seq = f"{0:016x}/{0:016x}/{i:016x}"
+            out.append((values, ct, seq))
         return out
 
     async def _apply_schema_change(self, ev: SchemaChangeEvent) -> None:

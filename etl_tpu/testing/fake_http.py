@@ -73,6 +73,10 @@ class RecordingHttpServer:
             out = responder(rec)
             if out is not None:
                 status, doc = out
+                if isinstance(doc, (bytes, bytearray)):
+                    return web.Response(
+                        body=bytes(doc), status=status,
+                        content_type="application/x-protobuf")
                 return web.json_response(doc, status=status)
         return web.json_response({}, status=200)
 

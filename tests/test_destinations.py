@@ -24,9 +24,19 @@ from etl_tpu.models import (ChangeType, ColumnSchema, ColumnarBatch,
                             DeleteEvent, InsertEvent, Lsn, Oid, PgNumeric,
                             ReplicatedTableSchema, TableName, TableRow,
                             TableSchema, TruncateEvent, UpdateEvent)
+from etl_tpu.testing.fake_bq import StorageWriteFake
 from etl_tpu.testing.fake_http import RecordingHttpServer
 
 TID = 700
+
+
+async def bq_server():
+    """RecordingHttpServer with a validating Storage Write proto fake."""
+    server = RecordingHttpServer()
+    fake = StorageWriteFake()
+    server.responders.append(fake)
+    await server.start()
+    return server, fake
 
 
 def make_schema():
@@ -194,8 +204,7 @@ class TestBigQuery:
                               base_url=server.url())
 
     async def test_copy_cdc_and_sequence_keys(self):
-        server = RecordingHttpServer()
-        await server.start()
+        server, fake = await bq_server()
         try:
             d = BigQueryDestination(self.config(server), RETRY_FAST)
             await d.startup()
@@ -209,12 +218,15 @@ class TestBigQuery:
             ])
             assert not ack.is_durable  # Accepted: background append
             await ack.wait_durable()
-            appends = [r for r in server.requests
-                       if r.path.endswith("/appendRows")]
-            assert len(appends) == 2
-            rows = appends[1].json["rows"]
+            assert len(fake.appends) == 2
+            # the fake DECODED the proto rows against the carried writer
+            # schema; typed values round-tripped through the wire format
+            rows = fake.appends[1][2]
             assert rows[0]["_CHANGE_TYPE"] == "UPSERT"
+            assert rows[0]["id"] == 2 and rows[0]["note"] == "b"
+            assert rows[0]["amount"] == "7"  # NUMERIC travels as text
             assert rows[1]["_CHANGE_TYPE"] == "DELETE"
+            assert rows[1]["id"] == 1 and "note" not in rows[1]  # NULL omitted
             assert rows[0]["_CHANGE_SEQUENCE_NUMBER"] < \
                 rows[1]["_CHANGE_SEQUENCE_NUMBER"]
             creates = [r for r in server.requests
@@ -226,8 +238,7 @@ class TestBigQuery:
             await server.stop()
 
     async def test_truncate_versioned_successor(self):
-        server = RecordingHttpServer()
-        await server.start()
+        server, fake = await bq_server()
         try:
             d = BigQueryDestination(self.config(server), RETRY_FAST)
             await d.startup()
@@ -241,9 +252,8 @@ class TestBigQuery:
             # new generation table + repointed view + append to table_1
             assert any("/tables" in p for p in paths)
             assert any(p.endswith("/views") for p in paths)
-            last_append = [r for r in server.requests
-                           if r.path.endswith("/appendRows")][-1]
-            assert "_1/appendRows" in last_append.path
+            assert fake.appends[-1][0] == "public_user__events_1"
+            assert fake.rows_for("public_user__events_1")[0]["id"] == 5
             await d.shutdown()
         finally:
             await server.stop()
@@ -251,8 +261,7 @@ class TestBigQuery:
     async def test_failed_append_fails_ack(self):
         from etl_tpu.models.errors import EtlError
 
-        server = RecordingHttpServer()
-        await server.start()
+        server, fake = await bq_server()
         try:
             d = BigQueryDestination(self.config(server), RETRY_FAST)
             await d.startup()
@@ -262,6 +271,219 @@ class TestBigQuery:
             ack = await d.write_events([ins(1, [1, "x", None])])
             with pytest.raises(EtlError):
                 await ack.wait_durable()
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+
+class TestBigQueryStorageWrite:
+    """Fault injection against the Storage Write proto wire format —
+    reference retry/propagation semantics (bigquery/client.rs:317-450,
+    551-650, 1224-1285)."""
+
+    def config(self, server, timeout_s=5.0):
+        return BigQueryConfig(
+            project_id="p", dataset_id="ds", base_url=server.url(),
+            storage_write_retry_timeout_s=timeout_s,
+            storage_write_retry_delay_s=0.01,
+            storage_write_max_retry_delay_s=0.05)
+
+    async def _dest(self, server, **kw):
+        d = BigQueryDestination(self.config(server, **kw), RETRY_FAST)
+        await d.startup()
+        return d
+
+    async def test_proto_framing_round_trip(self):
+        """Typed values survive the real proto wire format: ints as
+        varints, numerics/dates as strings, floats as fixed64."""
+        import datetime as dt
+
+        from etl_tpu.destinations import bq_proto
+
+        schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+            TID, TableName("public", "wide"),
+            (ColumnSchema("i", Oid.INT8, nullable=False,
+                          primary_key_ordinal=1),
+             ColumnSchema("f", Oid.FLOAT8),
+             ColumnSchema("d", Oid.DATE),
+             ColumnSchema("ts", Oid.TIMESTAMPTZ),
+             ColumnSchema("tags", Oid.TEXT_ARRAY),
+             ColumnSchema("ns", Oid.INT4_ARRAY))))
+        row = bq_proto.encode_row(
+            schema,
+            [-(2**62), 1.5, dt.date(2024, 5, 1),
+             dt.datetime(2024, 5, 1, 12, 0, tzinfo=dt.timezone.utc),
+             ["a", "b"], [1, -2, 3]],
+            "UPSERT", "0001/0002/0003")
+        req = bq_proto.append_rows_request(
+            "projects/p/datasets/ds/tables/wide/streams/_default",
+            bq_proto.row_descriptor(schema), [row], "trace-1")
+        decoded = bq_proto.decode_append_rows_request(req)
+        rows = decoded.decode_rows()
+        assert rows[0]["i"] == -(2**62)
+        assert rows[0]["f"] == 1.5
+        assert rows[0]["d"] == "2024-05-01"
+        assert rows[0]["ts"] == 1714564800000000  # instant micros (int64)
+        assert rows[0]["tags"] == ["a", "b"]
+        assert rows[0]["ns"] == [1, -2, 3]
+        assert rows[0]["_CHANGE_TYPE"] == "UPSERT"
+        assert rows[0]["_CHANGE_SEQUENCE_NUMBER"] == "0001/0002/0003"
+        assert decoded.trace_id == "trace-1"
+
+    async def test_infinity_timestamptz_fails_fast(self):
+        """'infinity' has no int64-micros instant: the encoder must raise
+        a typed error, not emit a string into an INT64-declared field
+        (validate-then-encode, reference validation.rs stance)."""
+        from etl_tpu.destinations import bq_proto
+        from etl_tpu.models.errors import ErrorKind, EtlError
+        from etl_tpu.postgres.codec.text import parse_cell_text
+
+        schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+            TID, TableName("public", "ts"),
+            (ColumnSchema("id", Oid.INT8, nullable=False,
+                          primary_key_ordinal=1),
+             ColumnSchema("at", Oid.TIMESTAMPTZ))))
+        inf = parse_cell_text("infinity", Oid.TIMESTAMPTZ)
+        with pytest.raises(EtlError) as ei:
+            bq_proto.encode_row(schema, [1, inf], "UPSERT", "0/0/0")
+        assert ei.value.kind is ErrorKind.ROW_CONVERSION_FAILED
+
+    async def test_schema_propagation_retries_then_succeeds(self):
+        """InvalidArgument + SCHEMA_MISMATCH_EXTRA_FIELDS in the status
+        details is absorbed by the LOCAL retry loop (client.rs:557-579):
+        the append succeeds once propagation completes, the ack resolves
+        durable, and the same rows were re-sent."""
+        from etl_tpu.destinations import bq_proto
+
+        server, fake = await bq_server()
+        try:
+            d = await self._dest(server)
+            fake.script_status(
+                bq_proto.GRPC_INVALID_ARGUMENT, "schema mismatch",
+                bq_proto.STORAGE_ERROR_SCHEMA_MISMATCH_EXTRA_FIELDS,
+                times=2)
+            ack = await d.write_events([ins(0, [1, "x", None])])
+            await ack.wait_durable()
+            assert len(fake.attempts) == 3  # 2 rejected + 1 accepted
+            assert len(fake.appends) == 1
+            assert fake.appends[0][2][0]["id"] == 1
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_schema_propagation_message_form_retries(self):
+        """Unstructured message fallback: 'missing in the proto message'
+        without a storage error code still classifies as propagation."""
+        from etl_tpu.destinations import bq_proto
+
+        server, fake = await bq_server()
+        try:
+            d = await self._dest(server)
+            fake.script_status(
+                bq_proto.GRPC_INVALID_ARGUMENT,
+                "Input schema has more fields than BigQuery schema, "
+                "extra proto fields: note2")
+            ack = await d.write_events([ins(0, [1, "x", None])])
+            await ack.wait_durable()
+            assert len(fake.attempts) == 2
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_not_found_with_existing_table_retries(self):
+        """Storage Write NOT_FOUND can be stale default-stream routing
+        after delete/recreate: retry only when the table API confirms the
+        table exists (client.rs:600-615)."""
+        from etl_tpu.destinations import bq_proto
+
+        server, fake = await bq_server()
+        try:
+            d = await self._dest(server)
+            fake.script_status(bq_proto.GRPC_NOT_FOUND,
+                               "Requested entity was not found")
+            ack = await d.write_events([ins(0, [1, "x", None])])
+            await ack.wait_durable()
+            assert len(fake.attempts) == 2
+            # the probe hit the table API between attempts
+            probes = [r for r in server.requests if r.method == "GET"
+                      and "/tables/public_user__events" in r.path]
+            assert probes
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_not_found_with_missing_table_fails(self):
+        from etl_tpu.destinations import bq_proto
+        from etl_tpu.models.errors import EtlError
+
+        server, fake = await bq_server()
+        try:
+            d = await self._dest(server)
+            ack0 = await d.write_events([ins(0, [0, "warm", None])])
+            await ack0.wait_durable()
+            fake.missing_tables.add("public_user__events")
+            fake.script_status(bq_proto.GRPC_NOT_FOUND,
+                               "Requested entity was not found")
+            ack = await d.write_events([ins(1, [1, "x", None])])
+            with pytest.raises(EtlError):
+                await ack.wait_durable()
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_propagation_retry_window_bounded(self):
+        """When BigQuery never accepts, the local window expires with the
+        RETRYABLE kind so the worker-level timed policy takes over
+        (client.rs:322-334)."""
+        from etl_tpu.destinations import bq_proto
+        from etl_tpu.models.errors import ErrorKind, EtlError
+
+        server, fake = await bq_server()
+        try:
+            d = await self._dest(server, timeout_s=0.05)
+            fake.script_status(
+                bq_proto.GRPC_INVALID_ARGUMENT, "schema mismatch",
+                bq_proto.STORAGE_ERROR_SCHEMA_MISMATCH_EXTRA_FIELDS,
+                times=1000)
+            ack = await d.write_events([ins(0, [1, "x", None])])
+            with pytest.raises(EtlError) as ei:
+                await ack.wait_durable()
+            assert ei.value.kind is ErrorKind.DESTINATION_THROTTLED
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_row_errors_are_permanent(self):
+        from etl_tpu.models.errors import ErrorKind, EtlError
+
+        server, fake = await bq_server()
+        try:
+            d = await self._dest(server)
+            fake.script_row_error(0, 3, "invalid value")
+            ack = await d.write_events([ins(0, [1, "x", None])])
+            with pytest.raises(EtlError) as ei:
+                await ack.wait_durable()
+            assert ei.value.kind is ErrorKind.DESTINATION_FAILED
+            assert len(fake.attempts) == 1  # no retry for row errors
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_transient_grpc_code_maps_to_retryable_kind(self):
+        from etl_tpu.destinations import bq_proto
+        from etl_tpu.models.errors import ErrorKind, EtlError
+
+        server, fake = await bq_server()
+        try:
+            d = await self._dest(server)
+            fake.script_status(bq_proto.GRPC_UNAVAILABLE,
+                               "Task is overloaded", times=1000)
+            ack = await d.write_events([ins(0, [1, "x", None])])
+            with pytest.raises(EtlError) as ei:
+                await ack.wait_durable()
+            # not locally retryable (not propagation/NOT_FOUND) — surfaces
+            # immediately with the kind the worker retry policy times
+            assert ei.value.kind is ErrorKind.DESTINATION_THROTTLED
             await d.shutdown()
         finally:
             await server.stop()
@@ -404,8 +626,7 @@ class TestWalOrderBarriers:
             await server.stop()
 
     async def test_bigquery_order(self):
-        server = RecordingHttpServer()
-        await server.start()
+        server, fake = await bq_server()
         try:
             d = BigQueryDestination(
                 BigQueryConfig(project_id="p", dataset_id="ds",
@@ -413,13 +634,11 @@ class TestWalOrderBarriers:
             await d.startup()
             ack = await d.write_events(self.mixed_batch())
             await ack.wait_durable()
-            appends = [r for r in server.requests
-                       if r.path.endswith("/appendRows")]
-            assert len(appends) == 2
+            assert len(fake.appends) == 2
             # pre-truncate append went to the generation-0 table, the
             # post-truncate one to the versioned successor
-            assert "_1/" not in appends[0].path
-            assert "_1/" in appends[1].path
+            assert fake.appends[0][0] == "public_user__events"
+            assert fake.appends[1][0] == "public_user__events_1"
             await d.shutdown()
         finally:
             await server.stop()
