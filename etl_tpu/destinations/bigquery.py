@@ -88,10 +88,18 @@ _BQ_TYPES: dict[CellKind, str] = {
 
 
 def bq_field(col, identity: set[str]) -> dict:
+    from ..models.default_expression import column_default_sql
+
     # non-identity columns stay NULLABLE so key-only DELETE rows append
     required = not col.nullable and col.name in identity
-    return {"name": col.name, "type": _BQ_TYPES.get(col.kind, "STRING"),
-            "mode": "REQUIRED" if required else "NULLABLE"}
+    out = {"name": col.name, "type": _BQ_TYPES.get(col.kind, "STRING"),
+           "mode": "REQUIRED" if required else "NULLABLE"}
+    # portable literal defaults (reference default_expression.rs →
+    # bigquery/schema.rs:28-36); unsupported source defaults are omitted
+    default = column_default_sql(col, "bigquery")
+    if default is not None:
+        out["defaultValueExpression"] = default
+    return out
 
 
 def encode_value(v: Any, kind: CellKind) -> Any:

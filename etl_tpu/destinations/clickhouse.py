@@ -91,13 +91,19 @@ def clickhouse_type(kind: CellKind, nullable: bool) -> str:
 def create_table_sql(database: str, table: str,
                      schema: ReplicatedTableSchema,
                      engine: ClickHouseEngine) -> str:
+    from ..models.default_expression import column_default_sql
+
     cols = []
     identity = {c.name for c in schema.identity_columns()}
     for c in schema.replicated_columns:
         # CDC tables must accept key-only DELETE rows: every non-identity
         # column is nullable at the destination regardless of source schema
         nullable = c.nullable or c.name not in identity
-        cols.append(f"`{c.name}` {clickhouse_type(c.kind, nullable)}")
+        spec = f"`{c.name}` {clickhouse_type(c.kind, nullable)}"
+        default = column_default_sql(c, "clickhouse")
+        if default is not None:
+            spec += f" DEFAULT {default}"
+        cols.append(spec)
     cols.append(f"`{CHANGE_TYPE_COLUMN}` String")
     cols.append(f"`{CHANGE_SEQUENCE_COLUMN}` String")
     pk = [c.name for c in schema.identity_columns()] or \
@@ -302,13 +308,26 @@ class ClickHouseDestination(Destination):
             self._created_tables.pop(ev.table_id, None)
             await self._ensure_table(new)
             return
+        from ..models.default_expression import column_default_sql
+
         diff = SchemaDiff.between(old.table_schema, new.table_schema)
         name = self._table_name(new)
+        identity = {c.name for c in new.identity_columns()}
         for col in diff.added:
-            await self._execute(
-                f"ALTER TABLE `{self.config.database}`.`{name}` ADD COLUMN "
-                f"IF NOT EXISTS `{col.name}` "
-                f"{clickhouse_type(col.kind, col.nullable)}")
+            # same forced-nullable rule as create_table_sql: non-identity
+            # columns must accept the NULLs key-only DELETE rows carry
+            nullable = col.nullable or col.name not in identity
+            # classified portable defaults travel into the ADD COLUMN DDL
+            # (reference default_expression.rs); non-portable ones
+            # (nextval/now()/expressions) are omitted — rows carry
+            # explicit values, the column backfills NULL
+            ddl = (f"ALTER TABLE `{self.config.database}`.`{name}` "
+                   f"ADD COLUMN IF NOT EXISTS `{col.name}` "
+                   f"{clickhouse_type(col.kind, nullable)}")
+            default = column_default_sql(col, "clickhouse")
+            if default is not None:
+                ddl += f" DEFAULT {default}"
+            await self._execute(ddl)
         for col in diff.dropped:
             await self._execute(
                 f"ALTER TABLE `{self.config.database}`.`{name}` DROP COLUMN "

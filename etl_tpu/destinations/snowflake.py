@@ -154,10 +154,18 @@ class SnowflakeDestination(Destination):
         identity = {c.name for c in schema.identity_columns()}
         # non-identity columns stay nullable: key-only DELETE rows carry
         # nulls for them
-        cols = [f'"{c.name}" {_SF_TYPES.get(c.kind, "VARCHAR")}'
-                + (" NOT NULL" if not c.nullable and c.name in identity
-                   else "")
-                for c in schema.replicated_columns]
+        from ..models.default_expression import column_default_sql
+
+        def spec(c):
+            s = f'"{c.name}" {_SF_TYPES.get(c.kind, "VARCHAR")}'
+            default = column_default_sql(c, "snowflake")
+            if default is not None:
+                s += f" DEFAULT {default}"
+            if not c.nullable and c.name in identity:
+                s += " NOT NULL"
+            return s
+
+        cols = [spec(c) for c in schema.replicated_columns]
         cols.append(f'"{CHANGE_TYPE_COLUMN}" VARCHAR(6)')
         cols.append(f'"{CHANGE_SEQUENCE_COLUMN}" VARCHAR(64)')
         await self._sql(f'CREATE TABLE IF NOT EXISTS "{name}" '
@@ -235,10 +243,16 @@ class SnowflakeDestination(Destination):
             await self._ensure_table(new)
             return
         name = self._table_name(new)
+        from ..models.default_expression import column_default_sql
+
         diff = SchemaDiff.between(old.table_schema, new.table_schema)
         for col in diff.added:
-            await self._sql(f'ALTER TABLE "{name}" ADD COLUMN IF NOT EXISTS '
-                            f'"{col.name}" {_SF_TYPES.get(col.kind, "VARCHAR")}')
+            ddl = (f'ALTER TABLE "{name}" ADD COLUMN IF NOT EXISTS '
+                   f'"{col.name}" {_SF_TYPES.get(col.kind, "VARCHAR")}')
+            default = column_default_sql(col, "snowflake")
+            if default is not None:
+                ddl += f" DEFAULT {default}"
+            await self._sql(ddl)
         for col in diff.dropped:
             await self._sql(f'ALTER TABLE "{name}" DROP COLUMN IF EXISTS '
                             f'"{col.name}"')

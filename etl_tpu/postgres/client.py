@@ -22,6 +22,7 @@ from ..models.lsn import Lsn
 from ..models.schema import (ColumnMask, ColumnSchema, ReplicatedTableSchema,
                              TableId, TableName, TableSchema)
 from .codec import pgoutput
+from .version import POSTGRES_15, meets_version, parse_server_version
 from .source import (CopyStream, CreatedSlot, ReplicationSource,
                      ReplicationStream, SlotInfo)
 from .wire import PgServerError, PgWireConnection
@@ -170,7 +171,7 @@ class PgReplicationClient(ReplicationSource):
         self._conn = self._new_conn(replication=True)
         await self._conn.connect()
         ver = self._conn.parameters.get("server_version", "0")
-        self.server_version = _parse_server_version(ver)
+        self.server_version = parse_server_version(ver)
 
     async def close(self) -> None:
         if self._conn is not None:
@@ -232,19 +233,23 @@ class PgReplicationClient(ReplicationSource):
                              name=TableName(nspname, relname),
                              columns=columns)
         n = len(columns)
-        # PG15+ publication column lists (transaction.rs:768)
+        # publication column lists exist only on PG15+ (version gate per
+        # reference transaction.rs:268 — pg_publication_tables.attnames is
+        # not even a column on 14, the query would error); pre-15 every
+        # column replicates
         repl_mask = ColumnMask.all_set(n)
-        filt = await self.conn.query(
-            "SELECT pt.attnames FROM pg_publication_tables pt "
-            "JOIN pg_namespace ns ON ns.nspname = pt.schemaname "
-            "JOIN pg_class pc ON pc.relnamespace = ns.oid "
-            "AND pc.relname = pt.tablename "
-            f"WHERE pt.pubname = {_quote_literal(publication)} "
-            f"AND pc.oid = {int(table_id)}")
-        if filt.rows and filt.rows[0][0] is not None:
-            names = _parse_name_array(filt.rows[0][0])
-            if names:
-                repl_mask = ColumnMask.from_column_names(schema, names)
+        if meets_version(self.server_version, POSTGRES_15):
+            filt = await self.conn.query(
+                "SELECT pt.attnames FROM pg_publication_tables pt "
+                "JOIN pg_namespace ns ON ns.nspname = pt.schemaname "
+                "JOIN pg_class pc ON pc.relnamespace = ns.oid "
+                "AND pc.relname = pt.tablename "
+                f"WHERE pt.pubname = {_quote_literal(publication)} "
+                f"AND pc.oid = {int(table_id)}")
+            if filt.rows and filt.rows[0][0] is not None:
+                names = _parse_name_array(filt.rows[0][0])
+                if names:
+                    repl_mask = ColumnMask.from_column_names(schema, names)
         identity = ColumnMask(c.is_primary_key for c in columns)
         if identity.count() == 0 and replident == "f":
             identity = ColumnMask.all_set(n)
@@ -373,17 +378,27 @@ class PgReplicationClient(ReplicationSource):
         qualified = TableName(r.rows[0][0], r.rows[0][1]).quoted()
         pub_oid = int(publication_table_id
                       if publication_table_id is not None else table_id)
-        filt = await conn.query(
-            "SELECT pt.attnames, pt.rowfilter FROM pg_publication_tables pt "
-            "JOIN pg_namespace ns ON ns.nspname = pt.schemaname "
-            "JOIN pg_class pc ON pc.relnamespace = ns.oid "
-            "AND pc.relname = pt.tablename "
-            f"WHERE pt.pubname = {_quote_literal(publication)} "
-            f"AND pc.oid = {pub_oid}")
-        rowfilter = filt.rows[0][1] if filt.rows and len(filt.rows[0]) > 1             else None
-        if filt.rows and filt.rows[0][0]:
-            names = _parse_name_array(filt.rows[0][0])
-        else:
+        # attnames/rowfilter are PG15+ columns; on 14 the COPY takes every
+        # column and no predicate exists (reference transaction.rs:661:
+        # "Row filters on publications were added in Postgres 15")
+        ver = parse_server_version(
+            conn.parameters.get("server_version", "0"))
+        rowfilter = None
+        names: list[str] = []
+        if meets_version(ver, POSTGRES_15):
+            filt = await conn.query(
+                "SELECT pt.attnames, pt.rowfilter "
+                "FROM pg_publication_tables pt "
+                "JOIN pg_namespace ns ON ns.nspname = pt.schemaname "
+                "JOIN pg_class pc ON pc.relnamespace = ns.oid "
+                "AND pc.relname = pt.tablename "
+                f"WHERE pt.pubname = {_quote_literal(publication)} "
+                f"AND pc.oid = {pub_oid}")
+            rowfilter = filt.rows[0][1] \
+                if filt.rows and len(filt.rows[0]) > 1 else None
+            if filt.rows and filt.rows[0][0]:
+                names = _parse_name_array(filt.rows[0][0])
+        if not names:
             cols = await conn.query(
                 f"SELECT a.attname FROM pg_attribute a WHERE a.attrelid = "
                 f"{int(table_id)} AND a.attnum > 0 AND NOT a.attisdropped "
@@ -426,16 +441,6 @@ class PgReplicationClient(ReplicationSource):
             await conn.close()
             raise
         return _WireReplicationStream(conn)
-
-
-def _parse_server_version(raw: str) -> int:
-    """'15.4' → 150004; '16beta1 (Debian...)' → 160000."""
-    import re
-
-    m = re.match(r"(\d+)(?:\.(\d+))?", raw.split()[0] if raw else "")
-    if not m:
-        return 0
-    return int(m.group(1)) * 10000 + int(m.group(2) or 0)
 
 
 def _parse_name_array(raw) -> list[str]:

@@ -119,6 +119,7 @@ class _LoopState:
     batch_commit_end: Lsn | None = None  # last commit boundary inside batch
     last_status_flush_lsn: Lsn = Lsn.ZERO  # flush LSN last reported upstream
     tx_bytes: int = 0  # payload bytes since the current BEGIN
+    in_transaction: bool = False  # between BEGIN and COMMIT
 
 
 class ApplyLoop:
@@ -408,10 +409,12 @@ class ApplyLoop:
             st.current_commit_lsn = msg.final_lsn
             st.tx_ordinal = 0
             st.tx_bytes = 0
+            st.in_transaction = True
             self.assembler.push_control(event_codec.decode_begin(msg, start_lsn))
         elif isinstance(msg, pgoutput.CommitMessage):
             ev = event_codec.decode_commit(msg, start_lsn)
             self.assembler.push_control(ev)
+            st.in_transaction = False
             st.last_commit_end_lsn = ev.end_lsn
             st.batch_commit_end = ev.end_lsn
             registry.counter_inc(ETL_TRANSACTIONS_TOTAL)
@@ -555,6 +558,27 @@ class ApplyLoop:
         for tid in await self.store.get_table_ids_with_schemas():
             await self.store.prune_schema_versions(tid, snapshot)
 
+    def _is_idle(self) -> bool:
+        """No open transaction, nothing assembled, nothing in flight, no
+        commit boundary awaiting durability (apply.rs:885-889). Only then
+        may keepalive progress be reported as flushed."""
+        return (not self.state.in_transaction
+                and len(self.assembler) == 0
+                and self._in_flight is None
+                and self.state.batch_commit_end is None)
+
+    def _effective_flush_lsn(self) -> Lsn:
+        """Flush LSN for standby feedback (apply.rs:891-912): when IDLE the
+        last received LSN — so the slot advances past unpublished/keepalive
+        WAL instead of pinning retention — otherwise the durable commit
+        floor. Idle-only advances are deliberately NOT persisted as durable
+        progress; monotonicity is enforced against the last report (a
+        post-idle transaction would otherwise jump the LSN back)."""
+        effective = self.state.received_lsn if self._is_idle() \
+            else self.state.durable_lsn
+        return max(effective, self.state.durable_lsn,
+                   self.state.last_status_flush_lsn)
+
     async def _send_status_update(self) -> None:
         failpoints.fail_point(failpoints.ON_STATUS_UPDATE)
         registry.gauge_set(ETL_APPLY_LOOP_FLUSH_LAG_BYTES,
@@ -562,11 +586,12 @@ class ApplyLoop:
         registry.gauge_set(
             ETL_APPLY_LOOP_RECEIVED_LAG_BYTES,
             max(0, self.state.server_end_lsn - self.state.received_lsn))
-        self.state.last_status_flush_lsn = self.state.durable_lsn
+        flush = self._effective_flush_lsn()
+        self.state.last_status_flush_lsn = flush
         await self.stream.send_status_update(
             written=self.state.received_lsn,
-            flushed=self.state.durable_lsn,
-            applied=self.state.durable_lsn)
+            flushed=flush,
+            applied=flush)
 
     # -- table-sync coordination (apply context) --------------------------------
 

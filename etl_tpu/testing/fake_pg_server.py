@@ -90,14 +90,23 @@ class FakePgServer:
     `('127.0.0.1', server.port)`."""
 
     def __init__(self, db: FakeDatabase, *, password: str | None = None,
-                 keepalive_interval_s: float = 0.05):
+                 keepalive_interval_s: float = 0.05,
+                 server_version: str = "16.3"):
         self.db = db
         self.password = password  # None = trust auth
         self.keepalive_interval_s = keepalive_interval_s
+        self.server_version = server_version
         self._server: asyncio.AbstractServer | None = None
         self.port = 0
         self.connections = 0
+        self.queries: list[str] = []  # every simple-query SQL, in order
         self._writers: set[asyncio.StreamWriter] = set()
+
+    @property
+    def version_num(self) -> int:
+        from ..postgres.version import parse_server_version
+
+        return parse_server_version(self.server_version)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
@@ -168,7 +177,8 @@ class FakePgServer:
             if not await self._scram(sess):
                 return False
         w.write(_msg(b"R", struct.pack(">i", 0)))  # AuthenticationOk
-        w.write(_msg(b"S", _cstr("server_version") + _cstr("16.3")))
+        w.write(_msg(b"S", _cstr("server_version")
+                     + _cstr(self.server_version)))
         w.write(_msg(b"S", _cstr("client_encoding") + _cstr("UTF8")))
         w.write(_msg(b"K", struct.pack(">ii", os.getpid(), 12345)))
         w.write(READY)
@@ -279,6 +289,16 @@ class FakePgServer:
         w = sess.writer
         db = self.db
         norm = " ".join(sql.split())
+        self.queries.append(norm)
+        if self.version_num < 150000 and ("pt.attnames" in norm
+                                          or "pt.rowfilter" in norm):
+            # faithful PG14: publication column lists / row filters don't
+            # exist — the catalog columns are absent, queries ERROR
+            w.write(_error("42703",
+                           'column pt.attnames does not exist'))
+            w.write(READY)
+            await w.drain()
+            return
         try:
             handled = await self._try_handle(sess, norm, sql)
         except Exception as e:  # surface as server error, keep session alive

@@ -757,3 +757,125 @@ class TestKeyChangingUpdate:
         assert set(recs) == {9, 2}, "old-identity row 1 must be deleted"
         assert recs[9]["note"] == "a2"
         await dest.shutdown()
+
+
+class TestDefaultExpressions:
+    """Portable default classification → destination DDL (reference
+    etl-postgres/src/default_expression.rs + bigquery/schema.rs:28-36).
+    Literal defaults travel; now()/serial/expressions are must-backfill
+    and omitted."""
+
+    def test_parser_classification_matches_reference_vectors(self):
+        from etl_tpu.models.default_expression import (
+            DefaultKind, parse_default_expression as p)
+        from etl_tpu.models.pgtypes import CellKind as K
+
+        # reference default_expression.rs test vectors
+        assert p("'pending'::text", K.STRING).text == "pending"
+        assert p("('don''t'::text)", K.STRING).text == "don't"  # unescaped
+        assert p("42", K.I32) == \
+            p("'42'::integer", K.I32)
+        assert p("42", K.I32).kind is DefaultKind.NUMERIC
+        assert p("false", K.BOOL).kind is DefaultKind.BOOLEAN
+        assert p("true::text", K.STRING).text == "true"
+        assert p("42::text", K.STRING).text == "42"
+        assert p("'true'::boolean", K.BOOL).text == "true"
+        assert p("'42.10'::numeric(10,2)", K.NUMERIC).text == "42.10"
+        assert p("'abc'::text", K.I32) is None  # not numeric-shaped
+        assert p("'2024-05-01'::date", K.DATE).kind is DefaultKind.DATE
+        assert p("'2024-05-01'::date", K.DATE).text == "2024-05-01"
+
+    def test_portability_boundaries_are_must_backfill(self):
+        from etl_tpu.models.default_expression import parse_default_expression as p
+        from etl_tpu.models.pgtypes import CellKind as K
+
+        assert p("nextval('t_id_seq'::regclass)", K.I64) is None  # serial
+        assert p("now()", K.TIMESTAMPTZ) is None
+        assert p("CURRENT_TIMESTAMP", K.TIMESTAMPTZ) is None
+        assert p("(select 1)", K.I32) is None
+        assert p("ARRAY['a']", K.ARRAY) is None
+        assert p("1 + 2", K.I32) is None
+        assert p("'a' || 'b'", K.STRING) is None
+        assert p(None, K.I32) is None
+        assert p("NULL", K.I32) is None
+
+    def test_clickhouse_ddl_with_defaults(self):
+        schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+            TID, TableName("public", "d"),
+            (ColumnSchema("id", Oid.INT8, nullable=False,
+                          primary_key_ordinal=1,
+                          default_expression="nextval('d_id_seq'::regclass)"),
+             ColumnSchema("status", Oid.TEXT,
+                          default_expression="'pending'::text"),
+             ColumnSchema("n", Oid.INT4, default_expression="42"),
+             ColumnSchema("created", Oid.TIMESTAMPTZ,
+                          default_expression="now()"))))
+        sql = create_table_sql("etl", "d", schema,
+                               ClickHouseEngine.REPLACING_MERGE_TREE)
+        assert "`status` Nullable(String) DEFAULT 'pending'" in sql
+        assert "`n` Nullable(Int32) DEFAULT 42" in sql
+        assert "DEFAULT nextval" not in sql  # serial: must-backfill
+        assert "`created` Nullable(DateTime64(6)) DEFAULT" not in sql
+
+    async def test_clickhouse_add_column_carries_default(self):
+        from etl_tpu.models.event import SchemaChangeEvent
+
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            d = ClickHouseDestination(
+                ClickHouseConfig(url=server.url(), database="etl"),
+                RETRY_FAST)
+            await d.startup()
+            await d.write_events([ins(0, [1, "a", None])])
+            new_schema = TableSchema(
+                TID, TableName("public", "user_events"),
+                (ColumnSchema("id", Oid.INT4, nullable=False,
+                              primary_key_ordinal=1),
+                 ColumnSchema("note", Oid.TEXT),
+                 ColumnSchema("amount", Oid.NUMERIC),
+                 ColumnSchema("state", Oid.TEXT,
+                              default_expression="'new'::text"),
+                 ColumnSchema("seq", Oid.INT8,
+                              default_expression="nextval('s'::regclass)")))
+            await d.write_events([SchemaChangeEvent(
+                Lsn(0x300), Lsn(0x300), TID,
+                ReplicatedTableSchema.with_all_columns(new_schema))])
+            alters = [q for q in server.queries() if "ADD COLUMN" in q]
+            state = [q for q in alters if "`state`" in q]
+            seq = [q for q in alters if "`seq`" in q]
+            assert state and "DEFAULT 'new'" in state[0]
+            assert seq and "DEFAULT" not in seq[0]  # backfill, no DDL default
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    def test_dialect_escaping(self):
+        """Postgres ''-doubling and raw backslashes must be re-escaped per
+        target dialect: GoogleSQL/ClickHouse escape with backslash,
+        Snowflake doubles quotes but treats backslash as an escape,
+        DuckDB is standard-conforming (review finding)."""
+        from etl_tpu.models.default_expression import (
+            parse_default_expression as p, render_default_sql as r)
+        from etl_tpu.models.pgtypes import CellKind as K
+
+        tricky = p("'don''t \\ win'::text", K.STRING)
+        assert tricky.text == "don't \\ win"
+        assert r(tricky, "bigquery") == "'don\\'t \\\\ win'"
+        assert r(tricky, "clickhouse") == "'don\\'t \\\\ win'"
+        assert r(tricky, "snowflake") == "'don''t \\\\ win'"
+        assert r(tricky, "duckdb") == "'don''t \\ win'"
+
+    def test_bigquery_field_default(self):
+        from etl_tpu.destinations.bigquery import bq_field
+
+        col = ColumnSchema("status", Oid.TEXT,
+                           default_expression="'pending'::text")
+        assert bq_field(col, set())["defaultValueExpression"] == "'pending'"
+        col2 = ColumnSchema("at", Oid.TIMESTAMPTZ,
+                            default_expression="now()")
+        assert "defaultValueExpression" not in bq_field(col2, set())
+        col3 = ColumnSchema("d", Oid.DATE,
+                            default_expression="'2024-05-01'::date")
+        assert bq_field(col3, set())["defaultValueExpression"] == \
+            "DATE '2024-05-01'"

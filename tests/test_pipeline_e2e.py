@@ -769,6 +769,60 @@ class TestReadReplica:
         await pipeline.shutdown_and_wait()
 
 
+    async def test_idle_keepalive_advances_slot_past_unpublished_wal(self):
+        """Reference pipeline_read_replica.rs:313: with only UNPUBLISHED /
+        keepalive WAL flowing, the slot's confirmed_flush must advance to
+        the received position (effective flush LSN, apply.rs:891-912) —
+        otherwise an idle pipeline pins the replica's WAL retention —
+        while durable ETL progress stays at the commit-boundary floor."""
+        from etl_tpu.postgres.slots import apply_slot_name
+
+        db = make_db()
+        db.is_standby = True
+        pipeline, store, dest = make_pipeline(db)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        await wait_ready(store, ORDERS)
+        slot_name = apply_slot_name(1)
+        durable_before = await store.get_durable_progress(slot_name)
+        # WAL advances with nothing published: physical-only records; the
+        # stream sees keepalives carrying the new position, no frames
+        db.next_lsn(4096)
+        target = db.current_lsn
+        slot = db.slots[slot_name]
+        await _wait_for(lambda: slot.confirmed_flush >= target)
+        # idle-only advances are NOT persisted as durable progress
+        assert await store.get_durable_progress(slot_name) == durable_before
+        await pipeline.shutdown_and_wait()
+
+    async def test_open_transaction_blocks_idle_flush_advance(self):
+        """Safety inverse: while a transaction is OPEN mid-stream, status
+        updates must keep reporting the durable floor — advancing to the
+        received LSN would let the server discard WAL that is not yet
+        durably applied (apply.rs is_idle, :885-889)."""
+        from etl_tpu.postgres.codec import pgoutput as pg
+        from etl_tpu.postgres.slots import apply_slot_name
+
+        db = make_db()
+        pipeline, store, dest = make_pipeline(db)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        await wait_ready(store, ORDERS)
+        slot_name = apply_slot_name(1)
+        # hand-feed a BEGIN with no COMMIT: transaction stays open
+        commit_at = int(db.current_lsn) + 64 * 8
+        await db.append_wal(pg.encode_begin(commit_at, 1_700_000_000_000_000,
+                                            777))
+        await asyncio.sleep(0.3)  # several keepalive periods
+        db.next_lsn(4096)
+        target = db.current_lsn
+        await asyncio.sleep(0.3)
+        slot = db.slots[slot_name]
+        assert slot.confirmed_flush < target, \
+            "open transaction must pin the reported flush LSN"
+        await pipeline.shutdown_and_wait()
+
+
 PART_ROOT = 17000
 PART_L1 = 17001
 PART_L2 = 17002

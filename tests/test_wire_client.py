@@ -14,7 +14,9 @@ from etl_tpu.config import (BatchConfig, BatchEngine, PgConnectionConfig,
                             PipelineConfig)
 from etl_tpu.destinations import MemoryDestination
 from etl_tpu.models import ErrorKind, EtlError, InsertEvent, Lsn
-from etl_tpu.postgres.client import PgReplicationClient, _parse_server_version
+from etl_tpu.postgres.client import PgReplicationClient
+from etl_tpu.postgres.version import (POSTGRES_15, meets_version,
+                                      parse_server_version)
 from etl_tpu.runtime import Pipeline, TableStateType
 from etl_tpu.store import NotifyingStore
 from etl_tpu.testing.fake_pg_server import FakePgServer
@@ -113,10 +115,13 @@ class TestWireBasics:
             await server.stop()
 
     def test_server_version_parse(self):
-        assert _parse_server_version("15.4") == 150004
-        assert _parse_server_version("16.3 (Debian 16.3-1)") == 160003
-        assert _parse_server_version("17beta1") == 170000
-        assert _parse_server_version("") == 0
+        assert parse_server_version("15.4") == 150004
+        assert parse_server_version("16.3 (Debian 16.3-1)") == 160003
+        assert parse_server_version("17beta1") == 170000
+        assert parse_server_version("") == 0
+        assert meets_version(150004, POSTGRES_15)
+        assert not meets_version(140011, POSTGRES_15)
+        assert not meets_version(0, POSTGRES_15)  # unknown never passes
 
 
 class TestWireReplication:
@@ -294,3 +299,94 @@ class TestDrainBufferedErrorFrame:
             stream.drain_buffered(10)
         # after raising once the stream drains normally again
         assert [f.end_lsn for f in stream.drain_buffered(10)] == [0x300]
+
+
+class TestVersionGates:
+    """PG14/15/17 matrix (reference etl-postgres/src/version.rs +
+    transaction.rs:268,661): publication column lists and row filters are
+    PG15+ catalog columns — on 14 the client must not even issue those
+    queries (the fake, like real PG14, errors with 42703 on pt.attnames)."""
+
+    async def test_pg14_schema_skips_publication_column_query(self):
+        db = make_db()
+        server = await start_server(db, server_version="14.11")
+        try:
+            c = client_for(server)
+            await c.connect()
+            assert c.server_version == 140011
+            schema = await c.get_table_schema(ACCOUNTS, "pub")
+            # all columns replicate pre-15
+            assert [col.name for col in schema.replicated_columns] == \
+                ["id", "name", "balance"]
+            assert not any("pt.attnames" in q for q in server.queries)
+            await c.close()
+        finally:
+            await server.stop()
+
+    @pytest.mark.parametrize("version", ["15.4", "17.0"])
+    async def test_pg15_plus_schema_applies_column_list(self, version):
+        db = make_db()
+        db.create_publication("pub", [ACCOUNTS],
+                              column_filters={ACCOUNTS: ["id", "balance"]})
+        server = await start_server(db, server_version=version)
+        try:
+            c = client_for(server)
+            await c.connect()
+            schema = await c.get_table_schema(ACCOUNTS, "pub")
+            assert [col.name for col in schema.replicated_columns] == \
+                ["id", "balance"]
+            assert any("pt.attnames" in q for q in server.queries)
+            await c.close()
+        finally:
+            await server.stop()
+
+    async def test_pg14_copy_ignores_row_filter_and_survives(self):
+        """A PG14 server has no rowfilter column: the gated client copies
+        every row without issuing the PG15-only query (ungated code would
+        die on 42703)."""
+        db = make_db()
+        db.create_publication(
+            "pub", [ACCOUNTS],
+            row_filters={ACCOUNTS: ("balance >= 0",
+                                    lambda r: r[2] is not None
+                                    and int(r[2]) >= 0)})
+        server = await start_server(db, server_version="14.11")
+        try:
+            c = client_for(server)
+            await c.connect()
+            created = await c.create_slot("supabase_etl_table_sync_9_16384")
+            stream = await c.copy_table_stream(ACCOUNTS, "pub",
+                                               created.snapshot_id)
+            data = b""
+            async for chunk in stream:
+                data += chunk
+            lines = [l for l in data.split(b"\n") if l]
+            assert len(lines) == 3  # no predicate applied pre-15
+            assert not any("pt.rowfilter" in q for q in server.queries)
+            await c.close()
+        finally:
+            await server.stop()
+
+    async def test_pg15_copy_applies_row_filter(self):
+        db = make_db()
+        db.create_publication(
+            "pub", [ACCOUNTS],
+            row_filters={ACCOUNTS: ("balance >= 0",
+                                    lambda r: r[2] is not None
+                                    and int(r[2]) >= 0)})
+        server = await start_server(db, server_version="15.4")
+        try:
+            c = client_for(server)
+            await c.connect()
+            created = await c.create_slot("supabase_etl_table_sync_8_16384")
+            stream = await c.copy_table_stream(ACCOUNTS, "pub",
+                                               created.snapshot_id)
+            data = b""
+            async for chunk in stream:
+                data += chunk
+            lines = [l for l in data.split(b"\n") if l]
+            ids = {l.split(b"\t")[0] for l in lines}
+            assert ids == {b"1", b"3"}
+            await c.close()
+        finally:
+            await server.stop()
