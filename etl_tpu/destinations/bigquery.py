@@ -48,6 +48,7 @@ from ..models.event import (ChangeType, DecodedBatchEvent, DeleteEvent,
 from ..models.pgtypes import CellKind
 from ..models.schema import (ReplicatedTableSchema, SchemaDiff, TableId)
 from ..models.table_row import ColumnarBatch, TableRow
+from ..models.default_expression import column_default_sql
 from . import bq_proto
 from .base import Destination, WriteAck, expand_batch_events
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
@@ -88,8 +89,6 @@ _BQ_TYPES: dict[CellKind, str] = {
 
 
 def bq_field(col, identity: set[str]) -> dict:
-    from ..models.default_expression import column_default_sql
-
     # non-identity columns stay NULLABLE so key-only DELETE rows append
     required = not col.nullable and col.name in identity
     out = {"name": col.name, "type": _BQ_TYPES.get(col.kind, "STRING"),

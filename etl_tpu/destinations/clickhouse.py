@@ -32,6 +32,7 @@ from ..models.event import (BeginEvent, ChangeType, CommitEvent,
                             InsertEvent, RelationEvent, SchemaChangeEvent,
                             TruncateEvent, UpdateEvent)
 from ..models.pgtypes import CellKind
+from ..models.default_expression import column_default_sql
 from ..models.schema import (ReplicatedTableSchema, SchemaDiff, TableId,
                              TableName)
 from ..models.table_row import ColumnarBatch
@@ -91,8 +92,6 @@ def clickhouse_type(kind: CellKind, nullable: bool) -> str:
 def create_table_sql(database: str, table: str,
                      schema: ReplicatedTableSchema,
                      engine: ClickHouseEngine) -> str:
-    from ..models.default_expression import column_default_sql
-
     cols = []
     identity = {c.name for c in schema.identity_columns()}
     for c in schema.replicated_columns:
@@ -308,8 +307,6 @@ class ClickHouseDestination(Destination):
             self._created_tables.pop(ev.table_id, None)
             await self._ensure_table(new)
             return
-        from ..models.default_expression import column_default_sql
-
         diff = SchemaDiff.between(old.table_schema, new.table_schema)
         name = self._table_name(new)
         identity = {c.name for c in new.identity_columns()}

@@ -29,6 +29,7 @@ from ..models.pgtypes import CellKind
 from ..models.schema import ReplicatedTableSchema, TableId
 from ..models.table_row import ColumnarBatch
 from .base import Destination, WriteAck, expand_batch_events
+from ..models.default_expression import column_default_sql
 from .bigquery import encode_value  # same JSON value encoding rules
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
                    DestinationRetryPolicy, change_type_label,
@@ -154,8 +155,6 @@ class SnowflakeDestination(Destination):
         identity = {c.name for c in schema.identity_columns()}
         # non-identity columns stay nullable: key-only DELETE rows carry
         # nulls for them
-        from ..models.default_expression import column_default_sql
-
         def spec(c):
             s = f'"{c.name}" {_SF_TYPES.get(c.kind, "VARCHAR")}'
             default = column_default_sql(c, "snowflake")
@@ -243,8 +242,6 @@ class SnowflakeDestination(Destination):
             await self._ensure_table(new)
             return
         name = self._table_name(new)
-        from ..models.default_expression import column_default_sql
-
         diff = SchemaDiff.between(old.table_schema, new.table_schema)
         for col in diff.added:
             ddl = (f'ALTER TABLE "{name}" ADD COLUMN IF NOT EXISTS '

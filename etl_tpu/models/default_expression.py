@@ -271,7 +271,12 @@ def _classify_string_literal(s: str,
     typed = _TYPED_STRING.get(kind)
     if typed is not None:
         return DefaultExpression(typed, inner)
-    return DefaultExpression(DefaultKind.STRING, inner)
+    if kind in (CellKind.STRING, CellKind.UUID):
+        return DefaultExpression(DefaultKind.STRING, inner)
+    # ARRAY / BYTES / anything unmapped: a quoted literal would be
+    # type-mismatched at the destination (e.g. STRING default on a BQ JSON
+    # array column) — must-backfill, omit the default
+    return None
 
 
 # -- destination rendering ---------------------------------------------------

@@ -122,34 +122,42 @@ class PgWireConnection:
         except (OSError, asyncio.TimeoutError) as e:
             raise EtlError(ErrorKind.SOURCE_CONNECTION_FAILED,
                            f"{self.host}:{self.port}: {e}")
-        if self.ssl_context is not None:
-            await self._start_tls()
-        params = {
-            "user": self.user,
-            "database": self.database,
-            "application_name": self.application_name,
-            "client_encoding": "UTF8",
-        }
-        if self.replication:
-            params["replication"] = "database"
-        body = struct.pack(">i", PROTOCOL_VERSION)
-        for k, v in params.items():
-            body += k.encode() + b"\x00" + v.encode() + b"\x00"
-        body += b"\x00"
-        assert self._writer is not None
-        self._writer.write(struct.pack(">i", len(body) + 4) + body)
-        await self._flush()
-        await self._authenticate()
-        # consume until ReadyForQuery
-        while True:
-            msg = await self._read_message()
-            if msg.tag == b"Z":
-                return
-            if msg.tag == b"S":
-                k, _, v = msg.payload.partition(b"\x00")
-                self.parameters[k.decode()] = v.rstrip(b"\x00").decode()
-            elif msg.tag == b"K":
-                self.backend_pid = struct.unpack(">i", msg.payload[:4])[0]
+        try:
+            if self.ssl_context is not None:
+                await self._start_tls()
+            params = {
+                "user": self.user,
+                "database": self.database,
+                "application_name": self.application_name,
+                "client_encoding": "UTF8",
+            }
+            if self.replication:
+                params["replication"] = "database"
+            body = struct.pack(">i", PROTOCOL_VERSION)
+            for k, v in params.items():
+                body += k.encode() + b"\x00" + v.encode() + b"\x00"
+            body += b"\x00"
+            assert self._writer is not None
+            self._writer.write(struct.pack(">i", len(body) + 4) + body)
+            await self._flush()
+            await self._authenticate()
+            # consume until ReadyForQuery
+            while True:
+                msg = await self._read_message()
+                if msg.tag == b"Z":
+                    return
+                if msg.tag == b"S":
+                    k, _, v = msg.payload.partition(b"\x00")
+                    self.parameters[k.decode()] = \
+                        v.rstrip(b"\x00").decode()
+                elif msg.tag == b"K":
+                    self.backend_pid = struct.unpack(
+                        ">i", msg.payload[:4])[0]
+        except BaseException:
+            # a failed TLS/auth/startup must not leak the socket
+            self._writer.close()
+            self._reader = self._writer = None
+            raise
 
     async def _start_tls(self) -> None:
         assert self._writer is not None and self._reader is not None
@@ -161,9 +169,23 @@ class PgWireConnection:
                            "server refused TLS")
         transport = self._writer.transport
         loop = asyncio.get_event_loop()
-        new_transport = await loop.start_tls(
-            transport, self._writer.transport.get_protocol(),
-            self.ssl_context, server_hostname=self.host)
+        try:
+            new_transport = await loop.start_tls(
+                transport, self._writer.transport.get_protocol(),
+                self.ssl_context, server_hostname=self.host)
+        except (ssl_mod.SSLError, OSError) as e:
+            # typed: cert verification / handshake failures are config
+            # problems, not transient IO (reference sslmode=require errors)
+            raise EtlError(ErrorKind.SOURCE_TLS_FAILED,
+                           f"TLS handshake with {self.host}:{self.port} "
+                           f"failed: {e}")
+        if new_transport is None:
+            # start_tls returns None when the peer drops as the handshake
+            # settles (SSLProtocol nulls the app transport) — surface it
+            # typed instead of poisoning the stream pair
+            raise EtlError(ErrorKind.SOURCE_TLS_FAILED,
+                           f"TLS handshake with {self.host}:{self.port} "
+                           "failed: connection lost during handshake")
         self._writer._transport = new_transport  # type: ignore[attr-defined]
         self._reader._transport = new_transport  # type: ignore[attr-defined]
 
@@ -204,11 +226,15 @@ class PgWireConnection:
                 raise EtlError(ErrorKind.SOURCE_AUTH_FAILED,
                                f"unsupported auth method {code}")
 
+    # injectable for golden-transcript tests (a pinned byte exchange needs
+    # deterministic nonces); production keeps the 18-byte random default
+    _scram_nonce_bytes = staticmethod(lambda: os.urandom(18))
+
     async def _scram_auth(self) -> None:
         """SCRAM-SHA-256 (RFC 5802/7677)."""
         if self.password is None:
             raise EtlError(ErrorKind.SOURCE_AUTH_FAILED, "password required")
-        nonce = base64.b64encode(os.urandom(18)).decode()
+        nonce = base64.b64encode(self._scram_nonce_bytes()).decode()
         first_bare = f"n=,r={nonce}"
         msg = b"SCRAM-SHA-256\x00" + struct.pack(
             ">i", len(first_bare) + 3) + b"n,," + first_bare.encode()

@@ -91,11 +91,21 @@ class FakePgServer:
 
     def __init__(self, db: FakeDatabase, *, password: str | None = None,
                  keepalive_interval_s: float = 0.05,
-                 server_version: str = "16.3"):
+                 server_version: str = "16.3",
+                 tls_cert: "tuple[bytes, bytes] | None" = None,
+                 scram_salt: bytes | None = None,
+                 scram_nonce_tail: str | None = None):
         self.db = db
         self.password = password  # None = trust auth
         self.keepalive_interval_s = keepalive_interval_s
         self.server_version = server_version
+        # (cert_pem, key_pem): accept SSLRequest and upgrade; None = refuse
+        self.tls_cert = tls_cert
+        self._tls_ctx = None
+        # fixed SCRAM parameters for golden-transcript tests (None = random)
+        self.scram_salt = scram_salt
+        self.scram_nonce_tail = scram_nonce_tail
+        self.scram_transcript: list[tuple[str, str]] = []  # (dir, message)
         self._server: asyncio.AbstractServer | None = None
         self.port = 0
         self.connections = 0
@@ -162,9 +172,36 @@ class FakePgServer:
         (length,) = struct.unpack(">i", await r.readexactly(4))
         body = await r.readexactly(length - 4)
         (version,) = struct.unpack(">i", body[:4])
-        if version == 80877103:  # SSLRequest → refuse, expect retry
-            w.write(b"N")
+        if version == 80877103:  # SSLRequest
+            if self.tls_cert is None:
+                w.write(b"N")  # refuse; client decides (require → error)
+                await w.drain()
+                return await self._startup(sess)
+            w.write(b"S")
             await w.drain()
+            if self._tls_ctx is None:
+                import ssl as ssl_mod
+                import tempfile
+
+                ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+                cert_pem, key_pem = self.tls_cert
+                with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
+                        tempfile.NamedTemporaryFile(suffix=".pem") as kf:
+                    cf.write(cert_pem)
+                    cf.flush()
+                    kf.write(key_pem)
+                    kf.flush()
+                    ctx.load_cert_chain(cf.name, kf.name)
+                self._tls_ctx = ctx
+            loop = asyncio.get_event_loop()
+            transport = w.transport
+            new_transport = await loop.start_tls(
+                transport, transport.get_protocol(), self._tls_ctx,
+                server_side=True)
+            if new_transport is None:  # client dropped mid-handshake
+                return False
+            w._transport = new_transport  # type: ignore[attr-defined]
+            r._transport = new_transport  # type: ignore[attr-defined]
             return await self._startup(sess)
         params: dict[str, str] = {}
         parts = body[4:].split(b"\x00")
@@ -196,19 +233,26 @@ class FakePgServer:
         mech_end = payload.index(b"\x00")
         (resp_len,) = struct.unpack(">i", payload[mech_end + 1 : mech_end + 5])
         client_first = payload[mech_end + 5 :][:resp_len].decode()
+        self.scram_transcript.append(("C", client_first))
         bare = client_first.split(",", 2)[2]
         client_nonce = dict(p.split("=", 1)
                             for p in bare.split(","))["r"]
-        salt = os.urandom(16)
+        salt = self.scram_salt if self.scram_salt is not None \
+            else os.urandom(16)
         iterations = 4096
-        server_nonce = client_nonce + base64.b64encode(os.urandom(9)).decode()
+        tail = self.scram_nonce_tail \
+            if self.scram_nonce_tail is not None \
+            else base64.b64encode(os.urandom(9)).decode()
+        server_nonce = client_nonce + tail
         server_first = (f"r={server_nonce},"
                         f"s={base64.b64encode(salt).decode()},i={iterations}")
+        self.scram_transcript.append(("S", server_first))
         w.write(_msg(b"R", struct.pack(">i", 11) + server_first.encode()))
         await w.drain()
         header = await r.readexactly(5)
         (length,) = struct.unpack(">i", header[1:5])
         client_final = (await r.readexactly(length - 4)).decode()
+        self.scram_transcript.append(("C", client_final))
         attrs = dict(p.split("=", 1) for p in client_final.split(","))
         salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(), salt,
                                      iterations)
@@ -226,6 +270,7 @@ class FakePgServer:
         verifier = hmac.new(server_key, auth_message.encode(),
                             hashlib.sha256).digest()
         final = f"v={base64.b64encode(verifier).decode()}"
+        self.scram_transcript.append(("S", final))
         w.write(_msg(b"R", struct.pack(">i", 12) + final.encode()))
         return True
 

@@ -850,6 +850,27 @@ class TestDefaultExpressions:
         finally:
             await server.stop()
 
+    def test_array_and_bytea_defaults_are_must_backfill(self):
+        """A quoted literal default on an ARRAY/BYTEA column would be
+        type-mismatched at the destination (STRING default on a BQ JSON
+        array / SF VARIANT column) — classification must return None
+        (review finding)."""
+        from etl_tpu.models.default_expression import column_default_sql
+
+        tags = ColumnSchema("tags", Oid.TEXT_ARRAY,
+                            default_expression="'{}'::text[]")
+        assert column_default_sql(tags, "bigquery") is None
+        assert column_default_sql(tags, "snowflake") is None
+        blob = ColumnSchema("blob", Oid.BYTEA,
+                            default_expression="'\\x'::bytea")
+        assert column_default_sql(blob, "bigquery") is None
+        # UUID stays expressible: STRING columns at every destination
+        uid = ColumnSchema(
+            "uid", Oid.UUID,
+            default_expression="'a0eebc99-9c0b-4ef8-bb6d-6bb9bd380a11'::uuid")
+        assert column_default_sql(uid, "clickhouse") == \
+            "'a0eebc99-9c0b-4ef8-bb6d-6bb9bd380a11'"
+
     def test_dialect_escaping(self):
         """Postgres ''-doubling and raw backslashes must be re-escaped per
         target dialect: GoogleSQL/ClickHouse escape with backslash,
