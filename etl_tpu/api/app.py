@@ -219,12 +219,32 @@ def build_app(state: ApiState) -> web.Application:
     # -- sources / destinations (same shape) ------------------------------------
 
     def make_config_routes(table: str, path: str):
+        from .validation import (validate_destination_shape,
+                                 validate_source_shape)
+
+        shape_check = validate_source_shape if table == "api_sources" \
+            else validate_destination_shape
+
+        def _reject_invalid(config: dict) -> None:
+            """Reject-before-store (reference routes validate configs at
+            deserialization): static shape failures → 400 with the same
+            failure list the :validate routes return."""
+            failures = shape_check(config)
+            if failures:
+                raise web.HTTPBadRequest(
+                    text=json.dumps({
+                        "error": "invalid config",
+                        "validation_failures": [f.to_json()
+                                                for f in failures]}),
+                    content_type="application/json")
+
         async def create(req: web.Request):
             tenant = _require_tenant(req)
             doc = await _json_body(req)
             name, config = doc.get("name"), doc.get("config")
             if not name or not isinstance(config, dict):
                 raise _json_error(400, "name and config required")
+            _reject_invalid(config)
             cur = state.db.execute(
                 f"INSERT INTO {table} (tenant_id, name, config_enc) "
                 "VALUES (?, ?, ?)", (tenant, name, state.cipher.encrypt(config)))
@@ -259,6 +279,7 @@ def build_app(state: ApiState) -> web.Application:
             if config is not None:
                 config = unmask_config(config,
                                        state.cipher.decrypt(row[3]))
+                _reject_invalid(config)
             enc = state.cipher.encrypt(config) if config is not None else row[3]
             state.db.execute(
                 f"UPDATE {table} SET name = ?, config_enc = ? WHERE id = ?",
@@ -291,6 +312,53 @@ def build_app(state: ApiState) -> web.Application:
 
     make_config_routes("api_sources", "/v1/sources")
     make_config_routes("api_destinations", "/v1/destinations")
+
+    # -- validation routes (reference routes/destinations.rs:468-516,
+    # routes/common.rs:67-79): static shape + LIVE probes, returning
+    # `validation_failures` with severity instead of erroring ------------------
+
+    async def validate_source_route(req: web.Request):
+        from .validation import validate_source
+
+        _require_tenant(req)
+        doc = await _json_body(req)
+        config = doc.get("config")
+        if not isinstance(config, dict):
+            raise _json_error(400, "config required")
+        pipeline_config = doc.get("pipeline_config") or {}
+        failures = await validate_source(
+            config, publication=pipeline_config.get("publication_name"))
+        return web.json_response(
+            {"validation_failures": [f.to_json() for f in failures]})
+
+    async def validate_destination_route(req: web.Request):
+        from .validation import validate_destination
+
+        tenant = _require_tenant(req)
+        doc = await _json_body(req)
+        config = doc.get("config")
+        if not isinstance(config, dict):
+            raise _json_error(400, "config required")
+        pipeline_config = doc.get("pipeline_config")
+        source_id = doc.get("source_id")
+        # source_id + pipeline_config travel together (destinations.rs:500)
+        if (source_id is None) != (pipeline_config is None):
+            raise _json_error(
+                400, "source_id and pipeline_config must be provided "
+                     "together")
+        if source_id is not None:
+            try:
+                source_id = int(source_id)
+            except (TypeError, ValueError):
+                raise _json_error(400, "source_id must be an integer")
+            if state.fetch_owned("api_sources", source_id, tenant) is None:
+                raise _json_error(404, "source not found")
+        failures = await validate_destination(config, pipeline_config)
+        return web.json_response(
+            {"validation_failures": [f.to_json() for f in failures]})
+
+    r.add_post("/v1/sources:validate", validate_source_route)
+    r.add_post("/v1/destinations:validate", validate_destination_route)
 
     # -- images (replicator container images; reference etl-api images CRUD)
 
@@ -494,8 +562,10 @@ def build_app(state: ApiState) -> web.Application:
             conn = PgWireConnection(
                 host=cfg.get("host", "localhost"),
                 port=int(cfg.get("port", 5432)),
-                database=cfg.get("database", "postgres"),
-                user=cfg.get("user", "postgres"),
+                # canonical source-config keys (name/username), with the
+                # legacy aliases as fallback
+                database=cfg.get("name", cfg.get("database", "postgres")),
+                user=cfg.get("username", cfg.get("user", "postgres")),
                 password=cfg.get("password"),
                 application_name="etl_tpu_api", connect_timeout_s=3.0)
             await conn.connect()

@@ -83,8 +83,17 @@ class K8sOrchestrator(Orchestrator):
             self._session = aiohttp.ClientSession()
         headers = {"Authorization": f"Bearer {self.token}"} if self.token \
             else {}
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+            # the k8s API rejects PATCH bodies that aren't declared as a
+            # patch type (415); strategic merge matches the partial
+            # template documents sent here
+            headers["Content-Type"] = \
+                "application/strategic-merge-patch+json" \
+                if method == "PATCH" else "application/json"
         async with self._session.request(
-                method, f"{self.api_url}{path}", json=body,
+                method, f"{self.api_url}{path}", data=data,
                 headers=headers) as resp:
             text = await resp.text()
             try:
@@ -97,6 +106,14 @@ class K8sOrchestrator(Orchestrator):
         ns = self.namespace
         name = self._name(spec.pipeline_id)
         config_yaml = yaml.safe_dump(spec.config)
+        import time
+
+        # fresh restarted-at template annotation on EVERY create-or-update:
+        # a config/image change patches the pod template, and the changed
+        # annotation makes the StatefulSet controller roll the pods even
+        # when nothing else in the template moved (reference
+        # k8s/http.rs:1676,1708 restart checksum)
+        restarted_at = f"{time.time():.6f}"
         resources = [
             ("POST", f"/api/v1/namespaces/{ns}/secrets", {
                 "metadata": {"name": f"{name}-secrets"},
@@ -116,7 +133,10 @@ class K8sOrchestrator(Orchestrator):
                     "serviceName": name, "replicas": 1,
                     "selector": {"matchLabels": {"app": name}},
                     "template": {
-                        "metadata": {"labels": {"app": name}},
+                        "metadata": {
+                            "labels": {"app": name},
+                            "annotations": {
+                                "etl/restarted-at": restarted_at}},
                         "spec": {"containers": [{
                             "name": "replicator",
                             "image": spec.image or self.image,
@@ -133,12 +153,20 @@ class K8sOrchestrator(Orchestrator):
         ]
         for method, path, body in resources:
             status, _ = await self._api(method, path, body)
-            if status == 409:  # exists → patch-equivalent: replace
-                put_path = f"{path}/{body['metadata']['name']}"
-                status, _ = await self._api("PUT", put_path, body)
+            if status == 409:  # exists → strategic-merge PATCH (rollout)
+                patch_path = f"{path}/{body['metadata']['name']}"
+                status, _ = await self._api("PATCH", patch_path, body)
             if status >= 400:
                 raise EtlError(ErrorKind.DESTINATION_FAILED,
                                f"k8s {method} {path} → {status}")
+
+    async def restart_pipeline(self, spec: ReplicatorSpec) -> None:
+        """Rolling restart, NOT the base class's delete+recreate: re-apply
+        the resource triple — the fresh restarted-at template annotation
+        makes the StatefulSet controller roll the pods even when the
+        config did not change (`kubectl rollout restart` semantics,
+        reference k8s/http.rs:1676,1708)."""
+        await self.start_pipeline(spec)
 
     async def stop_pipeline(self, pipeline_id: int) -> None:
         ns = self.namespace
@@ -177,11 +205,16 @@ class LocalOrchestrator(Orchestrator):
     def __init__(self, work_dir: str):
         self.work_dir = Path(work_dir)
         self._procs: dict[int, asyncio.subprocess.Process] = {}
+        self._specs: dict[int, ReplicatorSpec] = {}
 
     async def start_pipeline(self, spec: ReplicatorSpec) -> None:
         existing = self._procs.get(spec.pipeline_id)
         if existing is not None and existing.returncode is None:
-            return
+            if self._specs.get(spec.pipeline_id) == spec:
+                return  # unchanged: keep the running process
+            # config or image changed → restart with the new spec (the
+            # single-host analogue of the StatefulSet template roll)
+            await self.stop_pipeline(spec.pipeline_id)
         conf_dir = self.work_dir / f"pipeline-{spec.pipeline_id}"
         conf_dir.mkdir(parents=True, exist_ok=True)
         (conf_dir / "base.yaml").write_text(yaml.safe_dump(spec.config))
@@ -197,8 +230,10 @@ class LocalOrchestrator(Orchestrator):
         finally:
             log.close()
         self._procs[spec.pipeline_id] = proc
+        self._specs[spec.pipeline_id] = spec
 
     async def stop_pipeline(self, pipeline_id: int) -> None:
+        self._specs.pop(pipeline_id, None)
         proc = self._procs.pop(pipeline_id, None)
         if proc is None or proc.returncode is not None:
             return

@@ -163,7 +163,9 @@ class TestLifecycle:
             await client.post("/v1/tenants", json={"id": "acme", "name": "A"})
             src = await (await client.post(
                 "/v1/sources", headers=H,
-                json={"name": "s", "config": {}})).json()
+                json={"name": "s", "config": {
+                    "host": "db", "port": 5432, "name": "app",
+                    "username": "etl"}})).json()
             dst = await (await client.post(
                 "/v1/destinations", headers=H,
                 json={"name": "d", "config": {"type": "memory"}})).json()
@@ -236,8 +238,8 @@ class TestK8sOrchestrator:
             server.fail_next = [409]  # first resource exists
             orch = K8sOrchestrator(api_url=server.url())
             await orch.start_pipeline(ReplicatorSpec(1, "t", {}))
-            # 409 → PUT replace, then the remaining resources
-            assert any(p.startswith("PUT ") for p in server.paths())
+            # 409 → strategic-merge PATCH (template roll), then the rest
+            assert any(p.startswith("PATCH ") for p in server.paths())
             await orch.shutdown()
         finally:
             await server.stop()
@@ -311,7 +313,7 @@ class TestSlotLagSurface:
                 "/v1/sources", headers=H,
                 json={"name": "s", "config": {
                     "host": "127.0.0.1", "port": server.port,
-                    "database": "postgres", "user": "etl"}})).json()
+                    "name": "postgres", "username": "etl"}})).json()
             dst = await (await client.post(
                 "/v1/destinations", headers=H,
                 json={"name": "d", "config": {"type": "memory"}})).json()
@@ -347,7 +349,8 @@ class TestSlotLagSurface:
             src = await (await client.post(
                 "/v1/sources", headers=H,
                 json={"name": "s", "config": {
-                    "host": "127.0.0.1", "port": 1}})).json()
+                    "host": "127.0.0.1", "port": 1,
+                    "name": "postgres", "username": "etl"}})).json()
             dst = await (await client.post(
                 "/v1/destinations", headers=H,
                 json={"name": "d", "config": {"type": "memory"}})).json()
@@ -441,7 +444,9 @@ class TestRollbackDepth:
             await client.post("/v1/tenants", json={"id": "acme", "name": "A"})
             src = await (await client.post(
                 "/v1/sources", headers=H,
-                json={"name": "s", "config": {}})).json()
+                json={"name": "s", "config": {
+                    "host": "db", "port": 5432, "name": "app",
+                    "username": "etl"}})).json()
             dst = await (await client.post(
                 "/v1/destinations", headers=H,
                 json={"name": "d", "config": {"type": "memory"}})).json()
@@ -533,7 +538,8 @@ class TestSecretRoundTrip:
                 "/v1/sources", headers=H,
                 json={"name": "s", "config": {
                     "token": {"value": "eyJhbGci"},
-                    "keys": ["k1", "k2"], "host": "h"}})
+                    "keys": ["k1", "k2"], "host": "h", "port": 5432,
+                    "name": "app", "username": "etl"}})
             got = await (await client.get("/v1/sources/1",
                                           headers=H)).json()
             assert got["config"]["token"] == "********"
@@ -562,3 +568,224 @@ class TestImageTenancy:
             assert imgs and imgs[0]["name"] == "mine:v1"
         finally:
             await client.close()
+
+
+class TestValidationRoutes:
+    """Reject-before-store + the :validate live-probe routes (reference
+    routes/destinations.rs:468-516, validation/ framework)."""
+
+    async def test_create_rejects_invalid_source_config(self, tmp_path):
+        client, _ = await make_client(tmp_path)
+        await client.post("/v1/tenants", json={"id": "acme", "name": "A"})
+        resp = await client.post("/v1/sources", headers=H, json={
+            "name": "bad", "config": {"port": 99999}})
+        assert resp.status == 400
+        doc = await resp.json()
+        names = {f["name"] for f in doc["validation_failures"]}
+        # invalid-config snapshot: every static failure reported at once
+        assert {"Missing host", "Missing name", "Missing username",
+                "Invalid port"} <= names
+        assert all(f["failure_type"] == "critical"
+                   for f in doc["validation_failures"])
+        await client.close()
+
+    async def test_create_rejects_unknown_destination_type(self, tmp_path):
+        client, _ = await make_client(tmp_path)
+        await client.post("/v1/tenants", json={"id": "acme", "name": "A"})
+        resp = await client.post("/v1/destinations", headers=H, json={
+            "name": "bad", "config": {"type": "warehouse9000"}})
+        assert resp.status == 400
+        doc = await resp.json()
+        assert doc["validation_failures"][0]["name"] == \
+            "Unknown destination type"
+        # nothing was stored
+        listing = await (await client.get("/v1/destinations",
+                                          headers=H)).json()
+        assert listing == []
+        await client.close()
+
+    async def test_update_rejects_invalid_config(self, tmp_path):
+        client, _ = await make_client(tmp_path)
+        await setup_pipeline(client)
+        resp = await client.put("/v1/destinations/1", headers=H, json={
+            "config": {"type": "bigquery"}})  # missing project/dataset
+        assert resp.status == 400
+        stored = await (await client.get("/v1/destinations/1",
+                                         headers=H)).json()
+        assert stored["config"]["type"] == "lake"  # unchanged
+        await client.close()
+
+    async def test_validate_source_live_probes(self, tmp_path):
+        from etl_tpu.testing.fake_pg_server import FakePgServer
+        from tests.test_pipeline_e2e import make_db
+
+        server = FakePgServer(make_db())
+        await server.start()
+        client, _ = await make_client(tmp_path)
+        await client.post("/v1/tenants", json={"id": "acme", "name": "A"})
+        try:
+            good = {"host": "127.0.0.1", "port": server.port,
+                    "name": "postgres", "username": "etl"}
+            doc = await (await client.post(
+                "/v1/sources:validate", headers=H,
+                json={"config": good})).json()
+            assert doc["validation_failures"] == []
+            # existing publication passes; missing one is critical
+            doc = await (await client.post(
+                "/v1/sources:validate", headers=H,
+                json={"config": good,
+                      "pipeline_config": {"publication_name": "pub"}})).json()
+            assert doc["validation_failures"] == []
+            doc = await (await client.post(
+                "/v1/sources:validate", headers=H,
+                json={"config": good,
+                      "pipeline_config": {"publication_name": "nope"}})).json()
+            assert [f["name"] for f in doc["validation_failures"]] == \
+                ["Publication missing"]
+            # unreachable endpoint is a critical failure, not a 500
+            bad = dict(good, port=1)
+            doc = await (await client.post(
+                "/v1/sources:validate", headers=H,
+                json={"config": bad})).json()
+            assert doc["validation_failures"][0]["name"] == \
+                "Source connection failed"
+        finally:
+            await client.close()
+            await server.stop()
+
+    async def test_validate_destination_live_probes(self, tmp_path):
+        from etl_tpu.testing.fake_http import RecordingHttpServer
+
+        server = RecordingHttpServer()
+        await server.start()
+        client, _ = await make_client(tmp_path)
+        await client.post("/v1/tenants", json={"id": "acme", "name": "A"})
+        try:
+            doc = await (await client.post(
+                "/v1/destinations:validate", headers=H,
+                json={"config": {"type": "clickhouse",
+                                 "url": server.url(),
+                                 "database": "etl"}})).json()
+            assert doc["validation_failures"] == []
+            # auth rejection surfaces as a critical failure
+            server.responders.append(
+                lambda rec: (401, {"error": "bad token"})
+                if "/datasets/" in rec.path else None)
+            doc = await (await client.post(
+                "/v1/destinations:validate", headers=H,
+                json={"config": {"type": "bigquery", "project_id": "p",
+                                 "dataset_id": "d",
+                                 "base_url": server.url(),
+                                 "auth_token": "bad"}})).json()
+            assert doc["validation_failures"][0]["name"] == \
+                "BigQuery authentication failed"
+            # source_id and pipeline_config must travel together
+            resp = await client.post(
+                "/v1/destinations:validate", headers=H,
+                json={"config": {"type": "lake", "warehouse_path": "/tmp"},
+                      "source_id": 1})
+            assert resp.status == 400
+        finally:
+            await client.close()
+            await server.stop()
+
+
+class TestOrchestratorRollout:
+    async def test_statefulset_update_rolls_template(self):
+        """An image change on an EXISTING pipeline must PATCH the
+        StatefulSet with a fresh restarted-at template annotation — the
+        rolling-restart trigger (reference k8s/http.rs:1676,1708)."""
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
+            spec = ReplicatorSpec(3, "t", {"publication_name": "pub"},
+                                  image="img:v1")
+            await orch.start_pipeline(spec)
+            first = [r for r in server.requests
+                     if r.path.endswith("/statefulsets")][0].json
+            anno1 = first["spec"]["template"]["metadata"]["annotations"][
+                "etl/restarted-at"]
+            # every resource now exists → conflict on each create
+            server.responders.append(
+                lambda rec: (409, {}) if rec.method == "POST" else None)
+            await orch.start_pipeline(ReplicatorSpec(
+                3, "t", {"publication_name": "pub"}, image="img:v2"))
+            patches = [r for r in server.requests if r.method == "PATCH"]
+            sts = [r for r in patches
+                   if "statefulsets/etl-replicator-3" in r.path][0].json
+            tpl = sts["spec"]["template"]
+            assert tpl["spec"]["containers"][0]["image"] == "img:v2"
+            assert tpl["metadata"]["annotations"]["etl/restarted-at"] \
+                != anno1
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_restart_rolls_without_teardown(self):
+        """K8sOrchestrator.restart_pipeline must NOT delete+recreate (the
+        base-class default): it re-applies with a fresh restarted-at
+        annotation so the controller rolls the pods in place."""
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
+            spec = ReplicatorSpec(9, "t", {"publication_name": "pub"})
+            await orch.start_pipeline(spec)
+            first = [r for r in server.requests
+                     if r.path.endswith("/statefulsets")][0].json
+            anno1 = first["spec"]["template"]["metadata"]["annotations"][
+                "etl/restarted-at"]
+            server.responders.append(
+                lambda rec: (409, {}) if rec.method == "POST" else None)
+            await orch.restart_pipeline(spec)
+            assert not any(r.method == "DELETE" for r in server.requests)
+            patches = [r for r in server.requests if r.method == "PATCH"]
+            sts = [r for r in patches
+                   if "statefulsets/etl-replicator-9" in r.path][0]
+            assert sts.headers["Content-Type"] == \
+                "application/strategic-merge-patch+json"
+            anno2 = sts.json["spec"]["template"]["metadata"][
+                "annotations"]["etl/restarted-at"]
+            assert anno2 != anno1  # pods roll even with unchanged config
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_local_orchestrator_restarts_on_config_change(
+            self, tmp_path, monkeypatch):
+        """Same spec → keep the process; changed config/image → restart
+        with the new config on disk (single-host template roll)."""
+        import asyncio as aio
+        import sys
+
+        from etl_tpu.api.orchestrator import LocalOrchestrator
+
+        spawned = []
+        real_exec = aio.create_subprocess_exec
+
+        async def fake_exec(*args, **kwargs):
+            # record then run an inert long-lived process
+            spawned.append(args)
+            return await real_exec(sys.executable, "-c",
+                                   "import time; time.sleep(60)",
+                                   **{k: v for k, v in kwargs.items()
+                                      if k in ("stdout", "stderr")})
+
+        monkeypatch.setattr(aio, "create_subprocess_exec", fake_exec)
+        orch = LocalOrchestrator(str(tmp_path))
+        spec_a = ReplicatorSpec(5, "t", {"publication_name": "a"})
+        await orch.start_pipeline(spec_a)
+        pid1 = orch._procs[5].pid
+        await orch.start_pipeline(spec_a)  # unchanged → same process
+        assert orch._procs[5].pid == pid1 and len(spawned) == 1
+        spec_b = ReplicatorSpec(5, "t", {"publication_name": "b"})
+        await orch.start_pipeline(spec_b)  # changed → restart
+        assert orch._procs[5].pid != pid1 and len(spawned) == 2
+        import yaml
+        conf = yaml.safe_load(
+            (tmp_path / "pipeline-5" / "base.yaml").read_text())
+        assert conf["publication_name"] == "b"
+        assert (await orch.status(5)).state == "running"
+        await orch.shutdown()
+        assert (await orch.status(5)).state == "stopped"
