@@ -51,6 +51,40 @@ from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN, CDC_DELETE,
 class LakeConfig:
     warehouse_path: str  # directory for parquet files + catalog
     compact_min_files: int = 8  # compaction trigger threshold
+    # data inlining (reference ducklake/inline_size.rs): CDC batches whose
+    # Arrow payload is below inline_max_bytes are stored IN the catalog
+    # (Arrow IPC blob) instead of as tiny Parquet files; when a table's
+    # accumulated inlined bytes exceed inline_flush_bytes they flush into
+    # one Parquet file. 0 disables inlining.
+    inline_max_bytes: int = 0
+    inline_flush_bytes: int = 256 * 1024
+
+
+# replay epoch assigned to rows written before epoch tracking existed
+# (reference replay_epoch.rs LEGACY_REPLAY_EPOCH)
+LEGACY_REPLAY_EPOCH = "__legacy__"
+
+
+def _concat_cdc_batches(batches: "list[pa.RecordBatch]") -> pa.Table:
+    """Concatenate CDC record batches whose schemas may differ only in the
+    optional PATCH-missing column: align on the column union, null-filling
+    the absentees."""
+    tables = [pa.Table.from_batches([b]) for b in batches]
+    names: list[str] = []
+    for t in tables:
+        for n in t.schema.names:
+            if n not in names:
+                names.append(n)
+    aligned = []
+    for t in tables:
+        for n in names:
+            if n not in t.schema.names:
+                typ = next(tt.schema.field(n).type for tt in tables
+                           if n in tt.schema.names)
+                t = t.append_column(pa.field(n, typ),
+                                    pa.nulls(t.num_rows, typ))
+        aligned.append(t.select(names))
+    return pa.concat_tables(aligned)
 
 
 class LakeDestination(Destination):
@@ -100,8 +134,31 @@ CREATE TABLE IF NOT EXISTS lake_maintenance_history (
     files_affected BIGINT NOT NULL DEFAULT 0,
     outcome TEXT NOT NULL DEFAULT 'running'  -- running|ok|skipped|failed
 );
+CREATE TABLE IF NOT EXISTS lake_replay_epochs (
+    table_id BIGINT PRIMARY KEY,
+    replay_epoch TEXT NOT NULL,
+    pending_replay_epoch TEXT,
+    updated_at TEXT NOT NULL DEFAULT ''
+);
 """)
+        # older catalogs: add per-file epoch + inline payload columns
+        cols = {r[1] for r in self._db.execute(
+            "PRAGMA table_info(lake_files)")}
+        if "replay_epoch" not in cols:
+            self._db.execute(
+                "ALTER TABLE lake_files ADD COLUMN replay_epoch TEXT "
+                f"NOT NULL DEFAULT '{LEGACY_REPLAY_EPOCH}'")
+        if "inline_payload" not in cols:
+            self._db.execute(
+                "ALTER TABLE lake_files ADD COLUMN inline_payload BLOB")
         self._db.commit()
+        # resume an interrupted replay-epoch transition (two-phase:
+        # begin→reset→complete; a crash between begin and complete re-runs
+        # the reset — an extra empty generation is harmless — and promotes)
+        for (tid,) in self._db.execute(
+                "SELECT table_id FROM lake_replay_epochs "
+                "WHERE pending_replay_epoch IS NOT NULL").fetchall():
+            await self._finish_replay_reset(tid)
 
     def _catalog(self) -> sqlite3.Connection:
         if self._db is None:
@@ -140,13 +197,17 @@ CREATE TABLE IF NOT EXISTS lake_maintenance_history (
         pq.write_table(pa.Table.from_batches([rb]), path)
         return path
 
-    def _record_file(self, table_id: TableId, generation: int, path: Path,
-                     kind: str, rows: int, max_seq: str) -> None:
+    def _record_file(self, table_id: TableId, generation: int,
+                     path: "Path | str", kind: str, rows: int, max_seq: str,
+                     epoch: str = LEGACY_REPLAY_EPOCH,
+                     inline_payload: "bytes | None" = None) -> None:
         db = self._catalog()
         db.execute(
             "INSERT INTO lake_files (table_id, generation, path, kind, "
-            "row_count, max_seq) VALUES (?, ?, ?, ?, ?, ?)",
-            (table_id, generation, str(path), kind, rows, max_seq))
+            "row_count, max_seq, replay_epoch, inline_payload) "
+            "VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+            (table_id, generation, str(path), kind, rows, max_seq, epoch,
+             inline_payload))
         if max_seq:
             db.execute("UPDATE lake_tables SET max_seq = MAX(max_seq, ?) "
                        "WHERE table_id = ?", (max_seq, table_id))
@@ -162,7 +223,7 @@ CREATE TABLE IF NOT EXISTS lake_maintenance_history (
             rb = batch.to_arrow()
             path = self._write_parquet(self.root / name, rb)
             self._record_file(schema.id, gen, path, "base", batch.num_rows,
-                              "")
+                              "", self.current_replay_epoch(schema.id))
         return WriteAck.durable()
 
     async def write_events(self, events: Sequence[Event]) -> WriteAck:
@@ -231,32 +292,167 @@ CREATE TABLE IF NOT EXISTS lake_maintenance_history (
         if any(m is not None for m in missing):
             rb = rb.append_column(PATCH_MISSING_COLUMN,
                                   pa.array(missing, type=pa.string()))
-        path = self._write_parquet(self.root / name, rb)
-        self._record_file(schema.id, gen, path, "cdc", len(rows), max_seq)
+        epoch = self.current_replay_epoch(schema.id)
+        if 0 < rb.nbytes < self.config.inline_max_bytes:
+            # data inlining (ducklake/inline_size.rs): tiny CDC batches go
+            # into the catalog as Arrow IPC blobs, not 1-row Parquet files
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, rb.schema) as w:
+                w.write_batch(rb)
+            self._record_file(schema.id, gen, "", "cdc", len(rows),
+                              max_seq, epoch,
+                              sink.getvalue().to_pybytes())
+            if self._pending_inline_bytes(schema.id, gen) \
+                    >= self.config.inline_flush_bytes:
+                await self.flush_inlined(schema.id)
+        else:
+            path = self._write_parquet(self.root / name, rb)
+            self._record_file(schema.id, gen, path, "cdc", len(rows),
+                              max_seq, epoch)
         if self._cdc_file_count(schema.id, gen) >= self.config.compact_min_files:
             await self.compact(schema.id)
 
+    def _pending_inline_bytes(self, table_id: TableId, gen: int) -> int:
+        """Accumulated catalog-inlined bytes for one table generation —
+        the flush-policy input, exported as a gauge (reference
+        DuckLakePendingInlineSizeSampler)."""
+        from ..telemetry.metrics import ETL_LAKE_INLINED_DATA_BYTES, registry
+
+        (n,) = self._catalog().execute(
+            "SELECT COALESCE(SUM(LENGTH(inline_payload)), 0) FROM "
+            "lake_files WHERE table_id = ? AND generation = ? AND "
+            "inline_payload IS NOT NULL", (table_id, gen)).fetchone()
+        registry.gauge_set(ETL_LAKE_INLINED_DATA_BYTES, n,
+                           labels={"table": str(table_id)})
+        return int(n)
+
+    async def flush_inlined(self, table_id: TableId) -> int:
+        """Flush this table's inlined CDC batches into ONE Parquet file.
+        Sequence-aware collapse makes the reordering safe: application
+        order is the CHANGE_SEQUENCE sort, not catalog insertion order.
+        Returns the number of inlined entries flushed."""
+        db = self._catalog()
+        db.execute("BEGIN IMMEDIATE")
+        try:
+            row = db.execute(
+                "SELECT name, schema_json, generation, max_seq FROM "
+                "lake_tables WHERE table_id = ?", (table_id,)).fetchone()
+            if row is None:
+                db.execute("ROLLBACK")
+                return 0
+            name, _, gen, _ = row
+            entries = db.execute(
+                "SELECT id, inline_payload, max_seq, replay_epoch FROM "
+                "lake_files WHERE table_id = ? AND generation = ? AND "
+                "inline_payload IS NOT NULL ORDER BY id",
+                (table_id, gen)).fetchall()
+            if not entries:
+                db.execute("ROLLBACK")
+                return 0
+            batches = []
+            for _id, payload, _seq, _ep in entries:
+                with pa.ipc.open_stream(payload) as r:
+                    batches.extend(r)
+            merged = _concat_cdc_batches(batches)
+            path = self.root / name / f"data-{uuid.uuid4().hex}.parquet"
+            path.parent.mkdir(parents=True, exist_ok=True)
+            pq.write_table(merged, path)
+            ids = [e[0] for e in entries]
+            db.execute(f"DELETE FROM lake_files WHERE id IN "
+                       f"({','.join('?' * len(ids))})", ids)
+            db.execute(
+                "INSERT INTO lake_files (table_id, generation, path, kind, "
+                "row_count, max_seq, replay_epoch) "
+                "VALUES (?, ?, ?, 'cdc', ?, ?, ?)",
+                (table_id, gen, str(path), merged.num_rows,
+                 max(e[2] for e in entries), entries[-1][3]))
+            db.commit()
+        except BaseException:
+            try:
+                db.execute("ROLLBACK")
+            except sqlite3.OperationalError:
+                pass  # commit failures auto-rollback; keep the real error
+            raise
+        self._pending_inline_bytes(table_id, gen)  # refresh the gauge
+        return len(entries)
+
     def _cdc_file_count(self, table_id: TableId, gen: int) -> int:
+        """Real CDC FILES only: catalog-inlined entries are the cheap tier
+        flush_inlined consolidates — counting them would fire a full
+        compaction after a handful of tiny batches, the exact cost
+        inlining exists to avoid."""
         return self._catalog().execute(
             "SELECT COUNT(*) FROM lake_files WHERE table_id = ? AND "
-            "generation = ? AND kind = 'cdc'", (table_id, gen)).fetchone()[0]
+            "generation = ? AND kind = 'cdc' AND inline_payload IS NULL",
+            (table_id, gen)).fetchone()[0]
 
     async def drop_table(self, table_id: TableId) -> None:
         db = self._catalog()
         for (path,) in db.execute("SELECT path FROM lake_files WHERE "
                                   "table_id = ?", (table_id,)):
-            Path(path).unlink(missing_ok=True)
+            if path:  # inlined entries have no file
+                Path(path).unlink(missing_ok=True)
         db.execute("DELETE FROM lake_files WHERE table_id = ?", (table_id,))
         db.execute("DELETE FROM lake_tables WHERE table_id = ?", (table_id,))
         db.commit()
 
-    async def truncate_table(self, table_id: TableId) -> None:
-        """Generation bump: old files stay until vacuum, reads see only the
-        current generation (the versioned-successor stance)."""
+    # -- replay epochs (reference ducklake/replay_epoch.rs) -------------------
+
+    def current_replay_epoch(self, table_id: TableId) -> str:
+        row = self._catalog().execute(
+            "SELECT replay_epoch FROM lake_replay_epochs WHERE "
+            "table_id = ?", (table_id,)).fetchone()
+        return row[0] if row else LEGACY_REPLAY_EPOCH
+
+    def _begin_replay_reset(self, table_id: TableId) -> str:
+        """Start (or resume) an epoch transition: records the pending
+        epoch BEFORE the reset mutates anything, so a crash mid-reset is
+        detected and completed at the next startup (replay_epoch.rs
+        begin_table_replay_epoch_transition; idempotent via coalesce)."""
+        import datetime as _dt
+
+        db = self._catalog()
+        pending = uuid.uuid4().hex
+        db.execute(
+            "INSERT INTO lake_replay_epochs "
+            "(table_id, replay_epoch, pending_replay_epoch, updated_at) "
+            "VALUES (?, ?, ?, ?) "
+            "ON CONFLICT (table_id) DO UPDATE SET "
+            "pending_replay_epoch = COALESCE("
+            "  lake_replay_epochs.pending_replay_epoch, "
+            "  excluded.pending_replay_epoch), "
+            "updated_at = excluded.updated_at",
+            (table_id, LEGACY_REPLAY_EPOCH, pending,
+             _dt.datetime.now(_dt.timezone.utc).isoformat()))
+        db.commit()
+        row = db.execute(
+            "SELECT pending_replay_epoch FROM lake_replay_epochs "
+            "WHERE table_id = ?", (table_id,)).fetchone()
+        return row[0]
+
+    async def _finish_replay_reset(self, table_id: TableId) -> None:
+        """The reset itself + promotion: bump the generation (re-running
+        after a crash just adds another empty — therefore identical —
+        generation) and promote the pending epoch
+        (complete_table_replay_epoch_transition)."""
         db = self._catalog()
         db.execute("UPDATE lake_tables SET generation = generation + 1, "
                    "max_seq = '' WHERE table_id = ?", (table_id,))
+        db.execute(
+            "UPDATE lake_replay_epochs SET "
+            "replay_epoch = pending_replay_epoch, "
+            "pending_replay_epoch = NULL WHERE table_id = ? "
+            "AND pending_replay_epoch IS NOT NULL", (table_id,))
         db.commit()
+
+    async def truncate_table(self, table_id: TableId) -> None:
+        """Generation bump UNDER a replay-epoch transition: reads see only
+        the new (empty) generation, and the rotated epoch makes the
+        sequence watermark inert for re-replayed data — a re-streamed
+        batch after the reset can never be deduped against pre-reset
+        sequence keys (the versioned-successor stance + replay_epoch.rs)."""
+        self._begin_replay_reset(table_id)
+        await self._finish_replay_reset(table_id)
 
     async def shutdown(self) -> None:
         if self._db is not None:
@@ -274,44 +470,70 @@ CREATE TABLE IF NOT EXISTS lake_maintenance_history (
                            f"unknown table {table_id}")
         _, _, gen, _ = row
         files = self._catalog().execute(
-            "SELECT path, kind FROM lake_files WHERE table_id = ? AND "
-            "generation = ? ORDER BY id", (table_id, gen)).fetchall()
+            "SELECT path, kind, inline_payload FROM lake_files WHERE "
+            "table_id = ? AND generation = ? ORDER BY id",
+            (table_id, gen)).fetchall()
         return self._collapse(row, files)
 
-    def _collapse(self, table_row, files: "list[tuple[str, str]]") -> pa.Table:
-        """Collapse an EXPLICIT (path, kind) file list — the caller passes
-        the lake_tables row and file set it observed (compact: under its
-        transaction) so the merge and the catalog swap agree on inputs."""
+    @staticmethod
+    def _read_entry(path: str, payload: "bytes | None") -> pa.Table:
+        """One catalog entry's rows: a Parquet file, or a catalog-inlined
+        Arrow IPC blob (path == '')."""
+        if payload is not None:
+            with pa.ipc.open_stream(payload) as r:
+                return pa.Table.from_batches(list(r))
+        return pq.read_table(path)
+
+    def _collapse(self, table_row,
+                  files: "list[tuple[str, str, bytes | None]]") -> pa.Table:
+        """Collapse an EXPLICIT (path, kind, inline_payload) entry list —
+        the caller passes the lake_tables row and file set it observed
+        (compact: under its transaction) so the merge and the catalog swap
+        agree on inputs.
+
+        Application order is base entries (catalog order) then CDC records
+        sorted by CHANGE_SEQUENCE — the sequence keys are the table's
+        replay order, so catalog insertion order stops mattering and an
+        inline flush may merge non-contiguous entries safely."""
         name, schema_json, gen, _ = table_row
         schema = ReplicatedTableSchema.from_json(json.loads(schema_json))
         key_cols = [c.name for c in schema.identity_columns()] or \
             [c.name for c in schema.replicated_columns]
         live: dict[tuple, dict] = {}
-        for path, kind in files:
-            t = pq.read_table(path)
+        cdc_records: list[tuple[str, dict]] = []
+        for path, kind, payload in files:
+            t = self._read_entry(path, payload)
+            if kind != "cdc":
+                for rec in t.to_pylist():
+                    live[tuple(rec[k] for k in key_cols)] = rec
+                continue
             for rec in t.to_pylist():
-                key = tuple(rec[k] for k in key_cols)
-                ct = rec.get(CHANGE_TYPE_COLUMN) if kind == "cdc" else None
-                if ct == CDC_DELETE:
-                    live.pop(key, None)
+                cdc_records.append((rec.get(CHANGE_SEQUENCE_COLUMN) or "",
+                                    rec))
+        cdc_records.sort(key=lambda sr: sr[0])
+        for _seq, rec in cdc_records:
+            key = tuple(rec[k] for k in key_cols)
+            ct = rec.get(CHANGE_TYPE_COLUMN)
+            if ct == CDC_DELETE:
+                live.pop(key, None)
+                continue
+            patch_missing = rec.get(PATCH_MISSING_COLUMN)
+            rec.pop(CHANGE_TYPE_COLUMN, None)
+            rec.pop(CHANGE_SEQUENCE_COLUMN, None)
+            rec.pop(PATCH_MISSING_COLUMN, None)
+            if ct == CDC_PATCH:
+                # column-wise update: omitted columns keep stored values;
+                # patch for an absent key is a no-op (reference SQL
+                # UPDATE-with-predicate semantics)
+                prev = live.get(key)
+                if prev is None:
                     continue
-                patch_missing = rec.get(PATCH_MISSING_COLUMN)
-                rec.pop(CHANGE_TYPE_COLUMN, None)
-                rec.pop(CHANGE_SEQUENCE_COLUMN, None)
-                rec.pop(PATCH_MISSING_COLUMN, None)
-                if ct == CDC_PATCH:
-                    # column-wise update: omitted columns keep stored values;
-                    # patch for an absent key is a no-op (reference SQL
-                    # UPDATE-with-predicate semantics)
-                    prev = live.get(key)
-                    if prev is None:
-                        continue
-                    omitted = set(json.loads(patch_missing or "[]"))
-                    for k, v in rec.items():
-                        if k not in omitted:
-                            prev[k] = v
-                else:
-                    live[key] = rec
+                omitted = set(json.loads(patch_missing or "[]"))
+                for k, v in rec.items():
+                    if k not in omitted:
+                        prev[k] = v
+            else:
+                live[key] = rec
         if not live:
             return pa.table({c.name: [] for c in schema.replicated_columns})
         return pa.Table.from_pylist(list(live.values()))
@@ -341,7 +563,8 @@ CREATE TABLE IF NOT EXISTS lake_maintenance_history (
                 "ON t.table_id = f.table_id WHERE f.table_id = ? "
                 "AND f.generation < t.generation", (table_id,)).fetchall()
             for fid, path in rows:
-                Path(path).unlink(missing_ok=True)
+                if path:  # inlined entries have no file
+                    Path(path).unlink(missing_ok=True)
                 db.execute("DELETE FROM lake_files WHERE id = ?", (fid,))
             db.commit()
             n = len(rows)
@@ -475,25 +698,31 @@ CREATE TABLE IF NOT EXISTS lake_maintenance_history (
                 return 0
             name, _, gen, max_seq = row
             files = db.execute(
-                "SELECT id, path, kind FROM lake_files WHERE table_id = ? "
-                "AND generation = ? ORDER BY id", (table_id, gen)).fetchall()
+                "SELECT id, path, kind, inline_payload FROM lake_files "
+                "WHERE table_id = ? AND generation = ? ORDER BY id",
+                (table_id, gen)).fetchall()
             if len(files) < 2:
                 db.execute("ROLLBACK")
                 return 0
-            merged = self._collapse(row, [(p, k) for _, p, k in files])
+            merged = self._collapse(row, [(p, k, b) for _, p, k, b in files])
             path = self.root / name / f"data-{uuid.uuid4().hex}.parquet"
+            path.parent.mkdir(parents=True, exist_ok=True)
             pq.write_table(merged, path)
-            ids = [fid for fid, _, _ in files]
+            ids = [fid for fid, *_ in files]
             db.execute(
                 f"DELETE FROM lake_files WHERE id IN "
                 f"({','.join('?' * len(ids))})", ids)
             db.execute(
                 "INSERT INTO lake_files (table_id, generation, path, kind, "
-                "row_count, max_seq) VALUES (?, ?, ?, 'base', ?, ?)",
-                (table_id, gen, str(path), merged.num_rows, max_seq))
+                "row_count, max_seq, replay_epoch) "
+                "VALUES (?, ?, ?, 'base', ?, ?, ?)",
+                (table_id, gen, str(path), merged.num_rows, max_seq,
+                 self.current_replay_epoch(table_id)))
             db.commit()
-            for _id, p, _k in files:
-                Path(p).unlink(missing_ok=True)
+            self._pending_inline_bytes(table_id, gen)  # refresh the gauge
+            for _id, p, _k, _b in files:
+                if p:  # inlined entries have no file
+                    Path(p).unlink(missing_ok=True)
             n_files = len(files)
             outcome = "ok"
             return n_files

@@ -41,6 +41,9 @@ ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL = \
     "etl_device_decode_fallback_rows_total"
 ETL_DEVICE_DECODE_SECONDS = "etl_device_decode_seconds"
 ETL_PROCESSED_BYTES_TOTAL = "etl_processed_bytes_total"
+# pending catalog-inlined bytes per lake table (reference
+# ETL_DUCKLAKE_TABLE_ACTIVE_INLINED_DATA_BYTES, ducklake/inline_size.rs)
+ETL_LAKE_INLINED_DATA_BYTES = "etl_lake_inlined_data_bytes"
 
 # label keys
 LABEL_PIPELINE_ID = "pipeline_id"

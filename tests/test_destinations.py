@@ -900,3 +900,152 @@ class TestDefaultExpressions:
                             default_expression="'2024-05-01'::date")
         assert bq_field(col3, set())["defaultValueExpression"] == \
             "DATE '2024-05-01'"
+
+
+class TestLakeReplayEpochs:
+    """Replay-epoch markers (reference ducklake/replay_epoch.rs): resets
+    rotate an opaque per-table epoch under a two-phase transition so the
+    sequence watermark can never dedup re-replayed data, and a crash
+    mid-reset completes at the next startup."""
+
+    async def test_truncate_rotates_epoch_and_replays_old_sequences(
+            self, tmp_path):
+        from etl_tpu.destinations.lake import LEGACY_REPLAY_EPOCH
+
+        d = LakeDestination(LakeConfig(str(tmp_path)))
+        await d.startup()
+        await d.write_events([ins(0, [1, "pre", None], lsn=0x500)])
+        assert d.current_replay_epoch(TID) == LEGACY_REPLAY_EPOCH
+        await d.write_events([TruncateEvent(Lsn(1), Lsn(1), 0, 0,
+                                            (make_schema(),))])
+        epoch1 = d.current_replay_epoch(TID)
+        assert epoch1 != LEGACY_REPLAY_EPOCH
+        # re-replayed batch with the SAME pre-reset sequence key must land
+        await d.write_events([ins(0, [1, "replayed", None], lsn=0x500)])
+        recs = d.read_current(TID).to_pylist()
+        assert [r["note"] for r in recs] == ["replayed"]
+        # another reset rotates again
+        await d.write_events([TruncateEvent(Lsn(2), Lsn(2), 0, 0,
+                                            (make_schema(),))])
+        assert d.current_replay_epoch(TID) not in (LEGACY_REPLAY_EPOCH,
+                                                   epoch1)
+        await d.shutdown()
+
+    async def test_crashed_transition_completes_at_startup(self, tmp_path):
+        """begin recorded, crash before the reset: the next startup
+        re-runs the reset and promotes the pending epoch."""
+        d = LakeDestination(LakeConfig(str(tmp_path)))
+        await d.startup()
+        await d.write_events([ins(0, [1, "old", None], lsn=0x500)])
+        pending = d._begin_replay_reset(TID)
+        await d.shutdown()  # "crash" between begin and complete
+
+        d2 = LakeDestination(LakeConfig(str(tmp_path)))
+        await d2.startup()  # resumes the transition
+        assert d2.current_replay_epoch(TID) == pending
+        assert d2.read_current(TID).num_rows == 0  # reset happened
+        # watermark cleared: the old sequence key re-applies
+        await d2.write_events([ins(0, [1, "new", None], lsn=0x500)])
+        assert d2.read_current(TID).to_pylist()[0]["note"] == "new"
+        await d2.shutdown()
+
+    async def test_begin_is_idempotent(self, tmp_path):
+        d = LakeDestination(LakeConfig(str(tmp_path)))
+        await d.startup()
+        await d.write_events([ins(0, [1, "x", None])])
+        p1 = d._begin_replay_reset(TID)
+        p2 = d._begin_replay_reset(TID)  # resume keeps the SAME pending
+        assert p1 == p2
+        await d.shutdown()
+
+
+class TestLakeInlining:
+    """Data inlining (reference ducklake/inline_size.rs): small CDC
+    batches live in the catalog until the flush threshold merges them
+    into one Parquet file."""
+
+    def config(self, tmp_path, flush=10**9):
+        return LakeConfig(str(tmp_path), compact_min_files=10**9,
+                          inline_max_bytes=64 * 1024,
+                          inline_flush_bytes=flush)
+
+    def _parquet_files(self, tmp_path):
+        from pathlib import Path
+
+        return [p for p in Path(str(tmp_path)).rglob("data-*.parquet")]
+
+    async def test_small_batches_stay_inline(self, tmp_path):
+        d = LakeDestination(self.config(tmp_path))
+        await d.startup()
+        for i in range(5):
+            await d.write_events([ins(0, [i, f"n{i}", None],
+                                      lsn=0x600 + i)])
+        assert self._parquet_files(tmp_path) == []  # no tiny files
+        recs = {r["id"] for r in d.read_current(TID).to_pylist()}
+        assert recs == {0, 1, 2, 3, 4}
+        await d.shutdown()
+
+    async def test_flush_threshold_merges_to_one_parquet(self, tmp_path):
+        d = LakeDestination(self.config(tmp_path, flush=2_000))
+        await d.startup()
+        for i in range(30):
+            await d.write_events([ins(0, [i, "n" * 40, None],
+                                      lsn=0x700 + i)])
+        files = self._parquet_files(tmp_path)
+        assert files, "flush threshold never produced a parquet file"
+        # each flush merges several batches: fewer files than batches,
+        # nothing lost
+        assert len(files) < 15
+        assert d.read_current(TID).num_rows == 30
+        await d.shutdown()
+
+    async def test_flush_survives_interleaved_deletes(self, tmp_path):
+        """Sequence-aware collapse: flushing non-contiguous inlined
+        entries must not resurrect rows deleted by interleaved non-inlined
+        files."""
+        d = LakeDestination(self.config(tmp_path))
+        await d.startup()
+        await d.write_events([ins(0, [1, "keep", None], lsn=0x800)])
+        # big batch → goes to parquet, deletes id=1
+        big = [DeleteEvent(Lsn(0x801), Lsn(0x801), 0, make_schema(),
+                           TableRow([1, None, None]))]
+        big += [ins(i, [100 + i, "pad" * 600, None], lsn=0x802)
+                for i in range(60)]
+        await d.write_events(big)
+        # later small inline batch
+        await d.write_events([ins(0, [2, "after", None], lsn=0x900)])
+        await d.flush_inlined(TID)  # merge the non-contiguous inlined rows
+        recs = {r["id"] for r in d.read_current(TID).to_pylist()}
+        assert 1 not in recs, "flush reordering resurrected a deleted row"
+        assert 2 in recs and 100 in recs
+        await d.shutdown()
+
+    async def test_restart_preserves_inlined_data(self, tmp_path):
+        d = LakeDestination(self.config(tmp_path))
+        await d.startup()
+        await d.write_events([ins(0, [7, "inline-me", None], lsn=0xa00)])
+        await d.shutdown()
+        d2 = LakeDestination(self.config(tmp_path))
+        await d2.startup()
+        assert d2.read_current(TID).to_pylist()[0]["note"] == "inline-me"
+        await d2.shutdown()
+
+    async def test_compaction_includes_inlined_entries(self, tmp_path):
+        d = LakeDestination(LakeConfig(str(tmp_path), compact_min_files=4,
+                                       inline_max_bytes=64 * 1024,
+                                       inline_flush_bytes=10**9))
+        await d.startup()
+        for i in range(4):
+            await d.write_events([ins(0, [i, f"c{i}", None],
+                                      lsn=0xb00 + i)])
+        # inlined entries do NOT fire the compaction trigger (they are
+        # the cheap tier) — an explicit compact still consumes them
+        assert d.current_cdc_file_count(TID) == 0
+        assert await d.compact(TID) > 0
+        assert d.read_current(TID).num_rows == 4
+        db = d._catalog()
+        (inlined,) = db.execute(
+            "SELECT COUNT(*) FROM lake_files WHERE inline_payload IS NOT "
+            "NULL AND table_id = ?", (TID,)).fetchone()
+        assert inlined == 0, "compaction left inlined entries behind"
+        await d.shutdown()
