@@ -68,23 +68,9 @@ LEGACY_REPLAY_EPOCH = "__legacy__"
 def _concat_cdc_batches(batches: "list[pa.RecordBatch]") -> pa.Table:
     """Concatenate CDC record batches whose schemas may differ only in the
     optional PATCH-missing column: align on the column union, null-filling
-    the absentees."""
-    tables = [pa.Table.from_batches([b]) for b in batches]
-    names: list[str] = []
-    for t in tables:
-        for n in t.schema.names:
-            if n not in names:
-                names.append(n)
-    aligned = []
-    for t in tables:
-        for n in names:
-            if n not in t.schema.names:
-                typ = next(tt.schema.field(n).type for tt in tables
-                           if n in tt.schema.names)
-                t = t.append_column(pa.field(n, typ),
-                                    pa.nulls(t.num_rows, typ))
-        aligned.append(t.select(names))
-    return pa.concat_tables(aligned)
+    the absentees (Arrow's schema unification does exactly this)."""
+    return pa.concat_tables([pa.Table.from_batches([b]) for b in batches],
+                            promote_options="default")
 
 
 class LakeDestination(Destination):
@@ -316,14 +302,15 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
         """Accumulated catalog-inlined bytes for one table generation —
         the flush-policy input, exported as a gauge (reference
         DuckLakePendingInlineSizeSampler)."""
-        from ..telemetry.metrics import ETL_LAKE_INLINED_DATA_BYTES, registry
+        from ..telemetry.metrics import (ETL_LAKE_INLINED_DATA_BYTES,
+                                         LABEL_TABLE, registry)
 
         (n,) = self._catalog().execute(
             "SELECT COALESCE(SUM(LENGTH(inline_payload)), 0) FROM "
             "lake_files WHERE table_id = ? AND generation = ? AND "
             "inline_payload IS NOT NULL", (table_id, gen)).fetchone()
         registry.gauge_set(ETL_LAKE_INLINED_DATA_BYTES, n,
-                           labels={"table": str(table_id)})
+                           labels={LABEL_TABLE: str(table_id)})
         return int(n)
 
     async def flush_inlined(self, table_id: TableId) -> int:
@@ -372,6 +359,13 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
                 db.execute("ROLLBACK")
             except sqlite3.OperationalError:
                 pass  # commit failures auto-rollback; keep the real error
+            # the rollback restored the inlined entries, so the merged
+            # file is unreferenced — remove it or it leaks forever
+            # (vacuum only deletes cataloged paths)
+            try:
+                path.unlink(missing_ok=True)
+            except (OSError, UnboundLocalError):
+                pass
             raise
         self._pending_inline_bytes(table_id, gen)  # refresh the gauge
         return len(entries)
@@ -394,7 +388,18 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
                 Path(path).unlink(missing_ok=True)
         db.execute("DELETE FROM lake_files WHERE table_id = ?", (table_id,))
         db.execute("DELETE FROM lake_tables WHERE table_id = ?", (table_id,))
+        # a re-added table must start from LEGACY_REPLAY_EPOCH, not inherit
+        # the dropped table's epoch chain
+        db.execute("DELETE FROM lake_replay_epochs WHERE table_id = ?",
+                   (table_id,))
         db.commit()
+        from ..telemetry.metrics import (ETL_LAKE_INLINED_DATA_BYTES,
+                                         LABEL_TABLE, registry)
+
+        # clear the pending-inline gauge so a dropped table doesn't report
+        # phantom unflushed bytes forever
+        registry.gauge_set(ETL_LAKE_INLINED_DATA_BYTES, 0,
+                           labels={LABEL_TABLE: str(table_id)})
 
     # -- replay epochs (reference ducklake/replay_epoch.rs) -------------------
 
@@ -731,6 +736,13 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
             try:
                 db.execute("ROLLBACK")
             except sqlite3.OperationalError:
+                pass
+            # rollback restored the source file rows: the merged file is
+            # unreferenced — remove it or it leaks (vacuum only deletes
+            # cataloged paths)
+            try:
+                path.unlink(missing_ok=True)
+            except (OSError, UnboundLocalError):
                 pass
             raise
         finally:

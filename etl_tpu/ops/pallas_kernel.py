@@ -7,11 +7,15 @@ result blocks out without materializing any [R, W] intermediate in HBM —
 and (b) serve as the template for fusing more of the pipeline (validity
 masks, filtering) as column counts grow. `DeviceDecoder(use_pallas=True)`
 selects it; `bench.py --mode decode` measures BOTH engines every run and
-reports both numbers. XLA stays the production default: current libtpu's
-Mosaic rejects some byte-wise lowerings, and when the kernel fails to
-compile the decoder logs and falls back to the XLA program permanently
-for that instance (engine._device_call), so pallas can only win the
-bench headline when it genuinely compiles and measures faster.
+reports both numbers. XLA stays the production default BY MEASUREMENT
+(v5e, 262k-row pgbench batches): the XLA-fused program sustains ~1.47M
+rec/s while this kernel does ~98k — Mosaic lowers the byte-wise parse
+chain onto 128-lane-padded vectors at 1-12 useful lanes each, wasting
+>90% of the VPU, and the 256-step grid serializes what XLA fuses into
+one pass. If the kernel fails to compile the decoder logs and falls
+back to the XLA program permanently for that instance
+(engine._device_call), so pallas can only win the bench headline when
+it genuinely compiles and measures faster.
 
 Falls back to interpret mode off-TPU so the differential tests cover the
 same code path on CPU.
@@ -28,7 +32,12 @@ from jax.experimental import pallas as pl
 from ..models.pgtypes import CellKind
 from . import parsers
 
-DEFAULT_BLOCK_ROWS = 4096
+# Scoped-VMEM bound, measured on v5e (16 MB scoped limit): the kernel's
+# per-column byte slices are 1-12 lanes wide and Mosaic pads every
+# intermediate to 128 lanes, so the parse chain costs ~13.6 KB/row of
+# VMEM. 1024 rows/block ≈ 13.9 MB compiles; 2048 (27.8 MB) and the old
+# 4096 (55.6 MB) are rejected with a vmem-stack OOM at AOT time.
+DEFAULT_BLOCK_ROWS = 1024
 
 
 def build_pallas_program(specs: tuple[tuple[int, CellKind, int, int], ...],

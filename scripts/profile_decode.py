@@ -86,8 +86,9 @@ def main():
           f"-> {B.N_ROWS/fd_med:.0f} rows/s blocking")
 
     # pipelined, as bench does
-    tp, _ = B.bench_tpu(payloads, schema, B.N_ROWS)
-    print(f"bench_tpu pipelined: {tp:.0f} rows/s")
+    rates, _ = B.bench_tpu(payloads, schema, B.N_ROWS)
+    print(f"bench_tpu pipelined: peak={rates[-1]:.0f} "
+          f"med={rates[len(rates) // 2]:.0f} rows/s")
 
 
 if __name__ == "__main__":
