@@ -75,9 +75,16 @@ class Destination(abc.ABC):
         """CDC path: ordered events (possibly spanning tables)."""
 
     @abc.abstractmethod
-    async def drop_table(self, table_id: TableId) -> None:
+    async def drop_table(self, table_id: TableId,
+                         schema: ReplicatedTableSchema | None = None) -> None:
         """Drop destination table before a (re)copy
-        (reference table_sync/mod.rs:184-220 crash-consistency)."""
+        (reference table_sync/mod.rs:184-220 crash-consistency).
+
+        `schema` is the prior stored schema, passed so a freshly restarted
+        process — whose in-memory table-name mappings are empty — can still
+        resolve which destination table (and channel, for Snowpipe) to
+        drop. The reference resolves this through its schema store;
+        destinations here rebuild the mapping from the hint."""
 
     @abc.abstractmethod
     async def truncate_table(self, table_id: TableId) -> None: ...

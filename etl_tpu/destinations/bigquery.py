@@ -498,7 +498,10 @@ class BigQueryDestination(Destination):
             "viewId": f"{base}_view",
             "query": f"SELECT * FROM `{self.config.dataset_id}.{table}`"})
 
-    async def drop_table(self, table_id: TableId) -> None:
+    async def drop_table(self, table_id: TableId,
+                         schema: ReplicatedTableSchema | None = None) -> None:
+        if table_id not in self._names and schema is not None:
+            self._base_name(schema)  # restart recovery: rebuild the mapping
         name = self._names.get(table_id)
         if name is None:
             return

@@ -339,8 +339,10 @@ class ClickHouseDestination(Destination):
             await self._execute(create_current_view_sql(
                 self.config.database, name, new))
 
-    async def drop_table(self, table_id: TableId) -> None:
-        schema = self._created_tables.get(table_id)
+    async def drop_table(self, table_id: TableId,
+                         schema: ReplicatedTableSchema | None = None) -> None:
+        if table_id not in self._names and schema is not None:
+            self._table_name(schema)  # restart recovery: rebuild the mapping
         name = self._names.get(table_id)
         if name is None:
             return

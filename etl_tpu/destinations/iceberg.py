@@ -196,7 +196,11 @@ class IcebergDestination(Destination):
                           "schema": self._iceberg_schema(new)}]})
         self._created[new.id] = new
 
-    async def drop_table(self, table_id: TableId) -> None:
+    async def drop_table(self, table_id: TableId,
+                         schema: ReplicatedTableSchema | None = None) -> None:
+        if table_id not in self._names and schema is not None:
+            # restart recovery: rebuild the name mapping from the hint
+            self._names.setdefault(table_id, escaped_table_name(schema.name))
         name = self._names.get(table_id)
         if name is not None:
             await self._api(

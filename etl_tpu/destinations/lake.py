@@ -380,7 +380,10 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
             "generation = ? AND kind = 'cdc' AND inline_payload IS NULL",
             (table_id, gen)).fetchone()[0]
 
-    async def drop_table(self, table_id: TableId) -> None:
+    async def drop_table(self, table_id: TableId,
+                         schema: ReplicatedTableSchema | None = None) -> None:
+        # schema hint unused: the catalog is persistent, so the name
+        # mapping survives restarts
         db = self._catalog()
         for (path,) in db.execute("SELECT path FROM lake_files WHERE "
                                   "table_id = ?", (table_id,)):

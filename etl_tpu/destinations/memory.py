@@ -45,7 +45,8 @@ class MemoryDestination(Destination):
         self.events.extend(expand_batch_events(events))
         return WriteAck.durable()
 
-    async def drop_table(self, table_id: TableId) -> None:
+    async def drop_table(self, table_id: TableId,
+                         schema=None) -> None:
         self.table_rows.pop(table_id, None)
         self.dropped_tables.append(table_id)
 
@@ -129,9 +130,10 @@ class FaultInjectingDestination(Destination):
         return await self._apply_fault(
             "write_events", lambda: self.inner.write_events(events))
 
-    async def drop_table(self, table_id: TableId) -> None:
+    async def drop_table(self, table_id: TableId,
+                         schema=None) -> None:
         async def run():
-            await self.inner.drop_table(table_id)
+            await self.inner.drop_table(table_id, schema)
             return WriteAck.durable()
 
         await self._apply_fault("drop_table", run)

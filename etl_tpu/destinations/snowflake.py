@@ -1,20 +1,28 @@
-"""Snowflake destination: Snowpipe-Streaming-style REST + keypair JWT.
+"""Snowflake destination: real Snowpipe Streaming REST + keypair JWT.
 
 Reference parity: crates/etl-destinations/src/snowflake/ (6.2k LoC):
-  - streaming row batches through channel-scoped REST calls with offset
-    tokens (streaming/: RowBatch, OffsetToken, StreamClient) — the offset
-    token carries the batch's max sequence key so re-deliveries after a
-    crash are server-side deduplicated;
+  - Snowpipe Streaming wire protocol — hostname discovery, per-table
+    channels under `pipes/{table}-STREAMING`, continuation-token chaining,
+    zstd NDJSON row bodies, offset-token dedup and commit proof — lives in
+    `snowpipe.py` (streaming/{rest_client,channel,batch,offset_token}.rs);
   - JWT keypair auth (auth.rs): RS256 tokens with the
-    account.user.SHA256:fingerprint issuer convention;
+    account.user.SHA256:fingerprint issuer convention, invalidated and
+    re-signed when the API reports auth expiry;
   - SQL client for DDL (sql_client.rs) via the statements REST API;
-  - CDC metadata columns (encoding.rs CdcMeta/CdcOperation).
+  - CDC metadata columns `_cdc_operation` / `_cdc_sequence_number`
+    (schema.rs:6-7, encoding.rs CdcMeta).
+
+Durability model: the reference defers commit proof behind Accepted acks
+and waits at pipeline barriers (core.rs:260-275). Here each write call
+runs its own barrier before acking durable — the 64-batch/256 MB copy
+window still amortizes status polls across the many batches of one call,
+and the ack never claims durability Snowflake hasn't proven.
 """
 
 from __future__ import annotations
 
+import asyncio
 import base64
-import datetime as dt
 import json
 import time
 from dataclasses import dataclass
@@ -31,11 +39,16 @@ from ..models.table_row import ColumnarBatch
 from .base import Destination, WriteAck, expand_batch_events
 from ..models.default_expression import column_default_sql
 from .bigquery import encode_value  # same JSON value encoding rules
-from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
-                   DestinationRetryPolicy, change_type_label,
-                   escaped_table_name, http_status_retryable,
-                   require_full_row, sequential_event_program,
-                   with_retries)
+from .snowpipe import (ZERO_OFFSET, AcceptedBatch, ChannelHandle,
+                       RestStreamClient, RowBatch, RowBatchBuilder,
+                       offset_token)
+from .util import (DestinationRetryPolicy, escaped_table_name,
+                   http_status_retryable, require_full_row,
+                   sequential_event_program, with_retries)
+
+# CDC metadata column names (reference schema.rs:6-7)
+CDC_OPERATION_COLUMN = "_cdc_operation"
+CDC_SEQUENCE_COLUMN = "_cdc_sequence_number"
 
 _SF_TYPES: dict[CellKind, str] = {
     CellKind.BOOL: "BOOLEAN", CellKind.I16: "NUMBER(5,0)",
@@ -50,6 +63,9 @@ _SF_TYPES: dict[CellKind, str] = {
     CellKind.INTERVAL: "VARCHAR",
 }
 
+_OP_LABEL = {ChangeType.INSERT: "insert", ChangeType.UPDATE: "update",
+             ChangeType.DELETE: "delete"}
+
 
 @dataclass(frozen=True)
 class SnowflakeConfig:
@@ -59,6 +75,9 @@ class SnowflakeConfig:
     database: str
     schema: str = "PUBLIC"
     private_key_pem: str = ""  # PKCS#8 RSA key for JWT; "" = no auth header
+    pipeline_id: int = 0  # channel names embed it (channel.rs:251)
+    commit_poll_interval_s: float = 0.5  # channel.rs:22
+    commit_wait_timeout_s: float = 180.0  # channel.rs:28
 
 
 def make_jwt(config: SnowflakeConfig, lifetime_s: int = 3600) -> str:
@@ -90,42 +109,77 @@ def make_jwt(config: SnowflakeConfig, lifetime_s: int = 3600) -> str:
             + base64.urlsafe_b64encode(sig).rstrip(b"=")).decode()
 
 
+class _KeyPairTokenProvider:
+    """Caches the signed JWT until near expiry; `invalidate_token` forces a
+    re-sign on the next request (reference auth.rs TokenProvider)."""
+
+    def __init__(self, config: SnowflakeConfig):
+        self.config = config
+        self._cached: tuple[str, float] | None = None
+
+    async def get_token(self) -> str:
+        if not self.config.private_key_pem:
+            return ""
+        now = time.time()
+        if self._cached is None or now > self._cached[1] - 60:
+            self._cached = (make_jwt(self.config), now + 3600)
+        return self._cached[0]
+
+    def invalidate_token(self) -> None:
+        self._cached = None
+
+
 class SnowflakeDestination(Destination):
     def __init__(self, config: SnowflakeConfig,
                  retry: DestinationRetryPolicy | None = None):
         self.config = config
         self.retry = retry or DestinationRetryPolicy()
+        self.auth = _KeyPairTokenProvider(config)
         self._session: aiohttp.ClientSession | None = None
+        self._stream = RestStreamClient(config.base_url, self.auth,
+                                        self._get_session, self.retry)
         self._created: dict[TableId, ReplicatedTableSchema] = {}
         self._names: dict[TableId, str] = {}
-        self._offsets: dict[TableId, str] = {}  # channel offset tokens
-        self._jwt: tuple[str, float] | None = None  # (token, expiry)
+        self._channels: dict[TableId, ChannelHandle] = {}
+        # ChannelHandle mirrors the Rust original's &mut self methods: it
+        # is NOT safe under concurrent callers (continuation tokens chain
+        # across awaits). Parallel copy partitions hit the same table's
+        # channel, so every channel interaction holds this per-table lock.
+        self._table_locks: dict[TableId, asyncio.Lock] = {}
 
-    async def _api(self, method: str, path: str,
-                   body: dict | None = None) -> dict:
+    def _get_session(self) -> aiohttp.ClientSession:
         if self._session is None:
             self._session = aiohttp.ClientSession()
-        headers = {}
-        if self.config.private_key_pem:
-            # cache the signed token until near expiry: PEM parse +
-            # fingerprint + RSA sign per request would tax the hot path
-            now = time.time()
-            if self._jwt is None or now > self._jwt[1] - 60:
-                self._jwt = (make_jwt(self.config), now + 3600)
-            headers["Authorization"] = f"Bearer {self._jwt[0]}"
-            headers["X-Snowflake-Authorization-Token-Type"] = "KEYPAIR_JWT"
+        return self._session
 
+    # -- SQL statements API (sql_client.rs) ------------------------------------
+
+    async def _sql(self, statement: str) -> dict:
         async def attempt() -> dict:
-            async with self._session.request(
-                    method, f"{self.config.base_url}{path}", json=body,
+            headers = {}
+            token = await self.auth.get_token()
+            if token:
+                headers["Authorization"] = f"Bearer {token}"
+                headers["X-Snowflake-Authorization-Token-Type"] = \
+                    "KEYPAIR_JWT"
+            async with self._get_session().post(
+                    f"{self.config.base_url}/api/v2/statements",
+                    json={"statement": statement,
+                          "database": self.config.database,
+                          "schema": self.config.schema},
                     headers=headers) as resp:
                 text = await resp.text()
+                if resp.status == 401:
+                    # auth expiry is transient once re-signed: invalidate
+                    # the cached JWT and retry (reference auth.rs)
+                    self.auth.invalidate_token()
                 if resp.status >= 400:
                     raise EtlError(
                         ErrorKind.DESTINATION_THROTTLED
-                        if http_status_retryable(resp.status)
+                        if resp.status == 401
+                        or http_status_retryable(resp.status)
                         else ErrorKind.DESTINATION_FAILED,
-                        f"snowflake {resp.status} {path}: {text[:300]}")
+                        f"snowflake {resp.status} statements: {text[:300]}")
                 return json.loads(text) if text else {}
 
         def retryable(e: BaseException) -> bool:
@@ -135,14 +189,11 @@ class SnowflakeDestination(Destination):
 
         return await with_retries(attempt, self.retry, retryable)
 
-    async def _sql(self, statement: str) -> dict:
-        return await self._api("POST", "/api/v2/statements", {
-            "statement": statement, "database": self.config.database,
-            "schema": self.config.schema})
-
     async def startup(self) -> None:
         await self._sql(
             f'CREATE SCHEMA IF NOT EXISTS "{self.config.schema}"')
+
+    # -- table DDL -------------------------------------------------------------
 
     def _table_name(self, schema: ReplicatedTableSchema) -> str:
         return self._names.setdefault(
@@ -152,6 +203,13 @@ class SnowflakeDestination(Destination):
         name = self._table_name(schema)
         if self._created.get(schema.id) == schema:
             return name
+        for c in schema.replicated_columns:
+            # reference schema.rs validate_no_cdc_collisions
+            if c.name in (CDC_OPERATION_COLUMN, CDC_SEQUENCE_COLUMN):
+                raise EtlError(
+                    ErrorKind.CONFIG_INVALID,
+                    f"snowflake: source column {c.name!r} collides with a "
+                    f"CDC metadata column")
         identity = {c.name for c in schema.identity_columns()}
         # non-identity columns stay nullable: key-only DELETE rows carry
         # nulls for them
@@ -165,40 +223,70 @@ class SnowflakeDestination(Destination):
             return s
 
         cols = [spec(c) for c in schema.replicated_columns]
-        cols.append(f'"{CHANGE_TYPE_COLUMN}" VARCHAR(6)')
-        cols.append(f'"{CHANGE_SEQUENCE_COLUMN}" VARCHAR(64)')
+        cols.append(f'"{CDC_OPERATION_COLUMN}" VARCHAR NOT NULL')
+        cols.append(f'"{CDC_SEQUENCE_COLUMN}" VARCHAR NOT NULL')
         await self._sql(f'CREATE TABLE IF NOT EXISTS "{name}" '
                         f'({", ".join(cols)})')
         self._created[schema.id] = schema
         return name
 
-    def _channel_path(self, name: str) -> str:
-        return (f"/v2/streaming/databases/{self.config.database}/schemas/"
-                f"{self.config.schema}/tables/{name}/channels/etl")
+    # -- channels --------------------------------------------------------------
 
-    async def _insert_rows(self, schema: ReplicatedTableSchema, name: str,
-                           rows: list[dict], offset_token: str) -> None:
-        prev = self._offsets.get(schema.id, "")
-        if offset_token and prev and offset_token <= prev:
-            return  # offset-token dedup on re-delivery
-        await self._api("POST", f"{self._channel_path(name)}/rows",
-                        {"rows": rows, "offset_token": offset_token})
-        if offset_token:
-            self._offsets[schema.id] = offset_token
+    def _channel(self, schema: ReplicatedTableSchema) -> ChannelHandle:
+        handle = self._channels.get(schema.id)
+        if handle is None:
+            name = self._table_name(schema)
+            handle = ChannelHandle(
+                self._stream, self.config.database, self.config.schema,
+                name,
+                channel=(f"etl_{self.config.pipeline_id}_"
+                         f"{self.config.schema}_{name}_ch0"),
+                poll_interval_s=self.config.commit_poll_interval_s,
+                wait_timeout_s=self.config.commit_wait_timeout_s)
+            self._channels[schema.id] = handle
+        return handle
+
+    def _lock_for(self, table_id: TableId) -> asyncio.Lock:
+        return self._table_locks.setdefault(table_id, asyncio.Lock())
+
+    async def _open_channel(self, schema: ReplicatedTableSchema
+                            ) -> ChannelHandle:
+        handle = self._channel(schema)
+        if not handle.is_open:
+            await handle.open()
+        return handle
+
+    # -- row encoding ----------------------------------------------------------
+
+    def _doc(self, schema: ReplicatedTableSchema, row, op: str,
+             sequence: str) -> dict:
+        doc = {c.name: encode_value(v, c.kind)
+               for c, v in zip(schema.replicated_columns, row.values)}
+        doc[CDC_OPERATION_COLUMN] = op
+        doc[CDC_SEQUENCE_COLUMN] = sequence
+        return doc
+
+    # -- copy path -------------------------------------------------------------
 
     async def write_table_rows(self, schema: ReplicatedTableSchema,
                                batch: ColumnarBatch) -> WriteAck:
-        name = await self._ensure_table(schema)
-        rows = []
+        await self._ensure_table(schema)
+        builder = RowBatchBuilder()
         for i in range(batch.num_rows):
             doc = {c.schema.name: encode_value(c.value(i), c.schema.kind)
                    for c in batch.columns}
-            doc[CHANGE_TYPE_COLUMN] = "UPSERT"
-            doc[CHANGE_SEQUENCE_COLUMN] = f"{i:016x}"
-            rows.append(doc)
-        if rows:
-            await self._insert_rows(schema, name, rows, "")
+            doc[CDC_OPERATION_COLUMN] = "insert"
+            doc[CDC_SEQUENCE_COLUMN] = ZERO_OFFSET
+            builder.push_row(doc, ZERO_OFFSET)
+        batches = builder.finish()
+        if batches:
+            async with self._lock_for(schema.id):
+                handle = await self._open_channel(schema)
+                await handle.accept_table_copy_batches(batches)
+                await handle.wait_for_table_copy_durability()
         return WriteAck.durable()
+
+    # -- CDC path --------------------------------------------------------------
 
     async def write_events(self, events: Sequence[Event]) -> WriteAck:
         for op in sequential_event_program(expand_batch_events(events)):
@@ -207,6 +295,10 @@ class SnowflakeDestination(Destination):
                 await self._write_cdc_run(schema, evs)
             elif op[0] == "truncate":
                 for sch in op[1].schemas:
+                    # register the mapping first: after a restart the
+                    # truncate would otherwise silently no-op
+                    self._table_name(sch)
+                    self._created.setdefault(sch.id, sch)
                     await self.truncate_table(sch.id)
             else:
                 await self._apply_ddl(op[1])
@@ -214,23 +306,43 @@ class SnowflakeDestination(Destination):
 
     async def _write_cdc_run(self, schema: ReplicatedTableSchema,
                              evs: list) -> None:
-        name = await self._ensure_table(schema)
-        rows = []
-        max_seq = ""
-        for i, e in enumerate(evs):
-            seq = e.sequence_key.with_ordinal(i)
-            max_seq = max(max_seq, seq)
-            row = e.old_row if isinstance(e, DeleteEvent) else e.row
-            ct = ChangeType.DELETE if isinstance(e, DeleteEvent) \
-                else ChangeType.INSERT
-            if ct is not ChangeType.DELETE:
+        await self._ensure_table(schema)
+        builder = RowBatchBuilder()
+        for e in evs:
+            off = offset_token(int(e.commit_lsn), e.tx_ordinal)
+            if isinstance(e, DeleteEvent):
+                row, ct = e.old_row, ChangeType.DELETE
+            else:
+                row, ct = e.row, (ChangeType.UPDATE
+                                  if isinstance(e, UpdateEvent)
+                                  else ChangeType.INSERT)
                 require_full_row("snowflake", schema, row)
-            doc = {c.name: encode_value(v, c.kind)
-                   for c, v in zip(schema.replicated_columns, row.values)}
-            doc[CHANGE_TYPE_COLUMN] = change_type_label(ct)
-            doc[CHANGE_SEQUENCE_COLUMN] = seq
-            rows.append(doc)
-        await self._insert_rows(schema, name, rows, max_seq)
+            builder.push_row(self._doc(schema, row, _OP_LABEL[ct], off),
+                             off)
+        batches = builder.finish()
+        if not batches:
+            return
+        async with self._lock_for(schema.id):
+            handle = await self._open_channel(schema)
+            accepted = await handle.accept_streaming_batches(batches)
+            if accepted:
+                # durability barrier: don't ack until Snowflake proves the
+                # last offset committed. The proof aggregates EVERY
+                # accepted batch of this run — validating only the last
+                # batch would let rows silently dropped from an earlier
+                # batch pass the check that exists to catch them
+                total = AcceptedBatch(
+                    target_offset=accepted[-1].target_offset,
+                    rows=sum(a.rows for a in accepted),
+                    bytes=sum(a.bytes for a in accepted),
+                    baseline_rows_inserted=
+                        accepted[0].baseline_rows_inserted,
+                    baseline_rows_error_count=
+                        accepted[0].baseline_rows_error_count)
+                await handle.wait_for_offsets_committed(
+                    total.target_offset, total)
+
+    # -- DDL / lifecycle -------------------------------------------------------
 
     async def _apply_ddl(self, ev: SchemaChangeEvent) -> None:
         from ..models.schema import SchemaDiff
@@ -255,18 +367,39 @@ class SnowflakeDestination(Destination):
                             f'"{col.name}"')
         self._created[ev.table_id] = new
 
-    async def drop_table(self, table_id: TableId) -> None:
+    async def drop_table(self, table_id: TableId,
+                         schema: ReplicatedTableSchema | None = None) -> None:
+        if table_id not in self._names and schema is not None:
+            # restart recovery: rebuild the name mapping so the drop (and
+            # the channel drop, which clears server-side offsets) happens
+            self._table_name(schema)
+            self._created.setdefault(table_id, schema)
         name = self._names.get(table_id)
         if name is not None:
-            await self._sql(f'DROP TABLE IF EXISTS "{name}"')
-            self._created.pop(table_id, None)
-            self._offsets.pop(table_id, None)
+            async with self._lock_for(table_id):
+                stored = self._created.get(table_id)
+                handle = self._channels.pop(table_id, None)
+                if handle is None and stored is not None:
+                    handle = self._channel(stored)
+                    self._channels.pop(table_id, None)
+                if handle is not None:
+                    await handle.drop()
+                await self._sql(f'DROP TABLE IF EXISTS "{name}"')
+                self._created.pop(table_id, None)
 
     async def truncate_table(self, table_id: TableId) -> None:
         name = self._names.get(table_id)
         if name is not None:
-            await self._sql(f'TRUNCATE TABLE IF EXISTS "{name}"')
-            self._offsets.pop(table_id, None)
+            async with self._lock_for(table_id):
+                await self._sql(f'TRUNCATE TABLE IF EXISTS "{name}"')
+                # the table restarts empty: reset the channel so its
+                # server-side committed offsets don't dedup the re-copied
+                # rows — always, not only when locally open: a restarted
+                # process must clear offsets a previous incarnation
+                # committed
+                schema = self._created.get(table_id)
+                if schema is not None:
+                    await self._channel(schema).reset()
 
     async def shutdown(self) -> None:
         if self._session is not None:

@@ -336,10 +336,15 @@ class TableSyncWorker:
         (reference table_sync/mod.rs:184-378)."""
         pool = self.pool
         store = self.store
-        # 1. destination drop if a previous copy may have written rows
+        # 1. destination drop if a previous copy may have written rows.
+        # Pass the prior stored schema: after a process restart the
+        # destination's in-memory name mapping is empty and the drop would
+        # silently no-op without it (schemas are only pruned below, in
+        # prepare_table_for_copy, so the prior version is still readable)
         prior = await store.get_destination_metadata(self.tid)
         if prior is not None:
-            await pool.destination.drop_table(self.tid)
+            prior_schema = await store.get_table_schema(self.tid)
+            await pool.destination.drop_table(self.tid, prior_schema)
             await store.delete_destination_metadata(self.tid)
         # 2. fresh slot + snapshot
         await source.delete_slot(slot_name)

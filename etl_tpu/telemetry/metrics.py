@@ -44,6 +44,10 @@ ETL_PROCESSED_BYTES_TOTAL = "etl_processed_bytes_total"
 # pending catalog-inlined bytes per lake table (reference
 # ETL_DUCKLAKE_TABLE_ACTIVE_INLINED_DATA_BYTES, ducklake/inline_size.rs)
 ETL_LAKE_INLINED_DATA_BYTES = "etl_lake_inlined_data_bytes"
+# Snowpipe channel reopened after a stale continuation token (reference
+# ETL_SNOWFLAKE_CHANNEL_RECOVERIES_TOTAL, snowflake/metrics.rs)
+ETL_SNOWPIPE_CHANNEL_RECOVERIES_TOTAL = \
+    "etl_snowpipe_channel_recoveries_total"
 
 # label keys
 LABEL_PIPELINE_ID = "pipeline_id"

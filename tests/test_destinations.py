@@ -26,6 +26,7 @@ from etl_tpu.models import (ChangeType, ColumnSchema, ColumnarBatch,
                             TableSchema, TruncateEvent, UpdateEvent)
 from etl_tpu.testing.fake_bq import StorageWriteFake
 from etl_tpu.testing.fake_http import RecordingHttpServer
+from etl_tpu.testing.fake_snowpipe import FakeSnowpipeServer
 
 TID = 700
 
@@ -520,63 +521,245 @@ class TestIceberg:
 
 
 class TestSnowflake:
+    """Against the protocol-enforcing Snowpipe emulator: stale
+    continuation tokens 400, uncommitted rows 409, zstd NDJSON bodies
+    required (reference snowflake/streaming/ wire surface)."""
+
+    PIPE = "d/PUBLIC/PUBLIC_USER__EVENTS-STREAMING"
+
     def make_key(self):
         from cryptography.hazmat.primitives.asymmetric import rsa
         from cryptography.hazmat.primitives import serialization
 
         key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
-        return key.private_key_bytes(
-            serialization.Encoding.PEM,
-            serialization.PrivateFormat.PKCS8,
-            serialization.NoEncryption()).decode() \
-            if hasattr(key, "private_key_bytes") else key.private_bytes(
+        return key.private_bytes(
             serialization.Encoding.PEM,
             serialization.PrivateFormat.PKCS8,
             serialization.NoEncryption()).decode()
 
-    async def test_streaming_with_jwt(self):
-        server = RecordingHttpServer()
+    def config(self, server, **kw):
+        kw.setdefault("commit_poll_interval_s", 0.005)
+        kw.setdefault("commit_wait_timeout_s", 2.0)
+        return SnowflakeConfig(base_url=server.url(), account="acct",
+                               user="etl", database="d", **kw)
+
+    async def dest(self, **server_kw):
+        server = FakeSnowpipeServer(**server_kw)
+        await server.start()
+        d = SnowflakeDestination(self.config(server), RETRY_FAST)
+        await d.startup()
+        return server, d
+
+    async def test_jwt_claims(self):
+        cfg = SnowflakeConfig(base_url="http://x", account="acct",
+                              user="etl", database="db",
+                              private_key_pem=self.make_key())
+        jwt = make_jwt(cfg)
+        assert jwt.count(".") == 2
+        import base64 as b64
+
+        claims = json.loads(b64.urlsafe_b64decode(jwt.split(".")[1] + "=="))
+        assert claims["sub"] == "ACCT.ETL"
+        assert claims["iss"].startswith("ACCT.ETL.SHA256:")
+
+    async def test_streaming_wire_shape(self):
+        server = FakeSnowpipeServer(require_auth=True)
         await server.start()
         try:
-            pem = self.make_key()
-            cfg = SnowflakeConfig(base_url=server.url(), account="acct",
-                                  user="etl", database="db",
-                                  private_key_pem=pem)
-            jwt = make_jwt(cfg)
-            assert jwt.count(".") == 2
-            import base64 as b64, json as j
-
-            claims = j.loads(b64.urlsafe_b64decode(
-                jwt.split(".")[1] + "=="))
-            assert claims["sub"] == "ACCT.ETL"
-            assert claims["iss"].startswith("ACCT.ETL.SHA256:")
-
-            d = SnowflakeDestination(cfg, RETRY_FAST)
+            d = SnowflakeDestination(
+                self.config(server, private_key_pem=self.make_key()),
+                RETRY_FAST)
             await d.startup()
-            await d.write_events([ins(0, [1, "sf", None], lsn=0x700)])
-            reqs = server.requests
-            assert all("Authorization" in r.headers for r in reqs)
-            rows_req = [r for r in reqs if r.path.endswith("/rows")][0]
-            assert rows_req.json["rows"][0]["_CHANGE_TYPE"] == "UPSERT"
-            assert rows_req.json["offset_token"]
+            await d.write_events([
+                ins(0, [1, "sf", None], lsn=0x700),
+                DeleteEvent(Lsn(0x700), Lsn(0x700), 1, make_schema(),
+                            TableRow([1, None, None]))])
+            # hostname discovered once, channel opened via PUT, rows
+            # POSTed with the offset range in the query string
+            assert server.hostname_discoveries == 1
+            inserts = [q for m, p, q in server.requests
+                       if p.endswith("/rows")]
+            assert len(inserts) == 1
+            assert inserts[0]["continuationToken"].startswith("ct-")
+            assert inserts[0]["startOffsetToken"] == \
+                f"{0x700:016x}/{0:016x}"
+            assert inserts[0]["endOffsetToken"] == f"{0x700:016x}/{1:016x}"
+            docs = server.rows[self.PIPE]
+            assert docs[0]["_cdc_operation"] == "insert"
+            assert docs[0]["_cdc_sequence_number"] == \
+                f"{0x700:016x}/{0:016x}"
+            assert docs[1]["_cdc_operation"] == "delete"
+            assert docs[1]["id"] == 1
+            # DDL went through the statements API with CDC columns
+            create = [s for s in server.statements
+                      if s.startswith("CREATE TABLE")][0]
+            assert '"_cdc_operation" VARCHAR NOT NULL' in create
+            assert '"_cdc_sequence_number" VARCHAR NOT NULL' in create
             await d.shutdown()
         finally:
             await server.stop()
 
-    async def test_offset_token_dedup(self):
-        server = RecordingHttpServer()
-        await server.start()
+    async def test_offset_token_dedup_on_redelivery(self):
+        server, d = await self.dest()
         try:
-            cfg = SnowflakeConfig(base_url=server.url(), account="a",
-                                  user="u", database="d")
-            d = SnowflakeDestination(cfg, RETRY_FAST)
-            await d.startup()
             evs = [ins(0, [1, "x", None], lsn=0x800)]
             await d.write_events(evs)
-            await d.write_events(evs)  # same offset token → skipped
-            rows_reqs = [r for r in server.requests
-                         if r.path.endswith("/rows")]
+            await d.write_events(evs)  # offsets <= committed → skipped
+            rows_reqs = [p for _, p, _ in server.requests
+                         if p.endswith("/rows")]
             assert len(rows_reqs) == 1
+            assert len(server.rows[self.PIPE]) == 1
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_stale_continuation_reopens_and_retries(self):
+        server, d = await self.dest()
+        try:
+            await d.write_events([ins(0, [1, "a", None], lsn=0x900)])
+            # server rotates the token behind the client's back: next
+            # insert gets 400 STALE_CONTINUATION_TOKEN_SEQUENCER, the
+            # client must reopen the channel and resend
+            server.rotate_continuation_once = True
+            await d.write_events([ins(1, [2, "b", None], lsn=0x910)])
+            assert [r["id"] for r in server.rows[self.PIPE]] == [1, 2]
+            ch = next(iter(server.channels.values()))
+            assert ch.epoch == 1  # exactly one reopen
+            from etl_tpu.telemetry.metrics import (
+                ETL_SNOWPIPE_CHANNEL_RECOVERIES_TOTAL, registry)
+
+            assert registry.get_counter(
+                ETL_SNOWPIPE_CHANNEL_RECOVERIES_TOTAL) >= 1
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_copy_durability_barrier_polls_status(self):
+        """commit_mode=on_poll: inserts do NOT commit until a status
+        poll — write_table_rows must poll the durability barrier before
+        acking, with synthetic 0/N copy offsets."""
+        server, d = await self.dest(commit_mode="on_poll")
+        try:
+            ack = await d.write_table_rows(
+                make_schema(), batch([[1, "a", None], [2, "b", None]]))
+            assert ack.is_durable
+            assert server.status_polls >= 1
+            inserts = [q for m, p, q in server.requests
+                       if p.endswith("/rows")]
+            assert inserts[0]["startOffsetToken"] == f"{0:016x}/{1:016x}"
+            ch = next(iter(server.channels.values()))
+            assert ch.committed == f"{0:016x}/{1:016x}"
+            # streaming after the barrier works and commits
+            await d.write_events([ins(0, [3, "c", None], lsn=0xA00)])
+            assert [r["id"] for r in server.rows[self.PIPE]] == [1, 2, 3]
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_copy_requires_reset_channel(self):
+        """A channel with foreign committed offsets cannot host a table
+        copy (channel.rs:461-467) — truncate resets it first."""
+        server, d = await self.dest()
+        try:
+            await d.write_events([ins(0, [1, "x", None], lsn=0xB00)])
+            from etl_tpu.models.errors import EtlError
+
+            with pytest.raises(EtlError, match="reset channel"):
+                await d.write_table_rows(make_schema(),
+                                         batch([[2, "y", None]]))
+            await d.truncate_table(TID)
+            ack = await d.write_table_rows(make_schema(),
+                                           batch([[2, "y", None]]))
+            assert ack.is_durable
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_401_invalidates_and_resigns_token(self):
+        server = FakeSnowpipeServer(require_auth=True)
+        await server.start()
+        try:
+            d = SnowflakeDestination(
+                self.config(server, private_key_pem=self.make_key()),
+                RETRY_FAST)
+            await d.startup()
+            server.fail_next.append((401, '{"message": "expired"}'))
+            await d.write_events([ins(0, [1, "t", None], lsn=0xC00)])
+            assert len(server.rows[self.PIPE]) == 1
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_batch_splitting_under_api_limit(self):
+        import random
+
+        server, d = await self.dest()
+        try:
+            rng = random.Random(3)
+            evs = [ins(i, [i, "".join(chr(rng.randrange(33, 127))
+                                      for _ in range(120_000)), None],
+                       lsn=0xD00 + i)
+                   for i in range(60)]
+            await d.write_events(evs)
+            inserts = [p for _, p, _ in server.requests
+                       if p.endswith("/rows")]
+            assert len(inserts) > 1  # ~7MB of incompressible text split
+            assert len(server.rows[self.PIPE]) == 60
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_restart_drop_recovers_name_and_channel(self):
+        """A restarted process has empty name mappings; drop_table with
+        the stored-schema hint must still drop the SQL table AND the
+        server-side channel, or the re-copy hard-fails on foreign
+        committed offsets."""
+        server, d = await self.dest()
+        try:
+            await d.write_events([ins(0, [1, "x", None], lsn=0xF00)])
+            await d.shutdown()
+            # "restart": fresh destination, no in-memory mappings
+            d2 = SnowflakeDestination(self.config(server), RETRY_FAST)
+            await d2.startup()
+            await d2.drop_table(TID, make_schema())
+            assert not server.channels  # server-side channel dropped
+            assert any(s.startswith("DROP TABLE")
+                       for s in server.statements)
+            ack = await d2.write_table_rows(make_schema(),
+                                            batch([[2, "y", None]]))
+            assert ack.is_durable
+            await d2.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_concurrent_copy_partitions_serialize(self):
+        """Parallel copy partitions share one table channel; the per-table
+        lock must serialize the continuation-token chain (no stale-token
+        reopens)."""
+        server, d = await self.dest()
+        try:
+            import asyncio as aio
+
+            chunks = [batch([[i * 10 + j, f"r{i}{j}", None]
+                             for j in range(5)]) for i in range(4)]
+            acks = await aio.gather(*(
+                d.write_table_rows(make_schema(), c) for c in chunks))
+            assert all(a.is_durable for a in acks)
+            assert len(server.rows[self.PIPE]) == 20
+            ch = next(iter(server.channels.values()))
+            assert ch.epoch == 0  # no stale-continuation recoveries
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_truncate_resets_server_side_offsets(self):
+        server, d = await self.dest()
+        try:
+            evs = [ins(0, [1, "x", None], lsn=0xE00)]
+            await d.write_events(evs)
+            await d.truncate_table(TID)
+            await d.write_events(evs)  # same offsets accepted again
+            assert len(server.rows[self.PIPE]) == 2
             await d.shutdown()
         finally:
             await server.stop()
