@@ -61,9 +61,78 @@ class Orchestrator(abc.ABC):
         return None
 
 
+# config keys whose values are credentials: they move to the per-pipeline
+# Secret and re-enter the replicator through the APP_ env overlay
+# (reference k8s/base.rs create_or_update_{postgres,bigquery,clickhouse,
+# iceberg,ducklake,snowflake}_secret — one seam per credential type; here
+# one Secret whose keys are the env names)
+_SECRET_KEYS = frozenset({
+    "password", "private_key_pem", "token", "api_key", "catalog_token",
+    "s3_access_key_id", "s3_secret_access_key", "service_account_key",
+})
+
+
+def split_secrets(config: dict) -> tuple[dict, dict[str, str]]:
+    """(sanitized config, {APP_ env name: secret value}).
+
+    Secret-valued keys are REMOVED from the config document that lands in
+    the (world-readable) ConfigMap and injected back at runtime via the
+    config loader's `APP_A__B` env overlay, sourced from the Secret."""
+    env: dict[str, str] = {}
+
+    def walk(doc: dict, path: tuple[str, ...]) -> dict:
+        out = {}
+        for k, v in doc.items():
+            if isinstance(v, dict):
+                out[k] = walk(v, path + (k,))
+            elif k in _SECRET_KEYS and isinstance(v, str) and v:
+                env["APP_" + "__".join(path + (k,)).upper()] = v
+            else:
+                out[k] = v
+        return out
+
+    return walk(config, ()), env
+
+
+def derive_pod_status(doc: dict | None) -> str:
+    """Kubernetes pod document → operational state (reference
+    k8s/base.rs PodStatus: Stopped | Starting | Started | Stopping |
+    Failed | Unknown), combining phase, deletion timestamp, and container
+    states — readyReplicas alone cannot distinguish CrashLoopBackOff from
+    a slow start."""
+    if doc is None:
+        return "stopped"
+    if doc.get("metadata", {}).get("deletionTimestamp"):
+        return "stopping"
+    status = doc.get("status", {})
+    phase = status.get("phase", "")
+    for cs in status.get("containerStatuses", []):
+        waiting = cs.get("state", {}).get("waiting", {})
+        if waiting.get("reason") in ("CrashLoopBackOff", "ErrImagePull",
+                                     "ImagePullBackOff"):
+            return "failed"
+        terminated = cs.get("state", {}).get("terminated", {})
+        if terminated and terminated.get("exitCode", 0) != 0:
+            return "failed"
+    if phase == "Pending":
+        return "starting"
+    if phase == "Running":
+        ready = all(cs.get("ready") for cs in
+                    status.get("containerStatuses", [{"ready": False}]))
+        return "started" if ready else "starting"
+    if phase == "Succeeded":
+        return "stopped"
+    if phase == "Failed":
+        return "failed"
+    return "unknown"
+
+
 class K8sOrchestrator(Orchestrator):
-    """Creates Secret + ConfigMap + StatefulSet per pipeline, mirroring the
-    reference resource layout (k8s/http.rs)."""
+    """Creates Secret + ConfigMap + StatefulSet (and, for lake
+    destinations, a maintenance CronJob) per pipeline, mirroring the
+    reference resource layout (k8s/http.rs): credentials live in the
+    Secret and reach the replicator as APP_ env vars, the sanitized
+    config document rides the ConfigMap."""
 
     def __init__(self, *, api_url: str, namespace: str = "etl",
                  image: str = "etl-tpu-replicator:latest",
@@ -105,7 +174,7 @@ class K8sOrchestrator(Orchestrator):
     async def start_pipeline(self, spec: ReplicatorSpec) -> None:
         ns = self.namespace
         name = self._name(spec.pipeline_id)
-        config_yaml = yaml.safe_dump(spec.config)
+        sanitized, secret_env = split_secrets(spec.config)
         import time
 
         # fresh restarted-at template annotation on EVERY create-or-update:
@@ -117,11 +186,15 @@ class K8sOrchestrator(Orchestrator):
         resources = [
             ("POST", f"/api/v1/namespaces/{ns}/secrets", {
                 "metadata": {"name": f"{name}-secrets"},
-                "stringData": {"config.yaml": config_yaml},
+                "stringData": secret_env,
             }),
             ("POST", f"/api/v1/namespaces/{ns}/configmaps", {
                 "metadata": {"name": f"{name}-config"},
-                "data": {"pipeline_id": str(spec.pipeline_id),
+                # key MUST be base.yaml: the config loader reads
+                # base.yaml/{env}.yaml from --config-dir (load.py), same
+                # as LocalOrchestrator writes
+                "data": {"base.yaml": yaml.safe_dump(sanitized),
+                         "pipeline_id": str(spec.pipeline_id),
                          "tenant_id": spec.tenant_id},
             }),
             ("POST", f"/apis/apps/v1/namespaces/{ns}/statefulsets", {
@@ -141,24 +214,76 @@ class K8sOrchestrator(Orchestrator):
                             "name": "replicator",
                             "image": spec.image or self.image,
                             "args": ["--config-dir", "/etc/etl"],
+                            # credentials re-enter via the APP_ env
+                            # overlay, never the config document
+                            "envFrom": [{"secretRef": {
+                                "name": f"{name}-secrets"}}],
                             "volumeMounts": [{"name": "config",
                                               "mountPath": "/etc/etl"}],
                         }], "volumes": [{
                             "name": "config",
-                            "secret": {"secretName": f"{name}-secrets"},
+                            "configMap": {"name": f"{name}-config"},
                         }]},
                     },
                 },
             }),
         ]
+        if spec.config.get("destination", {}).get("type") == "lake":
+            # per-pipeline external-maintenance CronJob (reference
+            # k8s/base.rs create_or_update_ducklake_maintenance)
+            resources.append(self._maintenance_cronjob(spec, name))
         for method, path, body in resources:
             status, _ = await self._api(method, path, body)
-            if status == 409:  # exists → strategic-merge PATCH (rollout)
-                patch_path = f"{path}/{body['metadata']['name']}"
-                status, _ = await self._api("PATCH", patch_path, body)
+            if status == 409:  # resource exists → update strategy below
+                obj_path = f"{path}/{body['metadata']['name']}"
+                if "/secrets" in path or "/configmaps" in path:
+                    # REPLACE, don't merge: a strategic-merge PATCH keeps
+                    # stale keys alive, so a rotated-away credential (or a
+                    # pre-upgrade full-config blob) would keep reaching
+                    # pods through envFrom forever
+                    await self._api("DELETE", obj_path)
+                    status, _ = await self._api(method, path, body)
+                else:
+                    # StatefulSet/CronJob: strategic-merge PATCH rolls the
+                    # pod template without recreating the workload
+                    status, _ = await self._api("PATCH", obj_path, body)
             if status >= 400:
                 raise EtlError(ErrorKind.DESTINATION_FAILED,
                                f"k8s {method} {path} → {status}")
+
+    def _maintenance_cronjob(self, spec: ReplicatorSpec,
+                             name: str) -> tuple[str, str, dict]:
+        schedule = spec.config.get("maintenance", {}).get(
+            "schedule", "*/30 * * * *")
+        warehouse = spec.config.get("destination", {}).get(
+            "warehouse_path", "")
+        return (
+            "POST",
+            f"/apis/batch/v1/namespaces/{self.namespace}/cronjobs", {
+                "metadata": {"name": f"{name}-maintenance",
+                             "labels": {"app": "etl-maintenance",
+                                        "pipeline_id":
+                                            str(spec.pipeline_id)}},
+                "spec": {
+                    "schedule": schedule,
+                    "concurrencyPolicy": "Forbid",
+                    "jobTemplate": {"spec": {"template": {"spec": {
+                        "restartPolicy": "Never",
+                        "containers": [{
+                            "name": "maintenance",
+                            "image": spec.image or self.image,
+                            # explicit command: the image's entrypoint is
+                            # the REPLICATOR; the job must run the
+                            # maintenance module regardless
+                            "command": ["python", "-m",
+                                        "etl_tpu.maintenance"],
+                            "args": ["--warehouse", warehouse,
+                                     "--api-url",
+                                     f"http://{name}:8080"],
+                        }],
+                    }}}},
+                },
+            })
 
     async def restart_pipeline(self, spec: ReplicatorSpec) -> None:
         """Rolling restart, NOT the base class's delete+recreate: re-apply
@@ -173,11 +298,29 @@ class K8sOrchestrator(Orchestrator):
         name = self._name(pipeline_id)
         for path in (f"/apis/apps/v1/namespaces/{ns}/statefulsets/{name}",
                      f"/api/v1/namespaces/{ns}/secrets/{name}-secrets",
-                     f"/api/v1/namespaces/{ns}/configmaps/{name}-config"):
+                     f"/api/v1/namespaces/{ns}/configmaps/{name}-config",
+                     f"/apis/batch/v1/namespaces/{ns}/cronjobs/"
+                     f"{name}-maintenance"):
             status, _ = await self._api("DELETE", path)
             if status >= 400 and status != 404:
                 raise EtlError(ErrorKind.DESTINATION_FAILED,
                                f"k8s DELETE {path} → {status}")
+
+    async def pod_status(self, pipeline_id: int) -> str:
+        """Pod-level state (reference get_replicator_pod_status): derives
+        stopped/starting/started/stopping/failed/unknown from the pod
+        document rather than StatefulSet replica counts."""
+        ns = self.namespace
+        name = self._name(pipeline_id)
+        status, doc = await self._api(
+            "GET", f"/api/v1/namespaces/{ns}/pods"
+                   f"?labelSelector=app%3D{name}")
+        if status == 404:
+            return "stopped"
+        if status >= 400:
+            return "unknown"
+        items = doc.get("items", [])
+        return derive_pod_status(items[0] if items else None)
 
     async def status(self, pipeline_id: int) -> ReplicatorStatus:
         ns = self.namespace
@@ -189,6 +332,10 @@ class K8sOrchestrator(Orchestrator):
         if status >= 400:
             return ReplicatorStatus(pipeline_id, "failed",
                                     f"k8s status {status}")
+        pod = await self.pod_status(pipeline_id)
+        if pod == "failed":
+            return ReplicatorStatus(pipeline_id, "failed",
+                                    "pod failed (see pod status)")
         ready = doc.get("status", {}).get("readyReplicas", 0)
         return ReplicatorStatus(pipeline_id,
                                 "running" if ready else "starting")

@@ -152,7 +152,7 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
                     arrivals.append((int(e.commit_lsn), now))
             return WriteAck.durable()
 
-        async def drop_table(self, table_id):
+        async def drop_table(self, table_id, schema=None):
             return None
 
         async def truncate_table(self, table_id):

@@ -95,6 +95,7 @@ class FakeDatabase:
         self.is_standby = False  # read replica: pg_is_in_recovery() = true
         self.applied_migrations: list[str] = []
         self.ddl_trigger_installed = False
+        self.standbys: list["FakeStandby"] = []  # physical replicas
 
     # -- test-facing setup ----------------------------------------------------
 
@@ -168,6 +169,7 @@ class FakeDatabase:
         self.wal.append((lsn, payload, table_id, row))
         async with self._wal_cond:
             self._wal_cond.notify_all()
+        await self._replicate()
         return lsn
 
     async def append_wal_many(
@@ -185,6 +187,7 @@ class FakeDatabase:
         self._lsn = lsn
         async with self._wal_cond:
             self._wal_cond.notify_all()
+        await self._replicate()
         return Lsn(lsn)
 
     def row_filter_allows(self, publication: str, table_id: TableId | None,
@@ -196,6 +199,33 @@ class FakeDatabase:
 
     def transaction(self, xid: int | None = None) -> "FakeTransaction":
         return FakeTransaction(self, xid or (len(self.wal) + 100))
+
+    # -- physical replication (reference pipeline_read_replica.rs) -------------
+
+    def make_replica(self, snapshot_gate: bool = False) -> "FakeStandby":
+        """Attach a physical read replica. `snapshot_gate=True` models
+        PG16 logical-slot creation on a standby blocking until the
+        primary logs a standby snapshot record."""
+        sb = FakeStandby(self, snapshot_gate=snapshot_gate)
+        self.standbys.append(sb)
+        return sb
+
+    async def _replicate(self) -> None:
+        for sb in self.standbys:
+            if sb.auto_replay:
+                await sb.replay()
+
+    async def log_standby_snapshot(self) -> None:
+        """pg_log_standby_snapshot(): emits the running-xacts record that
+        lets logical slot creation on a standby reach a consistent point
+        (reference wait_with_standby_snapshots)."""
+        for sb in self.standbys:
+            sb._snapshot_logged.set()
+            async with sb._wal_cond:
+                sb._wal_cond.notify_all()
+
+    async def wait_slot_creation_allowed(self) -> None:
+        return None  # primaries never gate slot creation
 
     def invalidate_slot(self, name: str) -> None:
         self.slots[name].invalidated = True
@@ -430,6 +460,69 @@ class FakeTransaction:
                      if not all(r[i] == key[i] for i in kcols)]
 
 
+class FakeStandby(FakeDatabase):
+    """Physical read replica of a FakeDatabase (reference
+    pipeline_read_replica.rs semantics on the fake):
+
+    - shares cluster-wide logical state (tables, publications, filters)
+      with the primary BY REFERENCE — physical replication replays the
+      whole cluster;
+    - maintains its OWN WAL view bounded by `replay()` — streams on the
+      replica only see WAL the standby has replayed;
+    - owns its OWN slot map: ETL's logical slots live on the replica, the
+      primary keeps none (pipeline_read_replica.rs:294-297);
+    - optionally gates slot creation until the primary logs a standby
+      snapshot (PG16 logical decoding on standby,
+      wait_with_standby_snapshots);
+    - rejects writes (pg_is_in_recovery).
+
+    Approximation: COPY snapshots read the shared table store, so they see
+    the primary's latest rows — the reference tests likewise wait for full
+    catch-up before starting copies."""
+
+    def __init__(self, primary: FakeDatabase, *,
+                 snapshot_gate: bool = False):
+        super().__init__()
+        self.primary = primary
+        self.is_standby = True
+        self.tables = primary.tables
+        self.publications = primary.publications
+        self.column_filters = primary.column_filters
+        self.row_filters = primary.row_filters
+        self.row_filter_sql = primary.row_filter_sql
+        self.ddl_trigger_installed = primary.ddl_trigger_installed
+        self.auto_replay = True
+        self.snapshot_gate = snapshot_gate
+        self._snapshot_logged = asyncio.Event()
+        self._replay_index = 0
+        self._lsn = primary._lsn
+
+    async def replay(self, upto: Lsn | None = None) -> None:
+        """Replay primary WAL up to `upto` (default: full catch-up) and
+        wake streams waiting on the replica."""
+        target = int(upto) if upto is not None else self.primary._lsn
+        src = self.primary.wal
+        while (self._replay_index < len(src)
+               and int(src[self._replay_index][0]) <= target):
+            self.wal.append(src[self._replay_index])
+            self._lsn = int(src[self._replay_index][0])
+            self._replay_index += 1
+        # fully-replayed standbys track the primary's position even when
+        # the trailing WAL carries no logical records (keepalive LSNs)
+        self._lsn = max(self._lsn, min(target, self.primary._lsn))
+        async with self._wal_cond:
+            self._wal_cond.notify_all()
+
+    def transaction(self, xid: int | None = None) -> "FakeTransaction":
+        raise AssertionError(
+            "cannot write to a standby (pg_is_in_recovery) — write to the "
+            "primary and replay()")
+
+    async def wait_slot_creation_allowed(self) -> None:
+        if self.snapshot_gate:
+            await self._snapshot_logged.wait()
+
+
 class _FakeReplicationStream(ReplicationStream):
     _ids = 0
 
@@ -635,6 +728,9 @@ class FakeSource(ReplicationSource):
     async def create_slot(self, name: str) -> CreatedSlot:
         if name in self.db.slots:
             raise EtlError(ErrorKind.SLOT_ALREADY_EXISTS, name)
+        # on a standby, logical slot creation blocks until the primary
+        # logs a standby snapshot (PG16; FakeStandby.snapshot_gate)
+        await self.db.wait_slot_creation_allowed()
         point = self.db.current_lsn
         sid = self.db.take_snapshot()
         self.db.slots[name] = _FakeSlot(

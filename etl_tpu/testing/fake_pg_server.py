@@ -83,6 +83,11 @@ class _Session:
     # extended-protocol state (unnamed statement/portal only)
     ext_sql: str | None = None
     ext_params: "list[str | None]" = None  # type: ignore[assignment]
+    # store-transaction ownership: the embedded store sqlite is shared
+    # across sessions, so an open BEGIN..COMMIT holds the server's store
+    # lock — exactly the observable serialization real PG applies to
+    # same-row writers, without sqlite's shared-handle txn nesting errors
+    holds_store_lock: bool = False
 
 
 class FakePgServer:
@@ -107,6 +112,7 @@ class FakePgServer:
         self.scram_nonce_tail = scram_nonce_tail
         self.scram_transcript: list[tuple[str, str]] = []  # (dir, message)
         self._server: asyncio.AbstractServer | None = None
+        self._store_lock = asyncio.Lock()
         self.port = 0
         self.connections = 0
         self.queries: list[str] = []  # every simple-query SQL, in order
@@ -159,6 +165,17 @@ class FakePgServer:
                 BrokenPipeError):
             pass
         finally:
+            if sess.holds_store_lock:
+                # a client that died mid-transaction must not wedge every
+                # other pooled connection (PG aborts the txn on disconnect)
+                sess.holds_store_lock = False
+                try:
+                    db = getattr(self.db, "_store_sql_db", None)
+                    if db is not None and db.in_transaction:
+                        db.execute("ROLLBACK")
+                except Exception:
+                    pass
+                self._store_lock.release()
             self._writers.discard(writer)
             writer.close()
             try:
@@ -356,7 +373,8 @@ class FakePgServer:
             w.write(READY)
         await w.drain()
 
-    def _try_store_sql(self, sess: _Session, norm: str, sql: str) -> bool:
+    async def _try_store_sql(self, sess: _Session, norm: str,
+                             sql: str) -> bool:
         """Execute `etl` store-schema statements (PostgresStore over the
         wire) against an embedded per-database sqlite — the statements are
         the store's shared dialect, so sqlite semantics match; only the
@@ -402,14 +420,35 @@ class FakePgServer:
             store = sqlite3.connect(":memory:", check_same_thread=False)
             store.isolation_level = None  # explicit BEGIN/COMMIT pass through
             db._store_sql_db = store
+        # transaction serialization across pooled client connections: a
+        # bare BEGIN holds the store lock until its COMMIT/ROLLBACK;
+        # autocommit statements hold it per-statement. A failed statement
+        # inside a transaction keeps the lock — the client still owns the
+        # open transaction and will ROLLBACK.
+        if not sess.holds_store_lock:
+            await self._store_lock.acquire()
+            sess.holds_store_lock = True
+            release_after = first != "BEGIN"
+        else:
+            release_after = False
+        if first in ("COMMIT", "ROLLBACK"):
+            release_after = True
+
+        def maybe_release() -> None:
+            if release_after:
+                sess.holds_store_lock = False
+                self._store_lock.release()
+
         stmt = sql.replace("BIGINT GENERATED BY DEFAULT AS IDENTITY",
                            "INTEGER")
         try:
             cur = store.execute(stmt)
         except sqlite3.Error as e:
+            maybe_release()
             w.write(_error("42601", f"store sql: {e}"))
             w.write(READY)
             return True
+        maybe_release()
         if cur.description is not None:
             names = [d[0] for d in cur.description]
             rows = [[None if v is None else str(v) for v in r]
@@ -426,7 +465,7 @@ class FakePgServer:
         w = sess.writer
         db = self.db
 
-        if self._try_store_sql(sess, norm, sql):
+        if await self._try_store_sql(sess, norm, sql):
             return True
 
         if norm == "SELECT pg_is_in_recovery()":

@@ -211,7 +211,10 @@ class TestK8sOrchestrator:
             orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
             spec = ReplicatorSpec(pipeline_id=7, tenant_id="acme",
                                   config={"pipeline_id": 7,
-                                          "publication_name": "pub"})
+                                          "publication_name": "pub",
+                                          "pg_connection": {
+                                              "host": "db",
+                                              "password": "hunter2"}})
             await orch.start_pipeline(spec)
             paths = server.paths()
             assert "POST /api/v1/namespaces/etl/secrets" in paths
@@ -221,12 +224,94 @@ class TestK8sOrchestrator:
                    if r.path.endswith("/statefulsets")][0].json
             assert sts["metadata"]["name"] == "etl-replicator-7"
             assert sts["metadata"]["labels"]["tenant_id"] == "acme"
+            # credentials live in the Secret as APP_ env names; the
+            # ConfigMap's config document carries NO secret values
             secret = [r for r in server.requests
                       if r.path.endswith("/secrets")][0].json
-            assert "publication_name: pub" in secret["stringData"]["config.yaml"]
+            assert secret["stringData"] == {
+                "APP_PG_CONNECTION__PASSWORD": "hunter2"}
+            cm = [r for r in server.requests
+                  if r.path.endswith("/configmaps")][0].json
+            # key must be base.yaml — the name the config loader reads
+            assert "publication_name: pub" in cm["data"]["base.yaml"]
+            assert "hunter2" not in cm["data"]["base.yaml"]
+            container = sts["spec"]["template"]["spec"]["containers"][0]
+            assert container["envFrom"] == [
+                {"secretRef": {"name": "etl-replicator-7-secrets"}}]
             await orch.stop_pipeline(7)
             deletes = [p for p in server.paths() if p.startswith("DELETE")]
-            assert len(deletes) == 3
+            assert len(deletes) == 4  # sts, secret, configmap, cronjob
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_lake_destination_gets_maintenance_cronjob(self):
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
+            await orch.start_pipeline(ReplicatorSpec(
+                3, "t", {"destination": {"type": "lake",
+                                         "warehouse_path": "/wh"},
+                         "maintenance": {"schedule": "0 2 * * *"}}))
+            cron = [r for r in server.requests
+                    if r.path.endswith("/cronjobs")][0].json
+            assert cron["metadata"]["name"] == "etl-replicator-3-maintenance"
+            assert cron["spec"]["schedule"] == "0 2 * * *"
+            assert cron["spec"]["concurrencyPolicy"] == "Forbid"
+            args = cron["spec"]["jobTemplate"]["spec"]["template"]["spec"][
+                "containers"][0]["args"]
+            assert "--warehouse" in args and "/wh" in args
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_pod_status_derivation(self):
+        from etl_tpu.api.orchestrator import derive_pod_status
+
+        assert derive_pod_status(None) == "stopped"
+        assert derive_pod_status(
+            {"metadata": {"deletionTimestamp": "t"}}) == "stopping"
+        assert derive_pod_status(
+            {"metadata": {}, "status": {"phase": "Pending"}}) == "starting"
+        assert derive_pod_status({"metadata": {}, "status": {
+            "phase": "Running",
+            "containerStatuses": [{"ready": True, "state": {}}],
+        }}) == "started"
+        assert derive_pod_status({"metadata": {}, "status": {
+            "phase": "Running",
+            "containerStatuses": [{"ready": False, "state": {
+                "waiting": {"reason": "CrashLoopBackOff"}}}],
+        }}) == "failed"
+        assert derive_pod_status({"metadata": {}, "status": {
+            "phase": "Running",
+            "containerStatuses": [{"ready": False, "state": {
+                "terminated": {"exitCode": 1}}}],
+        }}) == "failed"
+        assert derive_pod_status(
+            {"metadata": {}, "status": {"phase": "Succeeded"}}) == "stopped"
+        assert derive_pod_status(
+            {"metadata": {}, "status": {"phase": "Failed"}}) == "failed"
+
+    async def test_status_reports_crashloop_as_failed(self):
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            def responder(req):
+                if "/pods" in req.path:
+                    return 200, {"items": [{"metadata": {}, "status": {
+                        "phase": "Running",
+                        "containerStatuses": [{"ready": False, "state": {
+                            "waiting": {"reason": "CrashLoopBackOff"}}}],
+                    }}]}
+                if req.path.endswith("/statefulsets/etl-replicator-9"):
+                    return 200, {"status": {"readyReplicas": 0}}
+                return None
+
+            server.responders.append(responder)
+            orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
+            st = await orch.status(9)
+            assert st.state == "failed"
             await orch.shutdown()
         finally:
             await server.stop()
@@ -235,11 +320,33 @@ class TestK8sOrchestrator:
         server = RecordingHttpServer()
         await server.start()
         try:
-            server.fail_next = [409]  # first resource exists
+            server.fail_next = [409]  # first resource (Secret) exists
             orch = K8sOrchestrator(api_url=server.url())
             await orch.start_pipeline(ReplicatorSpec(1, "t", {}))
-            # 409 → strategic-merge PATCH (template roll), then the rest
-            assert any(p.startswith("PATCH ") for p in server.paths())
+            # an existing Secret is REPLACED (delete + recreate) so
+            # rotated-away credential keys cannot survive a merge
+            paths = server.paths()
+            assert any(p.startswith("DELETE ") and "secrets" in p
+                       for p in paths)
+            assert sum(1 for p in paths
+                       if p.startswith("POST ") and p.endswith("/secrets")) \
+                == 2
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_conflict_patches_statefulset(self):
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            server.responders.append(
+                lambda req: (409, {}) if req.method == "POST"
+                and req.path.endswith("/statefulsets") else None)
+            orch = K8sOrchestrator(api_url=server.url())
+            await orch.start_pipeline(ReplicatorSpec(1, "t", {}))
+            # workloads roll via strategic-merge PATCH, not recreate
+            assert any(p.startswith("PATCH ") and "statefulsets" in p
+                       for p in server.paths())
             await orch.shutdown()
         finally:
             await server.stop()
@@ -690,6 +797,27 @@ class TestValidationRoutes:
             await server.stop()
 
 
+def k8s_existence_responder():
+    """Emulates resource existence: POST of an already-created name →
+    409; DELETE forgets it (so the orchestrator's replace path works the
+    way the real API does)."""
+    existing: set[str] = set()
+
+    def responder(rec):
+        if rec.method == "POST":
+            name = (rec.json or {}).get("metadata", {}).get("name", "")
+            key = f"{rec.path}/{name}"
+            if key in existing:
+                return 409, {}
+            existing.add(key)
+            return None
+        if rec.method == "DELETE":
+            existing.discard(rec.path)
+        return None
+
+    return responder
+
+
 class TestOrchestratorRollout:
     async def test_statefulset_update_rolls_template(self):
         """An image change on an EXISTING pipeline must PATCH the
@@ -698,6 +826,7 @@ class TestOrchestratorRollout:
         server = RecordingHttpServer()
         await server.start()
         try:
+            server.responders.append(k8s_existence_responder())
             orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
             spec = ReplicatorSpec(3, "t", {"publication_name": "pub"},
                                   image="img:v1")
@@ -707,8 +836,6 @@ class TestOrchestratorRollout:
             anno1 = first["spec"]["template"]["metadata"]["annotations"][
                 "etl/restarted-at"]
             # every resource now exists → conflict on each create
-            server.responders.append(
-                lambda rec: (409, {}) if rec.method == "POST" else None)
             await orch.start_pipeline(ReplicatorSpec(
                 3, "t", {"publication_name": "pub"}, image="img:v2"))
             patches = [r for r in server.requests if r.method == "PATCH"]
@@ -729,6 +856,7 @@ class TestOrchestratorRollout:
         server = RecordingHttpServer()
         await server.start()
         try:
+            server.responders.append(k8s_existence_responder())
             orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
             spec = ReplicatorSpec(9, "t", {"publication_name": "pub"})
             await orch.start_pipeline(spec)
@@ -736,10 +864,11 @@ class TestOrchestratorRollout:
                      if r.path.endswith("/statefulsets")][0].json
             anno1 = first["spec"]["template"]["metadata"]["annotations"][
                 "etl/restarted-at"]
-            server.responders.append(
-                lambda rec: (409, {}) if rec.method == "POST" else None)
             await orch.restart_pipeline(spec)
-            assert not any(r.method == "DELETE" for r in server.requests)
+            # the WORKLOAD is never torn down (secrets/configmaps are
+            # replaced, which is invisible to running pods until restart)
+            assert not any(r.method == "DELETE" and "statefulsets" in r.path
+                           for r in server.requests)
             patches = [r for r in server.requests if r.method == "PATCH"]
             sts = [r for r in patches
                    if "statefulsets/etl-replicator-9" in r.path][0]

@@ -769,6 +769,65 @@ class TestReadReplica:
         await pipeline.shutdown_and_wait()
 
 
+    async def test_slots_live_on_replica_not_primary(self):
+        """Reference pipeline_read_replica.rs:294-297: in read-replica
+        mode ETL's logical slots are created on the REPLICA; the primary
+        owns none. CDC still flows: primary writes replay to the standby
+        and stream from there."""
+        primary = make_db()
+        replica = primary.make_replica()
+        pipeline, store, dest = make_pipeline(replica)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        await wait_ready(store, ORDERS)
+        assert replica.slots, "logical slots must exist on the replica"
+        assert not primary.slots, "primary must own no logical slots"
+        async with primary.transaction() as tx:
+            tx.insert(ACCOUNTS, ["96", "from-primary", "1"])
+        await _wait_for(lambda: 96 in _account_ids(dest))
+        await pipeline.shutdown_and_wait()
+
+    async def test_stream_lags_until_standby_replays(self):
+        """The replica's walsender only serves WAL the standby has
+        REPLAYED: a primary commit is invisible to the pipeline until
+        replay catches up (wait_for_read_replica_replay semantics)."""
+        primary = make_db()
+        replica = primary.make_replica()
+        pipeline, store, dest = make_pipeline(replica)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        replica.auto_replay = False
+        async with primary.transaction() as tx:
+            tx.insert(ACCOUNTS, ["97", "lagged", "2"])
+        await asyncio.sleep(0.3)
+        assert 97 not in _account_ids(dest), \
+            "un-replayed WAL must not reach the pipeline"
+        await replica.replay()
+        await _wait_for(lambda: 97 in _account_ids(dest))
+        await pipeline.shutdown_and_wait()
+
+    async def test_slot_creation_waits_for_standby_snapshot(self):
+        """PG16 logical slot creation on a standby blocks until the
+        primary logs a standby snapshot; the reference drives this with
+        wait_with_standby_snapshots (pipeline_read_replica.rs:141-159)."""
+        primary = make_db()
+        replica = primary.make_replica(snapshot_gate=True)
+        pipeline, store, dest = make_pipeline(replica)
+        await pipeline.start()
+        await asyncio.sleep(0.3)
+        assert not replica.slots, \
+            "slot creation must block until the standby snapshot"
+        await primary.log_standby_snapshot()
+        await wait_ready(store, ACCOUNTS)
+        assert replica.slots
+        await pipeline.shutdown_and_wait()
+
+    async def test_standby_rejects_writes(self):
+        primary = make_db()
+        replica = primary.make_replica()
+        with pytest.raises(AssertionError, match="standby"):
+            replica.transaction()
+
     async def test_idle_keepalive_advances_slot_past_unpublished_wal(self):
         """Reference pipeline_read_replica.rs:313: with only UNPUBLISHED /
         keepalive WAL flowing, the slot's confirmed_flush must advance to
