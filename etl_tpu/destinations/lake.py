@@ -624,6 +624,14 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
             return 0
         return self._cdc_file_count(table_id, row[2])
 
+    def pending_inline_bytes(self, table_id: TableId) -> int:
+        """Catalog-inlined bytes awaiting flush in the current generation
+        — the inline-flush policy input (maintenance coordination)."""
+        row = self._table_row(table_id)
+        if row is None:
+            return 0
+        return self._pending_inline_bytes(table_id, row[2])
+
     def record_maintenance_skip(self, table_id: TableId, op: str) -> None:
         """Audit row for a policy decision that never invoked the op."""
         self._history_finish(self._history_start(table_id, op),

@@ -134,7 +134,47 @@ def main(argv=None) -> int:
                         "CDC files (operation policy)")
     p.add_argument("--history", action="store_true",
                    help="print maintenance history and exit (no ops)")
+    p.add_argument("--coordinate", action="store_true",
+                   help="run ONE coordinated controller pass through the "
+                        "catalog coordination store (operation requests, "
+                        "pause lease, history) instead of direct ops")
+    p.add_argument("--wait-for-pause", type=float, default=30.0,
+                   help="seconds to wait for the replicator to honor the "
+                        "pause lease before proceeding (coordinate mode)")
     args = p.parse_args(argv)
+    if args.coordinate:
+        if args.pipeline_id is None:
+            # the coordination row is keyed by pipeline id; defaulting
+            # would silently coordinate against a row no replicator reads
+            p.error("--coordinate requires --pipeline-id")
+
+        async def coordinate() -> dict:
+            from .maintenance_coordination import (CatalogMaintenanceStore,
+                                                   MaintenanceController,
+                                                   MaintenancePolicy)
+
+            lake = LakeDestination(LakeConfig(args.warehouse))
+            await lake.startup()
+            store = CatalogMaintenanceStore(args.warehouse,
+                                            args.pipeline_id)
+            ctrl = MaintenanceController(
+                store, lake,
+                MaintenancePolicy(merge_min_cdc_files=args.min_cdc_files,
+                                  cleanup_old_files_enabled=args.vacuum))
+            try:
+                return await ctrl.run_once(
+                    wait_for_pause_s=args.wait_for_pause)
+            finally:
+                store.close()
+                await lake.shutdown()
+
+        try:
+            print(json.dumps(asyncio.run(coordinate())))
+            return 0
+        except Exception as e:
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}),
+                  file=sys.stderr)
+            return 1
     if args.history:
         async def show() -> dict:
             lake = LakeDestination(LakeConfig(args.warehouse))

@@ -88,6 +88,25 @@ async def run_replicator(config_dir: str,
     doc = load_config_dict(config_dir, environment)
     dest_doc = doc.pop("destination", {"type": "memory"})
     store_doc = doc.pop("store", {"type": "memory"})
+    maint_doc = doc.pop("maintenance", {})
+    # validate BEFORE startup (config/load convention: unknown keys fail
+    # typed at load time, not as a TypeError after slots exist)
+    maint_policy = None
+    if maint_doc:
+        import dataclasses
+
+        from .maintenance_coordination import MaintenancePolicy
+        from .models.errors import ErrorKind
+
+        known = {f.name for f in dataclasses.fields(MaintenancePolicy)}
+        unknown = set(maint_doc) - known - {"coordination"}
+        if unknown:
+            raise EtlError(
+                ErrorKind.CONFIG_INVALID,
+                f"maintenance: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(known | {'coordination'})})")
+        maint_policy = MaintenancePolicy(
+            **{k: v for k, v in maint_doc.items() if k != "coordination"})
     metrics_port = doc.pop("metrics_port", 0)
     project_ref = doc.pop("project_ref", "")
     error_webhook = doc.pop("error_webhook_url", "")
@@ -133,9 +152,34 @@ async def run_replicator(config_dir: str,
         loop.add_signal_handler(
             sig, lambda: asyncio.ensure_future(pipeline.shutdown()))
 
+    maint_agent = None
+    maint_store = None
     try:
         await pipeline.start()
         logger.info("pipeline started")
+        if dest_doc.get("type") == "lake" and maint_doc.get("coordination"):
+            # external-maintenance coordination (reference
+            # etl-maintenance coordination.rs replicator role): sample
+            # lake stats into operation requests, pause intake under the
+            # controller's lease via the monitor's external pause
+            from .maintenance_coordination import (
+                CatalogMaintenanceStore, ReplicatorMaintenanceAgent)
+
+            maint_store = CatalogMaintenanceStore(
+                dest_doc["warehouse_path"], config.pipeline_id)
+            mon = pipeline.memory_monitor
+            loop_ = asyncio.get_event_loop()
+            # call_soon_threadsafe: agent ticks run in a worker thread
+            # (catalog lock waits must not stall WAL keepalives), and the
+            # monitor's pause event belongs to this loop
+            maint_agent = ReplicatorMaintenanceAgent(
+                maint_store, destination, policy=maint_policy,
+                pause=lambda: loop_.call_soon_threadsafe(
+                    mon.set_external_pause, True),
+                resume=lambda: loop_.call_soon_threadsafe(
+                    mon.set_external_pause, False))
+            maint_agent.start()
+            logger.info("maintenance coordination agent started")
         await pipeline.wait()
         logger.info("pipeline stopped cleanly")
     except BaseException as e:
@@ -146,6 +190,10 @@ async def run_replicator(config_dir: str,
             logger.error("replicator failed: %s", e)
         raise
     finally:
+        if maint_agent is not None:
+            await maint_agent.stop()
+        if maint_store is not None:
+            maint_store.close()
         if metrics_runner is not None:
             await metrics_runner.cleanup()
         close = getattr(store, "close", None)

@@ -69,9 +69,29 @@ class MemoryMonitor:
         self._rss_reader = rss_reader
         self.pressure = False
         self.last_rss = 0
+        self._mem_pressure = False
+        # externally-imposed pause (maintenance coordination lease): the
+        # published `pressure` is the OR of memory pressure and this flag
+        self.external_pause = False
         self._resumed = asyncio.Event()
         self._resumed.set()
         self._task: asyncio.Task | None = None
+
+    def set_external_pause(self, paused: bool) -> None:
+        """Pause/resume intake for a non-memory reason (external
+        maintenance pause lease). Composes with memory hysteresis: intake
+        resumes only when BOTH conditions clear."""
+        self.external_pause = paused
+        self._publish()
+
+    def _publish(self) -> None:
+        effective = self._mem_pressure or self.external_pause
+        if effective and not self.pressure:
+            self.pressure = True
+            self._resumed.clear()
+        elif not effective and self.pressure:
+            self.pressure = False
+            self._resumed.set()
 
     def start(self) -> None:
         if self._task is None:
@@ -97,15 +117,14 @@ class MemoryMonitor:
 
         self.last_rss = self._rss_reader()
         ratio = self.last_rss / max(1, self.limit_bytes)
-        if not self.pressure and ratio >= self.config.activate_ratio:
-            self.pressure = True
-            self._resumed.clear()
+        if not self._mem_pressure and ratio >= self.config.activate_ratio:
+            self._mem_pressure = True
             registry.counter_inc(ETL_MEMORY_BACKPRESSURE_ACTIVATIONS_TOTAL)
             registry.gauge_set(ETL_MEMORY_BACKPRESSURE_ACTIVE, 1)
-        elif self.pressure and ratio <= self.config.resume_ratio:
-            self.pressure = False
-            self._resumed.set()
+        elif self._mem_pressure and ratio <= self.config.resume_ratio:
+            self._mem_pressure = False
             registry.gauge_set(ETL_MEMORY_BACKPRESSURE_ACTIVE, 0)
+        self._publish()
         return self.pressure
 
     async def _run(self) -> None:

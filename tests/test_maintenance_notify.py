@@ -169,3 +169,225 @@ class TestMaintenancePolicyAndHistory:
                                      tenant_id=None, min_cdc_files=2)
         assert out2["compacted_files"] == 0
         assert out2["skipped_by_policy"] == 1
+
+
+class TestMaintenanceCoordination:
+    """External-maintenance coordination through the catalog store
+    (reference etl-maintenance coordination.rs: operation requests,
+    pause lease with max-pause, per-operation cooldowns, history)."""
+
+    def make_parts(self, tmp_path, **policy_kw):
+        from etl_tpu.maintenance_coordination import (
+            CatalogMaintenanceStore, MaintenanceController,
+            MaintenancePolicy, ReplicatorMaintenanceAgent)
+
+        lake = LakeDestination(LakeConfig(str(tmp_path),
+                                          compact_min_files=99))
+        policy = MaintenancePolicy(**policy_kw)
+        store = CatalogMaintenanceStore(str(tmp_path), 1)
+        pauses = []
+        agent = ReplicatorMaintenanceAgent(
+            store, lake, policy,
+            pause=lambda: pauses.append("pause"),
+            resume=lambda: pauses.append("resume"))
+        ctrl = MaintenanceController(store, lake, policy)
+        return lake, store, agent, ctrl, pauses
+
+    async def seed(self, lake, n_cdc=3):
+        await lake.startup()
+        await lake.write_table_rows(make_schema(),
+                                    batch([[1, "a", None]]))
+        for i in range(n_cdc):
+            await lake.write_events([ins(0, [10 + i, "x", None],
+                                         lsn=0x100 + 16 * i)])
+
+    async def test_request_pause_execute_history_cycle(self, tmp_path):
+        lake, store, agent, ctrl, pauses = self.make_parts(
+            tmp_path, merge_min_cdc_files=2, request_cooldown_seconds=0.0)
+        await self.seed(lake)
+        # replicator samples → posts a merge request
+        state = agent.tick()
+        assert state.request_operations.merge_adjacent_files
+        assert not state.request_operations.inline_flush
+        # controller takes the lease and runs; a background agent tick
+        # honors the pause so the controller sees replicator_paused
+        async def keep_ticking():
+            for _ in range(100):
+                agent.tick()
+                await asyncio.sleep(0.01)
+
+        tick_task = asyncio.ensure_future(keep_ticking())
+        report = await ctrl.run_once(wait_for_pause_s=2.0)
+        tick_task.cancel()
+        assert report["replicator_paused"] is True
+        assert report["operations"]["merge_adjacent_files"] >= 2
+        assert pauses[0] == "pause"
+        # lease cleared → next tick resumes the replicator
+        agent.tick()
+        assert pauses[-1] == "resume"
+        st = store.load()
+        assert st.pause_run_id is None
+        assert st.last_completed_at is not None
+        assert "merge_adjacent_files" in st.last_successful
+        # request consumed
+        assert not st.request_operations.merge_adjacent_files
+        store.close()
+        await lake.shutdown()
+
+    async def test_operation_cooldown_skips_repeat_runs(self, tmp_path):
+        lake, store, agent, ctrl, _ = self.make_parts(
+            tmp_path, merge_min_cdc_files=2,
+            request_cooldown_seconds=3600.0)
+        await self.seed(lake)
+        agent.tick()
+        report = await ctrl.run_once(wait_for_pause_s=0.0)
+        assert "merge_adjacent_files" in report["operations"]
+        # more CDC files arrive; the request re-posts but the operation
+        # is cooling down → the controller skips it
+        await lake.write_events([ins(0, [90, "y", None], lsn=0x900)])
+        await lake.write_events([ins(0, [91, "z", None], lsn=0x910)])
+        # force a fresh request despite the request cooldown window
+        def reset_request(st):
+            st.request_at = None
+            st.request_operations.merge_adjacent_files = True
+
+        store.mutate(reset_request)
+        report2 = await ctrl.run_once(wait_for_pause_s=0.0)
+        assert report2.get("skipped", "").startswith("no operations")
+        store.close()
+        await lake.shutdown()
+
+    async def test_pause_lease_expiry_self_resumes(self, tmp_path):
+        """If the controller dies mid-run the replicator must resume on
+        lease expiry (max_pause), not stay paused forever."""
+        import time as _t
+
+        lake, store, agent, ctrl, pauses = self.make_parts(
+            tmp_path, max_pause_seconds=1000.0)
+        await self.seed(lake, n_cdc=0)
+        now = _t.time()
+
+        def dead_controller(st):
+            st.pause_run_id = "dead"
+            st.pause_requested_at = now - 2000.0  # lease long expired
+            st.pause_max_pause_s = 1000.0
+
+        store.mutate(dead_controller)
+        agent.tick()
+        assert agent.paused is False
+        assert pauses == []  # expired lease never pauses
+        # a LIVE lease pauses...
+        def live_controller(st):
+            st.pause_run_id = "live"
+            st.pause_requested_at = _t.time()
+
+        store.mutate(live_controller)
+        agent.tick()
+        assert agent.paused is True
+        store.close()
+        await lake.shutdown()
+
+    async def test_inline_flush_requested_by_bytes_threshold(self, tmp_path):
+        from etl_tpu.maintenance_coordination import (
+            CatalogMaintenanceStore, MaintenanceController,
+            MaintenancePolicy, ReplicatorMaintenanceAgent)
+
+        lake = LakeDestination(LakeConfig(
+            str(tmp_path), compact_min_files=99, inline_max_bytes=1 << 20,
+            inline_flush_bytes=1 << 30))
+        await lake.startup()
+        await lake.write_table_rows(make_schema(), batch([[1, "a", None]]))
+        for i in range(3):
+            await lake.write_events([ins(0, [20 + i, "inline", None],
+                                         lsn=0x200 + 16 * i)])
+        assert lake.pending_inline_bytes(TID) > 0
+        policy = MaintenancePolicy(inline_flush_min_inlined_bytes=1,
+                                   request_cooldown_seconds=0.0)
+        store = CatalogMaintenanceStore(str(tmp_path), 1)
+        agent = ReplicatorMaintenanceAgent(store, lake, policy)
+        ctrl = MaintenanceController(store, lake, policy)
+        st = agent.tick()
+        assert st.request_operations.inline_flush
+        report = await ctrl.run_once(wait_for_pause_s=0.0)
+        assert report["operations"]["inline_flush"] == 3
+        assert lake.pending_inline_bytes(TID) == 0
+        store.close()
+        await lake.shutdown()
+
+    async def test_monitor_external_pause_composes_with_memory(self):
+        from etl_tpu.config.pipeline import MemoryBackpressureConfig
+        from etl_tpu.runtime.backpressure import MemoryMonitor
+
+        rss = {"v": 0}
+        mon = MemoryMonitor(MemoryBackpressureConfig(),
+                            limit_bytes=100, rss_reader=lambda: rss["v"])
+        mon.set_external_pause(True)
+        assert mon.pressure is True
+        # memory pressure rises while externally paused
+        rss["v"] = 100
+        mon.sample_once()
+        assert mon.pressure is True
+        # external pause lifts but memory still high → stays paused
+        mon.set_external_pause(False)
+        assert mon.pressure is True
+        rss["v"] = 0
+        mon.sample_once()
+        assert mon.pressure is False
+
+    async def test_two_controllers_cannot_both_take_the_lease(self, tmp_path):
+        lake, store, agent, ctrl, _ = self.make_parts(
+            tmp_path, merge_min_cdc_files=2, request_cooldown_seconds=0.0)
+        await self.seed(lake)
+        agent.tick()
+        from etl_tpu.maintenance_coordination import MaintenanceController
+
+        ctrl2 = MaintenanceController(store, lake, ctrl.policy)
+        r1, r2 = await asyncio.gather(
+            ctrl.run_once(wait_for_pause_s=0.0),
+            ctrl2.run_once(wait_for_pause_s=0.0))
+        ran = [r for r in (r1, r2) if "operations" in r]
+        skipped = [r for r in (r1, r2) if "skipped" in r]
+        assert len(ran) == 1 and len(skipped) == 1
+        assert skipped[0]["skipped"].startswith("run already active") or \
+            skipped[0]["skipped"].startswith("no operations")
+        store.close()
+        await lake.shutdown()
+
+    async def test_operator_vacuum_runs_without_request(self, tmp_path):
+        """--vacuum maps to cleanup_old_files_enabled: operator-driven,
+        selected even though no replicator ever requests it."""
+        from etl_tpu.models import Lsn, TruncateEvent
+
+        lake, store, _, _, _ = self.make_parts(tmp_path)
+        await self.seed(lake)
+        # truncate supersedes the old generation → vacuumable files
+        await lake.write_events([TruncateEvent(Lsn(0x800), Lsn(0x800), 0,
+                                               0, (make_schema(),))])
+        from etl_tpu.maintenance_coordination import (MaintenanceController,
+                                                      MaintenancePolicy)
+
+        ctrl = MaintenanceController(
+            store, lake,
+            MaintenancePolicy(cleanup_old_files_enabled=True))
+        report = await ctrl.run_once(wait_for_pause_s=0.0)
+        assert report["operations"]["cleanup_old_files"] >= 1
+        st = store.load()
+        assert "cleanup_old_files" in st.last_successful
+        store.close()
+        await lake.shutdown()
+
+    async def test_stale_request_cleared_when_need_vanished(self, tmp_path):
+        """A posted merge request whose CDC files were since compacted
+        away must be consumed without pausing the pipeline."""
+        lake, store, agent, ctrl, _ = self.make_parts(
+            tmp_path, merge_min_cdc_files=2, request_cooldown_seconds=0.0)
+        await self.seed(lake)
+        agent.tick()  # posts merge request
+        await lake.compact(TID)  # need vanishes out-of-band
+        report = await ctrl.run_once(wait_for_pause_s=0.0)
+        assert report["skipped"].startswith("no operations")
+        st = store.load()
+        assert not st.request_operations.merge_adjacent_files
+        assert st.pause_run_id is None  # never paused
+        store.close()
+        await lake.shutdown()
