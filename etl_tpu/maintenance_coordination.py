@@ -188,6 +188,41 @@ class CatalogMaintenanceStore:
                    "pipeline_id = ?", (self.pipeline_id,))
         db.commit()
 
+    # -- destination-state sampling (agent side) -------------------------------
+    # The agent samples through THIS connection, not the pipeline's
+    # LakeDestination: its ticks run on a worker thread, and the
+    # destination's sqlite connection belongs to the event-loop thread.
+
+    def sample_table_ids(self) -> list[int]:
+        try:
+            return [r[0] for r in self._conn().execute(
+                "SELECT table_id FROM lake_tables").fetchall()]
+        except sqlite3.OperationalError:
+            return []  # lake not initialized yet
+
+    def sample_cdc_file_count(self, table_id: int) -> int:
+        row = self._conn().execute(
+            "SELECT generation FROM lake_tables WHERE table_id = ?",
+            (table_id,)).fetchone()
+        if row is None:
+            return 0
+        return self._conn().execute(
+            "SELECT COUNT(*) FROM lake_files WHERE table_id = ? AND "
+            "generation = ? AND kind = 'cdc' AND inline_payload IS NULL",
+            (table_id, row[0])).fetchone()[0]
+
+    def sample_pending_inline_bytes(self, table_id: int) -> int:
+        row = self._conn().execute(
+            "SELECT generation FROM lake_tables WHERE table_id = ?",
+            (table_id,)).fetchone()
+        if row is None:
+            return 0
+        (n,) = self._conn().execute(
+            "SELECT COALESCE(SUM(LENGTH(inline_payload)), 0) FROM "
+            "lake_files WHERE table_id = ? AND generation = ? AND "
+            "inline_payload IS NOT NULL", (table_id, row[0])).fetchone()
+        return int(n)
+
     def close(self) -> None:
         if self._db is not None:
             self._db.close()
@@ -205,11 +240,10 @@ class ReplicatorMaintenanceAgent:
     `loop.call_soon_threadsafe` when they touch event-loop state (the
     replicator does)."""
 
-    def __init__(self, store: CatalogMaintenanceStore, lake,
+    def __init__(self, store: CatalogMaintenanceStore,
                  policy: MaintenancePolicy = MaintenancePolicy(),
                  pause=None, resume=None):
         self.store = store
-        self.lake = lake
         self.policy = policy
         self._pause_cb = pause or (lambda: None)
         self._resume_cb = resume or (lambda: None)
@@ -217,16 +251,19 @@ class ReplicatorMaintenanceAgent:
         self._task: asyncio.Task | None = None
 
     def sample_operations(self) -> Operations:
-        """Destination-state sampling → requested operation flags."""
+        """Destination-state sampling → requested operation flags. Reads
+        ride the store's own (thread-safe) catalog connection — the
+        pipeline's LakeDestination connection belongs to the loop
+        thread."""
         ops = Operations()
         p = self.policy
-        for tid in self.lake.table_ids():
+        for tid in self.store.sample_table_ids():
             if (p.inline_flush_enabled and
-                    self.lake.pending_inline_bytes(tid)
+                    self.store.sample_pending_inline_bytes(tid)
                     >= p.inline_flush_min_inlined_bytes):
                 ops.inline_flush = True
             if (p.merge_adjacent_files_enabled and
-                    self.lake.current_cdc_file_count(tid)
+                    self.store.sample_cdc_file_count(tid)
                     >= p.merge_min_cdc_files):
                 ops.merge_adjacent_files = True
         return ops

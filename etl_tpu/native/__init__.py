@@ -31,9 +31,14 @@ def _load() -> ctypes.CDLL | None:
         return _lib
     src = _DIR / "framer.c"
     tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
-    so = _DIR / f"_framer-{tag}.so"
+    # sanitizer harness hook (scripts/sanitize_framer.py): point the
+    # loader at a prebuilt instrumented .so instead of the -O3 build
+    override = os.environ.get("ETL_NATIVE_FRAMER_SO")
+    so = Path(override) if override else _DIR / f"_framer-{tag}.so"
     try:
         if not so.exists():
+            if override:
+                raise FileNotFoundError(override)
             cc = os.environ.get("CC", "cc")
             subprocess.run(
                 [cc, "-O3", "-shared", "-fPIC", str(src), "-o", str(so)],

@@ -156,16 +156,6 @@ int64_t etl_frame_pgoutput(const uint8_t *buf, int64_t buf_len,
  * Kept for parity with the numpy scan; the numpy version is already
  * vectorized, so this exists for callers that want a single pass without
  * numpy temporaries. Returns number of delimiters written (capped at cap). */
-int64_t etl_scan_copy_delims(const uint8_t *buf, int64_t n, int64_t *out,
-                             int64_t cap) {
-    int64_t k = 0;
-    for (int64_t i = 0; i < n && k < cap; i++) {
-        uint8_t b = buf[i];
-        if (b == '\t' || b == '\n') out[k++] = i;
-    }
-    return k;
-}
-
 /* Pack dense-column field bytes into the device byte matrix.
  *
  * bmat[r, w_off(c)..w_off(c)+min(len, width)) = field bytes, zero elsewhere;
@@ -179,12 +169,16 @@ void etl_pack_bmat(const uint8_t *data, int64_t data_len,
                    int64_t n_rows, int32_t n_cols, const int32_t *col_idx,
                    const int32_t *widths, int32_t n_dense, uint8_t *bmat,
                    int32_t total_w, uint8_t *lens_out) {
-    /* per-column output offsets */
+    /* per-column output offsets — defensive against caller mismatch: a C
+     * entry point fed from a dynamic language must never write past the
+     * bmat row stride even if widths[] disagrees with total_w (found by
+     * scripts/sanitize_framer.py's adversarial hammer) */
     int32_t w_off[256];
     int32_t acc = 0;
-    for (int32_t j = 0; j < n_dense && j < 256; j++) {
+    if (n_dense > 256) n_dense = 256;
+    for (int32_t j = 0; j < n_dense; j++) {
         w_off[j] = acc;
-        acc += widths[j];
+        acc += widths[j] > 0 ? widths[j] : 0;
     }
     for (int64_t r = 0; r < n_rows; r++) {
         const int32_t *row_off = offsets + r * n_cols;
@@ -193,7 +187,11 @@ void etl_pack_bmat(const uint8_t *data, int64_t data_len,
         for (int32_t j = 0; j < n_dense; j++) {
             int32_t c = col_idx[j];
             int32_t w = widths[j];
+            if (w < 0) w = 0;
+            if (w_off[j] >= total_w) break;
+            if (w > total_w - w_off[j]) w = total_w - w_off[j];
             int32_t len = row_len[c];
+            if (len < 0) len = 0;
             if (len > w) len = w;
             int64_t off = row_off[c];
             if (off < 0 || off + len > data_len) len = 0;
@@ -258,9 +256,10 @@ void etl_pack_bmat_nibble(const uint8_t *data, int64_t data_len,
     }
     int32_t w_off[256];
     int32_t acc = 0;
-    for (int32_t j = 0; j < n_dense && j < 256; j++) {
+    if (n_dense > 256) n_dense = 256;
+    for (int32_t j = 0; j < n_dense; j++) {
         w_off[j] = acc;
-        acc += widths[j] / 2;
+        acc += widths[j] > 0 ? widths[j] / 2 : 0;
     }
     for (int64_t r = 0; r < n_rows; r++) {
         const int32_t *row_off = offsets + r * n_cols;
@@ -270,7 +269,13 @@ void etl_pack_bmat_nibble(const uint8_t *data, int64_t data_len,
         for (int32_t j = 0; j < n_dense; j++) {
             int32_t c = col_idx[j];
             int32_t w = widths[j];
+            if (w < 0) w = 0;
+            /* same caller-mismatch defense as etl_pack_bmat, in packed
+             * (w/2) units */
+            if (w_off[j] >= packed_w) break;
+            if (w / 2 > packed_w - w_off[j]) w = (packed_w - w_off[j]) * 2;
             int32_t len = row_len[c];
+            if (len < 0) len = 0;
             if (len > w) len = w;
             int64_t off = row_off[c];
             if (off < 0 || off + len > data_len) len = 0;

@@ -107,6 +107,13 @@ async def run_replicator(config_dir: str,
                 f"(known: {sorted(known | {'coordination'})})")
         maint_policy = MaintenancePolicy(
             **{k: v for k, v in maint_doc.items() if k != "coordination"})
+        if maint_doc.get("coordination") and \
+                dest_doc.get("type") != "lake":
+            raise EtlError(
+                ErrorKind.CONFIG_INVALID,
+                "maintenance.coordination requires destination.type=lake "
+                f"(got {dest_doc.get('type')!r}) — the coordination state "
+                "lives in the lake catalog")
     metrics_port = doc.pop("metrics_port", 0)
     project_ref = doc.pop("project_ref", "")
     error_webhook = doc.pop("error_webhook_url", "")
@@ -173,7 +180,7 @@ async def run_replicator(config_dir: str,
             # (catalog lock waits must not stall WAL keepalives), and the
             # monitor's pause event belongs to this loop
             maint_agent = ReplicatorMaintenanceAgent(
-                maint_store, destination, policy=maint_policy,
+                maint_store, policy=maint_policy,
                 pause=lambda: loop_.call_soon_threadsafe(
                     mon.set_external_pause, True),
                 resume=lambda: loop_.call_soon_threadsafe(

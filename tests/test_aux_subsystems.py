@@ -196,3 +196,28 @@ class TestFailpointRestarts:
 def _rows(dest, tid):
     inner = getattr(dest, "inner", dest)
     return inner.table_rows[tid]
+
+
+class TestSanitizerHarness:
+    def test_framer_under_asan_ubsan(self):
+        """Memory-safety net for the C framer (SURVEY §5 race/sanitizer
+        row): build with ASan+UBSan (-fno-sanitize-recover) and run the
+        structured fuzz target, the framer differentials, and the
+        adversarial pack/gather hammer. Any OOB access aborts → rc != 0.
+        This harness caught a real heap overflow (pack_bmat trusting
+        widths[] over total_w) when first introduced."""
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        import pytest
+
+        repo = Path(__file__).resolve().parents[1]
+        proc = subprocess.run(
+            [sys.executable, str(repo / "scripts" / "sanitize_framer.py"),
+             "--seconds", "1.5", "--seed", "42"],
+            capture_output=True, text=True, timeout=240)
+        if proc.returncode == 77:  # toolchain has no gcc sanitizers
+            pytest.skip(proc.stderr.strip()[-200:])
+        assert proc.returncode == 0, \
+            f"sanitizer findings:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"

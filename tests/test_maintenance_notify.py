@@ -187,7 +187,7 @@ class TestMaintenanceCoordination:
         store = CatalogMaintenanceStore(str(tmp_path), 1)
         pauses = []
         agent = ReplicatorMaintenanceAgent(
-            store, lake, policy,
+            store, policy,
             pause=lambda: pauses.append("pause"),
             resume=lambda: pauses.append("resume"))
         ctrl = MaintenanceController(store, lake, policy)
@@ -304,7 +304,7 @@ class TestMaintenanceCoordination:
         policy = MaintenancePolicy(inline_flush_min_inlined_bytes=1,
                                    request_cooldown_seconds=0.0)
         store = CatalogMaintenanceStore(str(tmp_path), 1)
-        agent = ReplicatorMaintenanceAgent(store, lake, policy)
+        agent = ReplicatorMaintenanceAgent(store, policy)
         ctrl = MaintenanceController(store, lake, policy)
         st = agent.tick()
         assert st.request_operations.inline_flush
@@ -389,5 +389,19 @@ class TestMaintenanceCoordination:
         st = store.load()
         assert not st.request_operations.merge_adjacent_files
         assert st.pause_run_id is None  # never paused
+        store.close()
+        await lake.shutdown()
+
+    async def test_agent_tick_runs_on_worker_thread(self, tmp_path):
+        """The production agent ticks via asyncio.to_thread while the
+        pipeline's lake connection lives on the loop thread — sampling
+        must ride the store's own thread-safe connection (reviewed
+        failure: sqlite ProgrammingError made coordination silently
+        dead)."""
+        lake, store, agent, _, _ = self.make_parts(
+            tmp_path, merge_min_cdc_files=2, request_cooldown_seconds=0.0)
+        await self.seed(lake)
+        state = await asyncio.to_thread(agent.tick)
+        assert state.request_operations.merge_adjacent_files
         store.close()
         await lake.shutdown()
