@@ -64,6 +64,19 @@ class LakeConfig:
 # (reference replay_epoch.rs LEGACY_REPLAY_EPOCH)
 LEGACY_REPLAY_EPOCH = "__legacy__"
 
+# maintenance-policy sampling predicates — ONE definition shared with the
+# coordination agent's off-thread sampler (maintenance_coordination.py),
+# so the replicator side and the controller side can never drift on what
+# counts as a compactable CDC file or pending inlined bytes
+TABLE_GENERATION_SQL = "SELECT generation FROM lake_tables WHERE table_id = ?"
+CDC_FILE_COUNT_SQL = (
+    "SELECT COUNT(*) FROM lake_files WHERE table_id = ? AND "
+    "generation = ? AND kind = 'cdc' AND inline_payload IS NULL")
+PENDING_INLINE_BYTES_SQL = (
+    "SELECT COALESCE(SUM(LENGTH(inline_payload)), 0) FROM "
+    "lake_files WHERE table_id = ? AND generation = ? AND "
+    "inline_payload IS NOT NULL")
+
 
 def _concat_cdc_batches(batches: "list[pa.RecordBatch]") -> pa.Table:
     """Concatenate CDC record batches whose schemas may differ only in the
@@ -306,9 +319,7 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
                                          LABEL_TABLE, registry)
 
         (n,) = self._catalog().execute(
-            "SELECT COALESCE(SUM(LENGTH(inline_payload)), 0) FROM "
-            "lake_files WHERE table_id = ? AND generation = ? AND "
-            "inline_payload IS NOT NULL", (table_id, gen)).fetchone()
+            PENDING_INLINE_BYTES_SQL, (table_id, gen)).fetchone()
         registry.gauge_set(ETL_LAKE_INLINED_DATA_BYTES, n,
                            labels={LABEL_TABLE: str(table_id)})
         return int(n)
@@ -376,9 +387,7 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
         compaction after a handful of tiny batches, the exact cost
         inlining exists to avoid."""
         return self._catalog().execute(
-            "SELECT COUNT(*) FROM lake_files WHERE table_id = ? AND "
-            "generation = ? AND kind = 'cdc' AND inline_payload IS NULL",
-            (table_id, gen)).fetchone()[0]
+            CDC_FILE_COUNT_SQL, (table_id, gen)).fetchone()[0]
 
     async def drop_table(self, table_id: TableId,
                          schema: ReplicatedTableSchema | None = None) -> None:

@@ -15,6 +15,10 @@ Commands:
                  once delivery to the destination
   fuzz           seeded parser fuzzing (etl_tpu.testing.fuzz)
   bench-compare  diff two benchmark JSON reports (etl_tpu.benchmarks)
+  fill-table     bulk-load a table over the wire client — parallel
+                 connections, multi-row batches (xtask pg-fill-table)
+  rotate-encryption-key  re-encrypt stored control-plane configs under a
+                 new AES-GCM key (xtask rotate-encryption-key)
 """
 
 from __future__ import annotations
@@ -147,6 +151,106 @@ async def chaos(args) -> int:
     return 0
 
 
+async def fill_table(args) -> int:
+    """Bulk-load a table over the wire client (reference xtask
+    pg-fill-table): N parallel connections issuing multi-row INSERT
+    literals (the loader owns every value — ids are sequential ints, the
+    payload is a fixed [a-z0-9] filler — so literal SQL is the fastest
+    correct shape, like the reference's psql COPY feed), until --rows
+    rows of --row-bytes payload landed. Prints one JSON line with
+    sustained rows/s and bytes/s."""
+    import os
+    import random
+    import time
+
+    from .config.pipeline import PgConnectionConfig
+    from .postgres.client import wire_connection_from_config
+
+    cfg = PgConnectionConfig(
+        host=args.host, port=args.port, name=args.database,
+        username=args.username,
+        password=args.password or os.environ.get("POSTGRES_PASSWORD", ""))
+    setup = wire_connection_from_config(cfg, application_name="etl_fill")
+    await setup.connect()
+    await setup.query(
+        f"CREATE TABLE IF NOT EXISTS {args.table} ("
+        f"id BIGINT PRIMARY KEY, bucket INT, payload TEXT)")
+    await setup.close()
+
+    counter = {"rows": 0, "bytes": 0}
+    rng = random.Random(11)
+    alphabet = "abcdefghijklmnopqrstuvwxyz0123456789"
+    filler = "".join(rng.choice(alphabet) for _ in range(args.row_bytes))
+
+    async def worker(wid: int, base: int, n: int) -> None:
+        conn = wire_connection_from_config(
+            cfg, application_name=f"etl_fill_{wid}")
+        await conn.connect()
+        done = 0
+        while done < n:
+            chunk = min(args.batch_rows, n - done)
+            values = ", ".join(
+                f"({base + done + k + 1}, {(done + k) % 97}, "
+                f"'{filler}')" for k in range(chunk))
+            await conn.query(
+                f"INSERT INTO {args.table} (id, bucket, payload) "
+                f"VALUES {values}")
+            done += chunk
+            counter["rows"] += chunk
+            counter["bytes"] += chunk * (args.row_bytes + 16)
+        await conn.close()
+
+    per = -(-args.rows // args.parallelism)
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker(i, i * per,
+                                  min(per, args.rows - i * per))
+                           for i in range(args.parallelism)
+                           if args.rows - i * per > 0))
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "table": args.table, "rows": counter["rows"],
+        "bytes": counter["bytes"], "seconds": round(dt, 3),
+        "rows_per_sec": round(counter["rows"] / max(dt, 1e-9)),
+        "parallelism": args.parallelism}))
+    return 0
+
+
+def rotate_encryption_key(args) -> int:
+    """Re-encrypt every stored source/destination config under a new
+    primary key (reference xtask rotate-encryption-key). Keys are
+    '<id>:<base64-32-bytes>'; rows already on the new key id are left
+    untouched, so the command is idempotent and restartable."""
+    import sqlite3
+
+    from .api.crypto import ConfigCipher, EncryptionKey
+
+    def parse_key(s: str) -> EncryptionKey:
+        kid, _, b64 = s.partition(":")
+        return EncryptionKey.from_base64(int(kid), b64)
+
+    new = parse_key(args.new_key)
+    olds = [parse_key(s) for s in args.old_key]
+    cipher = ConfigCipher(new, olds)
+    db = sqlite3.connect(args.db)
+    rotated = skipped = 0
+    try:
+        for table in ("api_sources", "api_destinations"):
+            for row_id, enc in db.execute(
+                    f"SELECT id, config_enc FROM {table}").fetchall():
+                if json.loads(enc).get("key_id") == new.key_id:
+                    skipped += 1
+                    continue
+                db.execute(f"UPDATE {table} SET config_enc = ? WHERE "
+                           f"id = ?", (cipher.rotate(enc), row_id))
+                rotated += 1
+        db.commit()
+    finally:
+        db.close()
+    print(json.dumps({"rotated": rotated, "already_current": skipped,
+                      "new_key_id": new.key_id}))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="etl_tpu.devtools")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -174,6 +278,30 @@ def main(argv=None) -> int:
     bp.add_argument("b")
     bp.add_argument("--fail-pct", type=float, default=None)
 
+    ft = sub.add_parser("fill-table",
+                        help="bulk-load a table over the wire client "
+                             "(xtask pg-fill-table)")
+    ft.add_argument("--host", default="localhost")
+    ft.add_argument("--port", type=int, default=5432)
+    ft.add_argument("--database", default="postgres")
+    ft.add_argument("--username", default="postgres")
+    ft.add_argument("--password", default=None,
+                    help="falls back to $POSTGRES_PASSWORD")
+    ft.add_argument("--table", required=True)
+    ft.add_argument("--rows", type=int, default=100_000)
+    ft.add_argument("--row-bytes", type=int, default=256)
+    ft.add_argument("--batch-rows", type=int, default=500)
+    ft.add_argument("--parallelism", type=int, default=4)
+
+    rk = sub.add_parser("rotate-encryption-key",
+                        help="re-encrypt stored configs under a new key")
+    rk.add_argument("--db", required=True,
+                    help="path to the control-plane sqlite database")
+    rk.add_argument("--new-key", required=True,
+                    help="'<id>:<base64 32-byte key>' — the new primary")
+    rk.add_argument("--old-key", action="append", default=[],
+                    help="'<id>:<base64>' decrypt-only key (repeatable)")
+
     args = p.parse_args(argv)
     if args.cmd == "serve-source":
         return asyncio.run(serve_source(args))
@@ -196,6 +324,10 @@ def main(argv=None) -> int:
         if args.fail_pct is not None:
             cmp_args += ["--fail-pct", str(args.fail_pct)]
         return cmp_main(cmp_args)
+    if args.cmd == "fill-table":
+        return asyncio.run(fill_table(args))
+    if args.cmd == "rotate-encryption-key":
+        return rotate_encryption_key(args)
     return 2
 
 

@@ -200,27 +200,30 @@ class CatalogMaintenanceStore:
         except sqlite3.OperationalError:
             return []  # lake not initialized yet
 
+    def _current_generation(self, table_id: int) -> int | None:
+        from .destinations.lake import TABLE_GENERATION_SQL
+
+        row = self._conn().execute(TABLE_GENERATION_SQL,
+                                   (table_id,)).fetchone()
+        return None if row is None else row[0]
+
     def sample_cdc_file_count(self, table_id: int) -> int:
-        row = self._conn().execute(
-            "SELECT generation FROM lake_tables WHERE table_id = ?",
-            (table_id,)).fetchone()
-        if row is None:
+        from .destinations.lake import CDC_FILE_COUNT_SQL
+
+        gen = self._current_generation(table_id)
+        if gen is None:
             return 0
-        return self._conn().execute(
-            "SELECT COUNT(*) FROM lake_files WHERE table_id = ? AND "
-            "generation = ? AND kind = 'cdc' AND inline_payload IS NULL",
-            (table_id, row[0])).fetchone()[0]
+        return self._conn().execute(CDC_FILE_COUNT_SQL,
+                                    (table_id, gen)).fetchone()[0]
 
     def sample_pending_inline_bytes(self, table_id: int) -> int:
-        row = self._conn().execute(
-            "SELECT generation FROM lake_tables WHERE table_id = ?",
-            (table_id,)).fetchone()
-        if row is None:
+        from .destinations.lake import PENDING_INLINE_BYTES_SQL
+
+        gen = self._current_generation(table_id)
+        if gen is None:
             return 0
-        (n,) = self._conn().execute(
-            "SELECT COALESCE(SUM(LENGTH(inline_payload)), 0) FROM "
-            "lake_files WHERE table_id = ? AND generation = ? AND "
-            "inline_payload IS NOT NULL", (table_id, row[0])).fetchone()
+        (n,) = self._conn().execute(PENDING_INLINE_BYTES_SQL,
+                                    (table_id, gen)).fetchone()
         return int(n)
 
     def close(self) -> None:

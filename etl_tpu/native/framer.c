@@ -152,10 +152,6 @@ int64_t etl_frame_pgoutput(const uint8_t *buf, int64_t buf_len,
     return -1;
 }
 
-/* COPY text scan: find tab/newline delimiter positions.
- * Kept for parity with the numpy scan; the numpy version is already
- * vectorized, so this exists for callers that want a single pass without
- * numpy temporaries. Returns number of delimiters written (capped at cap). */
 /* Pack dense-column field bytes into the device byte matrix.
  *
  * bmat[r, w_off(c)..w_off(c)+min(len, width)) = field bytes, zero elsewhere;

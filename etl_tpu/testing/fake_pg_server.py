@@ -113,6 +113,7 @@ class FakePgServer:
         self.scram_transcript: list[tuple[str, str]] = []  # (dir, message)
         self._server: asyncio.AbstractServer | None = None
         self._store_lock = asyncio.Lock()
+        self.allow_generic_sql = False  # devtools fill-table passthrough
         self.port = 0
         self.connections = 0
         self.queries: list[str] = []  # every simple-query SQL, in order
@@ -363,6 +364,8 @@ class FakePgServer:
             return
         try:
             handled = await self._try_handle(sess, norm, sql)
+            if not handled and self.allow_generic_sql:
+                handled = await self._try_generic_sql(sess, norm, sql)
         except Exception as e:  # surface as server error, keep session alive
             w.write(_error("XX000", f"fake server error: {e!r}"))
             w.write(READY)
@@ -372,6 +375,43 @@ class FakePgServer:
             w.write(_error("0A000", f"fake server: unhandled SQL: {norm[:120]}"))
             w.write(READY)
         await w.drain()
+
+    async def _try_generic_sql(self, sess: _Session, norm: str,
+                               sql: str) -> bool:
+        """Opt-in generic DDL/DML passthrough to the embedded sqlite — the
+        devtools fill-table loader needs plain CREATE TABLE / INSERT /
+        SELECT against arbitrary user tables (off by default so protocol
+        tests still assert unhandled-SQL errors)."""
+        first = norm.split(" ", 1)[0].upper() if norm else ""
+        if first not in ("CREATE", "INSERT", "SELECT", "DROP", "DELETE"):
+            return False
+        db = self.db
+        store = getattr(db, "_generic_sql_db", None)
+        if store is None:
+            store = sqlite3.connect(":memory:", check_same_thread=False)
+            store.isolation_level = None
+            db._generic_sql_db = store
+        w = sess.writer
+        # no lock needed: this sqlite is separate from the store's, every
+        # execute is synchronous (no await between statements), and the
+        # loader speaks autocommit statements only
+        try:
+            cur = store.execute(sql)
+        except sqlite3.Error as e:
+            w.write(_error("42601", f"generic sql: {e}"))
+            w.write(READY)
+            return True
+        if cur.description is not None:
+            names = [d[0] for d in cur.description]
+            rows = [[None if v is None else str(v) for v in r]
+                    for r in cur.fetchall()]
+            self._send_rows(w, names, rows)
+        else:
+            tag = {"INSERT": f"INSERT 0 {cur.rowcount}",
+                   "DELETE": f"DELETE {cur.rowcount}"}.get(first, first)
+            w.write(_command_complete(tag))
+            w.write(READY)
+        return True
 
     async def _try_store_sql(self, sess: _Session, norm: str,
                              sql: str) -> bool:
