@@ -190,8 +190,15 @@ def build_app(state: ApiState) -> web.Application:
     async def openapi(_req):
         return web.json_response(OPENAPI_DOC)
 
+    async def docs(_req):
+        # the reference serves Swagger UI (utoipa-swagger-ui); this env
+        # has zero egress, so /docs is a SELF-CONTAINED renderer of the
+        # same /openapi.json — no CDN assets
+        return web.Response(text=_DOCS_HTML, content_type="text/html")
+
     r.add_get("/health", health)
     r.add_get("/metrics", metrics)
+    r.add_get("/docs", docs)
     r.add_get("/openapi.json", openapi)
 
     # -- tenants ----------------------------------------------------------------
@@ -752,6 +759,7 @@ def _ref(name):
 OPENAPI_DOC["paths"] = {
     "/health": {"get": _op("liveness probe")},
     "/metrics": {"get": _op("Prometheus metrics (text exposition)")},
+    "/docs": {"get": _op("this spec rendered as HTML (self-contained)")},
     "/v1/tenants": {
         "post": _op("create tenant", body=_ref("Tenant"),
                     resp=_ref("Tenant")),
@@ -810,3 +818,44 @@ OPENAPI_DOC["paths"] = {
                     params=_ID_PARAM, body=_ref("RollbackRequest"),
                     resp=_ref("RollbackResponse"))},
 }
+
+
+# self-contained /docs page (reference: utoipa-swagger-ui serving): renders
+# /openapi.json client-side with zero external assets
+_DOCS_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>etl_tpu API</title><style>
+body{font-family:system-ui,sans-serif;margin:2rem auto;max-width:60rem;
+     line-height:1.45;color:#1a1a2e}
+h1{font-size:1.4rem} .path{margin:.8rem 0;padding:.6rem .8rem;
+border:1px solid #d8d8e4;border-radius:6px}
+.m{display:inline-block;min-width:4.2rem;font-weight:700;
+   text-transform:uppercase;font-size:.8rem}
+.m.get{color:#0a7} .m.post{color:#06c} .m.put{color:#a60}
+.m.delete{color:#c33} .m.patch{color:#849}
+code{background:#f1f1f7;padding:.1rem .3rem;border-radius:3px}
+.desc{color:#555;margin-left:4.6rem;font-size:.92rem}
+</style></head><body>
+<h1>etl_tpu control-plane API</h1>
+<p>Spec: <a href="/openapi.json">/openapi.json</a>. Authenticated routes
+need <code>Authorization: Bearer &lt;key&gt;</code> and a
+<code>tenant_id</code> header.</p>
+<div id="paths">loading…</div>
+<script>
+fetch('/openapi.json').then(r=>r.json()).then(doc=>{
+  const el=document.getElementById('paths');el.innerHTML='';
+  for(const [p,ops] of Object.entries(doc.paths||{})){
+    const d=document.createElement('div');d.className='path';
+    for(const [m,op] of Object.entries(ops)){
+      const row=document.createElement('div');
+      const mm=document.createElement('span');mm.className='m '+m;
+      mm.textContent=m;row.appendChild(mm);
+      const pc=document.createElement('code');pc.textContent=p;
+      row.appendChild(pc);d.appendChild(row);
+      const ds=document.createElement('div');ds.className='desc';
+      ds.textContent=op.summary||op.description||'';d.appendChild(ds);
+    }
+    el.appendChild(d);
+  }
+}).catch(e=>{document.getElementById('paths').textContent=
+  'failed to load /openapi.json: '+e});
+</script></body></html>"""

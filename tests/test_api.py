@@ -918,3 +918,18 @@ class TestOrchestratorRollout:
         assert (await orch.status(5)).state == "running"
         await orch.shutdown()
         assert (await orch.status(5)).state == "stopped"
+
+
+class TestDocsPage:
+    async def test_docs_served_self_contained(self, tmp_path):
+        client, _ = await make_client(tmp_path)
+        try:
+            resp = await client.get("/docs")
+            assert resp.status == 200
+            assert "text/html" in resp.headers["Content-Type"]
+            body = await resp.text()
+            # renders the spec client-side with ZERO external assets
+            assert "/openapi.json" in body
+            assert "http://" not in body and "https://" not in body
+        finally:
+            await client.close()
