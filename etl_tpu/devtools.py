@@ -75,15 +75,26 @@ async def serve_source(args) -> int:
     return 0
 
 
-async def chaos(args) -> int:
-    """Partition chaos: sever every live replication stream every
-    `--interval` seconds while CDC flows; at the end, assert the
-    destination saw every row exactly once (at-least-once + idempotent
-    delivery must collapse to exactly-once in the memory destination's
-    event log given slot/progress resume)."""
-    from .config import (BatchConfig, BatchEngine, PgConnectionConfig,
-                         PipelineConfig, RetryConfig)
+async def _chaos_scenario(args, scenario: str) -> tuple[dict, bool]:
+    """One chaos scenario over a live pipeline on real TCP (the Chaos
+    Mesh matrix analogue). Scenarios:
+
+      partition    sever every replication stream each interval
+                   (NetworkChaos) — no loss, NO duplicate events;
+      destination  scripted destination faults (reject before apply +
+                   fail AFTER apply) — no loss; duplicates are the
+                   at-least-once redeliveries idempotent destinations
+                   collapse, bounded by the injected fail-after-apply
+                   count;
+      slot         invalidate the apply slot mid-stream (max_slot_wal_
+                   keep_size eviction) with recreate_and_resync — the
+                   pipeline must resync and converge with no loss.
+    """
+    from .config import (BatchConfig, BatchEngine, InvalidatedSlotBehavior,
+                         PgConnectionConfig, PipelineConfig, RetryConfig)
     from .destinations import MemoryDestination
+    from .destinations.memory import (FaultAction, FaultInjectingDestination,
+                                      FaultKind)
     from .models import InsertEvent
     from .postgres.client import PgReplicationClient
     from .runtime import Pipeline, TableStateType
@@ -97,21 +108,27 @@ async def chaos(args) -> int:
     cfg = PgConnectionConfig(host="127.0.0.1", port=server.port,
                              name="postgres", username="etl")
     store = NotifyingStore()
-    dest = MemoryDestination()
+    memory = MemoryDestination()
+    dest = memory
+    fail_after_applies = 0
+    if scenario == "destination":
+        dest = FaultInjectingDestination(memory)
     pipeline = Pipeline(
         config=PipelineConfig(
             pipeline_id=1, publication_name="pub", pg_connection=cfg,
             batch=BatchConfig(max_fill_ms=40,
                               batch_engine=BatchEngine(args.engine)),
             apply_retry=RetryConfig(max_attempts=100, initial_delay_ms=50,
-                                    max_delay_ms=200)),
+                                    max_delay_ms=200),
+            invalidated_slot_behavior=
+                InvalidatedSlotBehavior.RECREATE_AND_RESYNC),
         store=store, destination=dest,
         source_factory=lambda: PgReplicationClient(cfg))
     await pipeline.start()
     await asyncio.wait_for(store.notify_on(tid, TableStateType.READY), 60)
 
     n_cdc = 0
-    severs = 0
+    disruptions = 0
     deadline = asyncio.get_event_loop().time() + args.seconds
     while asyncio.get_event_loop().time() < deadline:
         tx = db.transaction()
@@ -120,34 +137,75 @@ async def chaos(args) -> int:
             tx.insert(tid, [str(10**6 + n_cdc), "0", f"chaos-{n_cdc}"])
         await tx.commit()
         await asyncio.sleep(args.interval / 2)
-        await db.sever_streams()  # the NetworkChaos partition
-        severs += 1
+        disruptions += 1
+        if scenario == "partition":
+            await db.sever_streams()  # the NetworkChaos partition
+        elif scenario == "destination":
+            # both failure sides of a write: before apply (clean retry)
+            # and AFTER apply (forces redelivery of applied events)
+            dest.script("write_events", FaultAction(FaultKind.REJECT))
+            dest.script("write_events",
+                        FaultAction(FaultKind.FAIL_AFTER_APPLY))
+            fail_after_applies += 1
+        elif scenario == "slot" and disruptions == 2:
+            # one mid-stream eviction is the scenario; repeated
+            # invalidations would just repeat the same resync
+            from .postgres.slots import apply_slot_name
+
+            db.invalidate_slot(apply_slot_name(1))
+            await db.sever_streams()
         await asyncio.sleep(args.interval / 2)
 
     def delivered():
-        return {e.row.values[0] for e in dest.events
+        return {e.row.values[0] for e in memory.events
                 if isinstance(e, InsertEvent)}
+
+    def resynced():
+        # a slot resync re-copies rows instead of re-streaming them
+        return {r.values[0] for r in (memory.table_rows.get(tid) or [])}
 
     expected = {10**6 + i for i in range(1, n_cdc + 1)}
     for _ in range(600):
-        if delivered() >= expected:
+        if delivered() | resynced() >= expected:
             break
         await asyncio.sleep(0.1)
-    got = delivered()
+    got = delivered() | resynced()
     missing = expected - got
     await pipeline.shutdown_and_wait()
     await server.stop()
     dup_count = sum(
-        1 for e in dest.events if isinstance(e, InsertEvent)) - len(got)
-    report = {"severs": severs, "cdc_rows": n_cdc,
-              "delivered": len(got & expected), "missing": sorted(missing),
-              "duplicate_events": dup_count,
-              "copied_rows": len(dest.table_rows[tid])}
-    print(json.dumps(report))
-    if missing or dup_count > 0 or report["copied_rows"] != args.rows:
-        print("CHAOS FAILED", file=sys.stderr)
+        1 for e in memory.events if isinstance(e, InsertEvent)) \
+        - len(delivered())
+    report = {"scenario": scenario, "disruptions": disruptions,
+              "cdc_rows": n_cdc, "delivered": len(got & expected),
+              "missing": sorted(missing)[:20],
+              "duplicate_events": dup_count}
+    if scenario == "partition":
+        ok = (not missing and dup_count == 0
+              and len(memory.table_rows[tid]) >= args.rows)
+    elif scenario == "destination":
+        # duplicates are EXPECTED here (fail-after-apply forces
+        # redelivery) but must be bounded by the injected faults x batch
+        ok = not missing and dup_count <= fail_after_applies * 64
+    else:  # slot
+        ok = not missing and bool(memory.dropped_tables)
+    return report, ok
+
+
+async def chaos(args) -> int:
+    scenarios = (["partition", "destination", "slot"]
+                 if args.scenario == "all" else [args.scenario])
+    failed = []
+    for sc in scenarios:
+        report, ok = await _chaos_scenario(args, sc)
+        print(json.dumps(report))
+        if not ok:
+            failed.append(sc)
+    if failed:
+        print(f"CHAOS FAILED: {failed}", file=sys.stderr)
         return 1
-    print("chaos OK: no loss across stream partitions", file=sys.stderr)
+    print(f"chaos OK: {', '.join(scenarios)} — no loss",
+          file=sys.stderr)
     return 0
 
 
@@ -262,11 +320,13 @@ def main(argv=None) -> int:
     sp.add_argument("--cdc-rate", type=int, default=0,
                     help="rows/second of continuous CDC traffic")
 
-    cp = sub.add_parser("chaos", help="stream-partition chaos scenario")
+    cp = sub.add_parser("chaos", help="chaos scenario matrix")
     cp.add_argument("--rows", type=int, default=2_000)
     cp.add_argument("--seconds", type=float, default=10.0)
     cp.add_argument("--interval", type=float, default=1.0)
     cp.add_argument("--engine", default="tpu", choices=["tpu", "cpu"])
+    cp.add_argument("--scenario", default="partition",
+                    choices=["partition", "destination", "slot", "all"])
 
     fp = sub.add_parser("fuzz", help="seeded parser fuzzing")
     fp.add_argument("--target", default=None)

@@ -792,6 +792,11 @@ class FakePgServer:
         publication = m.group(1) if m else ""
         pub_tables = set(db.publications.get(publication, []))
         slot.active = True
+        # register with the database's chaos hook: sever_streams() must
+        # cut WIRE replication sessions too, not only in-process streams
+        # (otherwise TCP-backed chaos scenarios partition nothing)
+        handle = _WireStreamHandle(w)
+        db.active_streams.append(handle)
         w.write(_msg(b"W", struct.pack(">bh", 0, 0)))
         await w.drain()
 
