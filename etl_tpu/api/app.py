@@ -479,7 +479,9 @@ def build_app(state: ApiState) -> web.Application:
     async def delete_pipeline(req: web.Request):
         tenant = _require_tenant(req)
         row = _pipeline_row(req, tenant)
-        await state.orchestrator.stop_pipeline(row[0])
+        # delete, not stop: permanent teardown may also drop
+        # pipeline-owned storage (the k8s warehouse PVC)
+        await state.orchestrator.delete_pipeline(row[0])
         state.db.execute("DELETE FROM api_pipelines WHERE id = ?", (row[0],))
         state.db.commit()
         return web.json_response({}, status=204)

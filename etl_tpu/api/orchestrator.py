@@ -57,6 +57,11 @@ class Orchestrator(abc.ABC):
         await self.stop_pipeline(spec.pipeline_id)
         await self.start_pipeline(spec)
 
+    async def delete_pipeline(self, pipeline_id: int) -> None:
+        """Permanent teardown. Unlike stop (a pause, paired with start),
+        delete may destroy pipeline-owned storage."""
+        await self.stop_pipeline(pipeline_id)
+
     async def shutdown(self) -> None:
         return None
 
@@ -136,11 +141,20 @@ class K8sOrchestrator(Orchestrator):
 
     def __init__(self, *, api_url: str, namespace: str = "etl",
                  image: str = "etl-tpu-replicator:latest",
-                 token: str = ""):
+                 token: str = "", control_api_url: str = "",
+                 control_api_key_secret: str = ""):
         self.api_url = api_url
         self.namespace = namespace
         self.image = image
         self.token = token
+        # where maintenance jobs reach the CONTROL-PLANE API (etl-api) for
+        # the stop/start pause gate — NOT the replicator pod, which serves
+        # only /metrics + /health
+        self.control_api_url = control_api_url
+        # name of a deployer-managed Secret holding the control-plane
+        # bearer token under key "api-key"; injected as ETL_API_KEY
+        # (maintenance.py reads it) so secured APIs don't 401 every run
+        self.control_api_key_secret = control_api_key_secret
         self._session: aiohttp.ClientSession | None = None
 
     def _name(self, pipeline_id: int) -> str:
@@ -183,6 +197,37 @@ class K8sOrchestrator(Orchestrator):
         # when nothing else in the template moved (reference
         # k8s/http.rs:1676,1708 restart checksum)
         restarted_at = f"{time.time():.6f}"
+        statefulset = ("POST",
+                       f"/apis/apps/v1/namespaces/{ns}/statefulsets", {
+            "metadata": {"name": name,
+                         "labels": {"app": "etl-replicator",
+                                    "pipeline_id": str(spec.pipeline_id),
+                                    "tenant_id": spec.tenant_id}},
+            "spec": {
+                "serviceName": name, "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {
+                        "labels": {"app": name},
+                        "annotations": {
+                            "etl/restarted-at": restarted_at}},
+                    "spec": {"containers": [{
+                        "name": "replicator",
+                        "image": spec.image or self.image,
+                        "args": ["--config-dir", "/etc/etl"],
+                        # credentials re-enter via the APP_ env
+                        # overlay, never the config document
+                        "envFrom": [{"secretRef": {
+                            "name": f"{name}-secrets"}}],
+                        "volumeMounts": [{"name": "config",
+                                          "mountPath": "/etc/etl"}],
+                    }], "volumes": [{
+                        "name": "config",
+                        "configMap": {"name": f"{name}-config"},
+                    }]},
+                },
+            },
+        })
         resources = [
             ("POST", f"/api/v1/namespaces/{ns}/secrets", {
                 "metadata": {"name": f"{name}-secrets"},
@@ -197,38 +242,20 @@ class K8sOrchestrator(Orchestrator):
                          "pipeline_id": str(spec.pipeline_id),
                          "tenant_id": spec.tenant_id},
             }),
-            ("POST", f"/apis/apps/v1/namespaces/{ns}/statefulsets", {
-                "metadata": {"name": name,
-                             "labels": {"app": "etl-replicator",
-                                        "pipeline_id": str(spec.pipeline_id),
-                                        "tenant_id": spec.tenant_id}},
-                "spec": {
-                    "serviceName": name, "replicas": 1,
-                    "selector": {"matchLabels": {"app": name}},
-                    "template": {
-                        "metadata": {
-                            "labels": {"app": name},
-                            "annotations": {
-                                "etl/restarted-at": restarted_at}},
-                        "spec": {"containers": [{
-                            "name": "replicator",
-                            "image": spec.image or self.image,
-                            "args": ["--config-dir", "/etc/etl"],
-                            # credentials re-enter via the APP_ env
-                            # overlay, never the config document
-                            "envFrom": [{"secretRef": {
-                                "name": f"{name}-secrets"}}],
-                            "volumeMounts": [{"name": "config",
-                                              "mountPath": "/etc/etl"}],
-                        }], "volumes": [{
-                            "name": "config",
-                            "configMap": {"name": f"{name}-config"},
-                        }]},
-                    },
-                },
-            }),
+            statefulset,
         ]
         if spec.config.get("destination", {}).get("type") == "lake":
+            # lake pipelines: replicator + maintenance job operate on ONE
+            # shared warehouse volume — without it each pod sees its own
+            # empty pod-local filesystem and compaction is a no-op
+            resources.insert(0, self._warehouse_pvc(spec, name))
+            sts_spec = statefulset[2]["spec"]["template"]["spec"]
+            sts_spec["volumes"].append({
+                "name": "warehouse", "persistentVolumeClaim": {
+                    "claimName": f"{name}-warehouse"}})
+            sts_spec["containers"][0]["volumeMounts"].append({
+                "name": "warehouse",
+                "mountPath": self._warehouse_mount(spec)})
             # per-pipeline external-maintenance CronJob (reference
             # k8s/base.rs create_or_update_ducklake_maintenance)
             resources.append(self._maintenance_cronjob(spec, name))
@@ -240,9 +267,13 @@ class K8sOrchestrator(Orchestrator):
                     # REPLACE, don't merge: a strategic-merge PATCH keeps
                     # stale keys alive, so a rotated-away credential (or a
                     # pre-upgrade full-config blob) would keep reaching
-                    # pods through envFrom forever
-                    await self._api("DELETE", obj_path)
-                    status, _ = await self._api(method, path, body)
+                    # pods through envFrom forever. PUT replaces the
+                    # object atomically — no delete-to-create window in
+                    # which a concurrently starting pod would fail
+                    # envFrom/volume resolution
+                    status, _ = await self._api("PUT", obj_path, body)
+                elif "persistentvolumeclaims" in path:
+                    status = 200  # PVCs are create-once; existing is fine
                 else:
                     # StatefulSet/CronJob: strategic-merge PATCH rolls the
                     # pod template without recreating the workload
@@ -251,12 +282,56 @@ class K8sOrchestrator(Orchestrator):
                 raise EtlError(ErrorKind.DESTINATION_FAILED,
                                f"k8s {method} {path} → {status}")
 
+    @staticmethod
+    def _warehouse_mount(spec: ReplicatorSpec) -> str:
+        # warehouse_path is a DIRECTORY (parquet files + catalog,
+        # lake.py:52) — mount the shared volume exactly there
+        return spec.config.get("destination", {}).get(
+            "warehouse_path", "") or "/var/lib/etl/warehouse"
+
+    def _warehouse_pvc(self, spec: ReplicatorSpec,
+                       name: str) -> tuple[str, str, dict]:
+        size = spec.config.get("destination", {}).get(
+            "warehouse_size", "10Gi")
+        return (
+            "POST",
+            f"/api/v1/namespaces/{self.namespace}/persistentvolumeclaims", {
+                "metadata": {"name": f"{name}-warehouse"},
+                "spec": {
+                    "accessModes": ["ReadWriteOnce"],
+                    "resources": {"requests": {"storage": size}},
+                },
+            })
+
     def _maintenance_cronjob(self, spec: ReplicatorSpec,
                              name: str) -> tuple[str, str, dict]:
-        schedule = spec.config.get("maintenance", {}).get(
-            "schedule", "*/30 * * * *")
-        warehouse = spec.config.get("destination", {}).get(
-            "warehouse_path", "")
+        maint = spec.config.get("maintenance", {})
+        schedule = maint.get("schedule", "*/30 * * * *")
+        # --warehouse must equal the volume mountPath (including the
+        # fallback when warehouse_path is unset) or the job would compact
+        # an unmounted pod-local directory
+        args = ["--warehouse", self._warehouse_mount(spec),
+                "--pipeline-id", str(spec.pipeline_id)]
+        if maint.get("coordination"):
+            # lease-based coordination rides the SHARED warehouse catalog
+            # (the replicator runs the agent side) — no API round-trip
+            args.append("--coordinate")
+        env = []
+        if not maint.get("coordination") and self.control_api_url:
+            # uncoordinated pipelines fall back to the stop/start pause
+            # gate, which talks to the CONTROL-PLANE API with the
+            # pipeline's tenant identity — and its bearer token, when the
+            # deployer secured the API (401s would otherwise fail every
+            # scheduled run, silently stopping compaction)
+            args += ["--api-url", self.control_api_url,
+                     "--tenant-id", spec.tenant_id]
+            if self.control_api_key_secret:
+                env.append({"name": "ETL_API_KEY", "valueFrom": {
+                    "secretKeyRef": {"name": self.control_api_key_secret,
+                                     "key": "api-key"}}})
+        # with neither coordination nor a control-plane URL the job runs
+        # ungated — lake catalog writes are transactional, so the risk is
+        # churn, not corruption
         return (
             "POST",
             f"/apis/batch/v1/namespaces/{self.namespace}/cronjobs", {
@@ -269,6 +344,14 @@ class K8sOrchestrator(Orchestrator):
                     "concurrencyPolicy": "Forbid",
                     "jobTemplate": {"spec": {"template": {"spec": {
                         "restartPolicy": "Never",
+                        # the warehouse PVC is ReadWriteOnce: it can only
+                        # attach to one node, so pin the job to whatever
+                        # node runs the replicator pod
+                        "affinity": {"podAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution":
+                            [{"labelSelector": {"matchLabels": {
+                                "app": name}},
+                              "topologyKey": "kubernetes.io/hostname"}]}},
                         "containers": [{
                             "name": "maintenance",
                             "image": spec.image or self.image,
@@ -277,10 +360,16 @@ class K8sOrchestrator(Orchestrator):
                             # maintenance module regardless
                             "command": ["python", "-m",
                                         "etl_tpu.maintenance"],
-                            "args": ["--warehouse", warehouse,
-                                     "--api-url",
-                                     f"http://{name}:8080"],
+                            "args": args,
+                            "env": env,
+                            "volumeMounts": [{
+                                "name": "warehouse",
+                                "mountPath": self._warehouse_mount(spec)}],
                         }],
+                        "volumes": [{
+                            "name": "warehouse",
+                            "persistentVolumeClaim": {
+                                "claimName": f"{name}-warehouse"}}],
                     }}}},
                 },
             })
@@ -294,6 +383,10 @@ class K8sOrchestrator(Orchestrator):
         await self.start_pipeline(spec)
 
     async def stop_pipeline(self, pipeline_id: int) -> None:
+        """Pause: remove the workload resources but KEEP the warehouse
+        PVC — stop is paired with start, and the lake data must survive
+        the pause (run_maintenance itself stops the pipeline before
+        compacting the very warehouse that volume holds)."""
         ns = self.namespace
         name = self._name(pipeline_id)
         for path in (f"/apis/apps/v1/namespaces/{ns}/statefulsets/{name}",
@@ -305,6 +398,21 @@ class K8sOrchestrator(Orchestrator):
             if status >= 400 and status != 404:
                 raise EtlError(ErrorKind.DESTINATION_FAILED,
                                f"k8s DELETE {path} → {status}")
+
+    async def delete_pipeline(self, pipeline_id: int) -> None:
+        """Permanent teardown: stop, then drop the warehouse PVC — an
+        orphaned claim would be silently re-adopted by a future pipeline
+        with the same id, running it against stale warehouse data (old
+        catalog, old replay epochs)."""
+        await self.stop_pipeline(pipeline_id)
+        ns = self.namespace
+        name = self._name(pipeline_id)
+        status, _ = await self._api(
+            "DELETE", f"/api/v1/namespaces/{ns}/persistentvolumeclaims/"
+                      f"{name}-warehouse")
+        if status >= 400 and status != 404:
+            raise EtlError(ErrorKind.DESTINATION_FAILED,
+                           f"k8s DELETE pvc {name}-warehouse → {status}")
 
     async def pod_status(self, pipeline_id: int) -> str:
         """Pod-level state (reference get_replicator_pod_status): derives

@@ -184,7 +184,13 @@ void etl_pack_bmat(const uint8_t *data, int64_t data_len,
             int32_t c = col_idx[j];
             int32_t w = widths[j];
             if (w < 0) w = 0;
-            if (w_off[j] >= total_w) break;
+            if (w_off[j] >= total_w) {
+                /* clamp fired: zero the length so the numpy-empty
+                 * lens buffer never leaks uninitialized bytes to the
+                 * device decode path */
+                lens_out[r * n_dense + j] = 0;
+                continue;
+            }
             if (w > total_w - w_off[j]) w = total_w - w_off[j];
             int32_t len = row_len[c];
             if (len < 0) len = 0;
@@ -268,7 +274,10 @@ void etl_pack_bmat_nibble(const uint8_t *data, int64_t data_len,
             if (w < 0) w = 0;
             /* same caller-mismatch defense as etl_pack_bmat, in packed
              * (w/2) units */
-            if (w_off[j] >= packed_w) break;
+            if (w_off[j] >= packed_w) {
+                lens_out[r * n_dense + j] = 0;
+                continue;
+            }
             if (w / 2 > packed_w - w_off[j]) w = (packed_w - w_off[j]) * 2;
             int32_t len = row_len[c];
             if (len < 0) len = 0;

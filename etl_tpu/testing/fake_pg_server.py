@@ -73,6 +73,26 @@ def _command_complete(tag: str) -> bytes:
 READY = _msg(b"Z", b"I")
 
 
+class _WireStreamHandle:
+    """Chaos handle for a WIRE replication session.
+
+    Registered in ``db.active_streams`` alongside the in-process
+    ``_FakeReplicationStream`` handles so ``FakeDatabase.sever_streams()``
+    (the NetworkChaos partition analogue, mirroring Chaos Mesh on
+    replicator pods — reference xtask chaos) cuts TCP-backed sessions
+    too: ``close()`` aborts the transport, so the client observes a hard
+    connection reset mid-stream rather than a graceful CopyDone.
+    """
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self._writer = writer
+
+    async def close(self) -> None:
+        transport = self._writer.transport
+        if transport is not None:
+            transport.abort()
+
+
 @dataclass
 class _Session:
     reader: asyncio.StreamReader
@@ -794,17 +814,19 @@ class FakePgServer:
         slot.active = True
         # register with the database's chaos hook: sever_streams() must
         # cut WIRE replication sessions too, not only in-process streams
-        # (otherwise TCP-backed chaos scenarios partition nothing)
+        # (otherwise TCP-backed chaos scenarios partition nothing).
+        # Registration happens inside the try so an early connection drop
+        # (drain raising before the loop starts) still unregisters the
+        # handle and resets slot.active in the finally.
         handle = _WireStreamHandle(w)
-        db.active_streams.append(handle)
-        w.write(_msg(b"W", struct.pack(">bh", 0, 0)))
-        await w.drain()
-
         pos = max(start_lsn, slot.confirmed_flush)
         wal_index = 0
         reader_task = asyncio.ensure_future(
             self._read_status_updates(sess, slot))
         try:
+            db.active_streams.append(handle)
+            w.write(_msg(b"W", struct.pack(">bh", 0, 0)))
+            await w.drain()
             while not reader_task.done():
                 sent = False
                 while wal_index < len(db.wal):
@@ -841,6 +863,8 @@ class FakePgServer:
             pass
         finally:
             slot.active = False
+            if handle in db.active_streams:
+                db.active_streams.remove(handle)
             if not reader_task.done():
                 reader_task.cancel()
             try:

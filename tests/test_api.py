@@ -240,7 +240,15 @@ class TestK8sOrchestrator:
                 {"secretRef": {"name": "etl-replicator-7-secrets"}}]
             await orch.stop_pipeline(7)
             deletes = [p for p in server.paths() if p.startswith("DELETE")]
-            assert len(deletes) == 4  # sts, secret, configmap, cronjob
+            # stop is a PAUSE: workload resources go, the warehouse PVC
+            # stays (sts, secret, configmap, cronjob)
+            assert len(deletes) == 4
+            assert not any("persistentvolumeclaims" in p for p in deletes)
+            # permanent teardown drops the PVC too
+            await orch.delete_pipeline(7)
+            deletes = [p for p in server.paths() if p.startswith("DELETE")]
+            assert sum(1 for p in deletes
+                       if "persistentvolumeclaims" in p) == 1
             await orch.shutdown()
         finally:
             await server.stop()
@@ -259,9 +267,103 @@ class TestK8sOrchestrator:
             assert cron["metadata"]["name"] == "etl-replicator-3-maintenance"
             assert cron["spec"]["schedule"] == "0 2 * * *"
             assert cron["spec"]["concurrencyPolicy"] == "Forbid"
+            job_spec = cron["spec"]["jobTemplate"]["spec"]["template"][
+                "spec"]
+            args = job_spec["containers"][0]["args"]
+            assert "--warehouse" in args and "/wh" in args
+            # the pause gate (maintenance.py run_maintenance) requires
+            # BOTH --api-url and --pipeline-id; without the id the job
+            # compacts while the replicator is live
+            assert "--pipeline-id" in args
+            assert args[args.index("--pipeline-id") + 1] == "3"
+            assert "--coordinate" not in args  # not opted in here
+            # no control-plane URL configured -> no pause-gate API args
+            # (the replicator pod serves only /metrics + /health, so
+            # pointing --api-url at it would fail every run)
+            assert "--api-url" not in args
+            # replicator + maintenance share ONE warehouse PVC mounted at
+            # the warehouse path — separate pod-local filesystems would
+            # make compaction a silent no-op
+            assert job_spec["volumes"] == [{
+                "name": "warehouse", "persistentVolumeClaim": {
+                    "claimName": "etl-replicator-3-warehouse"}}]
+            assert job_spec["containers"][0]["volumeMounts"] == [
+                {"name": "warehouse", "mountPath": "/wh"}]
+            pvc = [r for r in server.requests
+                   if r.path.endswith("/persistentvolumeclaims")]
+            assert len(pvc) == 1
+            assert pvc[0].json["metadata"]["name"] == \
+                "etl-replicator-3-warehouse"
+            sts = [r for r in server.requests
+                   if r.path.endswith("/statefulsets")][0].json
+            sts_spec = sts["spec"]["template"]["spec"]
+            assert {"name": "warehouse", "persistentVolumeClaim": {
+                "claimName": "etl-replicator-3-warehouse"}} \
+                in sts_spec["volumes"]
+            assert {"name": "warehouse", "mountPath": "/wh"} \
+                in sts_spec["containers"][0]["volumeMounts"]
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_coordinated_maintenance_cronjob_opt_in(self):
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
+            await orch.start_pipeline(ReplicatorSpec(
+                4, "t", {"destination": {"type": "lake",
+                                         "warehouse_path": "/wh"},
+                         "maintenance": {"coordination": True}}))
+            cron = [r for r in server.requests
+                    if r.path.endswith("/cronjobs")][0].json
+            job_spec = cron["spec"]["jobTemplate"]["spec"]["template"][
+                "spec"]
+            args = job_spec["containers"][0]["args"]
+            assert "--coordinate" in args
+            # coordination rides the shared warehouse catalog: no API args
+            assert "--api-url" not in args
+            # RWO PVC: the job must be co-scheduled with the replicator
+            aff = job_spec["affinity"]["podAffinity"][
+                "requiredDuringSchedulingIgnoredDuringExecution"][0]
+            assert aff["labelSelector"]["matchLabels"] == {
+                "app": "etl-replicator-4"}
+            assert aff["topologyKey"] == "kubernetes.io/hostname"
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_uncoordinated_cronjob_uses_control_plane_gate(self):
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            orch = K8sOrchestrator(api_url=server.url(), namespace="etl",
+                                   control_api_url="http://etl-api:8000",
+                                   control_api_key_secret="etl-api-key")
+            await orch.start_pipeline(ReplicatorSpec(
+                5, "acme", {"destination": {"type": "lake",
+                                            "warehouse_path": "/wh",
+                                            "warehouse_size": "50Gi"}}))
+            cron = [r for r in server.requests
+                    if r.path.endswith("/cronjobs")][0].json
             args = cron["spec"]["jobTemplate"]["spec"]["template"]["spec"][
                 "containers"][0]["args"]
-            assert "--warehouse" in args and "/wh" in args
+            # pause gate aimed at the CONTROL-PLANE API with the
+            # pipeline's tenant identity
+            assert args[args.index("--api-url") + 1] == \
+                "http://etl-api:8000"
+            assert args[args.index("--tenant-id") + 1] == "acme"
+            assert "--coordinate" not in args
+            env = cron["spec"]["jobTemplate"]["spec"]["template"]["spec"][
+                "containers"][0]["env"]
+            # secured control plane: bearer token reaches the job as
+            # ETL_API_KEY (maintenance.py:194) via a deployer Secret
+            assert env == [{"name": "ETL_API_KEY", "valueFrom": {
+                "secretKeyRef": {"name": "etl-api-key",
+                                 "key": "api-key"}}}]
+            pvc = [r for r in server.requests
+                   if r.path.endswith("/persistentvolumeclaims")][0].json
+            assert pvc["spec"]["resources"]["requests"]["storage"] == "50Gi"
             await orch.shutdown()
         finally:
             await server.stop()
@@ -323,14 +425,15 @@ class TestK8sOrchestrator:
             server.fail_next = [409]  # first resource (Secret) exists
             orch = K8sOrchestrator(api_url=server.url())
             await orch.start_pipeline(ReplicatorSpec(1, "t", {}))
-            # an existing Secret is REPLACED (delete + recreate) so
-            # rotated-away credential keys cannot survive a merge
+            # an existing Secret is REPLACED via PUT (atomic, no
+            # delete-to-create window) so rotated-away credential keys
+            # cannot survive a merge and a concurrently starting pod
+            # never sees the Secret missing
             paths = server.paths()
-            assert any(p.startswith("DELETE ") and "secrets" in p
-                       for p in paths)
-            assert sum(1 for p in paths
-                       if p.startswith("POST ") and p.endswith("/secrets")) \
-                == 2
+            puts = [p for p in paths
+                    if p.startswith("PUT ") and "secrets" in p]
+            assert len(puts) == 1
+            assert not any(p.startswith("DELETE ") for p in paths)
             await orch.shutdown()
         finally:
             await server.stop()
