@@ -201,7 +201,7 @@ def main():
     parser = argparse.ArgumentParser(prog="bench.py")
     parser.add_argument("--mode", default="decode",
                         choices=["decode", "table_copy", "table_streaming",
-                                 "wide_row"])
+                                 "wide_row", "lag"])
     parser.add_argument("--engine", default="tpu",
                         choices=["tpu", "cpu", "pallas"])
     args = parser.parse_args()
@@ -221,6 +221,8 @@ def main():
             out = asyncio.run(harness.run_table_copy(engine=args.engine))
         elif args.mode == "table_streaming":
             out = asyncio.run(harness.run_table_streaming(engine=args.engine))
+        elif args.mode == "lag":
+            out = asyncio.run(harness.run_lag_vs_rate(engine=args.engine))
         else:
             out = harness.run_wide_row(
                 engine="pallas" if args.engine == "pallas" else "xla")

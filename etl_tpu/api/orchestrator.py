@@ -273,7 +273,15 @@ class K8sOrchestrator(Orchestrator):
                     # envFrom/volume resolution
                     status, _ = await self._api("PUT", obj_path, body)
                 elif "persistentvolumeclaims" in path:
-                    status = 200  # PVCs are create-once; existing is fine
+                    # reconcile the size: volume EXPANSION is a legal PVC
+                    # update, and silently keeping the old claim would
+                    # drop an operator's warehouse_size raise on restart.
+                    # 403/422 = shrink or no-expansion storage class —
+                    # keep the existing claim rather than fail the start
+                    status, _ = await self._api("PATCH", obj_path, {
+                        "spec": {"resources": body["spec"]["resources"]}})
+                    if status in (403, 422):
+                        status = 200
                 else:
                     # StatefulSet/CronJob: strategic-merge PATCH rolls the
                     # pod template without recreating the workload
@@ -384,35 +392,39 @@ class K8sOrchestrator(Orchestrator):
 
     async def stop_pipeline(self, pipeline_id: int) -> None:
         """Pause: remove the workload resources but KEEP the warehouse
-        PVC — stop is paired with start, and the lake data must survive
-        the pause (run_maintenance itself stops the pipeline before
-        compacting the very warehouse that volume holds)."""
+        PVC and the maintenance CronJob. Stop is paired with start: the
+        lake data must survive the pause (run_maintenance itself stops
+        the pipeline before compacting the very warehouse that volume
+        holds), and deleting the CronJob here would cascade-GC its OWN
+        running Job mid-compaction — the pause gate calls /stop, and in
+        real Kubernetes the Job's ownerReference makes the delete
+        garbage-collect the pod that issued it."""
         ns = self.namespace
         name = self._name(pipeline_id)
         for path in (f"/apis/apps/v1/namespaces/{ns}/statefulsets/{name}",
                      f"/api/v1/namespaces/{ns}/secrets/{name}-secrets",
-                     f"/api/v1/namespaces/{ns}/configmaps/{name}-config",
-                     f"/apis/batch/v1/namespaces/{ns}/cronjobs/"
-                     f"{name}-maintenance"):
+                     f"/api/v1/namespaces/{ns}/configmaps/{name}-config"):
             status, _ = await self._api("DELETE", path)
             if status >= 400 and status != 404:
                 raise EtlError(ErrorKind.DESTINATION_FAILED,
                                f"k8s DELETE {path} → {status}")
 
     async def delete_pipeline(self, pipeline_id: int) -> None:
-        """Permanent teardown: stop, then drop the warehouse PVC — an
-        orphaned claim would be silently re-adopted by a future pipeline
-        with the same id, running it against stale warehouse data (old
-        catalog, old replay epochs)."""
+        """Permanent teardown: stop, then drop the maintenance CronJob
+        and the warehouse PVC — an orphaned claim would be silently
+        re-adopted by a future pipeline with the same id, running it
+        against stale warehouse data (old catalog, old replay epochs)."""
         await self.stop_pipeline(pipeline_id)
         ns = self.namespace
         name = self._name(pipeline_id)
-        status, _ = await self._api(
-            "DELETE", f"/api/v1/namespaces/{ns}/persistentvolumeclaims/"
-                      f"{name}-warehouse")
-        if status >= 400 and status != 404:
-            raise EtlError(ErrorKind.DESTINATION_FAILED,
-                           f"k8s DELETE pvc {name}-warehouse → {status}")
+        for path in (f"/apis/batch/v1/namespaces/{ns}/cronjobs/"
+                     f"{name}-maintenance",
+                     f"/api/v1/namespaces/{ns}/persistentvolumeclaims/"
+                     f"{name}-warehouse"):
+            status, _ = await self._api("DELETE", path)
+            if status >= 400 and status != 404:
+                raise EtlError(ErrorKind.DESTINATION_FAILED,
+                               f"k8s DELETE {path} → {status}")
 
     async def pod_status(self, pipeline_id: int) -> str:
         """Pod-level state (reference get_replicator_pod_status): derives

@@ -90,16 +90,26 @@ async def run_table_copy(n_rows: int = 100_000, samples: int = 3,
 async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
                               engine: str = "tpu",
                               destination: str = "null",
-                              max_fill_ms: int = 150) -> dict:
+                              max_fill_ms: int = 30,
+                              arrival_rate: int | None = None) -> dict:
     """CDC throughput + p50 end-to-end replication lag.
 
     destination='null' counts delivered rows without materializing
     per-row Python objects (reference etl-benchmarks null destination
     mode) — it still RESOLVES every decoded batch, so the device decode
     is on the measured path; 'memory' exercises full row expansion.
-    The default fill window (150 ms) lets sustained CDC accumulate
-    device-scale runs, engaging the batch engine the way a WAL burst
-    does in production.
+    The default fill window (30 ms, measured optimum in a 5-80 ms sweep)
+    keeps one flush in flight continuously: the XLA host-backend decode
+    executes on its own thread pool, so steady small flushes overlap
+    decode/resolve with WAL intake where a large window would alternate
+    idle-accumulate and burst-decode phases on this single-core host.
+
+    arrival_rate=None produces as fast as possible (drain-style: the
+    throughput number is the headline, lag measures queue depth under
+    saturation). arrival_rate=N paces production to N events/s in 10 ms
+    ticks — the lag percentiles then measure real end-to-end latency at
+    that offered load (the BASELINE.md "p50 end-to-end replication lag"
+    reading; see run_lag_vs_rate).
     """
     from ..config import BatchConfig, BatchEngine, PipelineConfig
     from ..destinations import MemoryDestination
@@ -141,12 +151,14 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
             return WriteAck.durable()
 
         async def write_events(self, events):
+            import numpy as np
+
             now = time.perf_counter()
             for e in events:
                 if isinstance(e, DecodedBatchEvent):
                     self.rows_delivered += e.batch.num_rows  # forces decode
-                    for lsn in set(int(x) for x in e.commit_lsns):
-                        arrivals.append((lsn, now))
+                    for lsn in np.unique(e.commit_lsns).tolist():
+                        arrivals.append((int(lsn), now))
                 elif isinstance(e, InsertEvent):
                     self.rows_delivered += 1
                     arrivals.append((int(e.commit_lsn), now))
@@ -184,24 +196,42 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
     await pipeline.start()
     await asyncio.wait_for(store.notify_on(TID, TableStateType.READY), 60)
 
-    # warmup: one transaction through the full path so the per-schema jit
-    # compile of the host-vectorized decode program (a one-time cost, like
-    # the decode bench's warmup) lands outside the measured window
-    warmup_rows = tx_size
-    tx = db.transaction()
-    for i in range(warmup_rows):
-        tx.insert(TID, [str(-1 - i), "0", "warmup"])
-    await tx.commit()
+    # warmup: drive transactions through the full path so the per-schema
+    # jit compiles of the host-vectorized decode program (a one-time cost,
+    # like the decode bench's warmup) land outside the measured window.
+    # The decode program is keyed by (row bucket, field-width signature),
+    # so the waves are sized to touch every ROW_BUCKET a measured flush
+    # can land in (1024 / 4096 / 16384) and encode the SAME value shapes
+    # as the measured payloads (a different field width would compile a
+    # different program and the warmup would warm nothing).
+    from ..postgres.codec.pgoutput import encode_insert as _enc
 
-    async def wait_warmup():
-        while dest.rows_delivered < warmup_rows:
+    def _payload(i: int) -> bytes:
+        return _enc(TID, [str(i).encode(), str(i % 97).encode(),
+                          b"note-%d" % i])
+
+    async def wait_delivered_at_least(n: int) -> None:
+        while dest.rows_delivered < n:
             if pipeline._apply_task is not None \
                     and pipeline._apply_task.done():
                 pipeline._apply_task.result()  # surface the pipeline error
                 raise RuntimeError("pipeline stopped during warmup")
             await asyncio.sleep(0.02)
 
-    await asyncio.wait_for(wait_warmup(), timeout=120)
+    # each wave is awaited to delivery before the next starts so waves
+    # can't coalesce into one run (which would warm only the largest
+    # bucket); sizes land in buckets 256 / 1024 / 4096 / 16384 — runs
+    # seal at RUN_SEAL_ROWS so no measured flush can stage beyond 16384
+    warmup_rows = 0
+    w = 0
+    for wave in (200, 800, 3000, 13000):
+        tx = db.transaction()
+        for _ in range(wave):
+            tx.insert_preencoded(TID, _payload(w))
+            w += 1
+        await tx.commit()
+        warmup_rows += wave
+        await asyncio.wait_for(wait_delivered_at_least(warmup_rows), 120)
     arrivals.clear()
     commit_times.clear()
     # baseline BEFORE production starts: measured rows deliver concurrently
@@ -219,13 +249,29 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
 
     t_prod0 = time.perf_counter()
     produced = 0
-    while produced < n_events:
-        tx = db.transaction()
-        for _ in range(min(tx_size, n_events - produced)):
-            tx.insert_preencoded(TID, payloads[produced])
-            produced += 1
-        lsn = await tx.commit()
-        commit_times[int(lsn)] = time.perf_counter()
+    if arrival_rate:
+        tick = 0.01
+        per_tick = max(1, int(arrival_rate * tick))
+        next_t = t_prod0
+        while produced < n_events:
+            tx = db.transaction()
+            for _ in range(min(per_tick, n_events - produced)):
+                tx.insert_preencoded(TID, payloads[produced])
+                produced += 1
+            lsn = await tx.commit()
+            commit_times[int(lsn)] = time.perf_counter()
+            next_t += tick
+            delay = next_t - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+    else:
+        while produced < n_events:
+            tx = db.transaction()
+            for _ in range(min(tx_size, n_events - produced)):
+                tx.insert_preencoded(TID, payloads[produced])
+                produced += 1
+            lsn = await tx.commit()
+            commit_times[int(lsn)] = time.perf_counter()
     t_prod1 = time.perf_counter()
 
     def delivered():
@@ -243,10 +289,11 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
     t_e2e = time.perf_counter()
     await pipeline.shutdown_and_wait()
     t_drain = time.perf_counter()
-    # NOTE: CDC flush runs are far below DeviceDecoder.DEVICE_MIN_ROWS, so
-    # this mode measures the host decode path for both engines (the hybrid
-    # threshold routes small runs to the CPU oracle by design); the device
-    # path is measured by the decode and wide_row modes.
+    # NOTE: runs seal at RUN_SEAL_ROWS (16384), below the device-routing
+    # threshold, so this mode measures the host-XLA decode path for both
+    # engines by design (the tunnel-attached chip's fixed round-trip
+    # loses at these sizes — see DeviceDecoder.DEVICE_MIN_ROWS); the
+    # device path is measured by the decode and wide_row modes.
     lags_ms = [(t - commit_times[lsn]) * 1000 for lsn, t in arrivals
                if lsn in commit_times]
     lags_ms.sort()
@@ -257,7 +304,7 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
 
     return {
         "mode": "table_streaming", "events": n_events, "engine": engine,
-        "destination": destination,
+        "destination": destination, "arrival_rate": arrival_rate,
         "producer_events_per_second":
             round(n_events / (t_prod1 - t_prod0)),
         "end_to_end_events_per_second":
@@ -270,6 +317,47 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
         "replication_lag_p95_ms":
             round(pct(0.95), 2) if lags_ms else None,
         "replication_lag_max_ms": round(lags_ms[-1], 2) if lags_ms else None,
+    }
+
+
+async def run_lag_vs_rate(engine: str = "tpu",
+                          fractions: tuple = (0.25, 0.5, 0.75),
+                          probe_events: int = 60_000,
+                          max_fill_ms: int = 5) -> dict:
+    """p50/p95 end-to-end replication lag at fixed offered loads.
+
+    The drain-style streaming bench saturates the pipeline, so its lag
+    percentiles measure queue depth, not latency. This mode first probes
+    the sustainable maximum, then replays at 25/50/75% of it with paced
+    production and reports real lag per rate (BASELINE.md names "p50
+    end-to-end replication lag" as a headline metric; reference gauges:
+    crates/etl/src/observability.rs:46-50). The fill window is 5 ms — a
+    lag-oriented batching config, reported in the output; the reference
+    default (10 s, pipeline.rs:52-68) optimizes throughput instead and
+    would floor every percentile at the batch deadline.
+    """
+    probe = await run_table_streaming(n_events=probe_events, engine=engine,
+                                      max_fill_ms=max_fill_ms)
+    max_rate = probe["end_to_end_events_per_second"]
+    rows = []
+    for f in fractions:
+        rate = max(1000, int(max_rate * f))
+        # ~3 s of paced traffic per rate, bounded for bench wall-clock
+        n = min(max(int(rate * 3), 3000), 240_000)
+        out = await run_table_streaming(n_events=n, engine=engine,
+                                        max_fill_ms=max_fill_ms,
+                                        arrival_rate=rate)
+        rows.append({
+            "fraction": f, "target_rate": rate, "events": n,
+            "p50_ms": out["replication_lag_p50_ms"],
+            "p95_ms": out["replication_lag_p95_ms"],
+            "max_ms": out["replication_lag_max_ms"],
+        })
+    return {
+        "mode": "lag_vs_rate", "engine": engine,
+        "max_events_per_second": max_rate,
+        "max_fill_ms": max_fill_ms,
+        "rates": rows,
     }
 
 
@@ -328,7 +416,11 @@ def run_wide_row(n_rows: int = 16_384, n_iters: int = 5,
             [TUPLE_NULL if v is None else TUPLE_TEXT for v in vals], vals))
 
     staged = stage_tuples(tuples, 100)
-    dec = DeviceDecoder(schema, use_pallas=(engine == "pallas"))
+    # this mode MEASURES THE DEVICE PATH by definition — pin the routing
+    # so the production DEVICE_MIN_ROWS (tuned for streaming flushes)
+    # can't silently reroute the benchmark to the host backend
+    dec = DeviceDecoder(schema, use_pallas=(engine == "pallas"),
+                        device_min_rows=1)
     dec.decode(staged)  # warmup
     times = []
     for _ in range(n_iters):

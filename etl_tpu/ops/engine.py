@@ -47,6 +47,15 @@ from ..postgres.codec.text import parse_cell_text
 from . import parsers
 from .staging import StagedBatch, bucket_pow2, bucket_width
 
+# NOTE on the persistent compilation cache: enabling
+# jax_compilation_cache_dir here was tried and REVERTED — the XLA:CPU
+# backend round-trips AOT results whose recorded machine features
+# (+prefer-no-scatter/+prefer-no-gather) don't match the execution host,
+# and reloading them hard-hangs the process inside the jitted call (GIL
+# held, faulthandler can't even fire). Decode programs instead bound
+# their compile count via the coarse row buckets (staging.ROW_BUCKETS)
+# and callers warm the buckets they stream through.
+
 # kinds parsed on device; everything else is host-object
 DEVICE_KINDS = frozenset({
     CellKind.BOOL, CellKind.I16, CellKind.I32, CellKind.U32, CellKind.I64,
@@ -240,9 +249,13 @@ class DeviceDecoder:
     (row_capacity, width-signature)."""
 
     # below this row count the device round trip (latency-bound) loses to
-    # the CPU oracle; small CDC flushes decode on host, WAL bursts and copy
-    # partitions go to the device
-    DEVICE_MIN_ROWS = 8192
+    # the host paths; small CDC flushes decode on host, WAL bursts and
+    # copy partitions go to the device. Measured on the tunnel-attached
+    # chip (fixed ~45-80 ms round trip): host-CPU XLA sustains 1.7-3.5M
+    # rows/s from 8k to 64k rows while the device manages 0.1-1.4M at
+    # those sizes — the crossover sits above 10^5 rows, so mid-size
+    # streaming flushes must stay on host
+    DEVICE_MIN_ROWS = 131_072
 
     # CDC flush runs (hundreds of rows between commit barriers) are far
     # below DEVICE_MIN_ROWS; at/above this row count they run the SAME

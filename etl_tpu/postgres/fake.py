@@ -183,7 +183,11 @@ class FakeDatabase:
         lsn = self._lsn
         for payload, tid, row in entries:
             lsn += 8
-            wal.append((Lsn(lsn), payload, tid, row))
+            # plain int, not Lsn: the hot consumers (drain_spans, the
+            # wire server loop) want ints anyway; Lsn construction per
+            # entry measurably drags high-rate producers. Readers that
+            # build frames wrap at the boundary (_next_buffered).
+            wal.append((lsn, payload, tid, row))
         self._lsn = lsn
         async with self._wal_cond:
             self._wal_cond.notify_all()
@@ -318,6 +322,22 @@ class FakeTransaction:
                 replica_identity=t.replica_identity), None, None))
             relation_sent.add(tid)
 
+        if all(op[0] == "P" and op[3] is None for op in self._ops):
+            # fast path for pre-encoded row bursts (bench producers):
+            # same WAL as the general loop below — relation messages per
+            # distinct target, then the payloads verbatim — without the
+            # per-op dispatch, which otherwise gates how fast a producer
+            # can feed the pipeline on a single core
+            targets = {tid: db.wal_relid(tid)
+                       for tid in {op[1] for op in self._ops}}
+            for target in targets.values():
+                if target not in relation_sent:
+                    emit_relation(target)
+            # the walsender knows every change's relation — carrying it on
+            # the WAL entry spares readers a payload re-parse
+            body_entries.extend((op[2], targets[op[1]], None)
+                                for op in self._ops)
+            self._ops = []
         for op in self._ops:
             kind = op[0]
             if kind in ("I", "U", "D", "P"):
@@ -328,7 +348,6 @@ class FakeTransaction:
                     emit_relation(target)
             if kind == "P":
                 _, tid, payload, values = op
-                target = db.wal_relid(tid)
                 body_entries.append(
                     (payload, target if values is not None else None, values))
                 if values is not None:
@@ -562,7 +581,7 @@ class _FakeReplicationStream(ReplicationStream):
             if not db.row_filter_allows(self.publication, tid, row):
                 continue
             return pgoutput.XLogData(
-                start_lsn=lsn, end_lsn=db.current_lsn,
+                start_lsn=Lsn(lsn), end_lsn=db.current_lsn,
                 clock_us=clock_us if clock_us is not None else _now_us(),
                 payload=payload)
         return None
@@ -580,6 +599,80 @@ class _FakeReplicationStream(ReplicationStream):
             if f is None:
                 break
             out.append(f)
+        return out
+
+    def drain_spans(self, max_n: int) -> list:
+        """Span-drain straight off the WAL: row runs become FrameSpans
+        with int LSNs and the payload bytes already in hand — no XLogData
+        / Lsn object per event (the walsender-side half of the CDC hot
+        path; wal entries carry (lsn, payload, relid, row) so neither the
+        relid nor the filters need a payload re-parse)."""
+        from .source import SPAN_MAX_ROWS, FrameSpan
+
+        out: list = []
+        if self._closed or self.slot.invalidated:
+            return out
+        db = self.db
+        if self._pub_tables is None:
+            self._pub_tables = set(db.publications.get(self.publication, []))
+        pub_tables = self._pub_tables
+        wal = db.wal
+        wal_len = len(wal)
+        end = int(db._lsn)
+        clock = None
+        span_relid = -1  # sentinel: no open span
+        span_payloads: list | None = None
+        span_lsns: list | None = None
+        span_room = 0
+        count = 0
+        idx = self._wal_index
+        pos = self.pos_lsn
+        pub = self.publication
+        filters = db.row_filters
+        # 73/85/68 = I/U/D — integer compare beats a bytes-slice + tuple
+        # membership test on this per-event loop
+        while idx < wal_len and count < max_n:
+            lsn, payload, tid, row = wal[idx]
+            idx += 1
+            # START_REPLICATION is INCLUSIVE of the requested LSN (see
+            # _next_buffered)
+            if lsn < pos:
+                continue
+            tag = payload[0]
+            if tag == 73 or tag == 85 or tag == 68:
+                # pre-encoded WAL entries (bench producers) don't carry a
+                # table_id column — fall back to the payload's relid
+                rid = tid if tid is not None \
+                    else int.from_bytes(payload[1:5], "big")
+                if rid not in pub_tables:
+                    continue
+                if filters and not db.row_filter_allows(pub, tid, row):
+                    continue
+                count += 1
+                if rid == span_relid and span_room > 0:
+                    span_payloads.append(payload)
+                    span_lsns.append(int(lsn))
+                    span_room -= 1
+                else:
+                    span_payloads = [payload]
+                    span_lsns = [int(lsn)]
+                    span_relid = rid
+                    span_room = SPAN_MAX_ROWS - 1
+                    out.append(FrameSpan(rid, span_payloads, span_lsns,
+                                         end))
+                continue
+            if not self._publication_allows(payload, pub_tables):
+                continue
+            if not db.row_filter_allows(pub, tid, row):
+                continue
+            count += 1
+            span_relid = -1
+            if clock is None:
+                clock = _now_us()
+            out.append(pgoutput.XLogData(
+                start_lsn=Lsn(lsn), end_lsn=db.current_lsn,
+                clock_us=clock, payload=payload))
+        self._wal_index = idx
         return out
 
     async def _frames(self):

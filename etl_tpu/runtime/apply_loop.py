@@ -40,7 +40,7 @@ from ..models.lsn import Lsn
 from ..models.schema import TableId
 from ..postgres.codec import event as event_codec
 from ..postgres.codec import pgoutput
-from ..postgres.source import ReplicationStream
+from ..postgres.source import FrameSpan, ReplicationStream
 from ..store.base import PipelineStore
 from ..destinations.base import Destination
 from ..telemetry.egress import record_egress
@@ -255,7 +255,7 @@ class ApplyLoop:
                             and self._in_flight.task.done()) or (
                             self.monitor is not None
                             and self.monitor.pressure)):
-                        frames = self.stream.drain_buffered(4096)
+                        frames = self.stream.drain_spans(4096)
                         if not frames:
                             break
                         intent = await self._handle_frames(frames)
@@ -286,69 +286,60 @@ class ApplyLoop:
 
     # -- frame handling ---------------------------------------------------------
 
-    async def _handle_frames(self, frames: list) -> ExitIntent | None:
-        """Bulk path for a drained frame window. Contiguous spans of row
-        messages for one table — the overwhelming majority of CDC traffic —
-        append into the assembler with per-SPAN bookkeeping (ownership
-        check, LSN watermarks, flush check) instead of per-frame Python;
-        control and keepalive frames take the per-frame slow path, which
-        doubles as the barrier bounding every span (so ownership and
-        current_commit_lsn are constants within one). This is what lifts
-        end-to-end CDC from the tens of µs/event the per-frame machinery
-        costs (reference loop: apply.rs:1280-1336 runs it in compiled Rust;
-        here the span batching amortizes it instead)."""
+    async def _handle_frames(self, items: list) -> ExitIntent | None:
+        """Bulk path for a drained window of FrameSpans + control frames
+        (stream.drain_spans). Spans — the overwhelming majority of CDC
+        traffic — append into the assembler with per-SPAN bookkeeping
+        (ownership check, LSN watermarks, flush check) instead of
+        per-frame Python; control and keepalive frames take the per-frame
+        slow path, which doubles as the barrier bounding every span (so
+        ownership and current_commit_lsn are constants within one). This
+        is what lifts end-to-end CDC from the tens of µs/event the
+        per-frame machinery costs (reference loop: apply.rs:1280-1336
+        runs it in compiled Rust; here the span batching amortizes it
+        instead)."""
         st = self.state
         tpu = self.config.batch.batch_engine is BatchEngine.TPU
-        xlog = pgoutput.XLogData
-        row_tags = (b"I", b"U", b"D")
-        i, n = 0, len(frames)
-        while i < n:
-            frame = frames[i]
-            if not (tpu and type(frame) is xlog
-                    and frame.payload[:1] in row_tags):
-                intent = await self._handle_frame(frame)
+        span_t = FrameSpan
+        for item in items:
+            if type(item) is not span_t:
+                intent = await self._handle_frame(item)
                 if intent is not None:
                     return intent
-                i += 1
                 continue
-            relid = int.from_bytes(frame.payload[1:5], "big")
-            j = i + 1
-            payloads = [frame.payload]
-            lsns = [int(frame.start_lsn)]
-            last = frame
-            # span cap: the batch-budget check runs per span, so an
-            # unbounded span could blow far past max_size_bytes inside one
-            # giant transaction (the split-at-budget e2e pins this)
-            cap = i + 512
-            while j < n and j < cap:
-                f = frames[j]
-                if type(f) is not xlog:
-                    break
-                p = f.payload
-                if p[:1] not in row_tags \
-                        or int.from_bytes(p[1:5], "big") != relid:
-                    break
-                payloads.append(p)
-                lsns.append(int(f.start_lsn))
-                last = f
-                j += 1
-            st.server_end_lsn = max(st.server_end_lsn, last.end_lsn)
-            st.received_lsn = max(st.received_lsn, last.start_lsn)
-            if await self._table_owned(relid):
-                schema = self.cache.get(relid)
-                if schema is None:
-                    raise EtlError(ErrorKind.SCHEMA_NOT_FOUND,
-                                   f"no RELATION seen for table {relid}")
+            lsns = item.start_lsns
+            st.server_end_lsn = max(st.server_end_lsn, item.end_lsn)
+            st.received_lsn = max(st.received_lsn, lsns[-1])
+            relid = item.relid
+            if not await self._table_owned(relid):
+                continue
+            schema = self.cache.get(relid)
+            if schema is None:
+                raise EtlError(ErrorKind.SCHEMA_NOT_FOUND,
+                               f"no RELATION seen for table {relid}")
+            payloads = item.payloads
+            if tpu:
                 nbytes = self.assembler.push_raw_rows(
                     payloads, schema, lsns, int(st.current_commit_lsn),
                     st.tx_ordinal)
                 st.tx_ordinal += len(payloads)
                 st.tx_bytes += nbytes
-                if self._batch_deadline is None:
-                    self._batch_deadline = time.monotonic() \
-                        + self.config.batch.max_fill_ms / 1000
-                self._maybe_dispatch_flush()
-            i = j
+            else:
+                # CPU engine: expand the span through the per-message
+                # oracle path (host-parsed events, reference per-tuple
+                # architecture)
+                commit_lsn = st.current_commit_lsn
+                for payload, lsn in zip(payloads, lsns):
+                    msg = pgoutput.decode_logical_message(payload)
+                    self.assembler.push_row_message(
+                        msg, payload, schema, Lsn(lsn), commit_lsn,
+                        st.tx_ordinal)
+                    st.tx_ordinal += 1
+                    st.tx_bytes += len(payload)
+            if self._batch_deadline is None:
+                self._batch_deadline = time.monotonic() \
+                    + self.config.batch.max_fill_ms / 1000
+            self._maybe_dispatch_flush()
         return None
 
     async def _handle_frame(self, frame) -> ExitIntent | None:
@@ -405,15 +396,27 @@ class ApplyLoop:
                     + self.config.batch.max_fill_ms / 1000
             return
         msg = pgoutput.decode_logical_message(payload)
+        tpu = self.config.batch.batch_engine is BatchEngine.TPU
         if isinstance(msg, pgoutput.BeginMessage):
             st.current_commit_lsn = msg.final_lsn
             st.tx_ordinal = 0
             st.tx_bytes = 0
             st.in_transaction = True
-            self.assembler.push_control(event_codec.decode_begin(msg, start_lsn))
+            # TPU engine: Begin/Commit are NOT run barriers — device
+            # batches span transactions (each row carries its own
+            # commit_lsn/tx_ordinal), so decode calls happen per FLUSH,
+            # not per transaction. Sealing here would cap CDC throughput
+            # at the per-transaction device-dispatch rate. Durability
+            # still advances only at commit boundaries via
+            # batch_commit_end (apply.rs:1932-1945 carries the commit LSN
+            # separately from the batch for the same reason).
+            if not tpu:
+                self.assembler.push_control(
+                    event_codec.decode_begin(msg, start_lsn))
         elif isinstance(msg, pgoutput.CommitMessage):
             ev = event_codec.decode_commit(msg, start_lsn)
-            self.assembler.push_control(ev)
+            if not tpu:
+                self.assembler.push_control(ev)
             st.in_transaction = False
             st.last_commit_end_lsn = ev.end_lsn
             st.batch_commit_end = ev.end_lsn

@@ -43,6 +43,16 @@ class _Run:
     tx_ordinals: list[int] = field(default_factory=list)
 
 
+#: seal an open run once it reaches this many rows. Two effects: decode
+#: dispatch starts while the stream keeps flowing (the device/host XLA
+#: call overlaps further WAL intake instead of bunching at flush), and
+#: staged batches never exceed the 16384-row bucket — so the decode
+#: program's (row-bucket, width-signature) key space stays small and a
+#: long-running pipeline stops hitting fresh ~0.3s XLA compiles when a
+#: backlog drains through ever-larger flushes.
+RUN_SEAL_ROWS = 16384
+
+
 class EventAssembler:
     def __init__(self, engine: BatchEngine):
         self.engine = engine
@@ -78,6 +88,8 @@ class EventAssembler:
         r.commit_lsns.append(int(commit_lsn))
         r.tx_ordinals.append(tx_ordinal)
         self.size_bytes += 64 + len(payload)
+        if len(r.payloads) >= RUN_SEAL_ROWS:
+            self._seal_run()
 
     def push_raw_rows(self, payloads: list[bytes],
                       schema: ReplicatedTableSchema, start_lsns: list[int],
@@ -92,12 +104,19 @@ class EventAssembler:
             self._run = _Run(table_id=schema.id, schema=schema)
         r = self._run
         k = len(payloads)
+        if len(r.payloads) + k > RUN_SEAL_ROWS and r.payloads:
+            # seal BEFORE extending: overshooting the cap would bump the
+            # staged batch into the next (unwarmed) row bucket
+            self._seal_run()
+            self._run = r = _Run(table_id=schema.id, schema=schema)
         r.payloads.extend(payloads)
         r.start_lsns.extend(start_lsns)
         r.commit_lsns.extend([commit_lsn] * k)
         r.tx_ordinals.extend(range(tx_ordinal0, tx_ordinal0 + k))
         nbytes = sum(map(len, payloads))
         self.size_bytes += 64 * k + nbytes
+        if len(r.payloads) >= RUN_SEAL_ROWS:
+            self._seal_run()
         return nbytes
 
     def push_row_message(self, msg: pgoutput.LogicalReplicationMessage,

@@ -240,15 +240,19 @@ class TestK8sOrchestrator:
                 {"secretRef": {"name": "etl-replicator-7-secrets"}}]
             await orch.stop_pipeline(7)
             deletes = [p for p in server.paths() if p.startswith("DELETE")]
-            # stop is a PAUSE: workload resources go, the warehouse PVC
-            # stays (sts, secret, configmap, cronjob)
-            assert len(deletes) == 4
+            # stop is a PAUSE: workload resources go (sts, secret,
+            # configmap); the warehouse PVC and the maintenance CronJob
+            # stay — deleting the CronJob from the pause gate's own
+            # /stop call would cascade-GC the running maintenance Job
+            assert len(deletes) == 3
             assert not any("persistentvolumeclaims" in p for p in deletes)
-            # permanent teardown drops the PVC too
+            assert not any("cronjobs" in p for p in deletes)
+            # permanent teardown drops the CronJob and PVC too
             await orch.delete_pipeline(7)
             deletes = [p for p in server.paths() if p.startswith("DELETE")]
             assert sum(1 for p in deletes
                        if "persistentvolumeclaims" in p) == 1
+            assert sum(1 for p in deletes if "cronjobs" in p) == 1
             await orch.shutdown()
         finally:
             await server.stop()
