@@ -1,16 +1,30 @@
-"""Iceberg destination: REST catalog + Parquet append writer.
+"""Iceberg destination: REST catalog + Parquet appends committed as REAL
+Iceberg v2 snapshots.
 
 Reference parity: crates/etl-destinations/src/iceberg/ ({catalog,client,
-core,schema}.rs, 5.6k LoC) — REST-catalog namespace/table management and
-Arrow→Parquet appends committed as table snapshots. Data files land in the
-warehouse directory (local path here; object-store URI in production);
-commits go through the standard Iceberg REST `/v1` API so any conformant
-catalog (fake server in tests) works.
+core,schema}.rs, 5.6k LoC). Each append:
+
+1. writes the Parquet data file into the warehouse;
+2. gathers data-file statistics from the Parquet footer (record counts,
+   per-column sizes/null counts, lower/upper bounds — iceberg_meta.py);
+3. writes an Avro manifest file + manifest list (hand-rolled Avro OCF
+   writer; no avro library in the environment);
+4. commits through the standard Iceberg REST protocol:
+   `POST /v1/namespaces/{ns}/tables/{t}` with an
+   assert-ref-snapshot-id requirement (optimistic CAS against the main
+   branch) and add-snapshot + set-snapshot-ref updates.
+
+Schema evolution rides add-schema + set-current-schema updates; truncate
+is a `delete`-operation snapshot whose manifest list is empty. The fake
+catalog used in tests (testing/fake_iceberg.py) parses the manifest
+chain with an INDEPENDENT Avro reader and rejects commits whose
+metadata doesn't hold together.
 """
 
 from __future__ import annotations
 
 import json
+import time
 import uuid
 from dataclasses import dataclass
 from pathlib import Path
@@ -21,18 +35,19 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from ..models.errors import ErrorKind, EtlError
-from ..models.event import (DeleteEvent, Event, InsertEvent,
-                            SchemaChangeEvent, TruncateEvent, UpdateEvent)
+from ..models.event import ChangeType, DeleteEvent, Event
 from ..models.pgtypes import CellKind
 from ..models.schema import ReplicatedTableSchema, TableId
 from ..models.table_row import ColumnarBatch
 from .base import Destination, WriteAck, expand_batch_events
+from .iceberg_meta import (DataFileInfo, build_snapshot, data_file_stats,
+                           new_snapshot_id, write_manifest,
+                           write_manifest_list)
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
                    DestinationRetryPolicy, change_type_label,
                    escaped_table_name, http_status_retryable,
                    require_full_row, sequential_event_program,
                    with_retries)
-from ..models.event import ChangeType
 
 _ICEBERG_TYPES: dict[CellKind, str] = {
     CellKind.BOOL: "boolean", CellKind.I16: "int", CellKind.I32: "int",
@@ -54,17 +69,40 @@ class IcebergConfig:
     auth_token: str = ""
 
 
+@dataclass
+class _TableState:
+    """Catalog-side state tracked per table between commits."""
+
+    name: str
+    snapshot_id: int | None = None  # main-branch head (CAS token)
+    sequence_number: int = 0
+    schema_id: int = 0
+    schema_count: int = 1  # schemas registered (add-schema ids are dense)
+    total_records: int = 0
+    schema: ReplicatedTableSchema | None = None
+    # column name → Iceberg field id. Ids are assigned once and NEVER
+    # reused or reassigned (spec: schema evolution must keep existing
+    # ids stable; manifests key statistics by id, so an ordinal
+    # reassignment would silently corrupt scan pruning on old files)
+    field_ids: dict[str, int] = None  # type: ignore[assignment]
+    last_column_id: int = 0  # high-water mark; fresh ids start past it
+    # the catalog's CURRENT schema fields (adopt path): lets a restarted
+    # destination decide whether a SchemaChangeEvent still needs an
+    # add-schema commit or the catalog already caught up
+    catalog_fields: list | None = None
+
+
 class IcebergDestination(Destination):
     def __init__(self, config: IcebergConfig,
                  retry: DestinationRetryPolicy | None = None):
         self.config = config
         self.retry = retry or DestinationRetryPolicy()
         self._session: aiohttp.ClientSession | None = None
-        self._created: dict[TableId, ReplicatedTableSchema] = {}
-        self._names: dict[TableId, str] = {}
+        self._tables: dict[TableId, _TableState] = {}
 
     async def _api(self, method: str, path: str,
-                   body: dict | None = None) -> dict:
+                   body: dict | None = None,
+                   conflict_ok: bool = False) -> dict:
         if self._session is None:
             self._session = aiohttp.ClientSession()
         headers = {"Authorization": f"Bearer {self.config.auth_token}"} \
@@ -75,7 +113,7 @@ class IcebergDestination(Destination):
                     method, f"{self.config.catalog_url}/v1{path}",
                     json=body, headers=headers) as resp:
                 text = await resp.text()
-                if resp.status == 409:  # already exists → idempotent ok
+                if resp.status == 409 and conflict_ok:
                     return {"alreadyExists": True}
                 if resp.status >= 400:
                     raise EtlError(
@@ -95,49 +133,177 @@ class IcebergDestination(Destination):
     async def startup(self) -> None:
         Path(self.config.warehouse_path).mkdir(parents=True, exist_ok=True)
         await self._api("POST", "/namespaces",
-                        {"namespace": [self.config.namespace]})
+                        {"namespace": [self.config.namespace]},
+                        conflict_ok=True)
 
-    def _iceberg_schema(self, schema: ReplicatedTableSchema) -> dict:
-        fields = [{"id": i + 1, "name": c.name, "required": not c.nullable,
+    # -- schema ---------------------------------------------------------------
+
+    @staticmethod
+    def _assign_field_ids(schema: ReplicatedTableSchema,
+                          prev: dict[str, int] | None = None,
+                          last: int = 0) -> tuple[dict[str, int], int]:
+        """Stable field-id assignment: columns present in `prev` keep
+        their ids; new columns get fresh ids past `last` (the table's
+        last-column-id). Ids are never reused — a dropped-then-re-added
+        column gets a NEW id, as the spec requires."""
+        ids: dict[str, int] = {}
+        names = [c.name for c in schema.replicated_columns]
+        names += [CHANGE_TYPE_COLUMN, CHANGE_SEQUENCE_COLUMN]
+        for name in names:
+            if prev and name in prev:
+                ids[name] = prev[name]
+            else:
+                last += 1
+                ids[name] = last
+        return ids, last
+
+    def _iceberg_schema(self, schema: ReplicatedTableSchema,
+                        field_ids: dict[str, int],
+                        schema_id: int = 0) -> dict:
+        fields = [{"id": field_ids[c.name], "name": c.name,
+                   "required": not c.nullable,
                    "type": _ICEBERG_TYPES.get(c.kind, "string")}
-                  for i, c in enumerate(schema.replicated_columns)]
-        n = len(fields)
-        fields.append({"id": n + 1, "name": CHANGE_TYPE_COLUMN,
+                  for c in schema.replicated_columns]
+        fields.append({"id": field_ids[CHANGE_TYPE_COLUMN],
+                       "name": CHANGE_TYPE_COLUMN,
                        "required": False, "type": "string"})
-        fields.append({"id": n + 2, "name": CHANGE_SEQUENCE_COLUMN,
+        fields.append({"id": field_ids[CHANGE_SEQUENCE_COLUMN],
+                       "name": CHANGE_SEQUENCE_COLUMN,
                        "required": False, "type": "string"})
-        return {"type": "struct", "fields": fields}
+        identifiers = [field_ids[c.name] for c in
+                       schema.replicated_columns
+                       if c.primary_key_ordinal is not None]
+        return {"type": "struct", "schema-id": schema_id,
+                "identifier-field-ids": identifiers, "fields": fields}
 
-    async def _ensure_table(self, schema: ReplicatedTableSchema) -> str:
-        name = self._names.setdefault(schema.id,
-                                      escaped_table_name(schema.name))
-        if self._created.get(schema.id) == schema:
-            return name
-        await self._api(
+    def _field_meta(self, st: _TableState
+                    ) -> tuple[dict[str, int], dict[int, str]]:
+        """(column name → field id, field id → iceberg type), derived
+        from the SAME schema document the catalog sees — one source of
+        truth for the id assignment."""
+        assert st.schema is not None
+        doc = self._iceberg_schema(st.schema, st.field_ids, st.schema_id)
+        ids = {f["name"]: f["id"] for f in doc["fields"]}
+        types = {f["id"]: f["type"] for f in doc["fields"]}
+        return ids, types
+
+    async def _ensure_table(self, schema: ReplicatedTableSchema
+                            ) -> _TableState:
+        st = self._tables.get(schema.id)
+        if st is not None and st.schema == schema:
+            return st
+        name = escaped_table_name(schema.name)
+        field_ids, last_id = self._assign_field_ids(schema)
+        schema_doc = self._iceberg_schema(schema, field_ids)
+        doc = await self._api(
             "POST", f"/namespaces/{self.config.namespace}/tables",
-            {"name": name, "schema": self._iceberg_schema(schema)})
-        self._created[schema.id] = schema
-        return name
+            {"name": name, "schema": schema_doc,
+             "partition-spec": {"spec-id": 0, "fields": []},
+             "properties": {"format-version": "2"}},
+            conflict_ok=True)
+        st = _TableState(name=name, schema=schema, field_ids=field_ids,
+                         last_column_id=last_id,
+                         catalog_fields=schema_doc["fields"])
+        if doc.get("alreadyExists"):
+            # adopt the catalog's current state (restart recovery / CAS)
+            loaded = await self._api(
+                "GET",
+                f"/namespaces/{self.config.namespace}/tables/{name}")
+            meta = loaded.get("metadata", {})
+            st.snapshot_id = meta.get("current-snapshot-id")
+            st.sequence_number = meta.get("last-sequence-number", 0)
+            st.schema_id = meta.get("current-schema-id", 0)
+            st.schema_count = max(1, len(meta.get("schemas", [])))
+            st.catalog_fields = None  # unknown until found below
+            adopted: dict[str, int] = {}
+            all_ids = [0]
+            for s in meta.get("schemas", []):
+                all_ids += [f["id"] for f in s.get("fields", [])]
+                if s.get("schema-id") == st.schema_id:
+                    st.catalog_fields = s.get("fields")
+                    adopted = {f["name"]: f["id"] for f in s["fields"]}
+            # keep the catalog's ids; columns the target schema adds on
+            # top get fresh ids past EVERY id any schema ever used
+            st.field_ids, st.last_column_id = self._assign_field_ids(
+                schema, adopted or None, max(all_ids))
+            for snap in meta.get("snapshots", []):
+                if snap.get("snapshot-id") == st.snapshot_id:
+                    st.total_records = int(
+                        snap.get("summary", {}).get("total-records", 0))
+        self._tables[schema.id] = st
+        return st
 
-    def _write_data_file(self, name: str, rb: pa.RecordBatch) -> str:
-        d = Path(self.config.warehouse_path) / self.config.namespace / name
+    # -- data + snapshot commit ------------------------------------------------
+
+    def _table_dir(self, name: str) -> Path:
+        return Path(self.config.warehouse_path) / self.config.namespace \
+            / name
+
+    def _write_data_file(self, st: _TableState,
+                         rb: pa.RecordBatch) -> DataFileInfo:
+        d = self._table_dir(st.name) / "data"
         d.mkdir(parents=True, exist_ok=True)
         path = d / f"{uuid.uuid4().hex}.parquet"
+        field_ids, field_types = self._field_meta(st)
+        # stamp Iceberg field ids into the Parquet schema
+        # (PARQUET:field_id metadata → parquet field_id on write): the
+        # spec requires data-file columns to resolve by ID, not name —
+        # without this a conformant engine cannot project any column
+        fields = [pa.field(f.name, f.type, f.nullable,
+                           metadata={b"PARQUET:field_id":
+                                     str(field_ids[f.name]).encode()})
+                  for f in rb.schema]
+        rb = pa.RecordBatch.from_arrays(list(rb.columns),
+                                        schema=pa.schema(fields))
         pq.write_table(pa.Table.from_batches([rb]), path)
-        return str(path)
+        return data_file_stats(path, field_ids, field_types)
 
-    async def _commit_append(self, name: str, file_path: str,
-                             rows: int) -> None:
+    async def _commit_snapshot(self, st: _TableState,
+                               files: list[DataFileInfo],
+                               operation: str = "append") -> None:
+        # all state transitions are staged LOCALLY and applied only after
+        # the catalog accepts the commit — a failed commit (CAS 409,
+        # exhausted retries) must leave the table's sequence number and
+        # row totals untouched or every later commit would be rejected
+        snapshot_id = new_snapshot_id()
+        sequence_number = st.sequence_number + 1
+        meta_dir = self._table_dir(st.name) / "metadata"
+        manifests = []
+        if files:
+            manifests.append(write_manifest(
+                meta_dir, files, snapshot_id, sequence_number,
+                json.dumps(self._iceberg_schema(st.schema, st.field_ids,
+                                                st.schema_id))))
+        manifest_list = write_manifest_list(
+            meta_dir, manifests, snapshot_id, sequence_number)
+        added = sum(f.record_count for f in files)
+        new_total = added if operation == "delete" \
+            else st.total_records + added
+        snapshot = build_snapshot(
+            snapshot_id, st.snapshot_id, sequence_number, manifest_list,
+            operation, len(files), added, new_total,
+            int(time.time() * 1000), st.schema_id)
+        body = {
+            "requirements": [{
+                "type": "assert-ref-snapshot-id", "ref": "main",
+                "snapshot-id": st.snapshot_id,
+            }],
+            "updates": [
+                {"action": "add-snapshot", "snapshot": snapshot},
+                {"action": "set-snapshot-ref", "ref-name": "main",
+                 "type": "branch", "snapshot-id": snapshot_id},
+            ],
+        }
         await self._api(
             "POST",
-            f"/namespaces/{self.config.namespace}/tables/{name}/commit",
-            {"updates": [{"action": "append", "data-files": [
-                {"file-path": file_path, "record-count": rows,
-                 "file-format": "PARQUET"}]}]})
+            f"/namespaces/{self.config.namespace}/tables/{st.name}", body)
+        st.snapshot_id = snapshot_id
+        st.sequence_number = sequence_number
+        st.total_records = new_total
 
     async def write_table_rows(self, schema: ReplicatedTableSchema,
                                batch: ColumnarBatch) -> WriteAck:
-        name = await self._ensure_table(schema)
+        st = await self._ensure_table(schema)
         if batch.num_rows:
             rb = batch.to_arrow()
             n = batch.num_rows
@@ -146,8 +312,8 @@ class IcebergDestination(Destination):
             rb = rb.append_column(CHANGE_SEQUENCE_COLUMN,
                                   pa.array([f"{i:016x}" for i in range(n)],
                                            pa.string()))
-            path = self._write_data_file(name, rb)
-            await self._commit_append(name, path, n)
+            f = self._write_data_file(st, rb)
+            await self._commit_snapshot(st, [f])
         return WriteAck.durable()
 
     async def write_events(self, events: Sequence[Event]) -> WriteAck:
@@ -157,6 +323,10 @@ class IcebergDestination(Destination):
                 await self._write_cdc_run(schema, evs)
             elif op[0] == "truncate":
                 for sch in op[1].schemas:
+                    # ensure first: after a restart the table may not be
+                    # in the in-memory map, and silently skipping a
+                    # truncate the source applied would leave stale data
+                    await self._ensure_table(sch)
                     await self.truncate_table(sch.id)
             else:
                 await self._apply_schema_change(op[1])
@@ -164,7 +334,7 @@ class IcebergDestination(Destination):
 
     async def _write_cdc_run(self, schema: ReplicatedTableSchema,
                              evs: list) -> None:
-        name = await self._ensure_table(schema)
+        st = await self._ensure_table(schema)
         rows, types, seqs = [], [], []
         for i, e in enumerate(evs):
             if isinstance(e, DeleteEvent):
@@ -179,42 +349,75 @@ class IcebergDestination(Destination):
         rb = rb.append_column(CHANGE_TYPE_COLUMN, pa.array(types, pa.string()))
         rb = rb.append_column(CHANGE_SEQUENCE_COLUMN,
                               pa.array(seqs, pa.string()))
-        path = self._write_data_file(name, rb)
-        await self._commit_append(name, path, len(rows))
+        f = self._write_data_file(st, rb)
+        await self._commit_snapshot(st, [f])
 
     async def _apply_schema_change(self, ev) -> None:
-        """Register the new schema with the catalog via an update commit —
-        table re-create 409s would silently diverge registered schema from
-        data files."""
+        """Schema evolution: add-schema + set-current-schema updates on
+        the SAME commit path (a table re-create 409 would silently
+        diverge the registered schema from the data files)."""
         new = ev.new_schema
         assert new is not None
-        name = self._names.setdefault(new.id, escaped_table_name(new.name))
+        st = self._tables.get(new.id)
+        if st is not None and st.schema == new:
+            # in-process redelivery (apply-worker timed retry): the
+            # add-schema already committed — registering it again would
+            # append a duplicate schema on every retry
+            return
+        if st is None:
+            # restart recovery: adopt the catalog's state first, then
+            # decide by comparing FIELDS whether the catalog's current
+            # schema already matches the evolved one (st.schema alone
+            # can't tell — _ensure_table stores the target schema)
+            st = await self._ensure_table(new)
+            desired = self._iceberg_schema(new, st.field_ids,
+                                           st.schema_id)["fields"]
+            if st.catalog_fields == desired:
+                return
+        # existing columns keep their ids; additions get fresh ones
+        ids, last = self._assign_field_ids(new, st.field_ids,
+                                           st.last_column_id)
+        new_schema_id = st.schema_count
+        body = {
+            "requirements": [{
+                "type": "assert-ref-snapshot-id", "ref": "main",
+                "snapshot-id": st.snapshot_id,
+            }],
+            "updates": [
+                {"action": "add-schema",
+                 "schema": self._iceberg_schema(new, ids, new_schema_id)},
+                {"action": "set-current-schema",
+                 "schema-id": new_schema_id},
+            ],
+        }
         await self._api(
             "POST",
-            f"/namespaces/{self.config.namespace}/tables/{name}/commit",
-            {"updates": [{"action": "set-schema",
-                          "schema": self._iceberg_schema(new)}]})
-        self._created[new.id] = new
+            f"/namespaces/{self.config.namespace}/tables/{st.name}", body)
+        st.schema = new
+        st.field_ids, st.last_column_id = ids, last
+        st.schema_id = new_schema_id
+        st.schema_count += 1
+        st.catalog_fields = None
 
     async def drop_table(self, table_id: TableId,
                          schema: ReplicatedTableSchema | None = None) -> None:
-        if table_id not in self._names and schema is not None:
+        if table_id not in self._tables and schema is not None:
             # restart recovery: rebuild the name mapping from the hint
-            self._names.setdefault(table_id, escaped_table_name(schema.name))
-        name = self._names.get(table_id)
-        if name is not None:
+            self._tables[table_id] = _TableState(
+                name=escaped_table_name(schema.name))
+        st = self._tables.get(table_id)
+        if st is not None:
             await self._api(
                 "DELETE",
-                f"/namespaces/{self.config.namespace}/tables/{name}")
-            self._created.pop(table_id, None)
+                f"/namespaces/{self.config.namespace}/tables/{st.name}")
+            self._tables.pop(table_id, None)
 
     async def truncate_table(self, table_id: TableId) -> None:
-        name = self._names.get(table_id)
-        if name is not None:
-            await self._api(
-                "POST",
-                f"/namespaces/{self.config.namespace}/tables/{name}/commit",
-                {"updates": [{"action": "truncate"}]})
+        st = self._tables.get(table_id)
+        if st is not None:
+            # a delete-operation snapshot with an EMPTY manifest list:
+            # readers of the new snapshot see zero data files
+            await self._commit_snapshot(st, [], operation="delete")
 
     async def shutdown(self) -> None:
         if self._session is not None:

@@ -210,6 +210,15 @@ class FakeSnowpipeServer:
         if not end:
             return web.json_response({"message": "missing offset range"},
                                      status=400)
+        # offset tokens must advance strictly: a client replaying or
+        # reordering batches within a channel would corrupt exactly-once
+        # accounting (tokens are zero-padded sequence keys, so string
+        # order == numeric order)
+        last = ch.pending[-1][0] if ch.pending else ch.committed
+        if last is not None and end <= last:
+            return web.json_response(
+                {"message": f"offset token {end!r} does not advance "
+                            f"past {last!r}"}, status=400)
         pipe_key = key.rsplit("/", 1)[0]
         self.rows.setdefault(pipe_key, []).extend(docs)
         ch.rows_parsed += len(docs)

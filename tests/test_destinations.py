@@ -277,6 +277,127 @@ class TestBigQuery:
             await server.stop()
 
 
+class TestIndependentWireVerifiers:
+    """Each wire client decoded by a reader that shares NO code with its
+    encoder (VERDICT r3 #5): AppendRows bytes through testing/pb_reader
+    (spec-written protobuf reader), lake parquet through a raw pyarrow
+    re-read, Snowpipe bodies re-decoded from the recorded zstd NDJSON."""
+
+    async def test_bq_append_rows_cross_decode(self):
+        from etl_tpu.testing import pb_reader
+
+        server, fake = await bq_server()
+        try:
+            d = BigQueryDestination(
+                BigQueryConfig(project_id="p", dataset_id="ds",
+                               base_url=server.url()), RETRY_FAST)
+            await d.startup()
+            ack = await d.write_events([
+                ins(0, [2, "b", PgNumeric("7")], lsn=0x900),
+                DeleteEvent(Lsn(0x901), Lsn(0x901), 1, make_schema(),
+                            TableRow([1, None, None])),
+            ])
+            await ack.wait_durable()
+            raw = [r.body for r in server.requests
+                   if r.path.endswith(":appendRows")]
+            assert len(raw) == 1
+            req = pb_reader.decode_append_rows(raw[0])
+            # request frame
+            assert req["write_stream"].endswith("/streams/_default")
+            assert req["trace_id"]
+            # descriptor: field numbers are ordinals+1, CDC columns after
+            by_name = {f["name"]: f for f in req["descriptor"]["fields"]}
+            assert by_name["id"]["number"] == 1
+            assert by_name["_CHANGE_TYPE"]["number"] == 4
+            # rows decoded purely from the wire + carried descriptor
+            assert req["rows"][0]["id"] == 2
+            assert req["rows"][0]["note"] == "b"
+            assert req["rows"][0]["amount"] == "7"
+            assert req["rows"][0]["_CHANGE_TYPE"] == "UPSERT"
+            assert req["rows"][1]["id"] == 1
+            assert "note" not in req["rows"][1]
+            assert req["rows"][1]["_CHANGE_TYPE"] == "DELETE"
+            # and it agrees with the in-repo decoder, field for field
+            assert req["rows"] == fake.appends[0][2]
+            await d.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_lake_parquet_raw_reread_cdc_collapse(self, tmp_path):
+        """Read the lake's parquet files straight off disk with pyarrow
+        (no LakeDestination read path) and re-apply the CDC collapse."""
+        import pyarrow.parquet as pq
+
+        d = LakeDestination(LakeConfig(str(tmp_path)))
+        await d.startup()
+        await d.write_table_rows(make_schema(),
+                                 batch([[1, "a", None], [2, "b", None]]))
+        await d.write_events([
+            ins(0, [3, "c", None], lsn=0x200),
+            UpdateEvent(Lsn(0x201), Lsn(0x201), 1, make_schema(),
+                        TableRow([1, "a2", None])),
+            DeleteEvent(Lsn(0x202), Lsn(0x202), 2, make_schema(),
+                        TableRow([2, None, None])),
+        ])
+        await d.shutdown()
+        rows = []
+        for p in sorted(tmp_path.rglob("*.parquet")):
+            rows.extend(pq.read_table(p).to_pylist())
+        state = {}
+        for r in sorted(rows,
+                        key=lambda r: r.get("_CHANGE_SEQUENCE_NUMBER")
+                        or ""):
+            if r.get("_CHANGE_TYPE") == "DELETE":
+                state.pop(r["id"], None)
+            else:
+                state[r["id"]] = r["note"]
+        assert state == {1: "a2", 3: "c"}, state
+
+    async def test_snowpipe_rejects_nonadvancing_offset_tokens(self):
+        """The emulator re-decodes each zstd NDJSON body independently
+        and now enforces strictly-advancing offset tokens per channel."""
+        import zstandard
+        import aiohttp
+
+        server = FakeSnowpipeServer()
+        await server.start()
+        try:
+
+            async def open_channel(s):
+                async with s.put(
+                        f"{server.url()}/v2/streaming/databases/d/schemas"
+                        "/PUBLIC/pipes/P/channels/ch") as r:
+                    return (await r.json())["next_continuation_token"]
+
+            def body(rows):
+                nd = "\n".join(json.dumps(r) for r in rows).encode()
+                return zstandard.ZstdCompressor().compress(nd)
+
+            headers = {"Content-Encoding": "zstd",
+                       "Content-Type": "application/x-ndjson"}
+            async with aiohttp.ClientSession() as s:
+                ct = await open_channel(s)
+                url = (f"{server.url()}/v2/streaming/data/databases/d/"
+                       "schemas/PUBLIC/pipes/P/channels/ch/rows")
+                async with s.post(
+                        url, params={"continuationToken": ct,
+                                     "offsetToken": "001",
+                                     "endOffsetToken": "005"},
+                        data=body([{"id": 1}]), headers=headers) as r:
+                    assert r.status == 200
+                    ct = (await r.json())["next_continuation_token"]
+                # REGRESSING token: must be rejected
+                async with s.post(
+                        url, params={"continuationToken": ct,
+                                     "offsetToken": "002",
+                                     "endOffsetToken": "003"},
+                        data=body([{"id": 2}]), headers=headers) as r:
+                    assert r.status == 400
+                    assert "advance" in (await r.json())["message"]
+        finally:
+            await server.stop()
+
+
 class TestBigQueryStorageWrite:
     """Fault injection against the Storage Write proto wire format —
     reference retry/propagation semantics (bigquery/client.rs:317-450,
@@ -491,33 +612,395 @@ class TestBigQueryStorageWrite:
 
 
 class TestIceberg:
-    async def test_append_flow(self, tmp_path):
-        server = RecordingHttpServer()
-        await server.start()
+    """Against the protocol-enforcing fake REST catalog
+    (testing/fake_iceberg.py): commits must carry a parseable Avro
+    manifest chain, correct statistics, CAS requirements, and
+    spec-shaped schema evolution — the catalog rejects anything less
+    (reference: iceberg/{catalog,client,core}.rs)."""
+
+    async def start(self, tmp_path):
+        from etl_tpu.testing.fake_iceberg import FakeIcebergCatalog
+
+        cat = FakeIcebergCatalog()
+        await cat.start()
+        d = IcebergDestination(IcebergConfig(
+            catalog_url=cat.url(), warehouse_path=str(tmp_path)),
+            RETRY_FAST)
+        await d.startup()
+        return cat, d
+
+    async def test_snapshot_chain_and_manifest_stats(self, tmp_path):
+        cat, d = await self.start(tmp_path)
         try:
-            d = IcebergDestination(IcebergConfig(
-                catalog_url=server.url(), warehouse_path=str(tmp_path)),
-                RETRY_FAST)
-            await d.startup()
             await d.write_table_rows(make_schema(),
                                      batch([[1, "a", None], [2, "b", None]]))
             await d.write_events([ins(0, [3, "c", None], lsn=0x600)])
-            paths = server.paths()
-            assert "POST /v1/namespaces" in paths[0]
-            assert any("/tables" in p for p in paths)
-            commits = [r for r in server.requests
-                       if r.path.endswith("/commit")]
-            assert len(commits) == 2
-            df = commits[0].json["updates"][0]["data-files"][0]
-            assert df["record-count"] == 2
-            # data file actually exists and is readable parquet
-            import pyarrow.parquet as pq
+            t = cat.table("etl", "public_user__events")
+            assert len(t.snapshots) == 2
+            s1, s2 = t.snapshots
+            # chain: second snapshot parents the first; ref follows head
+            assert s2["parent-snapshot-id"] == s1["snapshot-id"]
+            assert t.refs["main"] == s2["snapshot-id"]
+            assert s1["sequence-number"] == 1
+            assert s2["sequence-number"] == 2
+            assert s1["summary"]["operation"] == "append"
+            assert s1["summary"]["added-records"] == "2"
+            assert s2["summary"]["total-records"] == "3"
+            assert not cat.rejections
+            # manifest chain: parse with the independent reader and
+            # check the statistics the destination recorded
+            from etl_tpu.testing.avro_reader import read_avro_ocf
 
-            t = pq.read_table(df["file-path"])
-            assert t.num_rows == 2
+            _, manifests, _ = read_avro_ocf(s1["manifest-list"])
+            assert len(manifests) == 1
+            _, entries, mmeta = read_avro_ocf(manifests[0]["manifest_path"])
+            assert mmeta["format-version"] == "2"
+            df = entries[0]["data_file"]
+            assert df["record_count"] == 2
+            assert df["content"] == 0
+            # per-column stats present for every field (3 cols + 2 CDC)
+            assert len(df["column_sizes"]) == 5
+            assert len(df["value_counts"]) == 5
+            # id column (field 1): bounds are little-endian longs 1..2
+            lows = {e["key"]: e["value"] for e in df["lower_bounds"]}
+            highs = {e["key"]: e["value"] for e in df["upper_bounds"]}
+            import struct
+
+            # id is INT4 -> iceberg "int": bounds are 4-byte LE per the
+            # single-value serialization spec (Appendix D)
+            assert struct.unpack("<i", lows[1])[0] == 1
+            assert struct.unpack("<i", highs[1])[0] == 2
             await d.shutdown()
         finally:
-            await server.stop()
+            await cat.stop()
+
+    async def test_cdc_collapse_via_pyarrow_reread(self, tmp_path):
+        """Independent verification: read back every data file the
+        snapshots reference with pyarrow, apply the CDC collapse by
+        (change_type, change_sequence), and check the final table
+        state — no destination code in the read path."""
+        import pyarrow.parquet as pq
+
+        from etl_tpu.testing.avro_reader import read_avro_ocf
+
+        cat, d = await self.start(tmp_path)
+        try:
+            await d.write_table_rows(make_schema(),
+                                     batch([[1, "a", None], [2, "b", None]]))
+            await d.write_events([
+                ins(0, [3, "c", None], lsn=0x600),
+                UpdateEvent(Lsn(0x601), Lsn(0x601), 1, make_schema(),
+                            TableRow([1, "a2", None])),
+                DeleteEvent(Lsn(0x602), Lsn(0x602), 2, make_schema(),
+                            TableRow([2, None, None])),
+            ])
+            t = cat.table("etl", "public_user__events")
+            rows = []
+            for snap in t.snapshots:
+                _, manifests, _ = read_avro_ocf(snap["manifest-list"])
+                for m in manifests:
+                    _, entries, _ = read_avro_ocf(m["manifest_path"])
+                    for e in entries:
+                        tbl = pq.read_table(e["data_file"]["file_path"])
+                        rows.extend(tbl.to_pylist())
+            # CDC collapse: last change per id wins, DELETE removes
+            state = {}
+            for r in sorted(rows, key=lambda r: r["_CHANGE_SEQUENCE_NUMBER"]):
+                if r["_CHANGE_TYPE"] == "DELETE":
+                    state.pop(r["id"], None)
+                else:
+                    state[r["id"]] = r["note"]
+            assert state == {1: "a2", 3: "c"}, state
+            await d.shutdown()
+        finally:
+            await cat.stop()
+
+    async def test_schema_evolution_commits_new_schema(self, tmp_path):
+        from etl_tpu.models.event import SchemaChangeEvent
+
+        cat, d = await self.start(tmp_path)
+        try:
+            await d.write_events([ins(0, [1, "a", None])])
+            wider = ReplicatedTableSchema.with_all_columns(TableSchema(
+                TID, TableName("public", "user_events"),
+                (ColumnSchema("id", Oid.INT4, nullable=False,
+                              primary_key_ordinal=1),
+                 ColumnSchema("note", Oid.TEXT),
+                 ColumnSchema("amount", Oid.NUMERIC),
+                 ColumnSchema("added", Oid.TEXT))))
+            await d.write_events([SchemaChangeEvent(
+                Lsn(0x700), Lsn(0x700), TID, wider)])
+            t = cat.table("etl", "public_user__events")
+            assert len(t.schemas) == 2
+            assert t.current_schema_id == 1
+            names = [f["name"] for f in t.schemas[1]["fields"]]
+            assert "added" in names
+            # identifier-field-ids carry the PK through evolution
+            assert t.schemas[1]["identifier-field-ids"] == [1]
+            # field ids are STABLE across evolution: existing columns
+            # (and the CDC columns) keep their ids, the new column gets
+            # a fresh id past every id ever assigned (spec: ids are
+            # never reused; manifests key statistics by id)
+            ids0 = {f["name"]: f["id"] for f in t.schemas[0]["fields"]}
+            ids1 = {f["name"]: f["id"] for f in t.schemas[1]["fields"]}
+            for name, fid in ids0.items():
+                assert ids1[name] == fid, (name, fid, ids1[name])
+            assert ids1["added"] == max(ids0.values()) + 1
+            # data files written AFTER evolution carry the new column's
+            # fresh field id in the parquet schema
+            await d.write_events([InsertEvent(
+                Lsn(0x780), Lsn(0x780), TID, wider,
+                TableRow([2, "b", None, "x"]))])
+            import pyarrow.parquet as pq
+
+            from etl_tpu.testing.avro_reader import read_avro_ocf
+
+            _, manifests, _ = read_avro_ocf(
+                t.snapshots[-1]["manifest-list"])
+            _, entries, _ = read_avro_ocf(manifests[0]["manifest_path"])
+            arrow = pq.read_schema(entries[0]["data_file"]["file_path"])
+            got = {f.name: int((f.metadata or {})[b"PARQUET:field_id"])
+                   for f in arrow}
+            assert got == ids1, (got, ids1)
+            # in-process REDELIVERY of the same schema change (apply
+            # worker timed retry) must not register a duplicate schema
+            await d.write_events([SchemaChangeEvent(
+                Lsn(0x700), Lsn(0x700), TID, wider)])
+            assert len(t.schemas) == 2
+            assert not cat.rejections
+            await d.shutdown()
+        finally:
+            await cat.stop()
+
+    async def test_catalog_rejects_field_id_reuse(self, tmp_path):
+        """The fake enforces the id rules the destination must obey:
+        reassigning an existing column's id or recycling a used id for
+        a new column is rejected, and a rejected multi-update commit
+        leaves NO staged schema behind (transactional application)."""
+        import aiohttp
+
+        cat, d = await self.start(tmp_path)
+        try:
+            await d.write_events([ins(0, [1, "a", None])])
+            t = cat.table("etl", "public_user__events")
+            head = t.refs["main"]
+            base = [dict(f) for f in t.schemas[0]["fields"]]
+            url = f"{cat.url()}/v1/namespaces/etl/tables/" \
+                  "public_user__events"
+            async with aiohttp.ClientSession() as s:
+                # existing column id reassigned (ordinal-style shuffle)
+                bad = [dict(f) for f in base]
+                bad[1]["id"], bad[2]["id"] = bad[2]["id"], bad[1]["id"]
+                async with s.post(url, json={
+                    "requirements": [{"type": "assert-ref-snapshot-id",
+                                      "ref": "main",
+                                      "snapshot-id": head}],
+                    "updates": [
+                        {"action": "add-schema", "schema": {
+                            "type": "struct", "schema-id": 1,
+                            "fields": bad}},
+                        {"action": "set-current-schema",
+                         "schema-id": 1}],
+                }) as resp:
+                    assert resp.status == 400
+                # new column recycling an existing id
+                bad2 = base + [{"id": base[0]["id"], "name": "fresh",
+                                "required": False, "type": "string"}]
+                async with s.post(url, json={
+                    "requirements": [{"type": "assert-ref-snapshot-id",
+                                      "ref": "main",
+                                      "snapshot-id": head}],
+                    "updates": [
+                        {"action": "add-schema", "schema": {
+                            "type": "struct", "schema-id": 1,
+                            "fields": bad2}},
+                        {"action": "set-current-schema",
+                         "schema-id": 1}],
+                }) as resp:
+                    assert resp.status == 400
+                # atomicity: a VALID add-schema followed by a rejected
+                # update must not leave the schema registered
+                good = base + [{"id": max(f["id"] for f in base) + 1,
+                                "name": "fresh", "required": False,
+                                "type": "string"}]
+                async with s.post(url, json={
+                    "requirements": [{"type": "assert-ref-snapshot-id",
+                                      "ref": "main",
+                                      "snapshot-id": head}],
+                    "updates": [
+                        {"action": "add-schema", "schema": {
+                            "type": "struct", "schema-id": 1,
+                            "fields": good}},
+                        {"action": "set-current-schema",
+                         "schema-id": 99}],
+                }) as resp:
+                    assert resp.status == 400
+            assert len(t.schemas) == 1, \
+                "rejected commit must stage nothing"
+            assert t.current_schema_id == 0
+            # and the identical commit retried with the VALID tail is
+            # accepted — the fake didn't wedge on its own half-state
+            async with aiohttp.ClientSession() as s:
+                async with s.post(url, json={
+                    "requirements": [{"type": "assert-ref-snapshot-id",
+                                      "ref": "main",
+                                      "snapshot-id": head}],
+                    "updates": [
+                        {"action": "add-schema", "schema": {
+                            "type": "struct", "schema-id": 1,
+                            "fields": good}},
+                        {"action": "set-current-schema",
+                         "schema-id": 1}],
+                }) as resp:
+                    assert resp.status == 200
+            assert len(t.schemas) == 2
+            await d.shutdown()
+        finally:
+            await cat.stop()
+
+    async def test_truncate_is_delete_snapshot(self, tmp_path):
+        from etl_tpu.testing.avro_reader import read_avro_ocf
+
+        cat, d = await self.start(tmp_path)
+        try:
+            await d.write_events([ins(0, [1, "a", None])])
+            await d.write_events([TruncateEvent(
+                Lsn(0x800), Lsn(0x800), 0, 0, (make_schema(),))])
+            t = cat.table("etl", "public_user__events")
+            assert len(t.snapshots) == 2
+            snap = t.snapshots[-1]
+            assert snap["summary"]["operation"] == "delete"
+            assert snap["summary"]["total-records"] == "0"
+            _, manifests, _ = read_avro_ocf(snap["manifest-list"])
+            assert manifests == []  # no live data files after truncate
+            await d.shutdown()
+        finally:
+            await cat.stop()
+
+    async def test_catalog_rejects_stale_cas_and_legacy_shapes(
+            self, tmp_path):
+        import aiohttp
+
+        cat, d = await self.start(tmp_path)
+        try:
+            await d.write_events([ins(0, [1, "a", None])])
+            t = cat.table("etl", "public_user__events")
+            head = t.refs["main"]
+            async with aiohttp.ClientSession() as s:
+                url = f"{cat.url()}/v1/namespaces/etl/tables/" \
+                      "public_user__events"
+                # stale CAS: asserts None head while a snapshot exists
+                async with s.post(url, json={
+                    "requirements": [{"type": "assert-ref-snapshot-id",
+                                      "ref": "main", "snapshot-id": None}],
+                    "updates": [],
+                }) as resp:
+                    assert resp.status == 409
+                # round-3 legacy minimal shape: REJECTED
+                async with s.post(url, json={
+                    "updates": [{"action": "append", "data-files": []}],
+                }) as resp:
+                    assert resp.status == 400
+                # snapshot referencing a nonexistent manifest list
+                async with s.post(url, json={
+                    "requirements": [{"type": "assert-ref-snapshot-id",
+                                      "ref": "main", "snapshot-id": head}],
+                    "updates": [{"action": "add-snapshot", "snapshot": {
+                        "snapshot-id": 99, "sequence-number": 2,
+                        "timestamp-ms": 1, "parent-snapshot-id": head,
+                        "manifest-list": "/nope.avro",
+                        "summary": {"operation": "append"}}}],
+                }) as resp:
+                    assert resp.status == 400
+            assert t.refs["main"] == head  # nothing moved
+            await d.shutdown()
+        finally:
+            await cat.stop()
+
+    async def test_truncate_first_after_restart(self, tmp_path):
+        """A TruncateEvent as the FIRST event after a restart must not
+        be dropped: the destination recovers table state and commits the
+        delete snapshot."""
+        cat, d = await self.start(tmp_path)
+        try:
+            await d.write_events([ins(0, [1, "a", None])])
+            await d.shutdown()
+            d2 = IcebergDestination(IcebergConfig(
+                catalog_url=cat.url(), warehouse_path=str(tmp_path)),
+                RETRY_FAST)
+            await d2.startup()
+            await d2.write_events([TruncateEvent(
+                Lsn(0x900), Lsn(0x900), 0, 0, (make_schema(),))])
+            t = cat.table("etl", "public_user__events")
+            assert t.snapshots[-1]["summary"]["operation"] == "delete"
+            assert t.snapshots[-1]["summary"]["total-records"] == "0"
+            await d2.shutdown()
+        finally:
+            await cat.stop()
+
+    async def test_schema_change_first_after_restart(self, tmp_path):
+        """A SchemaChangeEvent as the first event after restart must
+        still register the evolved schema (the catalog holds the OLD
+        schema; adopting the target schema in memory must not suppress
+        the add-schema commit)."""
+        from etl_tpu.models.event import SchemaChangeEvent
+
+        cat, d = await self.start(tmp_path)
+        try:
+            await d.write_events([ins(0, [1, "a", None])])
+            await d.shutdown()
+            wider = ReplicatedTableSchema.with_all_columns(TableSchema(
+                TID, TableName("public", "user_events"),
+                (ColumnSchema("id", Oid.INT4, nullable=False,
+                              primary_key_ordinal=1),
+                 ColumnSchema("note", Oid.TEXT),
+                 ColumnSchema("amount", Oid.NUMERIC),
+                 ColumnSchema("added", Oid.TEXT))))
+            d2 = IcebergDestination(IcebergConfig(
+                catalog_url=cat.url(), warehouse_path=str(tmp_path)),
+                RETRY_FAST)
+            await d2.startup()
+            await d2.write_events([SchemaChangeEvent(
+                Lsn(0xA00), Lsn(0xA00), TID, wider)])
+            t = cat.table("etl", "public_user__events")
+            assert len(t.schemas) == 2
+            assert t.current_schema_id == 1
+            assert "added" in [f["name"] for f in t.schemas[1]["fields"]]
+            # and a REPEAT of the same schema change (redelivery) is a
+            # no-op, not a third schema
+            d3 = IcebergDestination(IcebergConfig(
+                catalog_url=cat.url(), warehouse_path=str(tmp_path)),
+                RETRY_FAST)
+            await d3.startup()
+            await d3.write_events([SchemaChangeEvent(
+                Lsn(0xA00), Lsn(0xA00), TID, wider)])
+            assert len(t.schemas) == 2
+            await d2.shutdown()
+            await d3.shutdown()
+        finally:
+            await cat.stop()
+
+    async def test_restart_adopts_catalog_state(self, tmp_path):
+        """A fresh destination instance (restart) must load the table,
+        adopt the branch head as its CAS token, and continue the chain."""
+        cat, d = await self.start(tmp_path)
+        try:
+            await d.write_events([ins(0, [1, "a", None])])
+            await d.shutdown()
+            d2 = IcebergDestination(IcebergConfig(
+                catalog_url=cat.url(), warehouse_path=str(tmp_path)),
+                RETRY_FAST)
+            await d2.startup()
+            await d2.write_events([ins(0, [2, "b", None], lsn=0x610)])
+            t = cat.table("etl", "public_user__events")
+            assert len(t.snapshots) == 2
+            assert t.snapshots[1]["parent-snapshot-id"] == \
+                t.snapshots[0]["snapshot-id"]
+            assert t.snapshots[1]["sequence-number"] == 2
+            assert t.snapshots[1]["summary"]["total-records"] == "2"
+            await d2.shutdown()
+        finally:
+            await cat.stop()
 
 
 class TestSnowflake:
