@@ -252,21 +252,34 @@ def main():
     # Off-TPU the kernel runs in interpret mode (correctness only, ~1000×
     # slower) — not a perf measurement, skip it.
     if jax.default_backend() == "tpu":
-        prates, pdec = bench_tpu(payloads, schema, N_ROWS,
-                                 use_pallas=True)
+        # SAME number of rounds as the XLA engine, pooled the same way —
+        # a single-round median would let one lucky tunnel window pick
+        # the engine and headline a non-comparable statistic
+        prates = []
+        pallas_ok = True
+        for _ in range(rounds):
+            r, pdec = bench_tpu(payloads, schema, N_ROWS, use_pallas=True)
+            prates.extend(r)
+            pallas_ok = pallas_ok and pdec.use_pallas
+        prates = sorted(prates)
         pallas_rps = prates[-1]
-        pallas_ok = pdec.use_pallas
+        pallas_med = prates[len(prates) // 2]
     else:
-        pallas_rps, pallas_ok = 0.0, False
-    if pallas_ok and pallas_rps > xla_rps:
-        best, engine = pallas_rps, "pallas"
+        pallas_rps, pallas_med, pallas_ok = 0.0, 0.0, False
+    # headline value/ratio = the MEDIAN (robust against the flapping
+    # tunnel, VERDICT r3 #9) of whichever engine's median wins — same
+    # statistic for both engines so the headline stays comparable across
+    # runs; the peak sustained window is reported alongside
+    if pallas_ok and pallas_med > xla_med:
+        lead, best, engine = pallas_med, pallas_rps, "pallas"
     else:
-        best, engine = xla_rps, "xla"
+        lead, best, engine = xla_med, xla_rps, "xla"
     result = {
         "metric": "wal_records_per_sec_decoded",
-        "value": round(best),
+        "value": round(lead),
         "unit": "records/s",
-        "vs_baseline": round(best / cpu_rps, 2),
+        "vs_baseline": round(lead / cpu_rps, 2),
+        "vs_baseline_peak": round(best / cpu_rps, 2),
         "cpu_baseline_records_per_sec": round(cpu_rps),
         "engine": engine,
         "xla_records_per_sec": round(xla_rps),

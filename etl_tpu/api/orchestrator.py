@@ -349,6 +349,9 @@ class K8sOrchestrator(Orchestrator):
                                             str(spec.pipeline_id)}},
                 "spec": {
                     "schedule": schedule,
+                    # explicit False: start_pipeline's 409→PATCH path must
+                    # UNSUSPEND a CronJob that stop_pipeline suspended
+                    "suspend": False,
                     "concurrencyPolicy": "Forbid",
                     "jobTemplate": {"spec": {"template": {"spec": {
                         "restartPolicy": "Never",
@@ -408,6 +411,17 @@ class K8sOrchestrator(Orchestrator):
             if status >= 400 and status != 404:
                 raise EtlError(ErrorKind.DESTINATION_FAILED,
                                f"k8s DELETE {path} → {status}")
+        # SUSPEND (not delete) the maintenance CronJob: a scheduled run
+        # against a paused pipeline would otherwise auto-restart it via
+        # the pause gate's finally-/start; start_pipeline's re-apply sets
+        # suspend back to False. 404 = non-lake pipeline, fine.
+        status, _ = await self._api(
+            "PATCH",
+            f"/apis/batch/v1/namespaces/{ns}/cronjobs/{name}-maintenance",
+            {"spec": {"suspend": True}})
+        if status >= 400 and status != 404:
+            raise EtlError(ErrorKind.DESTINATION_FAILED,
+                           f"k8s suspend cronjob {name} → {status}")
 
     async def delete_pipeline(self, pipeline_id: int) -> None:
         """Permanent teardown: stop, then drop the maintenance CronJob

@@ -348,7 +348,12 @@ async def run_lag_vs_rate(engine: str = "tpu",
                                         max_fill_ms=max_fill_ms,
                                         arrival_rate=rate)
         rows.append({
-            "fraction": f, "target_rate": rate, "events": n,
+            "fraction": f,
+            # the 1000 ev/s floor can raise the rate above f*max on slow
+            # hosts — report the load actually offered, not the request
+            "effective_fraction": round(rate / max_rate, 3) if max_rate
+            else None,
+            "target_rate": rate, "events": n,
             "p50_ms": out["replication_lag_p50_ms"],
             "p95_ms": out["replication_lag_p95_ms"],
             "max_ms": out["replication_lag_max_ms"],

@@ -417,6 +417,13 @@ class ApplyLoop:
             ev = event_codec.decode_commit(msg, start_lsn)
             if not tpu:
                 self.assembler.push_control(ev)
+            elif self._batch_deadline is None:
+                # no assembler event marks this boundary, so arm the
+                # deadline: an empty commit window still needs the
+                # force-flush to advance durable progress (see
+                # _maybe_dispatch_flush)
+                self._batch_deadline = time.monotonic() \
+                    + self.config.batch.max_fill_ms / 1000
             st.in_transaction = False
             st.last_commit_end_lsn = ev.end_lsn
             st.batch_commit_end = ev.end_lsn
@@ -477,8 +484,19 @@ class ApplyLoop:
     # -- batching / flush -------------------------------------------------------
 
     def _maybe_dispatch_flush(self, force: bool = False) -> None:
-        if self._in_flight is not None or len(self.assembler) == 0:
+        if self._in_flight is not None:
             return
+        if len(self.assembler) == 0:
+            # TPU engine: commits are not assembler events, so a commit
+            # window whose owned-row set is EMPTY (unowned tables,
+            # mid-sync traffic) still must advance durable progress —
+            # otherwise batch_commit_end never clears, _is_idle() stays
+            # false, and the slot's confirmed_flush pins while source WAL
+            # retention grows. Dispatch an event-less flush through the
+            # normal in-flight machinery (one per fill window, amortized
+            # like any other deadline flush).
+            if not (force and self.state.batch_commit_end is not None):
+                return
         # budget-aware threshold: under many active streams the per-stream
         # share shrinks below the static cap (batch_budget.rs:72-96) —
         # flushes happen mid-transaction with the commit LSN carried
@@ -496,6 +514,8 @@ class ApplyLoop:
         self._batch_deadline = None
 
         async def write() -> None:
+            if not events:
+                return  # commit-boundary-only flush: no destination call
             ack = await self.destination.write_events(events)
             await ack.wait_durable()
             # billing/egress accounting rides durable acks (egress.rs:1-20)

@@ -247,6 +247,12 @@ class TestK8sOrchestrator:
             assert len(deletes) == 3
             assert not any("persistentvolumeclaims" in p for p in deletes)
             assert not any("cronjobs" in p for p in deletes)
+            # ...but it is SUSPENDED so a scheduled run can't auto-start
+            # the deliberately paused pipeline
+            suspends = [r for r in server.requests
+                        if r.method == "PATCH" and "cronjobs" in r.path]
+            assert suspends and suspends[-1].json == {
+                "spec": {"suspend": True}}
             # permanent teardown drops the CronJob and PVC too
             await orch.delete_pipeline(7)
             deletes = [p for p in server.paths() if p.startswith("DELETE")]

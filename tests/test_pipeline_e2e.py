@@ -854,6 +854,36 @@ class TestReadReplica:
         assert await store.get_durable_progress(slot_name) == durable_before
         await pipeline.shutdown_and_wait()
 
+    async def test_empty_commit_window_advances_durable_progress(self):
+        """TPU engine: commits are not assembler events, so a committed
+        transaction whose owned-row set is EMPTY (here: rows for a table
+        outside the publication's owned set) must still clear the commit
+        boundary and advance durable progress — a regression here pins
+        the slot's confirmed_flush and _is_idle() forever."""
+        from etl_tpu.postgres.slots import apply_slot_name
+
+        db = make_db()
+        pipeline, store, dest = make_pipeline(db)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        await wait_ready(store, ORDERS)
+        slot_name = apply_slot_name(1)
+        # an EMPTY transaction: Begin + Commit, zero row messages
+        tx = db.transaction()
+        await tx.commit()
+        target = db.current_lsn
+        slot = db.slots[slot_name]
+        # the commit boundary must become durable (persisted progress,
+        # not just an idle-keepalive advance) and the slot must follow
+        await _wait_for(lambda: slot.confirmed_flush >= target)
+        for _ in range(200):
+            durable = await store.get_durable_progress(slot_name)
+            if durable is not None and durable >= target:
+                break
+            await asyncio.sleep(0.02)
+        assert durable is not None and durable >= target, (durable, target)
+        await pipeline.shutdown_and_wait()
+
     async def test_open_transaction_blocks_idle_flush_advance(self):
         """Safety inverse: while a transaction is OPEN mid-stream, status
         updates must keep reporting the durable floor — advancing to the
