@@ -77,10 +77,23 @@ async def serve_source(args) -> int:
 
 async def _chaos_scenario(args, scenario: str) -> tuple[dict, bool]:
     """One chaos scenario over a live pipeline on real TCP (the Chaos
-    Mesh matrix analogue). Scenarios:
+    Mesh matrix analogue, xtask chaos/scenario.rs: PacketLoss /
+    Partition / Latency). Scenarios:
 
       partition    sever every replication stream each interval
-                   (NetworkChaos) — no loss, NO duplicate events;
+                   (NetworkChaos Partition) — no loss, NO duplicate
+                   events;
+      latency      route all wire traffic through a TCP proxy adding
+                   delay±jitter per chunk (NetworkChaos Latency / tc
+                   netem delay) — no loss, no duplicates, just slower;
+      corruption   the proxy flips a byte in every Nth server→client
+                   chunk (tc netem corrupt): the wire client must
+                   surface typed protocol errors and reconnect —
+                   no loss, no duplicates;
+      copy         partitions injected DURING the initial table copy
+                   (sever until the table reaches READY): the copy's
+                   crash-marker/fencing must land exactly the source
+                   row set, then CDC flows;
       destination  scripted destination faults (reject before apply +
                    fail AFTER apply) — no loss; duplicates are the
                    at-least-once redeliveries idempotent destinations
@@ -101,11 +114,29 @@ async def _chaos_scenario(args, scenario: str) -> tuple[dict, bool]:
     from .store import NotifyingStore
     from .testing.fake_pg_server import FakePgServer
 
+    from .testing.chaos_proxy import ChaosProxy
+
     db, tids = _make_filled_db(args.rows)
     tid = tids[0]
     server = FakePgServer(db)
     await server.start()
-    cfg = PgConnectionConfig(host="127.0.0.1", port=server.port,
+    proxy: ChaosProxy | None = None
+    port = server.port
+    if scenario == "latency":
+        proxy = ChaosProxy("127.0.0.1", server.port,
+                           delay_ms=args.latency_ms,
+                           jitter_ms=args.latency_ms / 4)
+    elif scenario == "corruption":
+        # armed AFTER the initial copy reaches READY (corrupting the
+        # copy stream is the `copy` scenario's territory; corrupting
+        # every 6th copy chunk would just starve convergence)
+        proxy = ChaosProxy("127.0.0.1", server.port)
+    elif scenario == "copy":
+        proxy = ChaosProxy("127.0.0.1", server.port)
+    if proxy is not None:
+        await proxy.start()
+        port = proxy.port
+    cfg = PgConnectionConfig(host="127.0.0.1", port=port,
                              name="postgres", username="etl")
     store = NotifyingStore()
     memory = MemoryDestination()
@@ -124,8 +155,28 @@ async def _chaos_scenario(args, scenario: str) -> tuple[dict, bool]:
                 InvalidatedSlotBehavior.RECREATE_AND_RESYNC),
         store=store, destination=dest,
         source_factory=lambda: PgReplicationClient(cfg))
+    ready = store.notify_on(tid, TableStateType.READY)
     await pipeline.start()
-    await asyncio.wait_for(store.notify_on(tid, TableStateType.READY), 60)
+    copy_severs = 0
+    if scenario == "copy":
+        # partition the wire REPEATEDLY while the initial copy runs;
+        # stop as soon as the table reaches READY so the run converges
+        # tight cadence: the copy has to be HIT while in flight, so
+        # sever early and often rather than on the CDC interval
+        for _ in range(args.copy_severs):
+            if ready.done():
+                break
+            await asyncio.sleep(0.05)
+            if ready.done():
+                # READY landed during the sleep: a sever now would hit
+                # the CDC stream, not the copy — counting it would
+                # false-green the copy_severs > 0 gate
+                break
+            proxy.sever()
+            copy_severs += 1
+    await asyncio.wait_for(ready, 120)
+    if scenario == "corruption":
+        proxy.corrupt_every = 6
 
     n_cdc = 0
     disruptions = 0
@@ -140,6 +191,11 @@ async def _chaos_scenario(args, scenario: str) -> tuple[dict, bool]:
         disruptions += 1
         if scenario == "partition":
             await db.sever_streams()  # the NetworkChaos partition
+        elif scenario in ("latency", "corruption", "copy"):
+            # latency/corruption chaos is CONTINUOUS (every forwarded
+            # chunk); copy's partitions already happened pre-READY —
+            # the loop only produces CDC traffic to converge on
+            disruptions -= 1
         elif scenario == "destination":
             # both failure sides of a write: before apply (clean retry)
             # and AFTER apply (forces redelivery of applied events)
@@ -173,9 +229,12 @@ async def _chaos_scenario(args, scenario: str) -> tuple[dict, bool]:
     missing = expected - got
     await pipeline.shutdown_and_wait()
     await server.stop()
+    if proxy is not None:
+        await proxy.stop()
     dup_count = sum(
         1 for e in memory.events if isinstance(e, InsertEvent)) \
         - len(delivered())
+    copied = [r.values[0] for r in (memory.table_rows.get(tid) or [])]
     report = {"scenario": scenario, "disruptions": disruptions,
               "cdc_rows": n_cdc, "delivered": len(got & expected),
               "missing": sorted(missing)[:20],
@@ -183,6 +242,24 @@ async def _chaos_scenario(args, scenario: str) -> tuple[dict, bool]:
     if scenario == "partition":
         ok = (not missing and dup_count == 0
               and len(memory.table_rows[tid]) >= args.rows)
+    elif scenario == "latency":
+        report["delay_ms"] = args.latency_ms
+        ok = not missing and dup_count == 0
+    elif scenario == "corruption":
+        # the proxy must actually have flipped bytes for this run to
+        # mean anything; recovery must be loss- and duplicate-free
+        report["corrupted_chunks"] = proxy.corrupted
+        ok = not missing and dup_count == 0 and proxy.corrupted > 0
+    elif scenario == "copy":
+        # chaos DURING the copy: partitions were injected pre-READY and
+        # the destination's table rows must be EXACTLY the source set —
+        # a lost CTID range shows as missing, a refetched one as dupes
+        src = set(range(1, args.rows + 1))  # the pre-CDC table content
+        report["copy_severs"] = copy_severs
+        report["copy_rows"] = len(copied)
+        report["copy_dupes"] = len(copied) - len(set(copied))
+        ok = (not missing and copy_severs > 0
+              and set(copied) == src and len(copied) == args.rows)
     elif scenario == "destination":
         # duplicates are EXPECTED here (fail-after-apply forces
         # redelivery) but must be bounded by the injected faults x batch
@@ -193,7 +270,8 @@ async def _chaos_scenario(args, scenario: str) -> tuple[dict, bool]:
 
 
 async def chaos(args) -> int:
-    scenarios = (["partition", "destination", "slot"]
+    scenarios = (["partition", "latency", "corruption", "copy",
+                  "destination", "slot"]
                  if args.scenario == "all" else [args.scenario])
     failed = []
     for sc in scenarios:
@@ -326,7 +404,12 @@ def main(argv=None) -> int:
     cp.add_argument("--interval", type=float, default=1.0)
     cp.add_argument("--engine", default="tpu", choices=["tpu", "cpu"])
     cp.add_argument("--scenario", default="partition",
-                    choices=["partition", "destination", "slot", "all"])
+                    choices=["partition", "latency", "corruption",
+                             "copy", "destination", "slot", "all"])
+    cp.add_argument("--latency-ms", type=float, default=40.0,
+                    help="per-chunk proxy delay for --scenario latency")
+    cp.add_argument("--copy-severs", type=int, default=3,
+                    help="max partitions injected during initial copy")
 
     fp = sub.add_parser("fuzz", help="seeded parser fuzzing")
     fp.add_argument("--target", default=None)

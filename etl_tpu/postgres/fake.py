@@ -533,9 +533,31 @@ class FakeStandby(FakeDatabase):
             self._wal_cond.notify_all()
 
     def transaction(self, xid: int | None = None) -> "FakeTransaction":
-        raise AssertionError(
-            "cannot write to a standby (pg_is_in_recovery) — write to the "
-            "primary and replay()")
+        if self.is_standby:
+            raise AssertionError(
+                "cannot write to a standby (pg_is_in_recovery) — write "
+                "to the primary and replay()")
+        return super().transaction(xid)
+
+    async def promote(self) -> None:
+        """pg_promote(): final catch-up replay, detach from the primary,
+        leave recovery. The node keeps its replayed WAL and its logical
+        slots (slots survive promotion on PG16+) and accepts writes
+        from here on; the old primary gets no further reads."""
+        await self.replay()
+        self.is_standby = False
+        if self in self.primary.standbys:
+            self.primary.standbys.remove(self)
+        # private DEEP copies: post-promotion writes/DDL on the old
+        # primary must not leak in by reference (FakeTable.rows and
+        # .schema are mutated in place), and writes on the promoted
+        # node must not mutate the old primary's storage
+        self.tables = copy.deepcopy(self.tables)
+        self.publications = {k: list(v)
+                             for k, v in self.publications.items()}
+        self.column_filters = copy.deepcopy(self.column_filters)
+        self.row_filters = copy.deepcopy(self.row_filters)
+        self.row_filter_sql = dict(self.row_filter_sql)
 
     async def wait_slot_creation_allowed(self) -> None:
         if self.snapshot_gate:

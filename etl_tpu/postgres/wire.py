@@ -99,6 +99,16 @@ class PgWireConnection:
         header = await self._reader.readexactly(5)
         tag = header[:1]
         (length,) = struct.unpack(">i", header[1:5])
+        # corrupted stream defense: a flipped bit in the length field
+        # must surface as a typed protocol error, not a readexactly()
+        # that waits forever for gigabytes. Bound = PG's own 1GB
+        # message cap (a smaller cap would reject a valid CopyData
+        # carrying a near-1GB TOAST value and wedge the retry loop on
+        # correct data)
+        if length < 4 or length - 4 > 1 << 30:
+            raise EtlError(ErrorKind.SOURCE_PROTOCOL_VIOLATION,
+                           f"corrupt message length {length} "
+                           f"(tag {tag!r})")
         payload = await self._reader.readexactly(length - 4)
         if tag == b"E":
             raise PgServerError(_parse_error_fields(payload))

@@ -828,6 +828,101 @@ class TestReadReplica:
         with pytest.raises(AssertionError, match="standby"):
             replica.transaction()
 
+    async def test_promotion_mid_stream_continues_cdc(self):
+        """pg_promote() while the pipeline streams from the replica:
+        logical slots survive promotion (PG16+), so CDC continues from
+        the promoted node's own WAL with no re-copy and no duplicates —
+        the pre-promotion event set is delivered exactly once."""
+        primary = make_db()
+        replica = primary.make_replica()
+        pipeline, store, dest = make_pipeline(replica)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        async with primary.transaction() as tx:
+            tx.insert(ACCOUNTS, ["80", "pre-promotion", "1"])
+        await _wait_for(lambda: 80 in _account_ids(dest))
+        await replica.promote()
+        # the promoted node now accepts writes directly
+        async with replica.transaction() as tx:
+            tx.insert(ACCOUNTS, ["81", "post-promotion", "2"])
+        await _wait_for(lambda: 81 in _account_ids(dest))
+        assert replica.slots, "slots must survive promotion"
+        ids = [e.row.values[0] for e in _row_events(dest)
+               if isinstance(e, InsertEvent)]
+        assert ids.count(80) == 1 and ids.count(81) == 1, ids
+        await pipeline.shutdown_and_wait()
+
+    async def test_promotion_detaches_from_old_primary(self):
+        """After promotion the old primary's writes must NOT reach the
+        pipeline — the promoted node no longer replays (a split-brain
+        leak would double-apply on failback)."""
+        primary = make_db()
+        replica = primary.make_replica()
+        pipeline, store, dest = make_pipeline(replica)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        await replica.promote()
+        async with primary.transaction() as tx:
+            tx.insert(ACCOUNTS, ["82", "orphaned", "1"])
+        await asyncio.sleep(0.3)
+        assert 82 not in _account_ids(dest), \
+            "old-primary WAL must not leak into a promoted replica"
+        await pipeline.shutdown_and_wait()
+
+    async def test_disconnect_during_stream_from_standby_no_dupes(self):
+        """Severing the replica's walsender connections mid-stream
+        (NetworkChaos partition analogue) must recover exactly-once:
+        the apply worker reconnects from durable progress and the
+        destination sees each committed row once."""
+        primary = make_db()
+        replica = primary.make_replica()
+        pipeline, store, dest = make_pipeline(replica)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        async with primary.transaction() as tx:
+            tx.insert(ACCOUNTS, ["83", "before-cut", "1"])
+        await _wait_for(lambda: 83 in _account_ids(dest))
+        await replica.sever_streams()
+        async with primary.transaction() as tx:
+            tx.insert(ACCOUNTS, ["84", "after-cut", "2"])
+        await _wait_for(lambda: 84 in _account_ids(dest), timeout=15)
+        ids = [e.row.values[0] for e in _row_events(dest)
+               if isinstance(e, InsertEvent)]
+        assert ids.count(83) == 1 and ids.count(84) == 1, ids
+        await pipeline.shutdown_and_wait()
+
+    async def test_slot_invalidation_on_standby_recreate_and_resync(self):
+        """A replica-owned slot invalidated by the standby (hot-standby
+        feedback lapse / max_slot_wal_keep_size) with
+        recreate_and_resync: tables reset, destination tables dropped
+        and recopied from the replica — same policy as on a primary
+        (apply_worker.rs Error/Recreate semantics)."""
+        from etl_tpu.config import InvalidatedSlotBehavior
+        from etl_tpu.postgres.slots import apply_slot_name
+
+        primary = make_db()
+        replica = primary.make_replica()
+        store = NotifyingStore()
+        dest = MemoryDestination()
+        pipeline, _, _ = make_pipeline(replica, store=store,
+                                       destination=dest)
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        await pipeline.shutdown_and_wait()
+        replica.invalidate_slot(apply_slot_name(1))
+        pipeline2, _, _ = make_pipeline(
+            replica, store=store, destination=dest,
+            invalidated_slot_behavior=(
+                InvalidatedSlotBehavior.RECREATE_AND_RESYNC))
+        reset_seen = store.notify_on(ACCOUNTS, TableStateType.INIT)
+        await pipeline2.start()
+        await asyncio.wait_for(reset_seen, 20)  # table reset for resync
+        await wait_ready(store, ACCOUNTS, timeout=20)
+        assert ACCOUNTS in dest.dropped_tables
+        rows = {tuple(r.values) for r in dest.table_rows[ACCOUNTS]}
+        assert rows == {(1, "alice", 100), (2, "bob", -5), (3, None, 0)}
+        await pipeline2.shutdown_and_wait()
+
     async def test_idle_keepalive_advances_slot_past_unpublished_wal(self):
         """Reference pipeline_read_replica.rs:313: with only UNPUBLISHED /
         keepalive WAL flowing, the slot's confirmed_flush must advance to

@@ -269,6 +269,153 @@ class TestWireChaos:
             await p.shutdown_and_wait()
             await server.stop()
 
+    def _proxied_pipeline(self, proxy, max_attempts=12):
+        from etl_tpu.config import RetryConfig
+
+        cfg = PgConnectionConfig(host="127.0.0.1", port=proxy.port,
+                                 name="postgres", username="etl")
+        store = NotifyingStore()
+        dest = MemoryDestination()
+        p = Pipeline(
+            config=PipelineConfig(
+                pipeline_id=9, publication_name="pub",
+                pg_connection=cfg,
+                batch=BatchConfig(max_size_bytes=1 << 20, max_fill_ms=20,
+                                  batch_engine=BatchEngine.TPU),
+                apply_retry=RetryConfig(max_attempts=max_attempts,
+                                        initial_delay_ms=20)),
+            store=store, destination=dest,
+            source_factory=lambda: PgReplicationClient(cfg))
+        return p, store, dest
+
+    async def test_latency_chaos_no_loss_no_dupes(self):
+        """NetworkChaos Latency (tc netem delay analogue): every chunk
+        through the proxy sleeps; delivery must stay exactly-once, just
+        slower (xtask chaos/scenario.rs Latency)."""
+        from etl_tpu.testing.chaos_proxy import ChaosProxy
+
+        db = make_db()
+        server = await start_server(db, keepalive_interval_s=0.03)
+        proxy = ChaosProxy("127.0.0.1", server.port, delay_ms=15,
+                           jitter_ms=5)
+        await proxy.start()
+        p, store, dest = self._proxied_pipeline(proxy)
+        try:
+            await p.start()
+            await asyncio.wait_for(
+                store.notify_on(ACCOUNTS, TableStateType.READY), 30)
+            for pk in (50, 51, 52):
+                async with db.transaction() as tx:
+                    tx.insert(ACCOUNTS, [str(pk), "slow", "1"])
+            while sum(1 for e in dest.events
+                      if isinstance(e, InsertEvent)
+                      and e.row.values[0] in (50, 51, 52)) < 3:
+                await asyncio.sleep(0.02)
+            counts = [sum(1 for e in dest.events
+                          if isinstance(e, InsertEvent)
+                          and e.row.values[0] == pk)
+                      for pk in (50, 51, 52)]
+            assert counts == [1, 1, 1], counts
+        finally:
+            await p.shutdown_and_wait()
+            await proxy.stop()
+            await server.stop()
+
+    async def test_corruption_chaos_typed_error_then_recovery(self):
+        """tc netem corrupt analogue: the proxy flips a byte in the
+        walsender's stream; the wire client must surface a typed
+        protocol/IO error (not hang on a corrupt length), reconnect,
+        and resume exactly-once."""
+        from etl_tpu.testing.chaos_proxy import ChaosProxy
+
+        db = make_db()
+        server = await start_server(db, keepalive_interval_s=0.03)
+        proxy = ChaosProxy("127.0.0.1", server.port)
+        await proxy.start()
+        p, store, dest = self._proxied_pipeline(proxy, max_attempts=30)
+        try:
+            await p.start()
+            await asyncio.wait_for(
+                store.notify_on(ACCOUNTS, TableStateType.READY), 30)
+            # arm after copy: streaming chaos. Every 5th chunk — dense
+            # enough to fire on CDC traffic, sparse enough that retry
+            # reconnect handshakes usually survive (the devtools
+            # scenario uses the same density)
+            proxy.corrupt_every = 5
+            delivered = set()
+            pk = 60
+            # keep writing until corruption demonstrably fired AND the
+            # rows around it all arrived (recovery, not luck)
+            while proxy.corrupted < 1 or len(delivered) < 6:
+                async with db.transaction() as tx:
+                    tx.insert(ACCOUNTS, [str(pk), "x" * 200, "1"])
+                target = pk
+                pk += 1
+                for _ in range(900):
+                    got = {e.row.values[0] for e in dest.events
+                           if isinstance(e, InsertEvent)}
+                    if target in got:
+                        delivered = got
+                        break
+                    await asyncio.sleep(0.02)
+                else:
+                    raise AssertionError(
+                        f"row {target} never recovered after corruption")
+            counts = {v: 0 for v in delivered}
+            for e in dest.events:
+                if isinstance(e, InsertEvent) and e.row.values[0] in counts:
+                    counts[e.row.values[0]] += 1
+            assert all(c == 1 for c in counts.values()), counts
+            assert proxy.corrupted >= 1
+        finally:
+            await p.shutdown_and_wait()
+            await proxy.stop()
+            await server.stop()
+
+    async def test_partition_during_copy_exact_row_set(self):
+        """Chaos DURING the initial copy: partition the wire while the
+        table copy is in flight; the crash-marker/fencing path must
+        land EXACTLY the source row set (no loss, no dupes) before
+        going READY."""
+        from etl_tpu.models import (ColumnSchema, Oid, TableName,
+                                    TableSchema)
+        from etl_tpu.postgres.fake import FakeDatabase
+        from etl_tpu.testing.chaos_proxy import ChaosProxy
+
+        db = FakeDatabase()
+        big = 18000
+        n = 800
+        db.create_table(TableSchema(
+            big, TableName("public", "big"),
+            (ColumnSchema("id", Oid.INT4, nullable=False,
+                          primary_key_ordinal=1),
+             ColumnSchema("v", Oid.TEXT))),
+            rows=[[str(i + 1), f"v{i}" + "y" * 60] for i in range(n)])
+        db.create_publication("pub", [big])
+        server = await start_server(db, keepalive_interval_s=0.03)
+        proxy = ChaosProxy("127.0.0.1", server.port)
+        await proxy.start()
+        p, store, dest = self._proxied_pipeline(proxy, max_attempts=30)
+        try:
+            ready = store.notify_on(big, TableStateType.READY)
+            await p.start()
+            severs = 0
+            while not ready.done() and severs < 3:
+                await asyncio.sleep(0.05)
+                if ready.done():
+                    break  # sever now would hit CDC, not the copy
+                proxy.sever()
+                severs += 1
+            await asyncio.wait_for(ready, 60)
+            assert severs >= 1, "copy finished before any chaos fired"
+            got = [r.values[0] for r in dest.table_rows[big]]
+            assert sorted(got) == list(range(1, n + 1)), (
+                len(got), len(set(got)))
+        finally:
+            await p.shutdown_and_wait()
+            await proxy.stop()
+            await server.stop()
+
 
 class TestWirePartitionsAndFilters:
     async def test_partition_leaves_over_wire(self):
