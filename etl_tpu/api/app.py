@@ -7,13 +7,14 @@ pipeline lifecycle routes `start/stop/restart/status/replication-status/
 rollback-tables` (routes/pipelines.rs:662-1618), orchestration through the
 fakeable deploy seam (k8s/base.rs:197), OpenAPI document, /metrics.
 
-Storage: sqlite (the reference uses its own Postgres with sqlx migrations).
+Storage: the ApiDb seam (api/db.py) — sqlite file OR Postgres over the
+wire-client pool, mirroring the reference API owning its own Postgres
+database with sqlx migrations (crates/etl-api/migrations/).
 """
 
 from __future__ import annotations
 
 import json
-import sqlite3
 from pathlib import Path
 
 from aiohttp import web
@@ -21,6 +22,7 @@ from aiohttp import web
 from ..store.sql import SqliteStore
 from ..telemetry.metrics import registry
 from .crypto import ConfigCipher
+from .db import ApiDb, ApiIntegrityError, SqliteApiDb
 from .orchestrator import Orchestrator, ReplicatorSpec
 
 TENANT_HEADER = "tenant_id"
@@ -61,8 +63,17 @@ def _json_error(status: int, message: str) -> web.HTTPException:
                content_type="application/json")
 
 
+def _int(v) -> int:
+    """DB-value → int: the Postgres wire path returns text cells."""
+    return int(v)
+
+
+def _bool(v) -> bool:
+    return bool(int(v))
+
+
 class ApiState:
-    def __init__(self, db_path: str, cipher: ConfigCipher,
+    def __init__(self, db: "str | ApiDb", cipher: ConfigCipher,
                  orchestrator: Orchestrator, api_key: str | None = None):
         self.cipher = cipher
         self.orchestrator = orchestrator
@@ -71,52 +82,50 @@ class ApiState:
         # BEFORE tenant routing — the tenant header alone is an assertion,
         # not an authentication
         self.api_key = api_key
-        self.db = sqlite3.connect(db_path)
-        self.db.executescript("""
-CREATE TABLE IF NOT EXISTS api_tenants (
-    id TEXT PRIMARY KEY, name TEXT NOT NULL);
-CREATE TABLE IF NOT EXISTS api_sources (
-    id INTEGER PRIMARY KEY AUTOINCREMENT, tenant_id TEXT NOT NULL,
-    name TEXT NOT NULL, config_enc TEXT NOT NULL);
-CREATE TABLE IF NOT EXISTS api_destinations (
-    id INTEGER PRIMARY KEY AUTOINCREMENT, tenant_id TEXT NOT NULL,
-    name TEXT NOT NULL, config_enc TEXT NOT NULL);
-CREATE TABLE IF NOT EXISTS api_images (
-    id INTEGER PRIMARY KEY AUTOINCREMENT, tenant_id TEXT NOT NULL,
-    name TEXT NOT NULL, is_default INTEGER NOT NULL DEFAULT 0,
-    UNIQUE (tenant_id, name));
-CREATE TABLE IF NOT EXISTS api_pipelines (
-    id INTEGER PRIMARY KEY AUTOINCREMENT, tenant_id TEXT NOT NULL,
-    source_id INTEGER NOT NULL, destination_id INTEGER NOT NULL,
-    publication_name TEXT NOT NULL, config_json TEXT NOT NULL DEFAULT '{}',
-    store_path TEXT NOT NULL DEFAULT '');
-""")
-        self.db.commit()
+        self.db: ApiDb = SqliteApiDb(db) if isinstance(db, str) else db
+        self._connected = False
+
+    async def connect(self) -> None:
+        if not self._connected:
+            await self.db.connect()
+            self._connected = True
+
+    async def close(self) -> None:
+        if self._connected:
+            await self.db.close()
+            self._connected = False
 
     # -- row helpers ------------------------------------------------------------
 
-    def fetch_owned(self, table: str, row_id: int, tenant: str):
-        row = self.db.execute(
+    async def fetch_owned(self, table: str, row_id: int, tenant: str):
+        rows = await self.db.run(
             f"SELECT * FROM {table} WHERE id = ? AND tenant_id = ?",
-            (row_id, tenant)).fetchone()
-        return row
+            (row_id, tenant))
+        return rows[0] if rows else None
 
-    def default_image(self, tenant: str) -> "str | None":
-        row = self.db.execute(
+    async def default_image(self, tenant: str) -> "str | None":
+        rows = await self.db.run(
             "SELECT name FROM api_images WHERE tenant_id = ? AND "
-            "is_default = 1", (tenant,)).fetchone()
-        return row[0] if row else None
+            "is_default = 1", (tenant,))
+        return rows[0][0] if rows else None
 
-    def pipeline_config(self, row) -> dict:
+    async def pipeline_image(self, row) -> "str | None":
+        """The image a pipeline runs: its pinned version if set (the
+        /version route), else the tenant default."""
+        pinned = row[7] if len(row) > 7 else ""
+        return pinned or await self.default_image(row[1])
+
+    async def pipeline_config(self, row) -> dict:
         """Assemble the full replicator config for a pipeline row."""
-        _, tenant, source_id, dest_id, publication, config_json, store_path = row
-        src = self.fetch_owned("api_sources", source_id, tenant)
-        dst = self.fetch_owned("api_destinations", dest_id, tenant)
+        tenant, source_id, dest_id = row[1], _int(row[2]), _int(row[3])
+        publication, config_json, store_path = row[4], row[5], row[6]
+        src = await self.fetch_owned("api_sources", source_id, tenant)
+        dst = await self.fetch_owned("api_destinations", dest_id, tenant)
         if src is None or dst is None:
             raise _json_error(404, "source or destination missing")
         extra = json.loads(config_json)
         doc = {
-            "pipeline_id": row[0],
+            "pipeline_id": _int(row[0]),
             "publication_name": publication,
             "pg_connection": self.cipher.decrypt(src[3]),
             "destination": self.cipher.decrypt(dst[3]),
@@ -176,6 +185,15 @@ def build_app(state: ApiState) -> web.Application:
         return await handler(request)
 
     app = web.Application(middlewares=[auth_middleware])
+
+    async def _startup(_app):
+        await state.connect()
+
+    async def _cleanup(_app):
+        await state.close()
+
+    app.on_startup.append(_startup)
+    app.on_cleanup.append(_cleanup)
     r = app.router
 
     # -- health / metrics / openapi --------------------------------------------
@@ -209,15 +227,15 @@ def build_app(state: ApiState) -> web.Application:
         if not tid or not name:
             raise _json_error(400, "id and name required")
         try:
-            state.db.execute("INSERT INTO api_tenants (id, name) VALUES (?, ?)",
-                             (tid, name))
-            state.db.commit()
-        except sqlite3.IntegrityError:
+            await state.db.run(
+                "INSERT INTO api_tenants (id, name) VALUES (?, ?)",
+                (tid, name))
+        except ApiIntegrityError:
             raise _json_error(409, f"tenant {tid} exists")
         return web.json_response({"id": tid, "name": name}, status=201)
 
     async def list_tenants(_req):
-        rows = state.db.execute("SELECT id, name FROM api_tenants").fetchall()
+        rows = await state.db.run("SELECT id, name FROM api_tenants")
         return web.json_response([{"id": i, "name": n} for i, n in rows])
 
     r.add_post("/v1/tenants", create_tenant)
@@ -252,32 +270,33 @@ def build_app(state: ApiState) -> web.Application:
             if not name or not isinstance(config, dict):
                 raise _json_error(400, "name and config required")
             _reject_invalid(config)
-            cur = state.db.execute(
+            rows = await state.db.run(
                 f"INSERT INTO {table} (tenant_id, name, config_enc) "
-                "VALUES (?, ?, ?)", (tenant, name, state.cipher.encrypt(config)))
-            state.db.commit()
-            return web.json_response({"id": cur.lastrowid, "name": name},
-                                     status=201)
+                "VALUES (?, ?, ?) RETURNING id",
+                (tenant, name, state.cipher.encrypt(config)))
+            return web.json_response({"id": _int(rows[0][0]),
+                                      "name": name}, status=201)
 
         async def list_(req: web.Request):
             tenant = _require_tenant(req)
-            rows = state.db.execute(
+            rows = await state.db.run(
                 f"SELECT id, name FROM {table} WHERE tenant_id = ?",
-                (tenant,)).fetchall()
-            return web.json_response([{"id": i, "name": n} for i, n in rows])
+                (tenant,))
+            return web.json_response([{"id": _int(i), "name": n}
+                                      for i, n in rows])
 
         async def get(req: web.Request):
             tenant = _require_tenant(req)
-            row = state.fetch_owned(table, _path_id(req), tenant)
+            row = await state.fetch_owned(table, _path_id(req), tenant)
             if row is None:
                 raise _json_error(404, "not found")
             return web.json_response({
-                "id": row[0], "name": row[2],
+                "id": _int(row[0]), "name": row[2],
                 "config": redact_config(state.cipher.decrypt(row[3]))})
 
         async def update(req: web.Request):
             tenant = _require_tenant(req)
-            row = state.fetch_owned(table, _path_id(req), tenant)
+            row = await state.fetch_owned(table, _path_id(req), tenant)
             if row is None:
                 raise _json_error(404, "not found")
             doc = await _json_body(req)
@@ -288,27 +307,26 @@ def build_app(state: ApiState) -> web.Application:
                                        state.cipher.decrypt(row[3]))
                 _reject_invalid(config)
             enc = state.cipher.encrypt(config) if config is not None else row[3]
-            state.db.execute(
+            await state.db.run(
                 f"UPDATE {table} SET name = ?, config_enc = ? WHERE id = ?",
                 (name, enc, row[0]))
-            state.db.commit()
-            return web.json_response({"id": row[0], "name": name})
+            return web.json_response({"id": _int(row[0]), "name": name})
 
         async def delete(req: web.Request):
             tenant = _require_tenant(req)
             row_id = _path_id(req)
             ref_col = "source_id" if table == "api_sources" \
                 else "destination_id"
-            used = state.db.execute(
+            used = await state.db.run(
                 f"SELECT id FROM api_pipelines WHERE {ref_col} = ? AND "
-                "tenant_id = ?", (row_id, tenant)).fetchall()
+                "tenant_id = ?", (row_id, tenant))
             if used:
                 raise _json_error(
-                    409, f"in use by pipelines {[r[0] for r in used]}")
-            state.db.execute(
+                    409,
+                    f"in use by pipelines {[_int(r[0]) for r in used]}")
+            await state.db.run(
                 f"DELETE FROM {table} WHERE id = ? AND tenant_id = ?",
                 (row_id, tenant))
-            state.db.commit()
             return web.json_response({}, status=204)
 
         r.add_post(path, create)
@@ -358,7 +376,8 @@ def build_app(state: ApiState) -> web.Application:
                 source_id = int(source_id)
             except (TypeError, ValueError):
                 raise _json_error(400, "source_id must be an integer")
-            if state.fetch_owned("api_sources", source_id, tenant) is None:
+            if await state.fetch_owned("api_sources", source_id,
+                                       tenant) is None:
                 raise _json_error(404, "source not found")
         failures = await validate_destination(config, pipeline_config)
         return web.json_response(
@@ -376,51 +395,61 @@ def build_app(state: ApiState) -> web.Application:
         if not name:
             raise _json_error(400, "name required")
         try:
-            cur = state.db.execute(
+            rows = await state.db.run(
                 "INSERT INTO api_images (tenant_id, name, is_default) "
-                "VALUES (?, ?, ?)",
+                "VALUES (?, ?, ?) RETURNING id",
                 (tenant, name, 1 if doc.get("default") else 0))
-        except sqlite3.IntegrityError:
+        except ApiIntegrityError:
             raise _json_error(409, f"image {name} exists")
+        iid = _int(rows[0][0])
         if doc.get("default"):
-            state.db.execute("UPDATE api_images SET is_default = 0 "
-                             "WHERE tenant_id = ? AND id <> ?",
-                             (tenant, cur.lastrowid))
-        state.db.commit()
+            await state.db.run(
+                "UPDATE api_images SET is_default = 0 "
+                "WHERE tenant_id = ? AND id <> ?", (tenant, iid))
         return web.json_response(
-            {"id": cur.lastrowid, "name": name,
+            {"id": iid, "name": name,
              "default": bool(doc.get("default"))}, status=201)
 
     async def list_images(req: web.Request):
         tenant = _require_tenant(req)
-        rows = state.db.execute(
+        rows = await state.db.run(
             "SELECT id, name, is_default FROM api_images WHERE "
-            "tenant_id = ?", (tenant,)).fetchall()
+            "tenant_id = ?", (tenant,))
         return web.json_response([
-            {"id": i, "name": n, "default": bool(d)} for i, n, d in rows])
+            {"id": _int(i), "name": n, "default": _bool(d)}
+            for i, n, d in rows])
 
     async def set_default_image(req: web.Request):
         tenant = _require_tenant(req)
         iid = _path_id(req)
-        row = state.db.execute(
+        rows = await state.db.run(
             "SELECT id FROM api_images WHERE id = ? AND tenant_id = ?",
-            (iid, tenant)).fetchone()
-        if row is None:
+            (iid, tenant))
+        if not rows:
             raise _json_error(404, "image not found")
-        state.db.execute("UPDATE api_images SET is_default = 0 WHERE "
-                         "tenant_id = ?", (tenant,))
-        state.db.execute("UPDATE api_images SET is_default = 1 "
-                         "WHERE id = ?", (iid,))
-        state.db.commit()
+        await state.db.run("UPDATE api_images SET is_default = 0 WHERE "
+                           "tenant_id = ?", (tenant,))
+        await state.db.run("UPDATE api_images SET is_default = 1 "
+                           "WHERE id = ?", (iid,))
         return web.json_response({"id": iid, "default": True})
 
     async def delete_image(req: web.Request):
         tenant = _require_tenant(req)
         iid = _path_id(req)
-        state.db.execute(
+        row = await state.fetch_owned("api_images", iid, tenant)
+        if row is not None:
+            # a pipeline pinned to this image (the /version route) would
+            # silently deploy an unregistered name after the delete
+            pinned = await state.db.run(
+                "SELECT id FROM api_pipelines WHERE tenant_id = ? AND "
+                "image_name = ?", (tenant, row[2]))
+            if pinned:
+                raise _json_error(
+                    409, f"image pinned by pipelines "
+                         f"{sorted(_int(r[0]) for r in pinned)}")
+        await state.db.run(
             "DELETE FROM api_images WHERE id = ? AND tenant_id = ?",
             (iid, tenant))
-        state.db.commit()
         return web.json_response({}, status=204)
 
     r.add_post("/v1/images", create_image)
@@ -440,80 +469,123 @@ def build_app(state: ApiState) -> web.Application:
         except (KeyError, TypeError, ValueError):
             raise _json_error(
                 400, "source_id, destination_id, publication_name required")
-        if state.fetch_owned("api_sources", source_id, tenant) is None:
+        if await state.fetch_owned("api_sources", source_id,
+                                   tenant) is None:
             raise _json_error(404, f"source {source_id} not found")
-        if state.fetch_owned("api_destinations", dest_id, tenant) is None:
+        if await state.fetch_owned("api_destinations", dest_id,
+                                   tenant) is None:
             raise _json_error(404, f"destination {dest_id} not found")
-        cur = state.db.execute(
+        rows = await state.db.run(
             "INSERT INTO api_pipelines (tenant_id, source_id, destination_id,"
             " publication_name, config_json, store_path) VALUES "
-            "(?, ?, ?, ?, ?, ?)",
+            "(?, ?, ?, ?, ?, ?) RETURNING id",
             (tenant, source_id, dest_id, publication,
              json.dumps(doc.get("config", {})), doc.get("store_path", "")))
-        state.db.commit()
-        return web.json_response({"id": cur.lastrowid}, status=201)
+        return web.json_response({"id": _int(rows[0][0])}, status=201)
 
     async def list_pipelines(req: web.Request):
         tenant = _require_tenant(req)
-        rows = state.db.execute(
+        rows = await state.db.run(
             "SELECT id, source_id, destination_id, publication_name FROM "
-            "api_pipelines WHERE tenant_id = ?", (tenant,)).fetchall()
+            "api_pipelines WHERE tenant_id = ?", (tenant,))
         return web.json_response([
-            {"id": i, "source_id": s, "destination_id": d,
-             "publication_name": p} for i, s, d, p in rows])
+            {"id": _int(i), "source_id": _int(s),
+             "destination_id": _int(d), "publication_name": p}
+            for i, s, d, p in rows])
 
-    def _pipeline_row(req: web.Request, tenant: str):
-        row = state.fetch_owned("api_pipelines",
-                                _path_id(req), tenant)
+    async def _pipeline_row(req: web.Request, tenant: str):
+        row = await state.fetch_owned("api_pipelines",
+                                      _path_id(req), tenant)
         if row is None:
             raise _json_error(404, "pipeline not found")
         return row
 
     async def get_pipeline(req: web.Request):
         tenant = _require_tenant(req)
-        row = _pipeline_row(req, tenant)
-        return web.json_response({
-            "id": row[0], "source_id": row[2], "destination_id": row[3],
-            "publication_name": row[4], "config": json.loads(row[5])})
+        row = await _pipeline_row(req, tenant)
+        doc = {
+            "id": _int(row[0]), "source_id": _int(row[2]),
+            "destination_id": _int(row[3]),
+            "publication_name": row[4], "config": json.loads(row[5])}
+        if len(row) > 7 and row[7]:
+            doc["image"] = row[7]
+        return web.json_response(doc)
 
     async def delete_pipeline(req: web.Request):
         tenant = _require_tenant(req)
-        row = _pipeline_row(req, tenant)
+        row = await _pipeline_row(req, tenant)
         # delete, not stop: permanent teardown may also drop
         # pipeline-owned storage (the k8s warehouse PVC)
-        await state.orchestrator.delete_pipeline(row[0])
-        state.db.execute("DELETE FROM api_pipelines WHERE id = ?", (row[0],))
-        state.db.commit()
+        await state.orchestrator.delete_pipeline(_int(row[0]))
+        await state.db.run("DELETE FROM api_pipelines WHERE id = ?",
+                           (row[0],))
         return web.json_response({}, status=204)
 
     async def start_pipeline(req: web.Request):
         tenant = _require_tenant(req)
-        row = _pipeline_row(req, tenant)
-        config = state.pipeline_config(row)
+        row = await _pipeline_row(req, tenant)
+        config = await state.pipeline_config(row)
         await state.orchestrator.start_pipeline(ReplicatorSpec(
-            pipeline_id=row[0], tenant_id=tenant, config=config,
-            image=state.default_image(tenant)))
+            pipeline_id=_int(row[0]), tenant_id=tenant, config=config,
+            image=await state.pipeline_image(row)))
         return web.json_response({"status": "starting"}, status=202)
 
     async def stop_pipeline(req: web.Request):
         tenant = _require_tenant(req)
-        row = _pipeline_row(req, tenant)
-        await state.orchestrator.stop_pipeline(row[0])
+        row = await _pipeline_row(req, tenant)
+        await state.orchestrator.stop_pipeline(_int(row[0]))
         return web.json_response({"status": "stopping"}, status=202)
 
     async def restart_pipeline(req: web.Request):
         tenant = _require_tenant(req)
-        row = _pipeline_row(req, tenant)
-        config = state.pipeline_config(row)
+        row = await _pipeline_row(req, tenant)
+        config = await state.pipeline_config(row)
         await state.orchestrator.restart_pipeline(ReplicatorSpec(
-            pipeline_id=row[0], tenant_id=tenant, config=config,
-            image=state.default_image(tenant)))
+            pipeline_id=_int(row[0]), tenant_id=tenant, config=config,
+            image=await state.pipeline_image(row)))
         return web.json_response({"status": "restarting"}, status=202)
+
+    async def update_pipeline_version(req: web.Request):
+        """Pin/roll the replicator image a pipeline runs (reference
+        routes/pipelines.rs:662-735 update_pipeline_version): body
+        names an image by id, or omits it to track the tenant default.
+        A RUNNING pipeline is re-applied so the StatefulSet rolls to
+        the new image; a stopped one picks it up at next start."""
+        tenant = _require_tenant(req)
+        row = await _pipeline_row(req, tenant)
+        doc = await _json_body(req)
+        image_id = doc.get("image_id")
+        if image_id is not None:
+            try:
+                image_id = int(image_id)
+            except (TypeError, ValueError):
+                raise _json_error(400, "image_id must be an integer")
+            img = await state.fetch_owned("api_images", image_id, tenant)
+            if img is None:
+                raise _json_error(404, "image not found")
+            image_name = img[2]
+        else:
+            image_name = ""  # back to tracking the tenant default
+        await state.db.run(
+            "UPDATE api_pipelines SET image_name = ? WHERE id = ?",
+            (image_name, row[0]))
+        effective = image_name or await state.default_image(tenant)
+        st = await state.orchestrator.status(_int(row[0]))
+        rolled = False
+        if st.state not in ("stopped", "unknown"):
+            config = await state.pipeline_config(row)
+            await state.orchestrator.start_pipeline(ReplicatorSpec(
+                pipeline_id=_int(row[0]), tenant_id=tenant,
+                config=config, image=effective))
+            rolled = True
+        return web.json_response({
+            "id": _int(row[0]), "image": effective,
+            "pinned": bool(image_name), "rolled_out": rolled})
 
     async def pipeline_status(req: web.Request):
         tenant = _require_tenant(req)
-        row = _pipeline_row(req, tenant)
-        st = await state.orchestrator.status(row[0])
+        row = await _pipeline_row(req, tenant)
+        st = await state.orchestrator.status(_int(row[0]))
         return web.json_response({"pipeline_id": st.pipeline_id,
                                   "state": st.state, "detail": st.detail})
 
@@ -521,11 +593,11 @@ def build_app(state: ApiState) -> web.Application:
         """Table states from the pipeline's durable store
         (reference routes/pipelines.rs replication-status)."""
         tenant = _require_tenant(req)
-        row = _pipeline_row(req, tenant)
+        row = await _pipeline_row(req, tenant)
         store_path = row[6]
         if not store_path or not Path(store_path).exists():
             raise _json_error(404, "pipeline has no durable store")
-        store = SqliteStore(store_path, row[0])
+        store = SqliteStore(store_path, _int(row[0]))
         await store.connect()
         try:
             states = await store.get_table_states()
@@ -558,12 +630,13 @@ def build_app(state: ApiState) -> web.Application:
         from ..postgres.lag import query_slot_lag
         from ..postgres.wire import PgWireConnection
 
-        pid = pipeline_row[0]
+        pid = _int(pipeline_row[0])
         cached = _slot_lag_cache.get(pid)
         if cached is not None and _time.monotonic() - cached[0] \
                 < _SLOT_LAG_TTL_S:
             return cached[1]
-        src = state.fetch_owned("api_sources", pipeline_row[2], tenant)
+        src = await state.fetch_owned("api_sources",
+                                      _int(pipeline_row[2]), tenant)
         if src is None:
             return None
         try:
@@ -601,7 +674,7 @@ def build_app(state: ApiState) -> web.Application:
         """Repair op: reset errored tables to Init so they resync
         (reference routes/pipelines.rs:1372 rollback-tables)."""
         tenant = _require_tenant(req)
-        row = _pipeline_row(req, tenant)
+        row = await _pipeline_row(req, tenant)
         store_path = row[6]
         if not store_path or not Path(store_path).exists():
             raise _json_error(404, "pipeline has no durable store")
@@ -609,7 +682,7 @@ def build_app(state: ApiState) -> web.Application:
         table_ids = doc.get("table_ids")
         from ..postgres.slots import table_sync_slot_name
 
-        store = SqliteStore(store_path, row[0])
+        store = SqliteStore(store_path, _int(row[0]))
         await store.connect()
         try:
             states = await store.get_table_states()
@@ -623,7 +696,7 @@ def build_app(state: ApiState) -> web.Application:
                     # a stale sync-slot progress row would fence the fresh
                     # copy's catchup below its real position
                     await store.delete_durable_progress(
-                        table_sync_slot_name(row[0], tid))
+                        table_sync_slot_name(_int(row[0]), tid))
                     rolled.append({
                         "table_id": tid,
                         "previous_state": prior.type.value,
@@ -648,6 +721,7 @@ def build_app(state: ApiState) -> web.Application:
     r.add_post("/v1/pipelines/{id}/restart", restart_pipeline)
     r.add_get("/v1/pipelines/{id}/status", pipeline_status)
     r.add_get("/v1/pipelines/{id}/replication-status", replication_status)
+    r.add_post("/v1/pipelines/{id}/version", update_pipeline_version)
     r.add_post("/v1/pipelines/{id}/rollback-tables", rollback_tables)
     return app
 
@@ -815,6 +889,10 @@ OPENAPI_DOC["paths"] = {
     "/v1/pipelines/{id}/replication-status": {
         "get": _op("table states from the durable store + source slot lag",
                    params=_ID_PARAM, resp=_ref("ReplicationStatus"))},
+    "/v1/pipelines/{id}/version": {
+        "post": _op("pin the replicator image (or track the tenant "
+                    "default when image_id is omitted); rolls out a "
+                    "running pipeline", params=_ID_PARAM)},
     "/v1/pipelines/{id}/rollback-tables": {
         "post": _op("reset errored (or listed) tables for resync",
                     params=_ID_PARAM, body=_ref("RollbackRequest"),

@@ -493,11 +493,15 @@ class PostgresStore(_SqlStoreBase):
     @staticmethod
     def _is_broken(e: BaseException) -> bool:
         """Connection-level failures poison the wire framing; PG error
-        responses leave the connection reusable."""
+        responses leave the connection reusable. CANCELLATION is broken
+        too: a task cancelled mid-query abandons unread response frames
+        on the socket, and the next query on that connection would read
+        the stale ReadyForQuery and take the old query's rows."""
         import asyncio as aio
 
         return isinstance(e, (OSError, ConnectionError, EOFError,
-                              aio.IncompleteReadError))
+                              aio.IncompleteReadError,
+                              aio.CancelledError))
 
     async def _run_on(self, conn, sql: str,
                       params: tuple = ()) -> list[tuple]:

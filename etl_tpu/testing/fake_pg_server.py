@@ -459,7 +459,13 @@ class FakePgServer:
         sql = unqualify(sql)
         first = norm.split(" ", 1)[0].upper() if norm else ""
         is_txn = first in ("BEGIN", "COMMIT", "ROLLBACK") and " " not in norm
-        if not is_txn and not any(t in norm for t in STORE_TABLE_NAMES):
+        # the control-plane's api_* tables (api/db.py PostgresApiDb)
+        # ride the same embedded-sqlite path, flat names, no schema
+        # qualification — the API owns its own database in the reference
+        from ..api.db import API_TABLE_NAMES
+
+        if not is_txn and not any(t in norm for t in STORE_TABLE_NAMES
+                                  + API_TABLE_NAMES):
             return False
         if first == "ALTER" and ("SET SCHEMA etl" in norm
                                  or "RENAME TO" in norm):
@@ -471,8 +477,13 @@ class FakePgServer:
             w.write(_command_complete("ALTER TABLE"))
             w.write(READY)
             return True
-        if first not in ("CREATE", "INSERT", "UPDATE", "DELETE", "SELECT",
-                         "BEGIN", "COMMIT", "ROLLBACK"):
+        if first == "ALTER" and any(t in norm for t in API_TABLE_NAMES):
+            # api migrations use ALTER TABLE ... ADD COLUMN — pass it to
+            # the embedded sqlite (same dialect), duplicate-column errors
+            # surface for the client's idempotence check
+            pass
+        elif first not in ("CREATE", "INSERT", "UPDATE", "DELETE",
+                           "SELECT", "BEGIN", "COMMIT", "ROLLBACK"):
             return False
         db = self.db
         store = getattr(db, "_store_sql_db", None)

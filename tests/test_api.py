@@ -35,11 +35,48 @@ class StubOrchestrator(Orchestrator):
         return ReplicatorStatus(pipeline_id, state)
 
 
-async def make_client(tmp_path, orchestrator=None):
-    state = ApiState(str(tmp_path / "api.db"),
-                     ConfigCipher(EncryptionKey.generate()),
-                     orchestrator or StubOrchestrator())
-    client = TestClient(TestServer(build_app(state)))
+_BACKEND = "sqlite"
+
+
+@pytest.fixture(autouse=True, params=["sqlite", "postgres"])
+def api_backend(request):
+    """Every API test runs against BOTH storage backends (VERDICT r3
+    #10 — the reference API owns a Postgres database; the suite must
+    prove the same statement set works over the wire-client pool)."""
+    global _BACKEND
+    _BACKEND = request.param
+    yield request.param
+    _BACKEND = "sqlite"
+
+
+async def _make_db(tmp_path):
+    """(db-or-path, extra-cleanup) for the active backend."""
+    if _BACKEND == "postgres":
+        from etl_tpu.api.db import PostgresApiDb
+        from etl_tpu.config import PgConnectionConfig
+        from etl_tpu.postgres.fake import FakeDatabase
+        from etl_tpu.testing.fake_pg_server import FakePgServer
+
+        server = FakePgServer(FakeDatabase())
+        await server.start()
+        db = PostgresApiDb(PgConnectionConfig(
+            host="127.0.0.1", port=server.port,
+            name="postgres", username="etl"))
+        return db, server.stop
+    return str(tmp_path / "api.db"), None
+
+
+async def make_client(tmp_path, orchestrator=None, api_key=None):
+    db, cleanup = await _make_db(tmp_path)
+    state = ApiState(db, ConfigCipher(EncryptionKey.generate()),
+                     orchestrator or StubOrchestrator(), api_key=api_key)
+    app = build_app(state)
+    if cleanup is not None:
+        async def _stop(_app):
+            await cleanup()
+
+        app.on_cleanup.append(_stop)
+    client = TestClient(TestServer(app))
     await client.start_server()
     return client, state
 
@@ -80,8 +117,8 @@ class TestCrudAndTenancy:
             assert src["config"]["password"] == "********"
             assert src["config"]["host"] == "db"
             # raw row on disk is encrypted
-            raw = state.db.execute(
-                "SELECT config_enc FROM api_sources").fetchone()[0]
+            raw = (await state.db.run(
+                "SELECT config_enc FROM api_sources"))[0][0]
             assert "s3cret-password-42" not in raw
             env = json.loads(raw)
             assert set(env) == {"key_id", "nonce", "ciphertext"}
@@ -593,11 +630,7 @@ class TestSlotLagSurface:
 
 class TestAuth:
     async def test_bearer_key_required_when_configured(self, tmp_path):
-        state = ApiState(str(tmp_path / "api.db"),
-                         ConfigCipher(EncryptionKey.generate()),
-                         StubOrchestrator(), api_key="k-12345")
-        client = TestClient(TestServer(build_app(state)))
-        await client.start_server()
+        client, state = await make_client(tmp_path, api_key="k-12345")
         try:
             # no key → 401 before tenant routing
             r = await client.get("/v1/tenants", headers=H)
@@ -646,6 +679,66 @@ class TestImages:
             assert orch.specs[-1].image == "replicator:v3"
             assert (await client.delete(f"/v1/images/{v3['id']}",
                                         headers=H)).status == 204
+        finally:
+            await client.close()
+
+    async def test_pipeline_version_pins_and_rolls_out(self, tmp_path):
+        """POST /pipelines/{id}/version (reference
+        routes/pipelines.rs:662-735): pins the image the pipeline runs
+        independent of the tenant default, re-applies a RUNNING
+        pipeline so the rollout happens now, and reverts to
+        default-tracking when image_id is omitted."""
+        orch = StubOrchestrator()
+        client, _ = await make_client(tmp_path, orch)
+        try:
+            pid = await setup_pipeline(client)
+            await client.post("/v1/images", headers=H,
+                              json={"name": "replicator:v2",
+                                    "default": True})
+            v9 = await (await client.post(
+                "/v1/images", headers=H,
+                json={"name": "replicator:v9"})).json()
+
+            # stopped pipeline: version pin persists, no rollout yet
+            r = await (await client.post(
+                f"/v1/pipelines/{pid}/version", headers=H,
+                json={"image_id": v9["id"]})).json()
+            assert r == {"id": pid, "image": "replicator:v9",
+                         "pinned": True, "rolled_out": False}
+            got = await (await client.get(
+                f"/v1/pipelines/{pid}", headers=H)).json()
+            assert got["image"] == "replicator:v9"
+            # start uses the PIN, not the default
+            await client.post(f"/v1/pipelines/{pid}/start", headers=H)
+            assert orch.specs[-1].image == "replicator:v9"
+
+            # running pipeline: a version change rolls out immediately
+            r = await (await client.post(
+                f"/v1/pipelines/{pid}/version", headers=H,
+                json={})).json()
+            assert r["pinned"] is False and r["rolled_out"] is True
+            assert r["image"] == "replicator:v2"  # back on the default
+            assert orch.specs[-1].image == "replicator:v2"
+
+            # unknown image → 404; non-int → 400
+            assert (await client.post(
+                f"/v1/pipelines/{pid}/version", headers=H,
+                json={"image_id": 999})).status == 404
+            assert (await client.post(
+                f"/v1/pipelines/{pid}/version", headers=H,
+                json={"image_id": "vX"})).status == 400
+
+            # deleting an image a pipeline PINS → 409 (a silent delete
+            # would leave the pipeline deploying an unregistered name);
+            # unpin → delete succeeds
+            await client.post(f"/v1/pipelines/{pid}/version", headers=H,
+                              json={"image_id": v9["id"]})
+            assert (await client.delete(
+                f"/v1/images/{v9['id']}", headers=H)).status == 409
+            await client.post(f"/v1/pipelines/{pid}/version", headers=H,
+                              json={})
+            assert (await client.delete(
+                f"/v1/images/{v9['id']}", headers=H)).status == 204
         finally:
             await client.close()
 
