@@ -370,15 +370,49 @@ class TestPallasKernel:
 
     def test_pallas_matches_xla(self):
         oids = [Oid.INT4, Oid.INT8, Oid.DATE, Oid.TIMESTAMPTZ]
+        # tz forms cover every _parse_tz_at branch: hours-only, :MM,
+        # :MM:SS, and negative offsets (PG renders IST as +05:30)
+        tzs = ["+0{h}", "-0{h}", "+0{h}:30", "-0{h}:30", "+0{h}:30:15"]
         rows = []
         for i in range(256):
+            tz = tzs[i % len(tzs)].format(h=i % 9)
             rows.append([str(i - 128), str(rng.randrange(-2**62, 2**62)),
                          f"20{i % 100:02d}-03-{1 + i % 28:02d}",
-                         f"2024-05-01 12:{i % 60:02d}:33.25+0{i % 9}"])
+                         f"2024-05-01 12:{i % 60:02d}:33.25{tz}"])
         schema = make_schema(oids)
         staged = stage_tuples(tuples_from_texts(rows), len(oids))
         a = DeviceDecoder(schema, device_min_rows=0).decode(staged)
         b = DeviceDecoder(schema, use_pallas=True, device_min_rows=0).decode(staged)
+        assert_batches_equal(a, b)
+
+    def test_pallas_matches_xla_float_time_bool(self):
+        """The lane-packed kernel's float/time/bool paths against XLA —
+        including exponent forms, fractional-second runs, and specials
+        (which fall to the CPU oracle identically on both engines)."""
+        oids = [Oid.BOOL, Oid.INT2, Oid.FLOAT4, Oid.FLOAT8, Oid.TIME,
+                Oid.TIMESTAMP]
+        rows = []
+        floats = ["1.5", "-0.25", "3e4", "-2.5E-3", "0.0001", "12345.678",
+                  "NaN", "Infinity", "-Infinity", "1e30", "7", "-0"]
+        # 1e300 only on FLOAT8: the FLOAT4 cpu-fixup cast would emit a
+        # numpy overflow RuntimeWarning (inf result, parity unaffected)
+        floats8 = floats[:-3] + ["1e300"] + floats[-2:]
+        for i in range(256):
+            rows.append([
+                "t" if i % 2 else "f",
+                str(i - 128),
+                floats[i % len(floats)],
+                floats8[(i + 5) % len(floats8)],
+                f"{i % 24:02d}:{i % 60:02d}:{(i * 7) % 60:02d}"
+                + ("" if i % 3 == 0 else f".{i % 1_000_000:06d}"[:1 + i % 7]),
+                f"19{i % 100:02d}-11-{1 + i % 28:02d} "
+                f"{i % 24:02d}:00:{i % 60:02d}",
+            ])
+        schema = make_schema(oids)
+        staged = stage_tuples(tuples_from_texts(rows), len(oids))
+        a = DeviceDecoder(schema, device_min_rows=0).decode(staged)
+        b = DeviceDecoder(schema, use_pallas=True,
+                          device_min_rows=0).decode(staged)
         assert_batches_equal(a, b)
 
 
