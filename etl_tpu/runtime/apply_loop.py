@@ -432,8 +432,18 @@ class ApplyLoop:
             # messages don't count toward transaction size
             registry.histogram_observe(ETL_TRANSACTION_SIZE_BYTES,
                                        st.tx_bytes)
-            # a commit closes the unit destinations can make durable;
-            # size check happens in _maybe_dispatch_flush
+            # idle-commit fast path: with no write in flight, flushing AT
+            # the commit boundary cuts p50 replication lag by the whole
+            # fill window (an idle pipeline has nothing to batch FOR);
+            # under load the one-in-flight rule keeps later commits
+            # coalescing into full batches, so throughput is unaffected.
+            # Keyed on ROW events, not len(assembler): commits of
+            # unowned-table transactions (whose CPU-engine Begin/Commit
+            # controls still land in the assembler) stay on the deadline
+            # path — an immediate flush per such commit would write
+            # durable progress per commit instead of per fill window.
+            if self._in_flight is None and self.assembler.row_events:
+                self._maybe_dispatch_flush(force=True)
         elif isinstance(msg, pgoutput.RelationMessage):
             schema = event_codec.schema_from_relation_message(msg)
             prev = self.cache.get(msg.relation_id)

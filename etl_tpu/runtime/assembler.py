@@ -60,6 +60,12 @@ class EventAssembler:
         self._run: _Run | None = None
         self._decoders: dict[TableId, DeviceDecoder] = {}
         self.size_bytes = 0
+        # row (non-control) events in the open window: the apply loop's
+        # idle-commit fast flush keys on this — control-only windows
+        # (CPU-engine Begin/Commit of unowned-table transactions) must
+        # stay on the deadline path or durable progress would be written
+        # once per commit instead of once per fill window
+        self.row_events = 0
 
     def __len__(self) -> int:
         return len(self._events) + (len(self._run.payloads) if self._run else 0)
@@ -88,6 +94,7 @@ class EventAssembler:
         r.commit_lsns.append(int(commit_lsn))
         r.tx_ordinals.append(tx_ordinal)
         self.size_bytes += 64 + len(payload)
+        self.row_events += 1
         if len(r.payloads) >= RUN_SEAL_ROWS:
             self._seal_run()
 
@@ -115,6 +122,7 @@ class EventAssembler:
         r.tx_ordinals.extend(range(tx_ordinal0, tx_ordinal0 + k))
         nbytes = sum(map(len, payloads))
         self.size_bytes += 64 * k + nbytes
+        self.row_events += k
         if len(r.payloads) >= RUN_SEAL_ROWS:
             self._seal_run()
         return nbytes
@@ -138,6 +146,7 @@ class EventAssembler:
                                f"not a row message: {type(msg).__name__}")
             self._events.append(ev)
             self.size_bytes += 64 + len(payload)
+            self.row_events += 1
             return
         # TPU path: defer decode, accumulate raw payloads
         self.push_raw_row(payload, schema, start_lsn, commit_lsn, tx_ordinal)
@@ -186,4 +195,5 @@ class EventAssembler:
         events = self._events
         self._events = []
         self.size_bytes = 0
+        self.row_events = 0
         return events

@@ -41,11 +41,12 @@ def make_db() -> FakeDatabase:
 
 
 def make_pipeline(db, store=None, destination=None, engine=BatchEngine.TPU,
-                  **cfg):
+                  batch=None, **cfg):
     config = PipelineConfig(
         pipeline_id=1, publication_name="pub",
-        batch=BatchConfig(max_size_bytes=256 * 1024, max_fill_ms=50,
-                          batch_engine=engine),
+        batch=batch if batch is not None else
+        BatchConfig(max_size_bytes=256 * 1024, max_fill_ms=50,
+                    batch_engine=engine),
         **cfg)
     store = store if store is not None else NotifyingStore()
     destination = destination if destination is not None else MemoryDestination()
@@ -72,6 +73,24 @@ class TestInitialCopyAndCdc:
         from etl_tpu.models import PgNumeric
         assert [tuple(r.values) for r in dest.table_rows[ORDERS]] == \
             [(10, PgNumeric("9.99"))]
+        await pipeline.shutdown_and_wait()
+
+    async def test_idle_commit_flushes_before_fill_window(self):
+        """Idle-commit fast path: with no write in flight, a commit
+        boundary flushes IMMEDIATELY — an idle pipeline must not sit on
+        a committed transaction for the whole fill window (here 5s; the
+        wait below would time out if the deadline were the trigger)."""
+        db = make_db()
+        pipeline, store, dest = make_pipeline(
+            db, batch=BatchConfig(max_size_bytes=256 * 1024,
+                                  max_fill_ms=5000,
+                                  batch_engine=BatchEngine.TPU))
+        await pipeline.start()
+        await wait_ready(store, ACCOUNTS)
+        async with db.transaction() as tx:
+            tx.insert(ACCOUNTS, ["42", "instant", "1"])
+        await asyncio.wait_for(
+            _wait_for(lambda: 42 in _account_ids(dest)), 2.0)
         await pipeline.shutdown_and_wait()
 
     async def test_cdc_after_ready(self):
