@@ -1,0 +1,149 @@
+"""Pinned-snapshot route tests (reference: etl-api's insta snapshot
+suites, crates/etl-api/tests — 9.2k LoC of pinned route responses).
+
+Each case drives a route and compares the FULL response document
+(status + body) against a snapshot committed under tests/snapshots/.
+Any change to a response shape — field added, renamed, re-typed,
+status changed — fails until the snapshot is re-pinned, making API
+surface drift an explicit, reviewed event instead of an accident.
+
+Re-pin intentionally with:  UPDATE_SNAPSHOTS=1 pytest tests/test_api_snapshots.py
+
+The suite runs on BOTH storage backends (the autouse fixture in
+test_api.py does not apply here; this module pins shape parity
+explicitly): a response that differs between sqlite and Postgres is a
+bug by definition, so both backends must match the same snapshot.
+"""
+
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from tests.test_api import H, StubOrchestrator, make_client
+
+SNAP_DIR = Path(__file__).parent / "snapshots"
+
+
+@pytest.fixture(params=["sqlite", "postgres"])
+def api_backend(request):
+    import tests.test_api as ta
+
+    old = ta._BACKEND
+    ta._BACKEND = request.param
+    yield request.param
+    ta._BACKEND = old
+
+
+def assert_snapshot(name: str, doc) -> None:
+    path = SNAP_DIR / f"{name}.json"
+    rendered = json.dumps(doc, indent=2, sort_keys=True)
+    if os.environ.get("UPDATE_SNAPSHOTS", "0") not in ("", "0", "false"):
+        SNAP_DIR.mkdir(exist_ok=True)
+        if path.exists():
+            # re-pin runs parameterize over BOTH backends: the second
+            # backend must MATCH what the first just wrote, not silently
+            # overwrite it — a divergence is a bug, even mid-re-pin
+            assert json.loads(path.read_text()) == doc, (
+                f"backends disagree while re-pinning {path.name}:\n"
+                f"{rendered}")
+            return
+        path.write_text(rendered + "\n")
+        return
+    assert path.exists(), \
+        f"missing snapshot {path.name}; run with UPDATE_SNAPSHOTS=1"
+    pinned = json.loads(path.read_text())
+    assert doc == pinned, (
+        f"response drifted from snapshot {path.name}\n"
+        f"got:     {rendered}\n"
+        f"pinned:  {json.dumps(pinned, indent=2, sort_keys=True)}")
+
+
+async def snap(name, resp):
+    text = await resp.text()
+    body = json.loads(text) \
+        if text and resp.content_type == "application/json" else text
+    assert_snapshot(name, {"status": resp.status, "body": body})
+
+
+class TestRouteSnapshots:
+    async def test_full_surface(self, tmp_path, api_backend):
+        client, _ = await make_client(tmp_path, StubOrchestrator())
+        try:
+            await snap("tenant_create", await client.post(
+                "/v1/tenants", json={"id": "acme", "name": "Acme"}))
+            await snap("tenant_conflict", await client.post(
+                "/v1/tenants", json={"id": "acme", "name": "Acme"}))
+            await snap("tenant_missing_header",
+                       await client.get("/v1/sources"))
+
+            await snap("source_create", await client.post(
+                "/v1/sources", headers=H,
+                json={"name": "prod", "config": {
+                    "host": "db", "port": 5432, "name": "app",
+                    "username": "etl", "password": "pw-1234567"}}))
+            await snap("source_invalid_config", await client.post(
+                "/v1/sources", headers=H,
+                json={"name": "bad", "config": {"port": "nope"}}))
+            await snap("source_get_masks_secrets",
+                       await client.get("/v1/sources/1", headers=H))
+            await snap("source_404",
+                       await client.get("/v1/sources/99", headers=H))
+
+            await snap("destination_create", await client.post(
+                "/v1/destinations", headers=H,
+                json={"name": "lake", "config": {
+                    "type": "lake", "warehouse_path": "/tmp/wh"}}))
+
+            await snap("pipeline_create", await client.post(
+                "/v1/pipelines", headers=H,
+                json={"source_id": 1, "destination_id": 1,
+                      "publication_name": "pub"}))
+            await snap("pipeline_get",
+                       await client.get("/v1/pipelines/1", headers=H))
+            await snap("pipeline_list",
+                       await client.get("/v1/pipelines", headers=H))
+            await snap("pipeline_missing_source", await client.post(
+                "/v1/pipelines", headers=H,
+                json={"source_id": 77, "destination_id": 1,
+                      "publication_name": "pub"}))
+
+            await snap("image_create", await client.post(
+                "/v1/images", headers=H,
+                json={"name": "repl:v1", "default": True}))
+            await snap("image_list",
+                       await client.get("/v1/images", headers=H))
+
+            await snap("pipeline_start", await client.post(
+                "/v1/pipelines/1/start", headers=H))
+            await snap("pipeline_status",
+                       await client.get("/v1/pipelines/1/status",
+                                        headers=H))
+            await snap("pipeline_version_pin", await client.post(
+                "/v1/pipelines/1/version", headers=H,
+                json={"image_id": 1}))
+            await snap("image_delete_pinned", await client.delete(
+                "/v1/images/1", headers=H))
+            await snap("pipeline_version_unpin", await client.post(
+                "/v1/pipelines/1/version", headers=H, json={}))
+            await snap("pipeline_stop", await client.post(
+                "/v1/pipelines/1/stop", headers=H))
+            await snap("replication_status_no_store", await client.get(
+                "/v1/pipelines/1/replication-status", headers=H))
+            await snap("source_delete_in_use",
+                       await client.delete("/v1/sources/1", headers=H))
+            await snap("pipeline_delete",
+                       await client.delete("/v1/pipelines/1", headers=H))
+        finally:
+            await client.close()
+
+    async def test_openapi_document_pinned(self, tmp_path, api_backend):
+        """The whole API surface, pinned: any route/schema addition or
+        removal must re-pin this snapshot (surface drift is reviewed,
+        not accidental)."""
+        client, _ = await make_client(tmp_path, StubOrchestrator())
+        try:
+            await snap("openapi", await client.get("/openapi.json"))
+        finally:
+            await client.close()
