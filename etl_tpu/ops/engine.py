@@ -426,6 +426,24 @@ class DeviceDecoder:
             dev = _host_cpu_device()
             bmat = jax.device_put(bmat, dev)
             lengths = jax.device_put(lengths, dev)
+        if self.use_pallas and not host:
+            from .pallas_kernel import MAX_TOTAL_WIDTH, pallas_supported
+
+            if not pallas_supported(specs):
+                # wide schemas overflow the Mosaic compiler's appetite
+                # for the unrolled parse chain (MAX_TOTAL_WIDTH) — take
+                # the XLA program without a doomed remote-compile
+                # attempt. Flipping the FLAG (not silently routing)
+                # keeps bench/harness engine labels honest: they report
+                # which engine actually ran via use_pallas.
+                import logging
+
+                logging.getLogger("etl_tpu.ops").info(
+                    "schema too wide for the pallas kernel "
+                    "(total gather width %d > %d); using the XLA program",
+                    sum(widths), MAX_TOTAL_WIDTH)
+                self.use_pallas = False
+                self._fn_cache.clear()
         use_mesh = not host and self._use_mesh(staged.row_capacity)
         key = (staged.row_capacity, specs, nibble, use_mesh, host)
         fn = self._fn_cache.get(key)

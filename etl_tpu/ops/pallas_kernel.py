@@ -41,6 +41,23 @@ from .parsers_lanes import parse_column_lanes, unpack_nibbles_lanes
 # dense columns.
 DEFAULT_BLOCK_ROWS = 2048
 
+# The fully-unrolled parse chain crashes the Mosaic compiler
+# (tpu_compile_helper exit 1) once the kernel body grows past ~150
+# unrolled byte POSITIONS (sum of column widths — nibble packing halves
+# the gathered bytes but not the positions, so the cap is width-based)
+# — measured on v5e: 12 x 12-byte int columns (144 positions) compile,
+# 14 (168) kill the compiler. Wide schemas take the XLA program instead:
+# engine._device_call consults pallas_supported BEFORE building and
+# flips the decoder's use_pallas flag, so no doomed remote-compile
+# attempt happens and engine labels stay honest.
+MAX_TOTAL_WIDTH = 144
+
+
+def pallas_supported(specs) -> bool:
+    if jax.default_backend() != "tpu":
+        return True  # interpret mode — no Mosaic, nothing to crash
+    return sum(w for _, _, w, _ in specs) <= MAX_TOTAL_WIDTH
+
 
 def build_pallas_program(specs: tuple[tuple[int, CellKind, int, int], ...],
                          nibble: bool = False,
