@@ -141,6 +141,16 @@ def build_device_program(specs: tuple[tuple[int, CellKind, int, int], ...],
     return fn
 
 
+# jitted decode programs shared across ALL DeviceDecoder instances (one
+# is created per table and per copy partition; without sharing, each
+# re-pays the 10-40s XLA/Mosaic compile for an identical program).
+# Bounded FIFO: long-running processes with schema churn must not pin
+# executables for dropped tables forever — past the cap the oldest
+# entry is evicted (worst case: a rare recompile, never a leak).
+_SHARED_FN_CACHE: dict = {}
+_SHARED_FN_CACHE_MAX = 64
+
+
 def _build_device_fn(specs, nibble: bool = False, use_pallas: bool = False,
                      mesh=None):
     if mesh is not None:
@@ -245,8 +255,10 @@ def _host_cpu_device():
 
 
 class DeviceDecoder:
-    """Schema-bound batch decoder. jit caches are per-instance, keyed by
-    (row_capacity, width-signature)."""
+    """Schema-bound batch decoder. Jitted programs live in the
+    module-level _SHARED_FN_CACHE keyed by (row_capacity, specs, nibble,
+    mesh, pallas, host) — shared across instances; each decoder keeps a
+    record of the keys it used (`_fn_cache`) for compile-count tests."""
 
     # below this row count the device round trip (latency-bound) loses to
     # the host paths; small CDC flushes decode on host, WAL bursts and
@@ -308,6 +320,9 @@ class DeviceDecoder:
             for spec in self._dense[250:]:
                 self._object.append(spec)
             self._dense = self._dense[:250]
+        # record of the programs THIS decoder used (tests pin per-
+        # decoder compile-count invariants on it); the fns themselves
+        # live in the module-level _SHARED_FN_CACHE
         self._fn_cache: dict[tuple, Callable] = {}
         self._host_specs_cache: tuple | None = None
 
@@ -443,15 +458,23 @@ class DeviceDecoder:
                     "(total gather width %d > %d); using the XLA program",
                     sum(widths), MAX_TOTAL_WIDTH)
                 self.use_pallas = False
-                self._fn_cache.clear()
         use_mesh = not host and self._use_mesh(staged.row_capacity)
-        key = (staged.row_capacity, specs, nibble, use_mesh, host)
-        fn = self._fn_cache.get(key)
+        # the program cache is MODULE-level: decoders are created per
+        # table and per copy partition, and identical (bucket, specs)
+        # programs across instances must not recompile — the engine flag
+        # rides in the key, so a pallas fallback just stops selecting
+        # the pallas entries instead of clearing anything
+        pallas = self.use_pallas and not host
+        key = (staged.row_capacity, specs, nibble,
+               self.mesh if use_mesh else None, pallas, host)
+        fn = _SHARED_FN_CACHE.get(key)
         if fn is None:
-            fn = _build_device_fn(specs, nibble,
-                                  self.use_pallas and not host,
+            fn = _build_device_fn(specs, nibble, pallas,
                                   mesh=self.mesh if use_mesh else None)
-            self._fn_cache[key] = fn
+            _SHARED_FN_CACHE[key] = fn
+            while len(_SHARED_FN_CACHE) > _SHARED_FN_CACHE_MAX:
+                _SHARED_FN_CACHE.pop(next(iter(_SHARED_FN_CACHE)))
+        self._fn_cache[key] = fn
         try:
             return fn(bmat, lengths), bad_rows  # async dispatch
         except Exception:
@@ -469,7 +492,6 @@ class DeviceDecoder:
                 "pallas kernel failed to compile; falling back to XLA",
                 exc_info=True)
             self.use_pallas = False
-            self._fn_cache.clear()
             return self._device_call(staged, specs)
 
     def _gather_string_arrow(self, staged: StagedBatch, spec: _ColSpec,
