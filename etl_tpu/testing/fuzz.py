@@ -187,12 +187,74 @@ def fuzz_framer(rng: random.Random, _ignored=None) -> None:
             "framer emitted out-of-bounds field"
 
 
+_AVRO_FUZZ_DIR: str | None = None  # one temp dir per process, not per case
+
+
+def fuzz_avro_ocf(rng: random.Random, _ignored=None) -> None:
+    """The Iceberg metadata pair: random manifest-shaped records through
+    the OCF writer must round-trip EXACTLY through the independent
+    reader (they share no code — VERDICT r3 #5), and bit-flipped files
+    must raise cleanly (ValueError/EOF-shaped), never hang or emit
+    silently-wrong records."""
+    import tempfile
+    from pathlib import Path
+
+    from ..destinations.iceberg_meta import write_avro_ocf
+    from .avro_reader import read_avro_ocf
+
+    global _AVRO_FUZZ_DIR
+    if _AVRO_FUZZ_DIR is None:
+        _AVRO_FUZZ_DIR = tempfile.mkdtemp(prefix="avro_fuzz_")
+
+    schema = {"type": "record", "name": "r", "fields": [
+        {"name": "s", "type": "string"},
+        {"name": "n", "type": "long"},
+        {"name": "ob", "type": ["null", "bytes"]},
+        {"name": "arr", "type": {"type": "array", "items": {
+            "type": "record", "name": "kv", "fields": [
+                {"name": "key", "type": "int"},
+                {"name": "value", "type": "bytes"}]}}},
+        {"name": "flag", "type": "boolean"},
+    ]}
+    records = []
+    for _ in range(rng.randint(0, 6)):
+        records.append({
+            "s": "".join(chr(rng.randrange(32, 0x2FF))
+                         for _ in range(rng.randint(0, 12))),
+            "n": rng.randrange(-(1 << 62), 1 << 62),
+            "ob": None if rng.random() < 0.3 else
+            bytes(rng.randrange(256) for _ in range(rng.randint(0, 9))),
+            "arr": [{"key": rng.randrange(1 << 20),
+                     "value": bytes(rng.randrange(256) for _ in
+                                    range(rng.randint(0, 5)))}
+                    for _ in range(rng.randint(0, 3))],
+            "flag": rng.random() < 0.5,
+        })
+    path = Path(_AVRO_FUZZ_DIR) / "f.avro"
+    write_avro_ocf(path, schema, records)
+    _, got, _ = read_avro_ocf(path)
+    assert got == records, (got, records)
+    # corruption: any single bit flip must raise ValueError (the
+    # reader's one rejection type; UnicodeDecodeError is its subclass)
+    # or KeyError/TypeError from a corrupt-but-valid-JSON schema — or
+    # parse to something that simply differs. AssertionError stays
+    # UNCAUGHT so consistency checks inside this block keep reporting.
+    raw = bytearray(path.read_bytes())
+    raw[rng.randrange(len(raw))] ^= 1 << rng.randrange(8)
+    path.write_bytes(bytes(raw))
+    try:
+        read_avro_ocf(path)
+    except (ValueError, KeyError, TypeError, RecursionError):
+        pass  # typed rejection is the contract
+
+
 TARGETS = {
     "parse_text_cell": fuzz_parse_text_cell,
     "parse_copy_row": fuzz_parse_copy_row,
     "numeric_roundtrip": fuzz_numeric_roundtrip,
     "bytea_hex": fuzz_bytea_hex,
     "framer": fuzz_framer,
+    "avro_ocf": fuzz_avro_ocf,
 }
 
 
