@@ -19,6 +19,7 @@ CI-sized runs live in tests/test_fuzz.py.
 from __future__ import annotations
 
 import random
+import struct
 import time
 
 from ..models.errors import EtlError
@@ -248,6 +249,67 @@ def fuzz_avro_ocf(rng: random.Random, _ignored=None) -> None:
         pass  # typed rejection is the contract
 
 
+def fuzz_pb_append_rows(rng: random.Random, _ignored=None) -> None:
+    """The BigQuery protobuf pair: random AppendRowsRequest bytes decoded
+    by BOTH in-repo decoders — the generic TLV one (bq_proto) and the
+    spec-written independent one (pb_reader, which shares no code) —
+    must agree field-for-field; bit-flipped requests must reject typed
+    or parse to something that differs, never hang."""
+    from ..destinations import bq_proto
+    from ..models.cell import PgNumeric
+    from ..models.pgtypes import Oid
+    from ..models.schema import (ColumnSchema, ReplicatedTableSchema,
+                                 TableName, TableSchema)
+    from .pb_reader import decode_append_rows
+
+    kinds = [(Oid.INT4, lambda: rng.randrange(-(1 << 31), 1 << 31)),
+             (Oid.INT8, lambda: rng.randrange(-(1 << 62), 1 << 62)),
+             (Oid.TEXT, lambda: "".join(chr(rng.randrange(32, 0x24F))
+                                        for _ in range(rng.randint(0, 9)))),
+             (Oid.BOOL, lambda: rng.random() < 0.5),
+             (Oid.FLOAT8, lambda: rng.uniform(-1e12, 1e12)),
+             (Oid.NUMERIC, lambda: PgNumeric(str(rng.randrange(10 ** 12))))]
+    ncols = rng.randint(1, 5)
+    chosen = [kinds[rng.randrange(len(kinds))] for _ in range(ncols)]
+    schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+        999, TableName("public", "fz"),
+        tuple(ColumnSchema(f"c{i}", oid, nullable=True,
+                           primary_key_ordinal=1 if i == 0 else None)
+              for i, (oid, _) in enumerate(chosen))))
+    rows = []
+    for r in range(rng.randint(1, 4)):
+        values = [None if rng.random() < 0.25 else gen()
+                  for _, gen in chosen]
+        rows.append(bq_proto.encode_row(schema, values, "UPSERT",
+                                        f"{r:016x}"))
+    buf = bq_proto.append_rows_request(
+        "projects/p/datasets/d/tables/t/streams/_default",
+        bq_proto.row_descriptor(schema), rows, trace_id="fz",
+        offset=rng.randrange(1 << 40) if rng.random() < 0.5 else None)
+    ind = decode_append_rows(buf)
+    own = bq_proto.decode_append_rows_request(buf)
+    assert ind["write_stream"] == own.write_stream
+    assert ind["trace_id"] == own.trace_id
+    assert ind.get("offset") == own.offset
+    # full descriptor agreement: (name, number, label, type) 4-tuples
+    assert [(f["name"], f["number"], f["label"], f["type"])
+            for f in ind["descriptor"]["fields"]] == \
+        list(own.descriptor_fields)
+    assert len(ind["rows"]) == len(own.serialized_rows) == len(rows)
+    # row VALUES decoded by both lineages must agree field-for-field —
+    # this is the assertion that actually breaks the encode/decode
+    # self-confirmation loop for payloads
+    assert ind["rows"] == own.decode_rows(), (ind["rows"],
+                                              own.decode_rows())
+    # corruption: one bit flip → typed rejection or a differing parse
+    raw = bytearray(buf)
+    raw[rng.randrange(len(raw))] ^= 1 << rng.randrange(8)
+    try:
+        decode_append_rows(bytes(raw))
+    except (ValueError, KeyError):
+        pass  # typed rejection is the contract
+
+
 TARGETS = {
     "parse_text_cell": fuzz_parse_text_cell,
     "parse_copy_row": fuzz_parse_copy_row,
@@ -255,6 +317,7 @@ TARGETS = {
     "bytea_hex": fuzz_bytea_hex,
     "framer": fuzz_framer,
     "avro_ocf": fuzz_avro_ocf,
+    "pb_append_rows": fuzz_pb_append_rows,
 }
 
 

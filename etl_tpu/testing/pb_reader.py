@@ -28,6 +28,8 @@ def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
     result = 0
     shift = 0
     while True:
+        if i >= len(buf):
+            raise ValueError("pb: truncated varint")
         b = buf[i]
         i += 1
         result |= (b & 0x7F) << shift
@@ -50,13 +52,19 @@ def scan(buf: bytes):
             v, i = _read_varint(buf, i)
             yield field_no, 0, v
         elif wire == 1:
+            if i + 8 > n:
+                raise ValueError("pb: truncated fixed64")
             yield field_no, 1, buf[i : i + 8]
             i += 8
         elif wire == 2:
             ln, i = _read_varint(buf, i)
+            if ln < 0 or i + ln > n:
+                raise ValueError("pb: truncated LEN field")
             yield field_no, 2, buf[i : i + ln]
             i += ln
         elif wire == 5:
+            if i + 4 > n:
+                raise ValueError("pb: truncated fixed32")
             yield field_no, 5, buf[i : i + 4]
             i += 4
         else:
@@ -86,13 +94,13 @@ def parse_descriptor(buf: bytes) -> dict:
         elif fno == 2 and wire == 2:
             f = {"name": "", "number": 0, "label": 1, "type": 0}
             for ffno, fwire, fval in scan(val):
-                if ffno == 1:
+                if ffno == 1 and fwire == 2:
                     f["name"] = fval.decode()
-                elif ffno == 3:
+                elif ffno == 3 and fwire == 0:
                     f["number"] = fval
-                elif ffno == 4:
+                elif ffno == 4 and fwire == 0:
                     f["label"] = fval
-                elif ffno == 5:
+                elif ffno == 5 and fwire == 0:
                     f["type"] = fval
             fields.append(f)
     return {"name": name, "fields": fields}
@@ -105,6 +113,14 @@ _REPEATED = 3
 
 
 def _decode_scalar(ftype: int, wire: int, val):
+    # wire/type agreement: a corrupted tag can deliver the wrong wire
+    # type for the declared field type — reject typed, never
+    # AttributeError/struct.error into the caller
+    expected_wire = {_DOUBLE: 1, _FLOAT: 5, _STRING: 2, _BYTES: 2}.get(
+        ftype, 0)
+    if wire != expected_wire:
+        raise ValueError(
+            f"pb: wire type {wire} mismatches declared type {ftype}")
     if ftype == _DOUBLE:
         return struct.unpack("<d", val)[0]
     if ftype == _FLOAT:
@@ -165,18 +181,18 @@ def decode_append_rows(buf: bytes) -> dict:
         if fno == 1 and wire == 2:
             out["write_stream"] = val.decode()
         elif fno == 2 and wire == 2:  # Int64Value wrapper
-            for wfno, _, wval in scan(val):
-                if wfno == 1:
+            for wfno, wwire, wval in scan(val):
+                if wfno == 1 and wwire == 0:
                     out["offset"] = _to_int64(wval)
         elif fno == 4 and wire == 2:  # ProtoData
-            for pfno, _, pval in scan(val):
-                if pfno == 1:  # ProtoSchema
-                    for sfno, _, sval in scan(pval):
-                        if sfno == 1:
+            for pfno, pwire, pval in scan(val):
+                if pfno == 1 and pwire == 2:  # ProtoSchema
+                    for sfno, swire, sval in scan(pval):
+                        if sfno == 1 and swire == 2:
                             out["descriptor"] = parse_descriptor(sval)
-                elif pfno == 2:  # ProtoRows
-                    for rfno, _, rval in scan(pval):
-                        if rfno == 1:
+                elif pfno == 2 and pwire == 2:  # ProtoRows
+                    for rfno, rwire, rval in scan(pval):
+                        if rfno == 1 and rwire == 2:
                             serialized_rows.append(rval)
         elif fno == 6 and wire == 2:
             out["trace_id"] = val.decode()
