@@ -19,7 +19,6 @@ CI-sized runs live in tests/test_fuzz.py.
 from __future__ import annotations
 
 import random
-import struct
 import time
 
 from ..models.errors import EtlError

@@ -58,7 +58,7 @@ def scan(buf: bytes):
             i += 8
         elif wire == 2:
             ln, i = _read_varint(buf, i)
-            if ln < 0 or i + ln > n:
+            if i + ln > n:
                 raise ValueError("pb: truncated LEN field")
             yield field_no, 2, buf[i : i + ln]
             i += ln
@@ -153,6 +153,9 @@ def decode_row(buf: bytes, descriptor: dict) -> dict:
             if wire == 2 and f["type"] in (_DOUBLE, _FLOAT, _INT64,
                                            _INT32, _BOOL, _UINT32):
                 # packed encoding
+                width = {_DOUBLE: 8, _FLOAT: 4}.get(f["type"], 0)
+                if width and len(val) % width:
+                    raise ValueError("pb: truncated packed payload")
                 i = 0
                 while i < len(val):
                     if f["type"] == _DOUBLE:
