@@ -309,6 +309,70 @@ def fuzz_pb_append_rows(rng: random.Random, _ignored=None) -> None:
         pass  # typed rejection is the contract
 
 
+def fuzz_snowpipe_batches(rng: random.Random, _ignored=None) -> None:
+    """The Snowpipe streaming-zstd batch builder: random NDJSON rows
+    through RowBatchBuilder must re-decode EXACTLY (independent path:
+    zstandard decompressor + stdlib json, none of the builder's chunking
+    logic) with rows in order across batch splits, correct per-batch row
+    counts and offset ranges, and every batch under the API body limit.
+    Non-finite floats must reject typed."""
+    import json as _json
+
+    import zstandard
+
+    from ..destinations.snowpipe import MAX_COMPRESSED_BYTES, RowBatchBuilder
+
+    b = RowBatchBuilder()
+    docs = []
+    # ~5% of cases feed high-entropy megabyte rows so the compressed
+    # stream passes BATCH_SPLIT_THRESHOLD and the mid-stream split path
+    # (row order across batches, second batch's offset range) is REALLY
+    # exercised, not vacuously skipped
+    split_case = rng.random() < 0.05
+    gens = [lambda: rng.randrange(-(1 << 60), 1 << 60),
+            lambda: "".join(chr(rng.randrange(32, 0x2FF))
+                            for _ in range(rng.randint(0, 2000))),
+            lambda: None, lambda: rng.random() * 1e6,
+            lambda: rng.random() < 0.5,
+            lambda: {"nested": [1, "x", None]}]
+    n = rng.randint(8, 12) if split_case else rng.randint(1, 40)
+    for i in range(n):
+        # split rows: 512KB of random bytes → 1MB hex, safely under the
+        # 2MB per-row limit; ~4 bits/char entropy keeps zstd near 2:1 so
+        # ~8 rows pass the 3.8MB compressed split threshold
+        v = rng.randbytes(512 << 10).hex() if split_case \
+            else rng.choice(gens)()
+        doc = {"id": i, "v": v, "_cdc_sequence_number": f"{i:016x}"}
+        b.push_row(doc, f"{i:016x}")
+        docs.append(doc)
+    batches = b.finish()
+    if split_case:
+        assert len(batches) >= 2, \
+            f"split case produced {len(batches)} batch(es)"
+    dctx = zstandard.ZstdDecompressor()
+    got = []
+    row_total = 0
+    for rb in batches:
+        assert len(rb.data) <= MAX_COMPRESSED_BYTES
+        lines = dctx.decompressobj().decompress(rb.data).split(b"\n")
+        rows = [_json.loads(l) for l in lines if l]
+        assert len(rows) == rb.row_count, (len(rows), rb.row_count)
+        # inclusive offset range must be exactly first/last row's token
+        assert rb.start_offset == rows[0]["_cdc_sequence_number"]
+        assert rb.end_offset == rows[-1]["_cdc_sequence_number"]
+        row_total += rb.row_count
+        got.extend(rows)
+    assert row_total == n and got == docs, (row_total, n)
+    # non-finite floats reject typed (encoding.rs stance)
+    b2 = RowBatchBuilder()
+    try:
+        b2.push_row({"v": float("inf")}, "0")
+    except EtlError:
+        pass
+    else:
+        raise AssertionError("non-finite float accepted")
+
+
 TARGETS = {
     "parse_text_cell": fuzz_parse_text_cell,
     "parse_copy_row": fuzz_parse_copy_row,
@@ -317,6 +381,7 @@ TARGETS = {
     "framer": fuzz_framer,
     "avro_ocf": fuzz_avro_ocf,
     "pb_append_rows": fuzz_pb_append_rows,
+    "snowpipe_batches": fuzz_snowpipe_batches,
 }
 
 
