@@ -323,7 +323,8 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
 async def run_lag_vs_rate(engine: str = "tpu",
                           fractions: tuple = (0.25, 0.5, 0.75),
                           probe_events: int = 60_000,
-                          max_fill_ms: int = 5) -> dict:
+                          max_fill_ms: int = 5,
+                          per_rate_cap: int = 240_000) -> dict:
     """p50/p95 end-to-end replication lag at fixed offered loads.
 
     The drain-style streaming bench saturates the pipeline, so its lag
@@ -343,7 +344,9 @@ async def run_lag_vs_rate(engine: str = "tpu",
     for f in fractions:
         rate = max(1000, int(max_rate * f))
         # ~3 s of paced traffic per rate, bounded for bench wall-clock
-        n = min(max(int(rate * 3), 3000), 240_000)
+        # (smoke tests pass a small per_rate_cap — the paced replay
+        # scales with the MEASURED host rate, not probe_events)
+        n = min(max(int(rate * 3), 3000), per_rate_cap)
         out = await run_table_streaming(n_events=n, engine=engine,
                                         max_fill_ms=max_fill_ms,
                                         arrival_rate=rate)
