@@ -23,6 +23,7 @@ metadata doesn't hold together.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 import uuid
@@ -59,6 +60,11 @@ _ICEBERG_TYPES: dict[CellKind, str] = {
     CellKind.BYTES: "binary", CellKind.STRING: "string",
     CellKind.ARRAY: "string", CellKind.INTERVAL: "string",
 }
+
+
+class _CasConflict(Exception):
+    """assert-ref-snapshot-id lost the optimistic race (another writer
+    advanced the branch) — recoverable by re-adopting catalog state."""
 
 
 @dataclass(frozen=True)
@@ -102,7 +108,8 @@ class IcebergDestination(Destination):
 
     async def _api(self, method: str, path: str,
                    body: dict | None = None,
-                   conflict_ok: bool = False) -> dict:
+                   conflict_ok: bool = False,
+                   conflict_raises: bool = False) -> dict:
         if self._session is None:
             self._session = aiohttp.ClientSession()
         headers = {"Authorization": f"Bearer {self.config.auth_token}"} \
@@ -115,6 +122,11 @@ class IcebergDestination(Destination):
                 text = await resp.text()
                 if resp.status == 409 and conflict_ok:
                     return {"alreadyExists": True}
+                if resp.status == 409 and conflict_raises:
+                    # optimistic-CAS loss: blind HTTP retry would replay
+                    # the SAME stale requirement forever — the caller
+                    # must re-adopt catalog state and rebuild the commit
+                    raise _CasConflict(text[:300])
                 if resp.status >= 400:
                     raise EtlError(
                         ErrorKind.DESTINATION_THROTTLED
@@ -205,33 +217,43 @@ class IcebergDestination(Destination):
                          last_column_id=last_id,
                          catalog_fields=schema_doc["fields"])
         if doc.get("alreadyExists"):
-            # adopt the catalog's current state (restart recovery / CAS)
-            loaded = await self._api(
-                "GET",
-                f"/namespaces/{self.config.namespace}/tables/{name}")
-            meta = loaded.get("metadata", {})
-            st.snapshot_id = meta.get("current-snapshot-id")
-            st.sequence_number = meta.get("last-sequence-number", 0)
-            st.schema_id = meta.get("current-schema-id", 0)
-            st.schema_count = max(1, len(meta.get("schemas", [])))
-            st.catalog_fields = None  # unknown until found below
-            adopted: dict[str, int] = {}
-            all_ids = [0]
-            for s in meta.get("schemas", []):
-                all_ids += [f["id"] for f in s.get("fields", [])]
-                if s.get("schema-id") == st.schema_id:
-                    st.catalog_fields = s.get("fields")
-                    adopted = {f["name"]: f["id"] for f in s["fields"]}
-            # keep the catalog's ids; columns the target schema adds on
-            # top get fresh ids past EVERY id any schema ever used
-            st.field_ids, st.last_column_id = self._assign_field_ids(
-                schema, adopted or None, max(all_ids))
-            for snap in meta.get("snapshots", []):
-                if snap.get("snapshot-id") == st.snapshot_id:
-                    st.total_records = int(
-                        snap.get("summary", {}).get("total-records", 0))
+            await self._adopt_catalog_state(st, schema)
         self._tables[schema.id] = st
         return st
+
+    async def _adopt_catalog_state(self, st: _TableState,
+                                   schema: ReplicatedTableSchema) -> dict:
+        """Refresh st from the catalog's CURRENT metadata (restart
+        recovery, and CAS-conflict recovery — another writer advanced
+        the branch, so the cached head/sequence/totals are stale).
+        Returns the metadata document (conflict recovery inspects the
+        snapshot list for its own lost-response commit)."""
+        loaded = await self._api(
+            "GET",
+            f"/namespaces/{self.config.namespace}/tables/{st.name}")
+        meta = loaded.get("metadata", {})
+        st.snapshot_id = meta.get("current-snapshot-id")
+        st.sequence_number = meta.get("last-sequence-number", 0)
+        st.schema_id = meta.get("current-schema-id", 0)
+        st.schema_count = max(1, len(meta.get("schemas", [])))
+        st.catalog_fields = None  # unknown until found below
+        adopted: dict[str, int] = {}
+        all_ids = [0]
+        for s in meta.get("schemas", []):
+            all_ids += [f["id"] for f in s.get("fields", [])]
+            if s.get("schema-id") == st.schema_id:
+                st.catalog_fields = s.get("fields")
+                adopted = {f["name"]: f["id"] for f in s["fields"]}
+        # keep the catalog's ids; columns the target schema adds on
+        # top get fresh ids past EVERY id any schema ever used
+        st.field_ids, st.last_column_id = self._assign_field_ids(
+            schema, adopted or None, max(all_ids))
+        st.total_records = 0
+        for snap in meta.get("snapshots", []):
+            if snap.get("snapshot-id") == st.snapshot_id:
+                st.total_records = int(
+                    snap.get("summary", {}).get("total-records", 0))
+        return meta
 
     # -- data + snapshot commit ------------------------------------------------
 
@@ -264,39 +286,89 @@ class IcebergDestination(Destination):
         # all state transitions are staged LOCALLY and applied only after
         # the catalog accepts the commit — a failed commit (CAS 409,
         # exhausted retries) must leave the table's sequence number and
-        # row totals untouched or every later commit would be rejected
-        snapshot_id = new_snapshot_id()
-        sequence_number = st.sequence_number + 1
+        # row totals untouched or every later commit would be rejected.
+        # A lost CAS race (another writer advanced the branch) re-adopts
+        # the catalog state and REBUILDS the commit on the new head —
+        # blind retry would replay the stale requirement forever.
         meta_dir = self._table_dir(st.name) / "metadata"
-        manifests = []
-        if files:
-            manifests.append(write_manifest(
-                meta_dir, files, snapshot_id, sequence_number,
-                json.dumps(self._iceberg_schema(st.schema, st.field_ids,
-                                                st.schema_id))))
-        manifest_list = write_manifest_list(
-            meta_dir, manifests, snapshot_id, sequence_number)
-        added = sum(f.record_count for f in files)
-        new_total = added if operation == "delete" \
-            else st.total_records + added
-        snapshot = build_snapshot(
-            snapshot_id, st.snapshot_id, sequence_number, manifest_list,
-            operation, len(files), added, new_total,
-            int(time.time() * 1000), st.schema_id)
-        body = {
-            "requirements": [{
-                "type": "assert-ref-snapshot-id", "ref": "main",
-                "snapshot-id": st.snapshot_id,
-            }],
-            "updates": [
-                {"action": "add-snapshot", "snapshot": snapshot},
-                {"action": "set-snapshot-ref", "ref-name": "main",
-                 "type": "branch", "snapshot-id": snapshot_id},
-            ],
-        }
-        await self._api(
-            "POST",
-            f"/namespaces/{self.config.namespace}/tables/{st.name}", body)
+        # the files in this commit were ALREADY written (parquet field
+        # ids stamped) under the pre-conflict schema identity — the
+        # rebuilt manifest must keep describing them with that identity
+        # (schemas are append-only, so the id stays valid) even though
+        # adoption refreshes st for FUTURE writes
+        commit_schema_id = st.schema_id
+        commit_field_ids = dict(st.field_ids)
+        assert st.schema is not None
+        commit_schema_json = json.dumps(self._iceberg_schema(
+            st.schema, commit_field_ids, commit_schema_id))
+        snapshot_id = new_snapshot_id()  # stable across retries: a lost
+        # RESPONSE re-POSTs, 409s on our own head, and is recognized below
+        for attempt in range(4):
+            sequence_number = st.sequence_number + 1
+            manifests = []
+            if files:
+                manifests.append(write_manifest(
+                    meta_dir, files, snapshot_id, sequence_number,
+                    commit_schema_json))
+            manifest_list = write_manifest_list(
+                meta_dir, manifests, snapshot_id, sequence_number)
+            added = sum(f.record_count for f in files)
+            new_total = added if operation == "delete" \
+                else st.total_records + added
+            snapshot = build_snapshot(
+                snapshot_id, st.snapshot_id, sequence_number, manifest_list,
+                operation, len(files), added, new_total,
+                int(time.time() * 1000), commit_schema_id)
+            body = {
+                "requirements": [{
+                    "type": "assert-ref-snapshot-id", "ref": "main",
+                    "snapshot-id": st.snapshot_id,
+                }],
+                "updates": [
+                    {"action": "add-snapshot", "snapshot": snapshot},
+                    {"action": "set-snapshot-ref", "ref-name": "main",
+                     "type": "branch", "snapshot-id": snapshot_id},
+                ],
+            }
+            def _drop_attempt_files() -> None:
+                # a commit the catalog did NOT take leaves this
+                # attempt's manifest files unreachable — drop them
+                # instead of leaving orphans
+                for p in ([manifest_list]
+                          + [m.manifest_path for m in manifests]):
+                    Path(p).unlink(missing_ok=True)
+
+            try:
+                await self._api(
+                    "POST",
+                    f"/namespaces/{self.config.namespace}/tables/{st.name}",
+                    body, conflict_raises=True)
+            except _CasConflict as e:
+                meta = await self._adopt_catalog_state(st, st.schema)
+                if any(s.get("snapshot-id") == snapshot_id
+                       for s in meta.get("snapshots", [])):
+                    # the commit APPLIED but its response was lost: the
+                    # conflicting head is our own snapshot (or a later
+                    # one on top of it) — committing again would
+                    # double-write every row. Adoption already set
+                    # st.snapshot_id/sequence/totals from the catalog;
+                    # the metadata files stay (the catalog references
+                    # them).
+                    return
+                _drop_attempt_files()
+                if attempt == 3:
+                    raise EtlError(
+                        ErrorKind.DESTINATION_FAILED,
+                        f"iceberg: commit lost the CAS race 4 times "
+                        f"on {st.name}: {e}")
+                # jittered backoff before racing the other writer again
+                # (instant retries let a steady writer win every round)
+                await asyncio.sleep(self.retry.delay(attempt))
+                continue
+            except BaseException:
+                _drop_attempt_files()
+                raise
+            break
         st.snapshot_id = snapshot_id
         st.sequence_number = sequence_number
         st.total_records = new_total
@@ -374,25 +446,49 @@ class IcebergDestination(Destination):
                                            st.schema_id)["fields"]
             if st.catalog_fields == desired:
                 return
-        # existing columns keep their ids; additions get fresh ones
-        ids, last = self._assign_field_ids(new, st.field_ids,
-                                           st.last_column_id)
-        new_schema_id = st.schema_count
-        body = {
-            "requirements": [{
-                "type": "assert-ref-snapshot-id", "ref": "main",
-                "snapshot-id": st.snapshot_id,
-            }],
-            "updates": [
-                {"action": "add-schema",
-                 "schema": self._iceberg_schema(new, ids, new_schema_id)},
-                {"action": "set-current-schema",
-                 "schema-id": new_schema_id},
-            ],
-        }
-        await self._api(
-            "POST",
-            f"/namespaces/{self.config.namespace}/tables/{st.name}", body)
+        for attempt in range(4):
+            # existing columns keep their ids; additions get fresh ones
+            ids, last = self._assign_field_ids(new, st.field_ids,
+                                               st.last_column_id)
+            new_schema_id = st.schema_count
+            body = {
+                "requirements": [{
+                    "type": "assert-ref-snapshot-id", "ref": "main",
+                    "snapshot-id": st.snapshot_id,
+                }],
+                "updates": [
+                    {"action": "add-schema",
+                     "schema": self._iceberg_schema(new, ids,
+                                                    new_schema_id)},
+                    {"action": "set-current-schema",
+                     "schema-id": new_schema_id},
+                ],
+            }
+            try:
+                await self._api(
+                    "POST",
+                    f"/namespaces/{self.config.namespace}/tables/{st.name}",
+                    body, conflict_raises=True)
+            except (_CasConflict, EtlError) as e:
+                # staleness here wears TWO shapes: a 409 when a data
+                # commit moved the ref, and a 400 stale-schema-count
+                # when a concurrent add-schema registered first (it
+                # moves NO ref, so the CAS requirement still passes).
+                # Both recover the same way: re-adopt, return if the
+                # catalog already matches, else retry with the
+                # refreshed count — a genuinely deterministic error
+                # just fails again and surfaces on the last attempt.
+                await self._adopt_catalog_state(st, new)
+                desired = self._iceberg_schema(new, st.field_ids,
+                                               st.schema_id)["fields"]
+                if st.catalog_fields == desired:
+                    st.schema = new  # catalog already caught up
+                    return
+                if attempt == 3:
+                    raise
+                await asyncio.sleep(self.retry.delay(attempt))
+                continue
+            break
         st.schema = new
         st.field_ids, st.last_column_id = ids, last
         st.schema_id = new_schema_id

@@ -772,6 +772,78 @@ class TestIceberg:
         finally:
             await cat.stop()
 
+    async def test_cas_conflict_readopts_and_commits_on_new_head(
+            self, tmp_path):
+        """Losing the assert-ref-snapshot-id race (another writer
+        advanced the main branch) must NOT wedge the destination in a
+        blind retry of the stale requirement: it re-adopts the
+        catalog's current state and rebuilds the commit on the new
+        head — correct parent chain, sequence number, and totals."""
+        cat, d = await self.start(tmp_path)
+        try:
+            await d.write_table_rows(make_schema(),
+                                     batch([[1, "a", None]]))
+            t = cat.table("etl", "public_user__events")
+            # out-of-band writer: a SECOND destination instance commits,
+            # advancing the branch past d's cached head
+            d2 = IcebergDestination(IcebergConfig(
+                catalog_url=cat.url(), warehouse_path=str(tmp_path)),
+                RETRY_FAST)
+            await d2.startup()
+            await d2.write_events([ins(0, [5, "other", None], lsn=0x50)])
+            await d2.shutdown()
+            # d's next commit starts from a STALE snapshot id
+            ack = await d.write_events([ins(0, [2, "b", None],
+                                            lsn=0x60)])
+            await ack.wait_durable()
+            assert len(t.snapshots) == 3
+            s = t.snapshots[-1]
+            assert s["parent-snapshot-id"] == \
+                t.snapshots[-2]["snapshot-id"]
+            assert s["sequence-number"] == 3
+            assert s["summary"]["total-records"] == "3"
+            assert not cat.rejections
+            await d.shutdown()
+        finally:
+            await cat.stop()
+
+    async def test_schema_change_survives_cas_conflict(self, tmp_path):
+        """A SchemaChangeEvent whose assert-ref requirement loses to a
+        concurrent data commit must re-adopt and register the schema on
+        the new head, not wedge retrying the stale requirement."""
+        from etl_tpu.models.event import SchemaChangeEvent
+
+        cat, d = await self.start(tmp_path)
+        try:
+            await d.write_events([ins(0, [1, "a", None])])
+            # concurrent writer advances the branch
+            d2 = IcebergDestination(IcebergConfig(
+                catalog_url=cat.url(), warehouse_path=str(tmp_path)),
+                RETRY_FAST)
+            await d2.startup()
+            await d2.write_events([ins(0, [7, "x", None], lsn=0x55)])
+            await d2.shutdown()
+            wider = ReplicatedTableSchema.with_all_columns(TableSchema(
+                TID, TableName("public", "user_events"),
+                (ColumnSchema("id", Oid.INT4, nullable=False,
+                              primary_key_ordinal=1),
+                 ColumnSchema("note", Oid.TEXT),
+                 ColumnSchema("amount", Oid.NUMERIC),
+                 ColumnSchema("added", Oid.TEXT))))
+            await d.write_events([SchemaChangeEvent(
+                Lsn(0x700), Lsn(0x700), TID, wider)])
+            t = cat.table("etl", "public_user__events")
+            assert len(t.schemas) == 2 and t.current_schema_id == 1
+            assert "added" in [f["name"] for f in t.schemas[1]["fields"]]
+            # and data flows on the evolved schema afterwards
+            await d.write_events([InsertEvent(
+                Lsn(0x780), Lsn(0x780), TID, wider,
+                TableRow([9, "b", None, "y"]))])
+            assert not cat.rejections
+            await d.shutdown()
+        finally:
+            await cat.stop()
+
     async def test_catalog_rejects_field_id_reuse(self, tmp_path):
         """The fake enforces the id rules the destination must obey:
         reassigning an existing column's id or recycling a used id for
