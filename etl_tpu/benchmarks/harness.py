@@ -26,12 +26,18 @@ def _median(xs):
 # ---------------------------------------------------------------------------
 
 
-async def run_table_copy(n_rows: int = 100_000, samples: int = 3,
-                         engine: str = "tpu") -> dict:
+async def run_table_copy(n_rows: int = 1_000_000, samples: int = 3,
+                         engine: str = "tpu",
+                         destination: str = "null") -> dict:
+    """Initial-copy throughput. 1M rows (reference table_copy.rs seeds
+    1M-row pgbench tables): at 100k rows the ~0.1s state-machine handoff
+    latency — not copy throughput — dominates the window."""
     from ..config import BatchConfig, BatchEngine, PipelineConfig
     from ..destinations import MemoryDestination
+    from ..destinations.base import Destination, WriteAck
     from ..models import ColumnSchema, Oid, TableName, TableSchema
     from ..models.table_state import TableStateType
+    from ..postgres.codec.copy_text import encode_copy_row
     from ..postgres.fake import FakeDatabase, FakeSource
     from ..runtime import Pipeline
     from ..store import NotifyingStore
@@ -39,27 +45,74 @@ async def run_table_copy(n_rows: int = 100_000, samples: int = 3,
     TID = 16384
     rows = [[str(i), str(i % 100), str(i * 7 % 10**9), "x" * 64]
             for i in range(n_rows)]
-    bytes_estimate = sum(len("\t".join(r)) + 1 for r in rows[:1000]) \
-        * (n_rows / min(1000, max(1, n_rows)))
+    copy_bytes = sum(len(encode_copy_row(r)) + 1 for r in rows)
+    schema_def = TableSchema(
+        TID, TableName("public", "bench_copy"),
+        (ColumnSchema("id", Oid.INT8, nullable=False,
+                      primary_key_ordinal=1),
+         ColumnSchema("bucket", Oid.INT4),
+         ColumnSchema("val", Oid.INT8),
+         ColumnSchema("filler", Oid.TEXT)))
+
+    class CopyCountDestination(Destination):
+        """Counts copied rows; resolving batch.num_rows forces the decode,
+        so device/host decode stays on the measured path — the reference
+        null-destination stance (etl-benchmarks), matching
+        run_table_streaming."""
+
+        def __init__(self):
+            self.rows_delivered = 0
+
+        async def startup(self):
+            return None
+
+        async def write_table_rows(self, schema, batch):
+            self.rows_delivered += batch.num_rows
+            return WriteAck.durable()
+
+        async def write_events(self, events):
+            return WriteAck.durable()
+
+        async def drop_table(self, table_id, schema=None):
+            return None
+
+        async def truncate_table(self, table_id):
+            return None
+
+    # warmup OFF the clock: backend init (~6s on a tunnel-attached chip)
+    # and the per-(schema, row-bucket) decode-program compiles are one-time
+    # process costs a steady-state pipeline has already paid
+    from ..models.schema import ReplicatedTableSchema
+    from ..ops.engine import DeviceDecoder
+    from ..ops.staging import stage_copy_chunk
+
+    if engine == "tpu":
+        warm_schema = ReplicatedTableSchema.with_all_columns(schema_def)
+        warm_dec = DeviceDecoder(warm_schema)
+        # every row bucket a partition flush can stage (the 8 MiB batch
+        # threshold lands ~98k-row chunks in the 131072 bucket); 131_071
+        # not 131_072 — the exact bucket size would route to the DEVICE
+        # path (n_rows ≥ device_min_rows) while in-window chunks stay
+        # under it and need the HOST program for that bucket
+        warm_lines = [encode_copy_row(r) for r in rows[:131_071]]
+        for k in (512, 4096, 16_384, 65_536, 131_071):
+            chunk = b"\n".join(warm_lines[:min(k, len(warm_lines))]) + b"\n"
+            warm_dec.decode(stage_copy_chunk(chunk, 4))
 
     results = []
     for _ in range(samples):
         db = FakeDatabase()
-        db.create_table(TableSchema(
-            TID, TableName("public", "bench_copy"),
-            (ColumnSchema("id", Oid.INT8, nullable=False,
-                          primary_key_ordinal=1),
-             ColumnSchema("bucket", Oid.INT4),
-             ColumnSchema("val", Oid.INT8),
-             ColumnSchema("filler", Oid.TEXT))), rows=rows)
+        db.create_table(schema_def, rows=rows)
         db.create_publication("pub", [TID])
         store = NotifyingStore()
+        dest = CopyCountDestination() if destination == "null" \
+            else MemoryDestination()
         pipeline = Pipeline(
             config=PipelineConfig(
                 pipeline_id=1, publication_name="pub",
                 batch=BatchConfig(max_fill_ms=40,
                                   batch_engine=BatchEngine(engine))),
-            store=store, destination=MemoryDestination(),
+            store=store, destination=dest,
             source_factory=lambda: FakeSource(db))
         t0 = time.perf_counter()
         await pipeline.start()
@@ -74,12 +127,13 @@ async def run_table_copy(n_rows: int = 100_000, samples: int = 3,
             "shutdown_ms": (t_done - t_copied) * 1000,
             "total_ms": (t_done - t0) * 1000,
             "rows_per_second": n_rows / (t_copied - t_started),
-            "estimated_mib_per_second":
-                bytes_estimate / (1 << 20) / (t_copied - t_started),
+            "mib_per_second":
+                copy_bytes / (1 << 20) / (t_copied - t_started),
         })
     agg = {k: _median([r[k] for r in results]) for k in results[0]}
     return {"mode": "table_copy", "rows": n_rows, "samples": samples,
-            "engine": engine, **{k: round(v, 2) for k, v in agg.items()}}
+            "engine": engine, "destination": destination,
+            **{k: round(v, 2) for k, v in agg.items()}}
 
 
 # ---------------------------------------------------------------------------
@@ -87,7 +141,7 @@ async def run_table_copy(n_rows: int = 100_000, samples: int = 3,
 # ---------------------------------------------------------------------------
 
 
-async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
+async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
                               engine: str = "tpu",
                               destination: str = "null",
                               max_fill_ms: int = 30,
@@ -247,6 +301,42 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
                                     b"note-%d" % i])
                 for i in range(n_events)]
 
+    # ALSO off the clock: the device decode programs for the mega-seal
+    # buckets backlog growth can reach. Saturation drains grow seals
+    # 16384 → 65536 → 262144 (runtime/assembler.MEGA_SEAL_ROWS); on a
+    # real accelerator each unwarmed (bucket, widths) program costs a
+    # 10-40s compile that would otherwise land mid-window. Staging the
+    # MEASURED payloads keeps the width signature identical.
+    import jax as _jax
+
+    if engine == "tpu" and _jax.default_backend() != "cpu" \
+            and arrival_rate is None and n_events >= 65_536:
+        from ..models.schema import ReplicatedTableSchema as _RTS
+        from ..ops.engine import DeviceDecoder as _DD
+        from ..ops.wal import concat_payloads as _concat
+        from ..ops.wal import stage_wal_batch as _stage
+
+        _wdec = _DD(_RTS.with_all_columns(db.tables[TID].schema))
+        for _bucket in (65_536, 131_072, 262_144):
+            if _bucket > len(payloads):
+                break
+            _buf, _offs, _lens = _concat(payloads[:_bucket])
+            _wal = _stage(_buf, _offs, _lens, 3)
+            _wdec.decode(_wal.staged)
+
+    from ..telemetry.metrics import (ETL_DECODE_ROUTED_DEVICE_ROWS_TOTAL,
+                                     ETL_DECODE_ROUTED_HOST_ROWS_TOTAL,
+                                     ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL,
+                                     registry as _registry)
+
+    def _routed():
+        return {k: _registry.get_counter(n) for k, n in (
+            ("device", ETL_DECODE_ROUTED_DEVICE_ROWS_TOTAL),
+            ("host", ETL_DECODE_ROUTED_HOST_ROWS_TOTAL),
+            ("oracle", ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL))}
+
+    routed0 = _routed()
+
     t_prod0 = time.perf_counter()
     produced = 0
     if arrival_rate:
@@ -289,11 +379,14 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
     t_e2e = time.perf_counter()
     await pipeline.shutdown_and_wait()
     t_drain = time.perf_counter()
-    # NOTE: runs seal at RUN_SEAL_ROWS (16384), below the device-routing
-    # threshold, so this mode measures the host-XLA decode path for both
-    # engines by design (the tunnel-attached chip's fixed round-trip
-    # loses at these sizes — see DeviceDecoder.DEVICE_MIN_ROWS); the
-    # device path is measured by the decode and wide_row modes.
+    # decode routing over the measured window: under saturation the
+    # backlog signal grows seals past the measured device threshold, so
+    # the device share reports how much of the steady-state data plane
+    # actually ran on the accelerator (VERDICT r4 #1c — a host-only
+    # steady state can no longer hide behind the throughput number)
+    routed1 = _routed()
+    routed = {k: routed1[k] - routed0[k] for k in routed1}
+    routed_total = sum(routed.values())
     lags_ms = [(t - commit_times[lsn]) * 1000 for lsn, t in arrivals
                if lsn in commit_times]
     lags_ms.sort()
@@ -312,6 +405,11 @@ async def run_table_streaming(n_events: int = 100_000, tx_size: int = 500,
         "end_to_end_with_shutdown_events_per_second":
             round(n_events / (t_drain - t_prod0)),
         "throughput_events": delivered(),
+        "decode_rows_device": int(routed["device"]),
+        "decode_rows_host": int(routed["host"]),
+        "decode_rows_oracle": int(routed["oracle"]),
+        "device_decoded_share":
+            round(routed["device"] / routed_total, 3) if routed_total else 0.0,
         "replication_lag_p50_ms":
             round(pct(0.50), 2) if lags_ms else None,
         "replication_lag_p95_ms":

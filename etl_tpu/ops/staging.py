@@ -175,13 +175,19 @@ def stage_copy_chunk(chunk: bytes, n_cols: int) -> StagedBatch:
     nulls = (lengths == 2) & (first == _NULL_FIELD_BYTES[0]) \
         & (second == _NULL_FIELD_BYTES[1])
 
-    # escape detection per row: any backslash in the row span that is not a \N
-    bs_cum = np.concatenate(([0], np.cumsum(data == 92)))
-    row_start = starts[:, 0]
-    row_end = ends[:, -1]
-    bs_in_row = bs_cum[row_end] - bs_cum[row_start]
-    nulls_in_row = nulls.sum(axis=1)
-    fallback = np.flatnonzero(bs_in_row != nulls_in_row)
+    # escape detection per row: any backslash in the row span that is not
+    # a \N (chunks with no backslash at all — the common case — skip the
+    # cumsum, which costs ~5ms/MiB on the copy hot path)
+    is_bs = data == 92
+    if is_bs.any():
+        bs_cum = np.concatenate(([0], np.cumsum(is_bs)))
+        row_start = starts[:, 0]
+        row_end = ends[:, -1]
+        bs_in_row = bs_cum[row_end] - bs_cum[row_start]
+        nulls_in_row = nulls.sum(axis=1)
+        fallback = np.flatnonzero(bs_in_row != nulls_in_row)
+    else:
+        fallback = np.zeros(0, dtype=np.int64)
 
     cap_rows = bucket_rows(n_rows)
     if cap_rows != n_rows:

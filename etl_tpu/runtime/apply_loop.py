@@ -51,7 +51,7 @@ from ..telemetry.metrics import (ETL_APPLY_LOOP_BATCHES_TOTAL,
                                  ETL_TRANSACTION_SIZE_BYTES,
                                  ETL_TRANSACTIONS_TOTAL, registry)
 from . import failpoints
-from .assembler import EventAssembler
+from .assembler import RUN_SEAL_ROWS, EventAssembler
 from .shutdown import ShutdownSignal
 from .state import TableState, TableStateType
 from .table_cache import SharedTableCache
@@ -64,6 +64,11 @@ class ExitIntent(enum.Enum):
 
 class SyncCoordination(Protocol):
     """What the apply-context loop needs from the table-sync worker pool."""
+
+    # pulsed on table-state transitions so the apply loop can process
+    # handoffs immediately instead of polling on keepalives (optional —
+    # the loop degrades to keepalive-paced processing without it)
+    state_changed: asyncio.Event
 
     def table_state(self, table_id: TableId) -> TableState | None:
         """Merged store+memory view of one table's state (synchronous — the
@@ -142,6 +147,10 @@ class ApplyLoop:
                                 last_status_flush_lsn=start_lsn)
         self._in_flight: _InFlight | None = None
         self._batch_deadline: float | None = None
+        # True while the CURRENT drain keeps coming back full: flush
+        # pacing defers to mega-batching only during a live backlog
+        # (the moment the producer pauses, normal deadlines resume)
+        self._backlog_now = False
         self._ready_states: dict[TableId, bool] = {}
         interval = config.schema_cleanup_interval_s
         self._next_schema_cleanup = (time.monotonic() + interval) \
@@ -187,7 +196,20 @@ class ApplyLoop:
         stream_iter = self.stream.__aiter__()
         msg_task: asyncio.Task | None = None
         resume_task: asyncio.Task | None = None
+        coord_task: asyncio.Task | None = None
+        coord_event: asyncio.Event | None = getattr(
+            self.ctx.coordination, "state_changed", None) \
+            if isinstance(self.ctx, ApplyContext) else None
+        # table-sync context: selecting on the catchup future lets the
+        # worker react the moment the apply loop sets its target instead
+        # of at the next keepalive; disarmed after first resolution
+        catchup_future = self.ctx.catchup_target \
+            if isinstance(self.ctx, TableSyncContext) \
+            and not self.ctx.catchup_target.done() else None
         shutdown_task = asyncio.ensure_future(self.shutdown.wait())
+        # consecutive full drain windows: the backlog signal that grows
+        # the assembler's seal toward device-size batches (TPU engine)
+        backlog_streak = 0
         try:
             while True:
                 # memory backpressure: under RSS pressure stop pulling WAL
@@ -205,6 +227,12 @@ class ApplyLoop:
                         resume_task = asyncio.ensure_future(
                             self.monitor.wait_until_resumed())
                     waits.add(resume_task)
+                if coord_event is not None and coord_task is None:
+                    coord_task = asyncio.ensure_future(coord_event.wait())
+                if coord_task is not None:
+                    waits.add(coord_task)
+                if catchup_future is not None:
+                    waits.add(catchup_future)
                 if self._in_flight is not None:
                     waits.add(self._in_flight.task)
                 now = time.monotonic()
@@ -233,10 +261,25 @@ class ApplyLoop:
                     if intent is not None:
                         return intent
                     continue  # re-select; a deadline flush may now proceed
-                # priority 3: batch deadline
+                # priority 3: batch deadline. During a live backlog the
+                # deadline defers while the open run is still growing
+                # toward the (grown) seal: a deadline flush would seal —
+                # and decode — the run below the device threshold, pinning
+                # the saturated data plane to host-size batches. Lag is
+                # queue depth under saturation anyway; the moment the
+                # backlog clears, deadlines fire normally again.
                 if self._batch_deadline is not None \
                         and time.monotonic() >= self._batch_deadline:
-                    self._maybe_dispatch_flush(force=True)
+                    if (self._backlog_now
+                            and self.assembler.seal_rows > RUN_SEAL_ROWS
+                            and self.assembler.row_events
+                            < self.assembler.seal_rows
+                            and self.assembler.size_bytes
+                            < self._scaled_max_bytes()):
+                        self._batch_deadline = time.monotonic() \
+                            + self.config.batch.max_fill_ms / 1000
+                    else:
+                        self._maybe_dispatch_flush(force=True)
                 # priority 4: message — then bulk-drain frames that are
                 # already buffered: a full select per message costs tens of
                 # µs of asyncio machinery, which would cap CDC throughput
@@ -257,23 +300,59 @@ class ApplyLoop:
                             and self.monitor.pressure)):
                         frames = self.stream.drain_spans(4096)
                         if not frames:
+                            backlog_streak = 0
+                            self._backlog_now = False
                             break
+                        # sustained backlog → mega-batching: when the
+                        # drain keeps coming back full, the stream is
+                        # producing faster than the loop consumes; grow
+                        # the seal one row bucket per two full windows so
+                        # staged runs reach the measured device threshold
+                        # (paced/idle traffic never fills a window, so
+                        # lag-sensitive loads keep the small seal)
+                        drained = sum(
+                            len(it.payloads) if type(it) is FrameSpan
+                            else 1 for it in frames)
+                        self._backlog_now = drained >= 4096
+                        if self._backlog_now:
+                            backlog_streak += 1
+                            if backlog_streak >= 2:
+                                self.assembler.grow_seal()
+                        else:
+                            backlog_streak = 0
                         intent = await self._handle_frames(frames)
                         if intent is not None:
                             return intent
                 elif not done:
-                    # idle timeout: proactive keepalive + idle sync processing
+                    # idle timeout: proactive keepalive + idle sync
+                    # processing; an idle stream also ends any backlog
+                    # episode — seals shrink back to the latency-tuned size
+                    backlog_streak = 0
+                    self._backlog_now = False
+                    self.assembler.reset_seal()
                     await self._send_status_update()
                     if isinstance(self.ctx, ApplyContext):
                         await self._process_syncing_tables(
                             self.state.received_lsn)
+                # priority 5: coordination wakes — immediate handoff
+                # processing (no keepalive wait)
+                if coord_task is not None and coord_task in done:
+                    coord_task = None
+                    coord_event.clear()
+                    await self._process_syncing_tables(
+                        self.state.received_lsn)
+                if catchup_future is not None and catchup_future.done():
+                    catchup_future = None  # disarm; target readable from ctx
+                    intent = await self._check_catchup(self.state.received_lsn)
+                    if intent is not None:
+                        return intent
                 if self._next_schema_cleanup is not None \
                         and time.monotonic() >= self._next_schema_cleanup:
                     self._next_schema_cleanup = time.monotonic() \
                         + self.config.schema_cleanup_interval_s
                     await self._run_schema_cleanup()
         finally:
-            for t in (msg_task, shutdown_task, resume_task):
+            for t in (msg_task, shutdown_task, resume_task, coord_task):
                 if t is not None and not t.done():
                     t.cancel()
                     try:
@@ -442,7 +521,10 @@ class ApplyLoop:
             # controls still land in the assembler) stay on the deadline
             # path — an immediate flush per such commit would write
             # durable progress per commit instead of per fill window.
-            if self._in_flight is None and self.assembler.row_events:
+            # (suppressed during a live backlog: the fast flush exists to
+            # cut IDLE lag, and here it would seal a growing mega run)
+            if self._in_flight is None and self.assembler.row_events \
+                    and not self._backlog_now:
                 self._maybe_dispatch_flush(force=True)
         elif isinstance(msg, pgoutput.RelationMessage):
             schema = event_codec.schema_from_relation_message(msg)
@@ -493,6 +575,15 @@ class ApplyLoop:
 
     # -- batching / flush -------------------------------------------------------
 
+    def _scaled_max_bytes(self) -> int:
+        """Size-flush threshold, scaled with seal growth: the static cap
+        is tuned for latency-sized batches and would otherwise seal mega
+        runs at ~max_size_bytes of payload — below the device threshold —
+        no matter how far the seal grew. Memory stays bounded by the
+        growth cap (MEGA/RUN = 16×) and the backpressure monitor."""
+        return self.config.batch.max_size_bytes \
+            * max(1, self.assembler.seal_rows // RUN_SEAL_ROWS)
+
     def _maybe_dispatch_flush(self, force: bool = False) -> None:
         if self._in_flight is not None:
             return
@@ -512,7 +603,7 @@ class ApplyLoop:
         # flushes happen mid-transaction with the commit LSN carried
         # separately (apply.rs:1932-1945), so splitting huge transactions
         # is safe for durability accounting
-        threshold = self.config.batch.max_size_bytes
+        threshold = self._scaled_max_bytes()
         if self._lease is not None:
             threshold = min(threshold, self._lease.ideal_batch_bytes())
         if not force and self.assembler.size_bytes < threshold:

@@ -52,6 +52,13 @@ class _Run:
 #: backlog drains through ever-larger flushes.
 RUN_SEAL_ROWS = 16384
 
+#: backlog growth cap for the dynamic seal (the largest standard row
+#: bucket): under sustained backlog the apply loop grows seals toward
+#: this so staged batches clear the measured device-routing threshold —
+#: the steady-state data plane then decodes on the accelerator instead
+#: of capping every run at the host-size bucket (VERDICT r4 #1b).
+MEGA_SEAL_ROWS = 262_144
+
 
 class EventAssembler:
     def __init__(self, engine: BatchEngine):
@@ -59,6 +66,9 @@ class EventAssembler:
         self._events: list[Event] = []
         self._run: _Run | None = None
         self._decoders: dict[TableId, DeviceDecoder] = {}
+        # dynamic: the apply loop grows it ×4 (one row bucket per step)
+        # under sustained backlog and resets it when the stream idles
+        self.seal_rows = RUN_SEAL_ROWS
         self.size_bytes = 0
         # row (non-control) events in the open window: the apply loop's
         # idle-commit fast flush keys on this — control-only windows
@@ -95,7 +105,7 @@ class EventAssembler:
         r.tx_ordinals.append(tx_ordinal)
         self.size_bytes += 64 + len(payload)
         self.row_events += 1
-        if len(r.payloads) >= RUN_SEAL_ROWS:
+        if len(r.payloads) >= self.seal_rows:
             self._seal_run()
 
     def push_raw_rows(self, payloads: list[bytes],
@@ -111,7 +121,7 @@ class EventAssembler:
             self._run = _Run(table_id=schema.id, schema=schema)
         r = self._run
         k = len(payloads)
-        if len(r.payloads) + k > RUN_SEAL_ROWS and r.payloads:
+        if len(r.payloads) + k > self.seal_rows and r.payloads:
             # seal BEFORE extending: overshooting the cap would bump the
             # staged batch into the next (unwarmed) row bucket
             self._seal_run()
@@ -123,9 +133,21 @@ class EventAssembler:
         nbytes = sum(map(len, payloads))
         self.size_bytes += 64 * k + nbytes
         self.row_events += k
-        if len(r.payloads) >= RUN_SEAL_ROWS:
+        if len(r.payloads) >= self.seal_rows:
             self._seal_run()
         return nbytes
+
+    # -- dynamic seal (backlog mega-batching) ---------------------------------
+
+    def grow_seal(self) -> None:
+        """×4 per step = exactly one standard row bucket (16384 → 65536 →
+        262144), so growth never lands in an intermediate bucket whose
+        decode program would be a wasted compile."""
+        if self.seal_rows < MEGA_SEAL_ROWS:
+            self.seal_rows = min(self.seal_rows * 4, MEGA_SEAL_ROWS)
+
+    def reset_seal(self) -> None:
+        self.seal_rows = RUN_SEAL_ROWS
 
     def push_row_message(self, msg: pgoutput.LogicalReplicationMessage,
                          payload: bytes, schema: ReplicatedTableSchema,

@@ -97,7 +97,11 @@ async def _copy_partition(source: ReplicationSource,
         stream = await source.copy_table_stream(
             schema.id, publication, snapshot_id, ctid_range=rng)
     oids = [c.type_oid for c in schema.replicated_columns]
-    pending = b""
+    # chunk list + running length, joined once per flush: `pending += raw`
+    # re-copies the accumulated buffer per 43 KB stream chunk — O(n²)
+    # toward an 8 MB threshold, measured 0.7s/85MB on the copy bench
+    pending: list[bytes] = []
+    pending_len = 0
     acks: list[WriteAck] = []
     # device-decode pipeline: dispatch decode of chunk N and keep reading
     # COPY data for N+1..N+depth while the device works and streams results
@@ -143,16 +147,19 @@ async def _copy_partition(source: ReplicationSource,
             # stop pulling COPY data under memory pressure; the server-side
             # cursor waits (reference TryBatchBackpressureStream pause)
             await monitor.wait_until_resumed()
-        pending += raw
+        pending.append(raw)
+        pending_len += len(raw)
         # budget-aware chunking: the per-stream share shrinks when many
         # partitions copy concurrently (batch_budget.rs:72-96)
         threshold = max_batch_bytes if lease is None \
             else min(max_batch_bytes, lease.ideal_batch_bytes())
-        if len(pending) >= threshold:
-            cut = pending.rfind(b"\n") + 1
-            await write_chunk(pending[:cut])
-            pending = pending[cut:]
-    await write_chunk(pending)
+        if pending_len >= threshold:
+            buf = b"".join(pending)
+            cut = buf.rfind(b"\n") + 1
+            await write_chunk(buf[:cut])
+            pending = [buf[cut:]] if cut < len(buf) else []
+            pending_len = len(buf) - cut
+    await write_chunk(b"".join(pending))
     while in_flight:
         await drain_one()
     # durability barrier for this partition (mod.rs:360-378)

@@ -66,6 +66,12 @@ class TableSyncWorkerPool:
         self.monitor = monitor  # MemoryMonitor | None
         self.budget = budget  # BatchBudgetController | None
         self._permits = asyncio.Semaphore(config.max_table_sync_workers)
+        # pulsed on every cached state transition: the apply loop selects
+        # on it so SyncWait/SyncDone handoffs process immediately instead
+        # of waiting out the next keepalive (Postgres parity: tablesync
+        # workers wake the apply worker; polling cost ~3 keepalive
+        # intervals of pure latency per table handoff)
+        self.state_changed = asyncio.Event()
         self._workers: dict[TableId, _WorkerHandle] = {}
         self._states_cache: dict[TableId, TableState] = {}
         # transition-maintained index of non-Ready, non-Errored tables:
@@ -102,6 +108,7 @@ class TableSyncWorkerPool:
             else:
                 self._syncing.add(tid)
         self._update_table_gauges()
+        self.state_changed.set()
 
     def _update_table_gauges(self) -> None:
         from ..telemetry.metrics import (ETL_TABLES_ERRORED,

@@ -40,6 +40,12 @@ ETL_DEVICE_DECODE_ROWS_TOTAL = "etl_device_decode_rows_total"
 ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL = \
     "etl_device_decode_fallback_rows_total"
 ETL_DEVICE_DECODE_SECONDS = "etl_device_decode_seconds"
+# decode routing by path (device / host-XLA / per-row oracle): the
+# device share is the headline honesty metric for "decode on TPU" —
+# benches report it so a host-only steady state can't hide
+ETL_DECODE_ROUTED_DEVICE_ROWS_TOTAL = "etl_decode_routed_device_rows_total"
+ETL_DECODE_ROUTED_HOST_ROWS_TOTAL = "etl_decode_routed_host_rows_total"
+ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL = "etl_decode_routed_oracle_rows_total"
 ETL_PROCESSED_BYTES_TOTAL = "etl_processed_bytes_total"
 # pending catalog-inlined bytes per lake table (reference
 # ETL_DUCKLAKE_TABLE_ACTIVE_INLINED_DATA_BYTES, ducklake/inline_size.rs)

@@ -768,8 +768,8 @@ class FakePgServer:
             w.write(READY)
             return
         snap = db.snapshots.get(sess.snapshot_id or "", None)
-        rows = snap.get(table.schema.id, []) if snap is not None \
-            else table.rows
+        rows = snap.get(table.schema.id, ([], None))[0] \
+            if snap is not None else table.rows
         # apply a row filter ONLY when the COPY SQL carried its predicate
         # (the walsender applies filters at send time; the snapshot COPY
         # must spell them out — a client that forgets gets unfiltered rows

@@ -302,3 +302,120 @@ class TestPreencodedInserts:
             assert db1.tables[16384].rows == db2.tables[16384].rows
 
         asyncio.run(run())
+
+
+class TestDynamicSeal:
+    """Backlog mega-batching (VERDICT r4 #1b): the seal grows one row
+    bucket per step toward MEGA_SEAL_ROWS and resets to the latency size."""
+
+    def _schema(self):
+        from etl_tpu.models import ReplicatedTableSchema, TableName, TableSchema
+        return ReplicatedTableSchema.with_all_columns(TableSchema(
+            7, TableName("public", "t"),
+            (ColumnSchema("id", Oid.INT4, nullable=False,
+                          primary_key_ordinal=1),)))
+
+    def test_grow_and_reset_steps_are_row_buckets(self):
+        from etl_tpu.config.pipeline import BatchEngine
+        from etl_tpu.ops.staging import ROW_BUCKETS
+        from etl_tpu.runtime.assembler import (MEGA_SEAL_ROWS, RUN_SEAL_ROWS,
+                                               EventAssembler)
+
+        a = EventAssembler(BatchEngine.TPU)
+        assert a.seal_rows == RUN_SEAL_ROWS
+        seen = [a.seal_rows]
+        for _ in range(5):
+            a.grow_seal()
+            seen.append(a.seal_rows)
+        # monotone, capped, and every step lands exactly on a standard
+        # bucket (an off-bucket seal would compile a wasted program)
+        assert seen[-1] == MEGA_SEAL_ROWS
+        assert all(s in ROW_BUCKETS for s in seen)
+        assert seen == sorted(seen)
+        a.reset_seal()
+        assert a.seal_rows == RUN_SEAL_ROWS
+
+    def test_grown_seal_accumulates_past_default(self):
+        from etl_tpu.config.pipeline import BatchEngine
+        from etl_tpu.postgres.codec import pgoutput
+        from etl_tpu.runtime.assembler import RUN_SEAL_ROWS, EventAssembler
+
+        schema = self._schema()
+        a = EventAssembler(BatchEngine.TPU)
+        a.grow_seal()
+        n = RUN_SEAL_ROWS + 8
+        payloads = [pgoutput.encode_insert(7, [b"1"])] * n
+        a.push_raw_rows(payloads, schema, list(range(n)), 999, 0)
+        # the run is still OPEN (one future DecodedBatchEvent, not two)
+        assert a._run is not None and len(a._run.payloads) == n
+
+    def test_scaled_flush_threshold_tracks_seal(self):
+        from etl_tpu.config import BatchConfig, PipelineConfig
+        from etl_tpu.config.pipeline import BatchEngine
+        from etl_tpu.runtime.apply_loop import ApplyLoop
+        from etl_tpu.runtime.assembler import EventAssembler
+
+        loop = ApplyLoop.__new__(ApplyLoop)
+        loop.config = PipelineConfig(
+            pipeline_id=1, publication_name="p",
+            batch=BatchConfig(max_size_bytes=1000))
+        loop.assembler = EventAssembler(BatchEngine.TPU)
+        assert loop._scaled_max_bytes() == 1000
+        loop.assembler.grow_seal()
+        assert loop._scaled_max_bytes() == 4000
+        loop.assembler.grow_seal()
+        assert loop._scaled_max_bytes() == 16000
+        loop.assembler.reset_seal()
+        assert loop._scaled_max_bytes() == 1000
+
+
+class TestAutotuneModel:
+    """Measured device routing (VERDICT r4 #1a)."""
+
+    def test_crossover_math(self):
+        from etl_tpu.ops.autotune import _FLOOR_ROWS, DeviceCostModel
+
+        # host: 1M col-rows/s; link: 100MB/s with 10ms fixed cost.
+        # schema: 2 dense cols, 50B/row → host 2µs/row, link 0.5µs/row
+        # → margin 1.5µs/row → crossover ≈ 6667 rows
+        m = DeviceCostModel(fixed_s=0.010, bytes_per_s=100e6,
+                            host_col_rows_per_s=1e6, backend="tpu")
+        got = m.device_min_rows(n_dense=2, bytes_per_row=50.0,
+                                default=131_072)
+        assert _FLOOR_ROWS <= got <= 7000
+        assert got == int(0.010 / (2 / 1e6 - 50 / 100e6)) + 1
+
+    def test_slow_link_keeps_default(self):
+        from etl_tpu.ops.autotune import DeviceCostModel
+
+        # tunnel-class link: 40MB/s, 50B/row → 1.25µs/row link vs
+        # 0.5µs/row host → the device never wins on throughput;
+        # routing keeps the static default
+        m = DeviceCostModel(fixed_s=0.050, bytes_per_s=40e6,
+                            host_col_rows_per_s=4e6, backend="tpu")
+        assert m.device_min_rows(2, 50.0, default=131_072) == 131_072
+
+    def test_floor_guards_lucky_probe(self):
+        from etl_tpu.ops.autotune import _FLOOR_ROWS, DeviceCostModel
+
+        m = DeviceCostModel(fixed_s=1e-6, bytes_per_s=1e12,
+                            host_col_rows_per_s=1e5, backend="tpu")
+        assert m.device_min_rows(4, 60.0, default=131_072) == _FLOOR_ROWS
+
+    def test_no_dense_columns_keeps_default(self):
+        from etl_tpu.ops.autotune import DeviceCostModel
+
+        m = DeviceCostModel(fixed_s=0.01, bytes_per_s=1e8,
+                            host_col_rows_per_s=1e6, backend="tpu")
+        assert m.device_min_rows(0, 0.0, default=77) == 77
+
+    def test_cpu_backend_measures_none_and_default_resolves(self):
+        import etl_tpu.ops.autotune as at
+
+        # conftest pins JAX_PLATFORMS=cpu → no separate accelerator
+        at._MEASURED = None
+        try:
+            assert at.measure() is None
+            assert at.resolve_device_min_rows(4, 60.0, 131_072) == 131_072
+        finally:
+            at._MEASURED = None
