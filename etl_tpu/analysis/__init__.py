@@ -1,0 +1,39 @@
+"""etl-lint: AST-based async-safety & device-sync static analysis.
+
+The TPU decode path wins (BENCH_r05: 14-17x CPU baseline) are fragile in
+exactly the ways a human reviewer keeps missing: a synchronous
+jit-compiling probe inside the asyncio apply loop, a dropped
+`asyncio.create_task` handle, a broad `except` that eats a
+`CancelledError` mid-shutdown. This package enforces those invariants by
+machinery instead of post-hoc advice:
+
+  - `rules`      — the codebase-specific rule set (see docs/static-analysis.md)
+  - `visitor`    — scope/context-tracking AST walk the rules plug into
+  - `findings`   — the finding model + stable fingerprints
+  - `baseline`   — suppression file I/O for grandfathered findings
+  - `cli`        — `python -m etl_tpu.analysis [paths]`
+  - `annotations`— the runtime-visible `@hot_loop` marker
+
+Everything here is stdlib-only so hot modules (ops/engine, runtime/
+assembler) can import `hot_loop` without pulling analysis machinery.
+"""
+
+from __future__ import annotations
+
+from .annotations import hot_loop
+from .findings import Finding
+
+__all__ = ["Finding", "analyze_paths", "analyze_source", "hot_loop"]
+
+
+def analyze_source(source: str, rel_path: str):
+    """Lint one module's source; `rel_path` drives path-scoped rules."""
+    from .rules import analyze_source as _impl
+
+    return _impl(source, rel_path)
+
+
+def analyze_paths(paths, root=None):
+    from .rules import analyze_paths as _impl
+
+    return _impl(paths, root=root)

@@ -1,0 +1,33 @@
+"""Runtime-visible markers the static analyzer keys on.
+
+`@hot_loop` declares a function to be on the per-row/per-dispatch hot
+path where a host<->device transfer (np.asarray on a device value,
+jax.device_get, .block_until_ready) would serialize the pipeline against
+the accelerator link. The decorator itself is zero-cost — it tags the
+function and returns it unchanged — but etl-lint's
+`hot-loop-host-transfer` rule scans every function carrying the marker
+and fails tier-1 on any transfer call inside it.
+
+Contract for decorated functions:
+  - no host transfers: dispatch device work, hand back futures/pending
+    handles; fetch happens at the consumer (`_PendingDecode.result()`).
+  - intentional transfers (there are none today) must carry an inline
+    `# etl-lint: ignore[hot-loop-host-transfer]` with a justification.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+_F = TypeVar("_F", bound=Callable)
+
+#: attribute set on decorated functions (runtime-introspectable; the
+#: analyzer matches the decorator *name* lexically, so aliasing the
+#: import defeats the lint — don't)
+HOT_LOOP_ATTR = "__etl_hot_loop__"
+
+
+def hot_loop(fn: _F) -> _F:
+    """Mark `fn` as hot-path: etl-lint forbids host transfers inside."""
+    setattr(fn, HOT_LOOP_ATTR, True)
+    return fn

@@ -1,0 +1,102 @@
+"""`python -m etl_tpu.analysis [paths]` — run etl-lint.
+
+Exit codes: 0 clean (after baseline), 1 violations, 2 usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import baseline as baseline_mod
+from .rules import RULE_NAMES, analyze_paths, repo_package_dir
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m etl_tpu.analysis",
+        description="etl-lint: async-safety & device-sync static analysis "
+                    "for the etl_tpu codebase")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to scan "
+                        "(default: the etl_tpu package)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline suppression file "
+                        "(default: etl_tpu/analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to cover all current "
+                        "findings, pruning fixed entries")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule names and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the summary line")
+    return p
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        print("\n".join(RULE_NAMES))
+        return 0
+    paths = args.paths or [str(repo_package_dir())]
+    scanned: list[str] = []
+    try:
+        findings = analyze_paths(paths, scanned=scanned)
+    except (SyntaxError, OSError) as e:
+        print(f"etl-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        # scanned_paths bounds the rewrite: a scoped run only rewrites
+        # entries for the files it actually looked at
+        out = baseline_mod.save(findings, args.baseline,
+                                scanned_paths=set(scanned))
+        if not args.quiet:
+            print(f"etl-lint: baseline updated: {out} "
+                  f"({len(findings)} findings grandfathered)")
+        return 0
+
+    if args.no_baseline:
+        allowed: dict[str, int] = {}
+    else:
+        try:
+            allowed = baseline_mod.load(args.baseline)
+        except (ValueError, OSError) as e:
+            print(f"etl-lint: {e}", file=sys.stderr)
+            return 2
+    violations, stale = baseline_mod.apply(findings, allowed)
+    # stale warnings only make sense for files this run actually looked
+    # at — a scoped run can't know whether out-of-scope debt was fixed
+    scanned_set = set(scanned)
+    stale = {fp: n for fp, n in stale.items()
+             if baseline_mod.fingerprint_path(fp) in scanned_set}
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "violations": [f.to_dict() for f in violations],
+            "stale_baseline": stale,
+            "baselined": len(findings) - len(violations),
+        }, indent=2))
+    else:
+        for f in violations:
+            print(f.render())
+        for fp, unused in sorted(stale.items()):
+            print(f"etl-lint: stale baseline entry ({unused} unused): {fp}",
+                  file=sys.stderr)
+        if not args.quiet:
+            print(f"etl-lint: {len(findings)} findings, "
+                  f"{len(findings) - len(violations)} baselined, "
+                  f"{len(violations)} violations"
+                  + (f", {len(stale)} stale baseline entries" if stale
+                     else ""))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

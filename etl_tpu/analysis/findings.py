@@ -1,0 +1,54 @@
+"""Finding model + stable fingerprints for baseline matching.
+
+A fingerprint deliberately excludes the line number: baselines must
+survive unrelated edits above a grandfathered finding. Identity is
+(rule, canonical path, enclosing scope, normalized subject) — when the
+same subject appears N times in one scope, the baseline stores a count
+and only occurrences beyond it are violations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import PurePosixPath
+
+#: path segments stripped from the front of fingerprint paths so the
+#: same file fingerprints identically whether the scan root was the repo
+#: root, the package dir, or a mirrored fixtures tree
+_PACKAGE_SEGMENT = "etl_tpu"
+
+
+def canonical_path(path: str) -> str:
+    """Posix-normalize and strip everything up to the package segment:
+    `/root/repo/etl_tpu/runtime/x.py` and `runtime/x.py` both canonicalize
+    to `runtime/x.py` (fixture trees mirror the package layout)."""
+    parts = PurePosixPath(path.replace("\\", "/")).parts
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == _PACKAGE_SEGMENT:
+            parts = parts[i + 1:]
+            break
+    return "/".join(p for p in parts if p not in (".", ""))
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # kebab-case rule name
+    path: str  # canonical posix path (see canonical_path)
+    line: int
+    col: int
+    scope: str  # dotted qualname of the enclosing def/class, or <module>
+    detail: str  # normalized subject, e.g. "time.sleep" / "except Exception"
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return "|".join((self.rule, self.path, self.scope, self.detail))
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+                f"{self.message} [{self.scope}]")
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint
+        return d

@@ -1,0 +1,408 @@
+"""The etl-lint rule set (codebase-specific async-safety & device-sync).
+
+Six rules, each encoding an invariant the round-5 advisor or a prior
+VERDICT caught by hand (see docs/static-analysis.md for the contract and
+worked examples):
+
+  1. blocking-call-in-async   — sync sleep/subprocess/sqlite/socket/file
+                                I/O lexically inside `async def` bodies in
+                                runtime/, postgres/, api/
+  2. device-sync-in-async     — host<->device sync points (np.asarray,
+                                jax.device_get, .block_until_ready, the
+                                jit-compiling autotune probe) inside async
+                                code unless routed through run_in_executor
+  3. orphaned-task            — create_task/ensure_future whose handle is
+                                discarded (GC may cancel the task mid-flight)
+  4. unawaited-coroutine      — statement-level call of a locally-defined
+                                `async def` without await/gather/create_task
+  5. cancellation-swallow     — handlers that eat asyncio.CancelledError
+                                anywhere, plus broad `except Exception` in
+                                runtime/ that never re-raises
+  6. hot-loop-host-transfer   — host transfers inside `@hot_loop` functions
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from pathlib import Path
+
+from .findings import _PACKAGE_SEGMENT, Finding, canonical_path
+from .visitor import (LintContext, Rule, collect_async_defs, dotted_name,
+                      handler_type_names, has_raise, lint_module,
+                      terminal_name)
+
+# -- rule 1 -------------------------------------------------------------------
+
+#: directories whose async code runs on the replication event loop,
+#: where one blocking call stalls keepalives for every table
+EVENT_LOOP_SCOPES = ("runtime", "postgres", "api")
+
+BLOCKING_DOTTED = frozenset({
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "os.system", "os.popen", "os.waitpid",
+    "sqlite3.connect",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "urllib.request.urlopen",
+    "requests.get", "requests.post", "requests.put", "requests.request",
+})
+#: bare built-in calls that hit the filesystem synchronously
+BLOCKING_BARE = frozenset({"open"})
+
+
+class BlockingCallInAsync(Rule):
+    name = "blocking-call-in-async"
+
+    def applies_to(self, rel_path: str) -> bool:
+        head = rel_path.split("/", 1)[0]
+        return head in EVENT_LOOP_SCOPES
+
+    def on_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not ctx.in_async:
+            return
+        dotted = dotted_name(node.func)
+        subject = None
+        if dotted in BLOCKING_DOTTED:
+            subject = dotted
+        elif isinstance(node.func, ast.Name) and node.func.id in BLOCKING_BARE:
+            subject = node.func.id
+        if subject is None:
+            return
+        # NOTE deliberately no run_in_executor argument exemption:
+        # correct usage passes the callable UNCALLED (no Call node here),
+        # while `run_in_executor(None, time.sleep(5))` runs the blocking
+        # call eagerly on the loop — exactly when the rule must fire
+        ctx.report(
+            self.name, node, subject,
+            f"blocking call `{subject}` inside async def stalls the "
+            f"replication event loop; use the async equivalent or "
+            f"loop.run_in_executor")
+
+
+# -- rule 2 -------------------------------------------------------------------
+
+#: calls that synchronize with (or jit-compile for) the accelerator —
+#: inside async code each one stalls keepalives for the round trip
+DEVICE_SYNC_DOTTED = frozenset({
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.device_get", "jax.device_put", "jax.jit",
+    # the autotune probe jit-compiles + moves 2x8 MiB over the link.
+    # NOTE the round-5 advisor's actual bug fired through a SYNC call
+    # chain (DeviceDecoder.__init__ on the loop), which lexical analysis
+    # cannot see — that path is fixed by Pipeline.start() awaiting
+    # autotune.prewarm() (guarded by its own test); this rule prevents
+    # the probe from being reintroduced directly into async code
+    "autotune.measure", "autotune.resolve_device_min_rows",
+})
+DEVICE_SYNC_METHODS = frozenset({"block_until_ready"})
+
+
+class DeviceSyncInAsync(Rule):
+    name = "device-sync-in-async"
+
+    def on_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not ctx.in_async:
+            return
+        dotted = dotted_name(node.func)
+        subject = None
+        if dotted in DEVICE_SYNC_DOTTED:
+            subject = dotted
+        else:
+            term = terminal_name(node.func)
+            if term in DEVICE_SYNC_METHODS and isinstance(node.func,
+                                                          ast.Attribute):
+                subject = f".{term}"
+        if subject is None:
+            return
+        # no run_in_executor argument exemption — see BlockingCallInAsync
+        ctx.report(
+            self.name, node, subject,
+            f"device sync point `{subject}` inside async def blocks the "
+            f"event loop on the host<->device link; dispatch and hand "
+            f"back a pending handle, or route through run_in_executor")
+
+
+# -- rule 3 -------------------------------------------------------------------
+
+TASK_SPAWNERS = frozenset({"create_task", "ensure_future"})
+
+
+class OrphanedTask(Rule):
+    name = "orphaned-task"
+
+    def _report(self, ctx: LintContext, call: ast.Call) -> None:
+        subject = dotted_name(call.func) or terminal_name(call.func)
+        ctx.report(
+            self.name, call, subject,
+            f"`{subject}` result discarded: the event loop holds only a "
+            f"weak reference, so GC can cancel the task mid-flight — "
+            f"keep the handle (and await it on shutdown)")
+
+    def on_expr_statement(self, ctx: LintContext, node: ast.Expr) -> None:
+        call = node.value
+        if isinstance(call, ast.Call) \
+                and terminal_name(call.func) in TASK_SPAWNERS:
+            self._report(ctx, call)
+
+    def on_call(self, ctx: LintContext, node: ast.Call) -> None:
+        # `lambda: ensure_future(...)` as a callback (signal handlers,
+        # add_done_callback): the lambda returns the handle but every
+        # callback caller discards it — same GC hazard, different shape
+        if terminal_name(node.func) not in TASK_SPAWNERS:
+            return
+        ancestors = ctx.ancestors()
+        if ancestors and isinstance(ancestors[-1], ast.Lambda) \
+                and ancestors[-1].body is node:
+            self._report(ctx, node)
+
+
+# -- rule 4 -------------------------------------------------------------------
+
+class UnawaitedCoroutine(Rule):
+    name = "unawaited-coroutine"
+
+    def __init__(self) -> None:
+        self._plain: set[str] = set()
+        self._methods: dict[str, set[str]] = {}
+
+    def before_module(self, ctx: LintContext, tree: ast.Module) -> None:
+        self._plain, self._methods = collect_async_defs(tree)
+
+    def on_expr_statement(self, ctx: LintContext, node: ast.Expr) -> None:
+        call = node.value
+        if not isinstance(call, ast.Call):
+            return
+        func = call.func
+        subject = None
+        if isinstance(func, ast.Name) and func.id in self._plain:
+            subject = func.id
+        elif (isinstance(func, ast.Attribute)
+              and isinstance(func.value, ast.Name)
+              and func.value.id in ("self", "cls")
+              and func.attr in self._methods.get(
+                  ctx.current_class or "", ())):
+            subject = f"{func.value.id}.{func.attr}"
+        if subject is None:
+            return
+        ctx.report(
+            self.name, call, subject,
+            f"`{subject}` is an async def: calling it without "
+            f"await/gather/create_task builds a coroutine object and "
+            f"silently drops it — the body never runs")
+
+
+# -- rule 5 -------------------------------------------------------------------
+
+class CancellationSwallow(Rule):
+    name = "cancellation-swallow"
+
+    @staticmethod
+    def _is_cancel_drain(ctx: LintContext,
+                         node: ast.ExceptHandler) -> bool:
+        """The canonical safe idiom `t.cancel(); try: await t; except
+        CancelledError: pass` — the swallow IS the point: awaiting a task
+        you just cancelled raises its CancelledError into you. Recognized
+        lexically (a `.cancel()` on the awaited target earlier in the
+        same function, trivial handler body) so the repo's shutdown
+        drains need no per-site suppression."""
+        for stmt in node.body:
+            if not (isinstance(stmt, (ast.Pass, ast.Continue, ast.Break))
+                    or (isinstance(stmt, ast.Return)
+                        and (stmt.value is None
+                             or isinstance(stmt.value, ast.Constant)))):
+                return False
+        ancestors = ctx.ancestors()
+        try_node = next((n for n in reversed(ancestors)
+                         if isinstance(n, ast.Try)), None)
+        if try_node is None or node not in try_node.handlers:
+            return False
+        targets = set()
+        for stmt in try_node.body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Await):
+                    d = dotted_name(n.value)
+                    if d:
+                        targets.add(d)
+        if not targets:
+            return False
+        scope = next((n for n in reversed(ancestors)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))),
+                     ancestors[0] if ancestors else try_node)
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Call):
+                d = dotted_name(n.func)
+                if d is not None and d.endswith(".cancel") \
+                        and d[:-len(".cancel")] in targets \
+                        and getattr(n, "lineno", 1 << 30) <= node.lineno:
+                    return True
+        return False
+
+    @staticmethod
+    def _cancellation_shielded(ctx: LintContext,
+                               node: ast.ExceptHandler) -> bool:
+        """True when an EARLIER handler of the same `try` catches
+        CancelledError and re-raises — cancellation never reaches `node`,
+        so a broad catch there (panic containment) is not a swallow."""
+        for anc in reversed(ctx.ancestors()):
+            if isinstance(anc, ast.Try):
+                for prior in anc.handlers:
+                    if prior is node:
+                        break
+                    if "CancelledError" in handler_type_names(prior) \
+                            and has_raise(prior):
+                        return True
+                return False
+        return False
+
+    def on_except_handler(self, ctx: LintContext,
+                          node: ast.ExceptHandler) -> None:
+        names = handler_type_names(node)
+        if has_raise(node):
+            return
+        if self._is_cancel_drain(ctx, node):
+            return
+        if (("<bare>" in names or "BaseException" in names
+                or "CancelledError" in names)
+                and not self._cancellation_shielded(ctx, node)):
+            caught = "except" if "<bare>" in names \
+                else f"except {'|'.join(names)}"
+            ctx.report(
+                self.name, node, caught,
+                f"`{caught}` catches asyncio.CancelledError and never "
+                f"re-raises: shutdown/timeout cancellation dies here and "
+                f"the worker keeps running")
+            return
+        broad = {"Exception", "BaseException", "<bare>"} & set(names)
+        if broad and ctx.rel_path.split("/", 1)[0] == "runtime":
+            caught = sorted(broad)[0]
+            caught = "except" if caught == "<bare>" else f"except {caught}"
+            ctx.report(
+                self.name, node, caught,
+                f"broad `{caught}` in runtime/ without re-raise hides "
+                f"apply-loop failures; narrow it, re-raise, or baseline "
+                f"with a justification")
+
+
+# -- rule 6 -------------------------------------------------------------------
+
+HOT_TRANSFER_DOTTED = frozenset({
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.device_get", "jax.device_put",
+})
+HOT_TRANSFER_METHODS = frozenset({"block_until_ready"})
+
+
+class HotLoopHostTransfer(Rule):
+    name = "hot-loop-host-transfer"
+
+    def on_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not ctx.in_hot_loop:
+            return
+        dotted = dotted_name(node.func)
+        subject = None
+        if dotted in HOT_TRANSFER_DOTTED:
+            subject = dotted
+        else:
+            term = terminal_name(node.func)
+            if term in HOT_TRANSFER_METHODS and isinstance(node.func,
+                                                           ast.Attribute):
+                subject = f".{term}"
+        if subject is None:
+            return
+        ctx.report(
+            self.name, node, subject,
+            f"host transfer `{subject}` inside a @hot_loop function "
+            f"serializes the hot path against the device link; fetch at "
+            f"the consumer (_PendingDecode.result) instead")
+
+
+# -- entry points -------------------------------------------------------------
+
+def default_rules() -> list[Rule]:
+    return [
+        BlockingCallInAsync(),
+        DeviceSyncInAsync(),
+        OrphanedTask(),
+        UnawaitedCoroutine(),
+        CancellationSwallow(),
+        HotLoopHostTransfer(),
+    ]
+
+
+RULE_NAMES = tuple(r.name for r in default_rules())
+
+
+def analyze_source(source: str, rel_path: str,
+                   rules: list[Rule] | None = None) -> list[Finding]:
+    """Lint one module's source. `rel_path` drives path-scoped rules and
+    fixture trees mirror the package layout, so `runtime/foo.py` gets the
+    runtime/ rule scoping whether it is real or a test snippet."""
+    return lint_module(source, rel_path, rules or default_rules())
+
+
+def iter_python_files(path: str | Path) -> "list[Path]":
+    p = Path(path)
+    if p.is_file():
+        return [p]
+    return sorted(f for f in p.rglob("*.py")
+                  if "__pycache__" not in f.parts)
+
+
+def analyze_paths(paths, root: "str | None" = None,
+                  scanned: "list[str] | None" = None) -> list[Finding]:
+    """Lint every .py under `paths`. Rel paths are computed against each
+    argument (directory args act as scan roots), then canonicalized, so
+    `analyze_paths(["etl_tpu"])` and `analyze_paths(["."])` fingerprint
+    identically. When `scanned` is given, the canonical path of every
+    file visited is appended to it (clean files included) — baseline
+    updates need the full scan scope, not just files with findings."""
+    findings: list[Finding] = []
+    for arg in paths:
+        if not Path(arg).exists():
+            # a typo'd path silently scanning nothing would keep CI green
+            raise OSError(f"no such path: {arg}")
+        for f in iter_python_files(arg):
+            resolved = f.resolve()
+            # fingerprint identity must not depend on HOW the file was
+            # reached: `analysis etl_tpu`, `analysis etl_tpu/api`, and
+            # `analysis etl_tpu/api/db.py` all canonicalize db.py to
+            # api/db.py, or path-scoped rules and baseline matching
+            # silently break for scoped runs. Package files key off the
+            # etl_tpu segment of the FULL path (caveat: a checkout whose
+            # root dir is itself named etl_tpu would confuse this);
+            # mirror trees (fixtures) key off the scan root.
+            if root is not None:
+                base = Path(root).resolve()
+            elif _PACKAGE_SEGMENT in resolved.parts:
+                base = None  # canonical_path strips to the package
+            elif Path(arg).is_dir():
+                base = Path(arg).resolve()
+            else:
+                base = Path.cwd()
+            rel = resolved
+            if base is not None:
+                try:
+                    rel = resolved.relative_to(base)
+                except ValueError:
+                    pass
+            if scanned is not None:
+                scanned.append(canonical_path(rel.as_posix()))
+            source = f.read_text(encoding="utf-8")
+            try:
+                findings.extend(
+                    analyze_source(source, rel.as_posix(),
+                                   rules=default_rules()))
+            except SyntaxError as e:
+                raise SyntaxError(
+                    f"etl-lint: cannot parse {f}: {e}") from e
+    findings.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
+    return findings
+
+
+def repo_package_dir() -> Path:
+    """The installed etl_tpu package directory (the default scan target)."""
+    return Path(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

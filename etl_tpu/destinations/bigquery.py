@@ -260,7 +260,7 @@ class BigQueryDestination(Destination):
                         await self._apply_schema_change(op[1])
                 if not fut.done():
                     fut.set_result(None)
-            except BaseException as e:
+            except BaseException as e:  # etl-lint: ignore[cancellation-swallow] — transferred to the ack future, not dropped
                 if not fut.done():
                     fut.set_exception(e)
 
@@ -275,7 +275,7 @@ class BigQueryDestination(Destination):
             await self._append_rows(table, schema, rows)
             if not fut.done():
                 fut.set_result(None)
-        except BaseException as e:
+        except BaseException as e:  # etl-lint: ignore[cancellation-swallow] — transferred to the ack future, not dropped
             if not fut.done():
                 fut.set_exception(e)
 

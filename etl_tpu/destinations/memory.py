@@ -20,6 +20,7 @@ from ..models.event import Event
 from ..models.schema import ReplicatedTableSchema, TableId
 from ..models.table_row import ColumnarBatch, TableRow
 from .base import Destination, WriteAck, expand_batch_events
+from .util import TaskSet
 
 
 class MemoryDestination(Destination):
@@ -78,6 +79,12 @@ class FaultInjectingDestination(Destination):
         self._faults: dict[str, deque[FaultAction]] = defaultdict(deque)
         self.write_events_calls = 0
         self.write_rows_calls = 0
+        # strong refs: a bare ensure_future handle is GC-collectable and
+        # the loop may cancel the release task mid-HOLD (etl-lint:
+        # orphaned-task)
+        self._tasks = TaskSet()
+        self._held_acks: list[asyncio.Future] = []
+        self._shut_down = False
 
     def script(self, op: str, action: FaultAction) -> None:
         """op: one of write_table_rows / write_events / drop_table /
@@ -111,12 +118,44 @@ class FaultInjectingDestination(Destination):
             await release.wait()
             if not fut.done():
                 fut.set_result(None)
+            if fut in self._held_acks:  # released: nothing to resolve at
+                # shutdown (and the list must not grow per HOLD); may be
+                # gone already if shutdown swept mid-release
+                self._held_acks.remove(fut)
 
-        asyncio.ensure_future(_release())
+        if self._shut_down:
+            # the writer was suspended in `await run()` while shutdown
+            # swept _held_acks — registering now would hang the consumer
+            self._fail_held(fut)
+            return ack
+        self._tasks.spawn(_release())
+        self._held_acks.append(fut)
         return ack
+
+    @staticmethod
+    def _fail_held(fut: asyncio.Future) -> None:
+        fut.set_exception(EtlError(
+            ErrorKind.DESTINATION_FAILED,
+            "destination shut down with HOLD pending"))
+        # the consumer may be gone already (cancelled apply loop); mark
+        # retrieved so GC doesn't log "exception was never retrieved" —
+        # a later await still sees the error
+        fut.exception()
 
     async def startup(self) -> None:
         await self.inner.startup()
+
+    async def shutdown(self) -> None:
+        self._shut_down = True  # writers mid-`await run()` must not
+        # register new held acks after the sweep below
+        await self._tasks.cancel_all()
+        # a cancelled (or never-started) release task can't resolve its
+        # ack — a consumer awaiting durability would hang forever
+        for fut in self._held_acks:
+            if not fut.done():
+                self._fail_held(fut)
+        self._held_acks.clear()
+        await self.inner.shutdown()
 
     async def write_table_rows(self, schema: ReplicatedTableSchema,
                                batch: ColumnarBatch) -> WriteAck:

@@ -29,6 +29,7 @@ on hardware where the chip sits behind a high-latency link.
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import logging
 import time
@@ -127,8 +128,11 @@ def _measure_host_rate() -> float:
     chunk = (line + b"\n") * _HOST_PROBE_ROWS
     staged = stage_copy_chunk(chunk, _HOST_PROBE_COLS)
     # device_min_rows above the probe size pins the host path; mesh=None
-    # keeps the probe off any multi-device routing
-    dec = DeviceDecoder(schema, device_min_rows=1 << 30, mesh=None)
+    # keeps the probe off any multi-device routing; telemetry=False keeps
+    # the warm+reps probe decodes out of the routed-rows counters — the
+    # device-share honesty metric must reflect real traffic only
+    dec = DeviceDecoder(schema, device_min_rows=1 << 30, mesh=None,
+                        telemetry=False)
     dec.decode(staged)  # compile + warm
     best = float("inf")
     for _ in range(_PROBE_REPS):
@@ -167,6 +171,23 @@ def measure(force: bool = False) -> DeviceCostModel | None:
         model = None
     _MEASURED = [model]
     return model
+
+
+async def prewarm() -> DeviceCostModel | None:
+    """Measure from async code WITHOUT blocking the event loop.
+
+    `measure()` jit-compiles a probe program and moves 2x8 MiB over the
+    host<->device link — seconds of wall time on a tunnel-attached chip.
+    The round-5 advisor caught it running synchronously inside the apply
+    loop when the first `DeviceDecoder` was constructed mid-stream
+    (engine.py device_min_rows resolution), stalling keepalives for every
+    table. `Pipeline.start()` awaits this before spawning workers, so the
+    per-process cache is hot by the time any decoder is built on the loop.
+    """
+    if _MEASURED is not None:
+        return _MEASURED[0]
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, measure)
 
 
 def resolve_device_min_rows(n_dense: int, bytes_per_row: float,

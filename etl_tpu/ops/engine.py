@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.annotations import hot_loop
 from ..models.pgtypes import CellKind
 from ..models.schema import ReplicatedTableSchema
 from ..models.table_row import Column, ColumnarBatch, dense_dtype
@@ -286,9 +287,14 @@ class DeviceDecoder:
                  device_min_rows: int | None = None,
                  host_min_rows: int | None = None,
                  mesh: "object | str | None" = "auto",
-                 mesh_min_rows: int | None = None):
+                 mesh_min_rows: int | None = None,
+                 telemetry: bool = True):
         self.schema = schema
         self.use_pallas = use_pallas
+        # telemetry=False keeps synthetic decodes (the autotune host-rate
+        # probe) out of the routed-rows/decode counters so the device-share
+        # metric reflects real replication traffic only
+        self._telemetry = telemetry
         self.host_min_rows = self.HOST_MIN_ROWS \
             if host_min_rows is None else host_min_rows
         if mesh == "auto":
@@ -331,6 +337,10 @@ class DeviceDecoder:
             # and this schema's actual per-row traffic (gather widths up,
             # packed words down). Falls back to the static default when
             # no separate accelerator exists or the probe failed.
+            # Pipeline.start() awaits autotune.prewarm() before spawning
+            # workers, so this resolve hits the per-process cache when a
+            # decoder is built on the event loop (the r5 advisor caught
+            # the unwarmed probe stalling the apply loop for seconds).
             from . import autotune
             from .bitpack import layout_for_specs
 
@@ -568,20 +578,16 @@ class DeviceDecoder:
             # — the remaining rows' raw bytes ARE the exact text (the
             # per-row Python loop here measured 10× the whole decode)
             return self._gather_string_arrow(staged, spec, valid)
+        # STRING never reaches here: it is in _LAZY_TEXT_KINDS, so the
+        # Arrow-gather path above always returns first
         out: list[Any] = [None] * n
         offs = staged.offsets[:, spec.index]
         lens = staged.lengths[:, spec.index]
         data = staged.data
-        if spec.kind is CellKind.STRING:
-            # COPY path may carry escapes → per-row decode (escaped rows are
-            # already routed to cpu_fallback_rows and fixed up afterwards)
-            for i in np.flatnonzero(valid[:n]):
-                out[i] = data[offs[i] : offs[i] + lens[i]].tobytes().decode("utf-8")
-        else:
-            oid = col.type_oid
-            for i in np.flatnonzero(valid[:n]):
-                text = data[offs[i] : offs[i] + lens[i]].tobytes().decode("utf-8")
-                out[i] = parse_cell_text(text, oid)
+        oid = col.type_oid
+        for i in np.flatnonzero(valid[:n]):
+            text = data[offs[i] : offs[i] + lens[i]].tobytes().decode("utf-8")
+            out[i] = parse_cell_text(text, oid)
         return out
 
     def _cpu_fixup(self, staged: StagedBatch, rows: np.ndarray,
@@ -693,24 +699,29 @@ class DeviceDecoder:
             ETL_DEVICE_DECODE_ROWS_TOTAL, ETL_DEVICE_DECODE_SECONDS,
             registry)
 
-        registry.counter_inc(ETL_DEVICE_DECODE_ROWS_TOTAL, n)
+        if self._telemetry:
+            registry.counter_inc(ETL_DEVICE_DECODE_ROWS_TOTAL, n)
         if fallback:
             rows_arr = np.asarray(sorted(r for r in fallback if r < n),
                                   dtype=np.int64)
             self._cpu_fixup(staged, rows_arr, columns)
-            registry.counter_inc(ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL,
-                                 len(rows_arr))
+            if self._telemetry:
+                registry.counter_inc(ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL,
+                                     len(rows_arr))
         # completion time (fetch wait + unpack + combines + object cols);
         # dispatch/transfer overlap is deliberately excluded
-        registry.histogram_observe(ETL_DEVICE_DECODE_SECONDS,
-                                   _time.perf_counter() - _t0)
+        if self._telemetry:
+            registry.histogram_observe(ETL_DEVICE_DECODE_SECONDS,
+                                       _time.perf_counter() - _t0)
         return ColumnarBatch(self.schema, columns)
 
     # -- public -------------------------------------------------------------
 
+    @hot_loop
     def decode_async(self, staged: StagedBatch) -> _PendingDecode:
         """Dispatch the device work and return immediately; stage the next
-        batch while this one is in flight."""
+        batch while this one is in flight. @hot_loop: dispatch-only — the
+        fetch happens at `_PendingDecode.result()` on the consumer."""
         cols = self.schema.replicated_columns
         if len(cols) != staged.n_cols:
             raise ValueError(
@@ -724,19 +735,22 @@ class DeviceDecoder:
         if self._dense and staged.n_rows >= self.device_min_rows:
             specs = self._specs(staged, self._widths(staged))
             packed, bad_rows = self._device_call(staged, specs)
-            registry.counter_inc(ETL_DECODE_ROUTED_DEVICE_ROWS_TOTAL,
-                                 staged.n_rows)
+            if self._telemetry:
+                registry.counter_inc(ETL_DECODE_ROUTED_DEVICE_ROWS_TOTAL,
+                                     staged.n_rows)
         elif self._dense and staged.n_rows >= self.host_min_rows \
                 and _host_cpu_device() is not None:
             specs = self._host_specs()
             packed, bad_rows = self._device_call(staged, specs, host=True)
-            registry.counter_inc(ETL_DECODE_ROUTED_HOST_ROWS_TOTAL,
-                                 staged.n_rows)
+            if self._telemetry:
+                registry.counter_inc(ETL_DECODE_ROUTED_HOST_ROWS_TOTAL,
+                                     staged.n_rows)
         else:
             specs = ()
             packed, bad_rows = None, None
-            registry.counter_inc(ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL,
-                                 staged.n_rows)
+            if self._telemetry:
+                registry.counter_inc(ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL,
+                                     staged.n_rows)
         return _PendingDecode(self, staged, specs, packed, bad_rows)
 
     def decode(self, staged: StagedBatch) -> ColumnarBatch:

@@ -155,9 +155,18 @@ async def run_replicator(config_dir: str,
 
     metrics_runner = await serve_metrics(metrics_port)
     loop = asyncio.get_event_loop()
+    # hold the shutdown-task handle: the loop keeps only a weak ref, so
+    # a bare ensure_future in the handler could be GC'd mid-shutdown
+    # (etl-lint: orphaned-task)
+    signal_tasks: set[asyncio.Task] = set()
+
+    def _request_shutdown() -> None:
+        t = asyncio.ensure_future(pipeline.shutdown())
+        signal_tasks.add(t)
+        t.add_done_callback(signal_tasks.discard)
+
     for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(
-            sig, lambda: asyncio.ensure_future(pipeline.shutdown()))
+        loop.add_signal_handler(sig, _request_shutdown)
 
     maint_agent = None
     maint_store = None

@@ -137,13 +137,13 @@ class ApplyWorker:
                     wal = await source.get_current_wal_lsn()
                 except asyncio.CancelledError:
                     raise
-                except Exception:
+                except Exception:  # etl-lint: ignore[cancellation-swallow]
                     # lag sampling must never take down the apply worker;
                     # drop the connection and retry on the next tick
                     if source is not None:
                         try:
                             await source.close()
-                        except Exception:
+                        except Exception:  # etl-lint: ignore[cancellation-swallow] — best-effort close of an already-broken connection
                             pass
                         source = None
                     continue

@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..analysis.annotations import hot_loop
 from ..config.pipeline import BatchEngine
 from ..models.errors import ErrorKind, EtlError
 from ..models.event import DecodedBatchEvent, Event
@@ -88,12 +89,14 @@ class EventAssembler:
         self._events.append(ev)
         self.size_bytes += size_hint
 
+    @hot_loop
     def push_raw_row(self, payload: bytes, schema: ReplicatedTableSchema,
                      start_lsn: Lsn, commit_lsn: Lsn,
                      tx_ordinal: int) -> None:
         """TPU fast path: accumulate the raw row-message payload without
         host-side tuple parsing (the framer parses it on the device staging
-        path). Callers guarantee payload[0] is I/U/D."""
+        path). Callers guarantee payload[0] is I/U/D. @hot_loop: runs once
+        per CDC row — a host transfer here caps stream throughput."""
         if self._run is None or self._run.table_id != schema.id \
                 or self._run.schema is not schema:
             self._seal_run()
@@ -108,13 +111,15 @@ class EventAssembler:
         if len(r.payloads) >= self.seal_rows:
             self._seal_run()
 
+    @hot_loop
     def push_raw_rows(self, payloads: list[bytes],
                       schema: ReplicatedTableSchema, start_lsns: list[int],
                       commit_lsn: int, tx_ordinal0: int) -> int:
         """Bulk form of push_raw_row for a contiguous same-table span (the
         apply loop's drained-window fast path): one call per span, list
         extends instead of per-row pushes. Returns the span's payload
-        bytes (the caller's tx_bytes accounting needs the same sum)."""
+        bytes (the caller's tx_bytes accounting needs the same sum).
+        @hot_loop: one call per drained span on the saturated path."""
         if self._run is None or self._run.table_id != schema.id \
                 or self._run.schema is not schema:
             self._seal_run()

@@ -12,7 +12,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from ..config.pipeline import PipelineConfig
+from ..config.pipeline import BatchEngine, PipelineConfig
 from ..models.errors import ErrorKind, EtlError
 from ..postgres.slots import table_sync_slot_name
 from ..postgres.source import ReplicationSource
@@ -61,6 +61,16 @@ class Pipeline:
         finally:
             await source.close()
         await self.destination.startup()
+        if self.config.batch.batch_engine is BatchEngine.TPU:
+            # warm the per-process device cost model OFF the event loop
+            # now: the probe jit-compiles and moves 2x8 MiB over the link
+            # (seconds on a tunnel-attached chip), and without prewarm it
+            # would run synchronously inside the apply loop at first
+            # DeviceDecoder construction, stalling keepalives for every
+            # table (round-5 advisor finding, ops/engine.py)
+            from ..ops import autotune
+
+            await autotune.prewarm()
         # memory defense (reference pipeline.rs:168 MemoryMonitor::new +
         # batch_budget.rs): the monitor pauses WAL/COPY intake under RSS
         # pressure; the budget controller sizes batches by the active

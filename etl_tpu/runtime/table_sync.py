@@ -215,7 +215,7 @@ class TableSyncWorker:
             pass
         except asyncio.CancelledError:
             raise
-        except BaseException as e:  # panic containment → Errored
+        except BaseException as e:  # panic containment → Errored  # etl-lint: ignore[cancellation-swallow] — CancelledError re-raised above; containment mirrors reference worker.rs
             await self._mark_errored(e)
         finally:
             self.h.done_event.set()

@@ -158,12 +158,13 @@ class MetricsRegistry:
                     cum = 0
                     for i, b in enumerate(h.bounds):
                         cum += h.buckets[i]
+                        le = f'le="{b:g}"'
                         out.append(
-                            f"{name}_bucket"
-                            f"{fmt_labels(key, f'le=\"{b:g}\"')} {cum}")
+                            f"{name}_bucket{fmt_labels(key, le)} {cum}")
                     cum += h.buckets[-1]
+                    inf = 'le="+Inf"'
                     out.append(
-                        f"{name}_bucket{fmt_labels(key, 'le=\"+Inf\"')} {cum}")
+                        f"{name}_bucket{fmt_labels(key, inf)} {cum}")
                     out.append(f"{name}_sum{fmt_labels(key)} {h.total:g}")
                     out.append(f"{name}_count{fmt_labels(key)} {h.count}")
         return "\n".join(out) + "\n"

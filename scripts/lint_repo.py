@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Fast standalone etl-lint run over the repo (CI/pre-push entry point).
+
+    python scripts/lint_repo.py            # human output, exit 1 on violations
+    python scripts/lint_repo.py --json     # machine-readable findings
+    python scripts/lint_repo.py --no-baseline   # include grandfathered debt
+
+Equivalent to `python -m etl_tpu.analysis etl_tpu/` but runnable from the
+repo root without installing the package (it prepends the repo to
+sys.path). The tier-1 suite runs the same analyzer in-process via
+tests/test_static_analysis.py::TestCli::test_repo_wide_run_is_clean.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from etl_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    # default scan target: the package dir, pinned to THIS repo checkout
+    if not any(not a.startswith("-") for a in argv):
+        argv = [str(REPO_ROOT / "etl_tpu")] + argv
+    sys.exit(main(argv))

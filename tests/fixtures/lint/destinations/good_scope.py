@@ -1,0 +1,23 @@
+# etl-lint fixture: blocking-call-in-async is scoped to runtime/,
+# postgres/, api/ — the same call in destinations/ is out of scope —
+# and a broad containment handler shielded by an earlier
+# CancelledError re-raise is not a cancellation swallow.
+# (no expectations: zero findings)
+import asyncio
+import time
+
+
+async def out_of_scope_retry_backoff():
+    time.sleep(0.1)
+
+
+async def contained_panic(task):
+    try:
+        await task
+    except asyncio.CancelledError:
+        raise
+    except BaseException:
+        # shielded: the handler above re-raises cancellation, so this
+        # broad containment never sees CancelledError (the runtime/
+        # broad-except check still applies there — not here)
+        return None

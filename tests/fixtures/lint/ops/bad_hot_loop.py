@@ -1,0 +1,12 @@
+# etl-lint fixture: host transfers inside a @hot_loop function — each
+# one serializes the hot path against the device link.
+# expect: hot-loop-host-transfer=2
+import numpy as np
+
+from etl_tpu.analysis.annotations import hot_loop
+
+
+@hot_loop
+def dispatch_and_fetch(packed):
+    packed.block_until_ready()
+    return np.asarray(packed)

@@ -1,0 +1,15 @@
+# etl-lint fixture: dispatch-only @hot_loop function, with the fetch in
+# an undecorated consumer — the hot-loop rule must stay quiet.
+# (no expectations: zero findings)
+import numpy as np
+
+from etl_tpu.analysis.annotations import hot_loop
+
+
+@hot_loop
+def dispatch_only(fn, staged):
+    return fn(staged)  # hands back the device future
+
+
+def consumer_fetch(pending):
+    return np.asarray(pending)  # not @hot_loop: fetch belongs here

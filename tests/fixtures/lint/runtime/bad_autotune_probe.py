@@ -1,0 +1,18 @@
+# etl-lint fixture: the pre-fix engine.py:340 pattern the round-5
+# advisor caught — the jit-compiling autotune probe (and other device
+# sync points) running synchronously inside the asyncio apply loop at
+# first-decoder construction. Regression guard for device-sync-in-async.
+# expect: device-sync-in-async=3
+import numpy as np
+
+from etl_tpu.ops import autotune
+
+
+class Sealer:
+    async def seal_run(self, device_value):
+        # resolve_device_min_rows -> measure(): jit compile + 2x8 MiB
+        # device round trips, all on the event loop
+        rows = autotune.resolve_device_min_rows(4, 36.0, 16384)
+        host = np.asarray(device_value)
+        device_value.block_until_ready()
+        return rows, host

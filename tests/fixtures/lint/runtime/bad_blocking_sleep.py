@@ -1,0 +1,18 @@
+# etl-lint fixture: blocking calls lexically inside async defs in a
+# runtime/ path. Parsed by the analyzer, never imported.
+# expect: blocking-call-in-async=4
+import subprocess
+import time
+
+
+async def stalls_the_loop(path):
+    time.sleep(0.5)
+    subprocess.run(["pg_dump", path])
+    with open(path) as f:
+        return f.read()
+
+
+async def executor_typo(loop):
+    # classic mistake: the CALL runs eagerly on the loop, the executor
+    # gets its (None) result — must be flagged, not exempted
+    await loop.run_in_executor(None, time.sleep(5))

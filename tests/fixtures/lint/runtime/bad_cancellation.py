@@ -1,0 +1,18 @@
+# etl-lint fixture: handlers that eat CancelledError, and a broad
+# runtime/ except that never re-raises.
+# expect: cancellation-swallow=2
+import asyncio
+
+
+async def swallows_cancel(task):
+    try:
+        await task
+    except asyncio.CancelledError:
+        return None
+
+
+async def hides_failures(op):
+    try:
+        return await op()
+    except Exception:
+        return None

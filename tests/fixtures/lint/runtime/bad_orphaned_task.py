@@ -1,0 +1,16 @@
+# etl-lint fixture: task handles discarded at statement level, or born
+# inside a callback lambda whose return value every caller throws away —
+# the loop keeps only a weak ref, so GC may cancel them mid-flight.
+# expect: orphaned-task=3
+import asyncio
+import signal
+
+
+async def fire_and_forget(coro, loop):
+    asyncio.create_task(coro)
+    loop.create_task(coro)
+
+
+def install_handler(loop, shutdown):
+    loop.add_signal_handler(
+        signal.SIGTERM, lambda: asyncio.ensure_future(shutdown()))

@@ -1,0 +1,17 @@
+# etl-lint fixture: locally-defined async defs called without
+# await/gather/create_task — the coroutine object is built and dropped.
+# expect: unawaited-coroutine=2
+async def flush_progress():
+    pass
+
+
+def sync_caller():
+    flush_progress()
+
+
+class Worker:
+    async def stop(self):
+        pass
+
+    def shutdown(self):
+        self.stop()

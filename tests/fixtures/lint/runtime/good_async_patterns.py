@@ -1,0 +1,47 @@
+# etl-lint fixture: the approved shapes for everything the bad snippets
+# do wrong — all six rules must stay quiet here.
+# (no expectations: zero findings)
+import asyncio
+
+import numpy as np
+
+
+async def sleeps_right():
+    await asyncio.sleep(0.5)
+
+
+async def fetch_off_loop(loop, pending):
+    # device sync routed through the executor: the nested sync def is
+    # exactly how blocking work legally leaves the event loop
+    def fetch():
+        return np.asarray(pending)
+
+    return await loop.run_in_executor(None, fetch)
+
+
+async def keeps_the_handle(coro):
+    task = asyncio.create_task(coro)
+    return await task
+
+
+async def awaits_local():
+    await sleeps_right()
+    await asyncio.gather(sleeps_right())
+
+
+async def reraises_cancel(task):
+    try:
+        await task
+    except asyncio.CancelledError:
+        raise
+
+
+async def cancel_then_drain(task):
+    # the canonical shutdown idiom: the swallow IS the point — awaiting
+    # a task we just cancelled raises its CancelledError into us; the
+    # rule recognizes the shape, no suppression needed
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass

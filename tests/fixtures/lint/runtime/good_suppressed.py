@@ -1,0 +1,8 @@
+# etl-lint fixture: an inline `# etl-lint: ignore[...]` on the finding
+# line suppresses exactly that rule.
+# (no expectations: zero findings)
+import time
+
+
+async def reviewed_and_blessed():
+    time.sleep(0.001)  # etl-lint: ignore[blocking-call-in-async] — 1ms calibration spin, measured harmless

@@ -1,0 +1,445 @@
+"""etl-lint: fixture expectations, baseline round-trip, CLI contract,
+and the tier-1 repo-wide enforcement run.
+
+Fixture files under tests/fixtures/lint/ mirror the package layout
+(runtime/, ops/, destinations/) so path-scoped rules apply exactly as
+they do on the real tree. Each declares its expected finding counts in
+`# expect: <rule>=<n>` header lines; absent rules expect zero.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from collections import Counter
+from pathlib import Path
+
+import pytest
+
+from etl_tpu.analysis import analyze_source, baseline as baseline_mod
+from etl_tpu.analysis.cli import main as cli_main
+from etl_tpu.analysis.findings import Finding, canonical_path
+from etl_tpu.analysis.rules import (RULE_NAMES, analyze_paths,
+                                    repo_package_dir)
+
+FIXTURES = Path(__file__).parent / "fixtures" / "lint"
+_EXPECT_RE = re.compile(r"^#\s*expect:\s*([a-z-]+)=(\d+)\s*$", re.M)
+
+
+def fixture_files() -> list[Path]:
+    return sorted(FIXTURES.rglob("*.py"))
+
+
+def expected_counts(source: str) -> Counter:
+    return Counter({rule: int(n)
+                    for rule, n in _EXPECT_RE.findall(source)})
+
+
+def lint_fixture(path: Path) -> list[Finding]:
+    rel = path.relative_to(FIXTURES).as_posix()
+    return analyze_source(path.read_text(), rel)
+
+
+class TestFixtures:
+    @pytest.mark.parametrize("path", fixture_files(),
+                             ids=lambda p: p.relative_to(FIXTURES).as_posix())
+    def test_fixture_expectations(self, path: Path) -> None:
+        source = path.read_text()
+        got = Counter(f.rule for f in lint_fixture(path))
+        assert got == expected_counts(source), \
+            [f.render() for f in lint_fixture(path)]
+
+    def test_every_rule_has_positive_and_negative_coverage(self) -> None:
+        """Acceptance criterion: rules 1-6 each have at least one fixture
+        that triggers them and at least one CLEAN fixture whose path the
+        rule actually applies to (a clean fixture outside a rule's path
+        scope proves nothing about that rule)."""
+        from etl_tpu.analysis.rules import default_rules
+
+        positive: set[str] = set()
+        negative: set[str] = set()
+        for path in fixture_files():
+            rel = path.relative_to(FIXTURES).as_posix()
+            counts = expected_counts(path.read_text())
+            positive |= {r for r, n in counts.items() if n > 0}
+            if sum(counts.values()) == 0:
+                negative |= {r.name for r in default_rules()
+                             if r.applies_to(rel)}
+        assert positive == set(RULE_NAMES), \
+            f"rules without a positive fixture: " \
+            f"{set(RULE_NAMES) - positive}"
+        assert negative == set(RULE_NAMES), \
+            f"rules without an in-scope clean fixture: " \
+            f"{set(RULE_NAMES) - negative}"
+
+    def test_regression_engine_autotune_probe_pattern(self) -> None:
+        """device-sync-in-async catches the jit-compiling autotune probe
+        written directly into async code. (The round-5 advisor's actual
+        bug fired through a sync call chain the lexical rule cannot see;
+        that fix is guarded by test_pipeline_start_awaits_prewarm.)"""
+        findings = lint_fixture(FIXTURES / "runtime" / "bad_autotune_probe.py")
+        details = {f.detail for f in findings
+                   if f.rule == "device-sync-in-async"}
+        assert "autotune.resolve_device_min_rows" in details
+        assert "np.asarray" in details
+
+    def test_pipeline_start_awaits_prewarm(self) -> None:
+        """The engine.py:340 fix itself: Pipeline.start() must await
+        autotune.prewarm() so first-decoder construction on the event
+        loop hits the per-process cost-model cache instead of running
+        the jit-compiling probe synchronously (round-5 advisor)."""
+        import ast as ast_mod
+
+        src = (repo_package_dir() / "runtime" / "pipeline.py").read_text()
+        tree = ast_mod.parse(src)
+        start = next(
+            n for cls in ast_mod.walk(tree)
+            if isinstance(cls, ast_mod.ClassDef) and cls.name == "Pipeline"
+            for n in cls.body
+            if isinstance(n, ast_mod.AsyncFunctionDef) and n.name == "start")
+        awaited = {
+            n.value.func.attr for n in ast_mod.walk(start)
+            if isinstance(n, ast_mod.Await)
+            and isinstance(n.value, ast_mod.Call)
+            and isinstance(n.value.func, ast_mod.Attribute)}
+        assert "prewarm" in awaited, \
+            "Pipeline.start() no longer awaits autotune.prewarm()"
+
+    def test_raise_after_nested_callable_still_counts(self) -> None:
+        """has_raise must not stop at a nested lambda/def that appears
+        before the raise in walk order (code-review finding)."""
+        src = ("import asyncio\n\n\n"
+               "async def f(task, x):\n"
+               "    try:\n"
+               "        await task\n"
+               "    except asyncio.CancelledError:\n"
+               "        for h in (lambda: 1,):\n"
+               "            if x:\n"
+               "                raise\n")
+        assert analyze_source(src, "runtime/x.py") == []
+
+    def test_async_method_match_is_per_class(self) -> None:
+        """A sync `self.flush()` must not be flagged because an UNRELATED
+        class in the module has `async def flush` (code-review finding)."""
+        src = ("class A:\n"
+               "    def flush(self):\n"
+               "        pass\n"
+               "\n"
+               "    def run(self):\n"
+               "        self.flush()\n"
+               "\n\n"
+               "class B:\n"
+               "    async def flush(self):\n"
+               "        pass\n")
+        assert analyze_source(src, "runtime/x.py") == []
+        # ...but the same call IS flagged within the defining class
+        src_bad = src.replace("class B:", "class C:\n"
+                              "    async def go(self):\n"
+                              "        pass\n\n"
+                              "    def stop(self):\n"
+                              "        self.go()\n\n\nclass B:")
+        assert [f.rule for f in analyze_source(src_bad, "runtime/x.py")] \
+            == ["unawaited-coroutine"]
+
+    def test_inline_suppression_is_rule_specific(self) -> None:
+        src = ("import time\n\n\n"
+               "async def f():\n"
+               "    time.sleep(1)  # etl-lint: ignore[orphaned-task]\n")
+        findings = analyze_source(src, "runtime/x.py")
+        # the ignore names a different rule -> the finding stays
+        assert [f.rule for f in findings] == ["blocking-call-in-async"]
+
+    def test_suppression_in_string_literal_is_inert(self) -> None:
+        """Only COMMENT tokens suppress — quoting the ignore syntax in a
+        string on the finding's line must not mask it (code-review
+        finding)."""
+        src = ("import time\n\n\n"
+               "async def f():\n"
+               "    time.sleep(1); s = \"use # etl-lint: "
+               "ignore[blocking-call-in-async] to suppress\"\n"
+               "    return s\n")
+        findings = analyze_source(src, "runtime/x.py")
+        assert [f.rule for f in findings] == ["blocking-call-in-async"]
+
+
+class TestFingerprints:
+    def test_canonical_path_strips_package_prefix(self) -> None:
+        assert canonical_path("/root/repo/etl_tpu/runtime/x.py") \
+            == "runtime/x.py"
+        assert canonical_path("etl_tpu/runtime/x.py") == "runtime/x.py"
+        assert canonical_path("runtime/x.py") == "runtime/x.py"
+
+    def test_fingerprint_survives_line_drift(self) -> None:
+        src = "import time\n\n\nasync def f():\n    time.sleep(1)\n"
+        shifted = "\n\n# a new header comment\n" + src
+        fp = [f.fingerprint for f in analyze_source(src, "runtime/x.py")]
+        fp2 = [f.fingerprint
+               for f in analyze_source(shifted, "runtime/x.py")]
+        assert fp == fp2 and len(fp) == 1
+
+
+class TestBaseline:
+    SRC = "import time\n\n\nasync def f():\n    time.sleep(1)\n"
+
+    def findings(self) -> list[Finding]:
+        return analyze_source(self.SRC, "runtime/x.py")
+
+    def test_round_trip_add_suppress_remove(self, tmp_path: Path) -> None:
+        findings = self.findings()
+        assert findings, "fixture must produce a finding"
+        bl_file = tmp_path / "baseline.json"
+
+        # add: grandfather the finding
+        baseline_mod.save(findings, bl_file,
+                          reasons={findings[0].fingerprint: "grandfathered"})
+        allowed = baseline_mod.load(bl_file)
+        violations, stale = baseline_mod.apply(findings, allowed)
+        assert violations == [] and stale == {}
+
+        # the finding gets fixed: the entry goes stale, nothing fails
+        violations, stale = baseline_mod.apply([], allowed)
+        assert violations == []
+        assert stale == {findings[0].fingerprint: 1}
+
+        # remove: saving over the fixed state prunes the entry
+        baseline_mod.save([], bl_file)
+        assert baseline_mod.load(bl_file) == {}
+
+    def test_new_debt_never_hides_behind_old_debt(self,
+                                                  tmp_path: Path) -> None:
+        findings = self.findings()
+        bl_file = tmp_path / "baseline.json"
+        baseline_mod.save(findings, bl_file)
+        allowed = baseline_mod.load(bl_file)
+        # a SECOND occurrence of the same fingerprint appears lower in
+        # the file -> only the new one is a violation
+        doubled = analyze_source(
+            self.SRC + "\n\nasync def g():\n    time.sleep(2)\n",
+            "runtime/x.py")
+        assert len(doubled) == 2
+        violations, _ = baseline_mod.apply(doubled, allowed)
+        assert len(violations) == 1
+        assert violations[0].line == max(f.line for f in doubled)
+
+    def test_missing_baseline_file_allows_nothing(self,
+                                                  tmp_path: Path) -> None:
+        assert baseline_mod.load(tmp_path / "absent.json") == {}
+
+
+class TestCli:
+    def test_bad_fixtures_exit_nonzero(self, capsys) -> None:
+        rc = cli_main([str(FIXTURES), "--no-baseline", "-q"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "bad_blocking_sleep.py" in out
+
+    def test_repo_wide_run_is_clean(self, capsys) -> None:
+        """Tier-1 enforcement: the analyzer over the whole package with
+        the shipped baseline must be violation-free — every rule is live
+        for all future PRs."""
+        rc = cli_main([str(repo_package_dir())])
+        out = capsys.readouterr()
+        assert rc == 0, out.out + out.err
+        assert "stale" not in out.err, \
+            f"baseline has stale entries, prune them:\n{out.err}"
+
+    def test_json_output_shape(self, capsys) -> None:
+        rc = cli_main([str(FIXTURES / "runtime" / "bad_orphaned_task.py"),
+                       "--no-baseline", "--json"])
+        assert rc == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data["baselined"] == 0
+        assert {v["rule"] for v in data["violations"]} == {"orphaned-task"}
+        for key in ("fingerprint", "path", "line", "scope", "detail"):
+            assert key in data["violations"][0]
+
+    def test_update_baseline_then_clean(self, tmp_path, capsys) -> None:
+        target = str(FIXTURES / "runtime" / "bad_cancellation.py")
+        bl = tmp_path / "bl.json"
+        assert cli_main([target, "--baseline", str(bl),
+                         "--update-baseline", "-q"]) == 0
+        assert cli_main([target, "--baseline", str(bl), "-q"]) == 0
+        capsys.readouterr()
+
+    def test_scoped_update_preserves_out_of_scope_entries(
+            self, tmp_path, capsys) -> None:
+        """--update-baseline over a subtree must not destroy grandfathered
+        entries (and reasons) for files it never scanned (code-review
+        finding)."""
+        bl = tmp_path / "bl.json"
+        pkg = repo_package_dir()
+        # full-tree baseline first
+        assert cli_main([str(pkg), "--baseline", str(bl),
+                         "--update-baseline", "-q"]) == 0
+        full = baseline_mod.load(bl)
+        assert any(fp.split("|")[1].startswith("api/") for fp in full)
+        # scoped update over testing/ only: api/ entries must survive
+        assert cli_main([str(pkg / "testing"), "--baseline", str(bl),
+                         "--update-baseline", "-q"]) == 0
+        scoped = baseline_mod.load(bl)
+        assert scoped == full
+        # and the whole tree still passes against it
+        assert cli_main([str(pkg), "--baseline", str(bl), "-q"]) == 0
+        capsys.readouterr()
+
+    def test_subdir_scan_matches_full_scan_fingerprints(self) -> None:
+        """Scanning a package subtree produces the same fingerprints as
+        the full scan reaching the same files."""
+        pkg = repo_package_dir()
+        sub = {f.fingerprint for f in analyze_paths([str(pkg / "api")])}
+        full = {f.fingerprint for f in analyze_paths([str(pkg)])
+                if f.path.startswith("api/")}
+        assert sub == full and sub
+
+    def test_list_rules(self, capsys) -> None:
+        assert cli_main(["--list-rules"]) == 0
+        assert set(capsys.readouterr().out.split()) == set(RULE_NAMES)
+
+    def test_syntax_error_exits_two(self, tmp_path, capsys) -> None:
+        bad = tmp_path / "broken.py"
+        bad.write_text("def f(:\n")
+        assert cli_main([str(bad)]) == 2
+        capsys.readouterr()
+
+    def test_nonexistent_path_exits_two(self, tmp_path, capsys) -> None:
+        # a typo'd CI path must not silently scan nothing and pass
+        assert cli_main([str(tmp_path / "nope")]) == 2
+        capsys.readouterr()
+
+
+class TestAnalyzePaths:
+    def test_directory_scan_matches_per_file(self) -> None:
+        per_dir = analyze_paths([str(FIXTURES)])
+        per_file = [f for p in fixture_files() for f in lint_fixture(p)]
+        assert sorted(f.fingerprint for f in per_dir) \
+            == sorted(f.fingerprint for f in per_file)
+
+    def test_single_file_arg_keeps_path_scope_and_fingerprint(self) -> None:
+        """Scanning one file must apply the same path-scoped rules and
+        produce the same fingerprints as the directory scan, so per-file
+        editor/pre-commit runs agree with the baseline (code-review
+        finding: the old base=parent collapsed api/db.py to db.py)."""
+        target = repo_package_dir() / "api" / "db.py"
+        per_file = analyze_paths([str(target)])
+        assert any(f.rule == "blocking-call-in-async"
+                   and f.path == "api/db.py" for f in per_file), \
+            [f.render() for f in per_file]
+
+
+class TestRuntimeFixes:
+    """The satellite fixes the analyzer forced, verified behaviorally."""
+
+    async def test_autotune_prewarm_runs_off_loop_and_caches(self) -> None:
+        from etl_tpu.ops import autotune
+
+        model = await autotune.prewarm()
+        # CPU backend (conftest pins JAX_PLATFORMS=cpu): no separate
+        # accelerator -> probe resolves to None and is cached
+        assert model is None
+        assert autotune._MEASURED is not None
+        assert await autotune.prewarm() is None
+
+    def _probe_batch(self):
+        from etl_tpu.models import (ColumnSchema, Oid,
+                                    ReplicatedTableSchema, TableName,
+                                    TableSchema)
+        from etl_tpu.ops.staging import stage_copy_chunk
+
+        schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+            1, TableName("etl", "lint_probe"),
+            tuple(ColumnSchema(f"c{i}", Oid.INT8) for i in range(4))))
+        line = b"\t".join(str(100 + i).encode() for i in range(4))
+        return schema, stage_copy_chunk((line + b"\n") * 64, 4)
+
+    def test_probe_decode_with_telemetry_off_leaves_counters_alone(self):
+        """Satellite: autotune's warm+reps probe decodes must not skew
+        the decode-routing share metrics."""
+        from etl_tpu.ops.engine import DeviceDecoder
+        from etl_tpu.telemetry.metrics import (
+            ETL_DECODE_ROUTED_DEVICE_ROWS_TOTAL,
+            ETL_DECODE_ROUTED_HOST_ROWS_TOTAL,
+            ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL, registry)
+
+        names = (ETL_DECODE_ROUTED_DEVICE_ROWS_TOTAL,
+                 ETL_DECODE_ROUTED_HOST_ROWS_TOTAL,
+                 ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL)
+        schema, staged = self._probe_batch()
+
+        def routed_total() -> float:
+            return sum(registry.get_counter(n) for n in names)
+
+        silent = DeviceDecoder(schema, device_min_rows=1 << 30, mesh=None,
+                               telemetry=False)
+        before = routed_total()
+        silent.decode(staged)
+        assert routed_total() == before, \
+            "telemetry=False decode must not touch routing counters"
+
+        loud = DeviceDecoder(schema, device_min_rows=1 << 30, mesh=None)
+        loud.decode(staged)
+        assert routed_total() == before + staged.n_rows
+
+    def test_string_column_decodes_via_arrow_gather(self):
+        """Satellite: the unreachable per-row STRING branch is gone;
+        STRING still decodes correctly through the lazy Arrow path."""
+        from etl_tpu.models import (ColumnSchema, Oid,
+                                    ReplicatedTableSchema, TableName,
+                                    TableSchema)
+        from etl_tpu.ops.engine import DeviceDecoder
+        from etl_tpu.ops.staging import stage_copy_chunk
+
+        schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+            1, TableName("etl", "lint_str"),
+            (ColumnSchema("s", Oid.TEXT),)))
+        staged = stage_copy_chunk(b"hello\nworld\n\\N\n", 1)
+        batch = DeviceDecoder(schema, mesh=None).decode(staged)
+        rows = [r.values[0] for r in batch.to_rows()]
+        assert rows == ["hello", "world", None]
+
+    def test_fault_injecting_destination_holds_release_task(self):
+        """Satellite (orphaned-task): the HOLD release task is owned by a
+        TaskSet, not a bare ensure_future."""
+        from etl_tpu.destinations.memory import FaultInjectingDestination, \
+            MemoryDestination
+
+        dest = FaultInjectingDestination(MemoryDestination())
+        assert hasattr(dest, "_tasks")
+
+    async def test_shutdown_resolves_held_acks(self):
+        """A HOLD ack outstanding at shutdown must resolve (with an
+        error), not hang the consumer forever (code-review finding on
+        the TaskSet fix)."""
+        import asyncio
+
+        from etl_tpu.destinations.memory import (FaultAction,
+                                                 FaultInjectingDestination,
+                                                 FaultKind,
+                                                 MemoryDestination)
+        from etl_tpu.models.errors import EtlError
+
+        dest = FaultInjectingDestination(MemoryDestination())
+        dest.script("write_events", FaultAction(FaultKind.HOLD))
+        ack = await dest.write_events([])
+        await dest.shutdown()
+        with pytest.raises(EtlError):
+            await asyncio.wait_for(ack.wait_durable(), timeout=5)
+
+    async def test_hold_write_racing_shutdown_still_resolves(self):
+        """A HOLD write whose writer task hasn't registered its ack yet
+        when shutdown() sweeps must still resolve, not hang (code-review
+        finding on the sweep)."""
+        import asyncio
+
+        from etl_tpu.destinations.memory import (FaultAction,
+                                                 FaultInjectingDestination,
+                                                 FaultKind,
+                                                 MemoryDestination)
+        from etl_tpu.models.errors import EtlError
+
+        dest = FaultInjectingDestination(MemoryDestination())
+        dest.script("write_events", FaultAction(FaultKind.HOLD))
+        writer = asyncio.create_task(dest.write_events([]))
+        await dest.shutdown()  # may complete before the writer starts
+        ack = await writer
+        with pytest.raises(EtlError):
+            await asyncio.wait_for(ack.wait_durable(), timeout=5)
