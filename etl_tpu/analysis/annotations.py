@@ -31,3 +31,21 @@ def hot_loop(fn: _F) -> _F:
     """Mark `fn` as hot-path: etl-lint forbids host transfers inside."""
     setattr(fn, HOT_LOOP_ATTR, True)
     return fn
+
+
+#: attribute set by @dispatch_stage (runtime-introspectable, same lexical
+#: matching caveat as HOT_LOOP_ATTR)
+DISPATCH_STAGE_ATTR = "__etl_dispatch_stage__"
+
+
+def dispatch_stage(fn: _F) -> _F:
+    """Mark `fn` as the decode pipeline's DISPATCH stage (ops/pipeline.py
+    architecture): a hot-loop function whose job is to start device work,
+    where host→device *uploads* (`jax.device_put` committing a packed
+    arena to the host-CPU backend) are the point and ride the pipeline
+    rather than stalling it. etl-lint's `hot-loop-host-transfer` rule
+    permits uploads here but still forbids device→host *fetches*
+    (np.asarray / jax.device_get / .block_until_ready) — those belong at
+    the consumer (`_PendingDecode.result()`, the fetch stage)."""
+    setattr(fn, DISPATCH_STAGE_ATTR, True)
+    return fn

@@ -18,7 +18,10 @@ worked examples):
   5. cancellation-swallow     — handlers that eat asyncio.CancelledError
                                 anywhere, plus broad `except Exception` in
                                 runtime/ that never re-raises
-  6. hot-loop-host-transfer   — host transfers inside `@hot_loop` functions
+  6. hot-loop-host-transfer   — host transfers inside `@hot_loop`
+                                functions; `@dispatch_stage` (the decode
+                                pipeline's dispatch stage) sanctions
+                                host→device uploads only
 """
 
 from __future__ import annotations
@@ -295,6 +298,13 @@ HOT_TRANSFER_DOTTED = frozenset({
 })
 HOT_TRANSFER_METHODS = frozenset({"block_until_ready"})
 
+#: host→device UPLOADS: inside a @dispatch_stage function (the decode
+#: pipeline's dispatch stage, ops/pipeline.py architecture) these are the
+#: point — the committed placement of a packed arena rides the pipeline.
+#: Fetch-side transfers (asarray / device_get / block_until_ready) stay
+#: forbidden there: they belong at the consumer, the fetch stage.
+DISPATCH_UPLOAD_DOTTED = frozenset({"jax.device_put"})
+
 
 class HotLoopHostTransfer(Rule):
     name = "hot-loop-host-transfer"
@@ -313,6 +323,8 @@ class HotLoopHostTransfer(Rule):
                 subject = f".{term}"
         if subject is None:
             return
+        if ctx.in_dispatch_stage and subject in DISPATCH_UPLOAD_DOTTED:
+            return  # upload in the dispatch stage: sanctioned
         ctx.report(
             self.name, node, subject,
             f"host transfer `{subject}` inside a @hot_loop function "

@@ -36,6 +36,12 @@ _IGNORE_RE = re.compile(r"#\s*etl-lint:\s*ignore\[([a-z0-9_,\s-]+)\]")
 #: `@analysis.hot_loop` all count)
 HOT_LOOP_DECORATORS = frozenset({"hot_loop"})
 
+#: decorator marking the decode pipeline's dispatch stage
+#: (annotations.dispatch_stage): a hot-loop function where host→device
+#: UPLOADS are the point — the hot-loop-host-transfer rule permits
+#: `jax.device_put` there while still forbidding fetch-side transfers
+DISPATCH_STAGE_DECORATORS = frozenset({"dispatch_stage"})
+
 
 def dotted_name(node: ast.AST) -> str | None:
     """`a.b.c` for a Name/Attribute chain, else None."""
@@ -116,12 +122,14 @@ class Rule:
 
 
 class _Frame:
-    __slots__ = ("name", "is_async", "is_hot")
+    __slots__ = ("name", "is_async", "is_hot", "is_dispatch")
 
-    def __init__(self, name: str, is_async: bool, is_hot: bool):
+    def __init__(self, name: str, is_async: bool, is_hot: bool,
+                 is_dispatch: bool = False):
         self.name = name
         self.is_async = is_async
         self.is_hot = is_hot
+        self.is_dispatch = is_dispatch
 
 
 class LintContext(ast.NodeVisitor):
@@ -161,6 +169,10 @@ class LintContext(ast.NodeVisitor):
     @property
     def in_hot_loop(self) -> bool:
         return bool(self._frames) and self._frames[-1].is_hot
+
+    @property
+    def in_dispatch_stage(self) -> bool:
+        return bool(self._frames) and self._frames[-1].is_dispatch
 
     @property
     def current_class(self) -> "str | None":
@@ -207,6 +219,8 @@ class LintContext(ast.NodeVisitor):
         decorators = {terminal_name(d.func if isinstance(d, ast.Call) else d)
                       for d in node.decorator_list}
         is_hot = bool(decorators & HOT_LOOP_DECORATORS) or self.in_hot_loop
+        is_dispatch = bool(decorators & DISPATCH_STAGE_DECORATORS) \
+            or self.in_dispatch_stage
         for rule in self.rules:
             rule.on_function(self, node)
         # decorators, default args, and annotations execute ONCE at def
@@ -220,7 +234,8 @@ class LintContext(ast.NodeVisitor):
             self.visit(node.args)
             if node.returns is not None:
                 self.visit(node.returns)
-            self._frames.append(_Frame(node.name, is_async, is_hot))
+            self._frames.append(_Frame(node.name, is_async, is_hot,
+                                       is_dispatch))
             try:
                 for stmt in node.body:
                     self.visit(stmt)
@@ -242,7 +257,8 @@ class LintContext(ast.NodeVisitor):
         self._ancestors.append(node)
         try:
             self.visit(node.args)
-            self._frames.append(_Frame("<lambda>", False, self.in_hot_loop))
+            self._frames.append(_Frame("<lambda>", False, self.in_hot_loop,
+                                       self.in_dispatch_stage))
             try:
                 self.visit(node.body)
             finally:

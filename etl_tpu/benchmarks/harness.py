@@ -21,6 +21,29 @@ def _median(xs):
     return statistics.median(xs)
 
 
+def _pipeline_metrics() -> dict:
+    """Snapshot of the decode-pipeline stage metrics (ops/pipeline.py):
+    per-stage totals + the overlap counters. Benches report the DELTA over
+    their measured window (snapshot before and after, subtract)."""
+    from ..telemetry.metrics import (
+        ETL_DECODE_DISPATCH_SECONDS, ETL_DECODE_FETCH_SECONDS,
+        ETL_DECODE_PACK_SECONDS, ETL_DECODE_PIPELINE_OVERLAP_SECONDS_TOTAL,
+        ETL_DECODE_PIPELINE_PACK_SECONDS_TOTAL, registry)
+
+    out = {}
+    for key, name in (("pack", ETL_DECODE_PACK_SECONDS),
+                      ("dispatch", ETL_DECODE_DISPATCH_SECONDS),
+                      ("fetch", ETL_DECODE_FETCH_SECONDS)):
+        count, total = registry.get_histogram(name)
+        out[f"{key}_batches"] = count
+        out[f"{key}_seconds"] = total
+    out["overlap_seconds"] = registry.get_counter(
+        ETL_DECODE_PIPELINE_OVERLAP_SECONDS_TOTAL)
+    out["pipeline_pack_seconds"] = registry.get_counter(
+        ETL_DECODE_PIPELINE_PACK_SECONDS_TOTAL)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # table_copy (reference table_copy.rs:74-183)
 # ---------------------------------------------------------------------------
@@ -336,6 +359,7 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
             ("oracle", ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL))}
 
     routed0 = _routed()
+    stages0 = _pipeline_metrics()
 
     t_prod0 = time.perf_counter()
     produced = 0
@@ -387,6 +411,9 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
     routed1 = _routed()
     routed = {k: routed1[k] - routed0[k] for k in routed1}
     routed_total = sum(routed.values())
+    stages1 = _pipeline_metrics()
+    stages = {k: stages1[k] - stages0[k] for k in stages1}
+    pack_s = stages["pipeline_pack_seconds"]
     lags_ms = [(t - commit_times[lsn]) * 1000 for lsn, t in arrivals
                if lsn in commit_times]
     lags_ms.sort()
@@ -410,6 +437,15 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
         "decode_rows_oracle": int(routed["oracle"]),
         "device_decoded_share":
             round(routed["device"] / routed_total, 3) if routed_total else 0.0,
+        # decode pipeline stage activity over the measured window: the
+        # overlap ratio is the share of pack time that ran concurrently
+        # with another batch in flight (the three-stage scheduler's win)
+        "decode_pack_seconds": round(stages["pack_seconds"], 4),
+        "decode_dispatch_seconds": round(stages["dispatch_seconds"], 4),
+        "decode_fetch_seconds": round(stages["fetch_seconds"], 4),
+        "decode_overlap_seconds": round(stages["overlap_seconds"], 4),
+        "decode_overlap_ratio":
+            round(stages["overlap_seconds"] / pack_s, 3) if pack_s else 0.0,
         "replication_lag_p50_ms":
             round(pct(0.50), 2) if lags_ms else None,
         "replication_lag_p95_ms":

@@ -66,10 +66,15 @@ class BatchConfig:
     max_size_bytes: int = 8 * 1024 * 1024
     max_fill_ms: int = 10_000
     batch_engine: BatchEngine = BatchEngine.TPU
+    # bounded in-flight window of the decode pipeline (ops/pipeline.py):
+    # batches packed/dispatched but not yet fetched. 3 ≈ one packing, one
+    # on the device, one streaming back; drops to 1 under memory pressure
+    decode_window: int = 3
 
     def validate(self) -> None:
         _require(self.max_size_bytes > 0, "max_size_bytes must be > 0")
         _require(self.max_fill_ms > 0, "max_fill_ms must be > 0")
+        _require(self.decode_window >= 1, "decode_window must be >= 1")
 
 
 @dataclass(frozen=True)

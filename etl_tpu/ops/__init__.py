@@ -1,5 +1,7 @@
-"""TPU decode engine: staging, device parsers, the batch decoder."""
+"""TPU decode engine: staging, device parsers, the batch decoder, and the
+three-stage pipelined decode scheduler."""
 
 from .engine import DEVICE_KINDS, DeviceDecoder
-from .staging import (StagedBatch, bucket_pow2, bucket_rows,
-                      stage_copy_chunk, stage_tuples)
+from .pipeline import DecodePipeline
+from .staging import (ARENA_POOL, StagedBatch, StagingArenaPool, bucket_pow2,
+                      bucket_rows, stage_copy_chunk, stage_tuples)

@@ -34,19 +34,22 @@ boundary the reference flushes at (apply.rs:1910-1948).
 from __future__ import annotations
 
 import dataclasses
+import threading
+from collections import OrderedDict
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..analysis.annotations import hot_loop
+from ..analysis.annotations import dispatch_stage, hot_loop
 from ..models.pgtypes import CellKind
 from ..models.schema import ReplicatedTableSchema
 from ..models.table_row import Column, ColumnarBatch, dense_dtype
 from ..postgres.codec.text import parse_cell_text
 from . import parsers
-from .staging import StagedBatch, bucket_pow2, bucket_width
+from .staging import (ArenaLease, StagedBatch, bucket_pow2, bucket_width,
+                      pad_to_multiple)
 
 # NOTE on the persistent compilation cache: enabling
 # jax_compilation_cache_dir here was tried and REVERTED — the XLA:CPU
@@ -118,6 +121,20 @@ class _ColSpec:
     kind: CellKind
 
 
+@dataclasses.dataclass
+class _PackedInputs:
+    """Output of the pack stage, input of the dispatch stage.
+    `row_capacity` may exceed the staged capacity (mesh padding rows,
+    zeroed); the fn-cache key and device shapes use it."""
+
+    bmat: np.ndarray
+    lengths: np.ndarray
+    nibble: bool
+    bad_rows: np.ndarray | None
+    row_capacity: int
+    use_mesh: bool
+
+
 def build_device_program(specs: tuple[tuple[int, CellKind, int, int], ...],
                          nibble: bool = False):
     """The (unjitted) single-chip forward step for one width-signature.
@@ -145,15 +162,49 @@ def build_device_program(specs: tuple[tuple[int, CellKind, int, int], ...],
 # jitted decode programs shared across ALL DeviceDecoder instances (one
 # is created per table and per copy partition; without sharing, each
 # re-pays the 10-40s XLA/Mosaic compile for an identical program).
-# Bounded FIFO: long-running processes with schema churn must not pin
-# executables for dropped tables forever — past the cap the oldest
-# entry is evicted (worst case: a rare recompile, never a leak).
-_SHARED_FN_CACHE: dict = {}
+# Bounded LRU: long-running processes with schema churn must not pin
+# executables for dropped tables forever — past the cap the
+# least-RECENTLY-USED entry is evicted (hits refresh recency via
+# move_to_end, so a hot program can't be popped by churn in cold ones;
+# worst case: a rare recompile, never a leak). The lock covers lookup and
+# eviction: the pipeline's dispatch stage runs on worker threads, and a
+# torn OrderedDict relink would corrupt the cache for every decoder.
+_SHARED_FN_CACHE: "OrderedDict[tuple, Callable]" = OrderedDict()
 _SHARED_FN_CACHE_MAX = 64
+_SHARED_FN_LOCK = threading.Lock()
+
+
+def _shared_fn_get(key: tuple) -> Callable | None:
+    with _SHARED_FN_LOCK:
+        fn = _SHARED_FN_CACHE.get(key)
+        if fn is not None:
+            _SHARED_FN_CACHE.move_to_end(key)
+        return fn
+
+
+def _shared_fn_put(key: tuple, fn: Callable) -> None:
+    with _SHARED_FN_LOCK:
+        _SHARED_FN_CACHE[key] = fn
+        _SHARED_FN_CACHE.move_to_end(key)
+        while len(_SHARED_FN_CACHE) > _SHARED_FN_CACHE_MAX:
+            _SHARED_FN_CACHE.popitem(last=False)
+
+
+def _donation_supported() -> bool:
+    """Buffer donation is implemented on TPU/GPU only; on the CPU backend
+    jax warns per call and keeps both buffers alive, so donating there
+    buys nothing and spams logs."""
+    return jax.default_backend() in ("tpu", "gpu")
 
 
 def _build_device_fn(specs, nibble: bool = False, use_pallas: bool = False,
-                     mesh=None):
+                     mesh=None, donate: bool = False):
+    # donate_argnums on the packed inputs: XLA reuses the uploaded bmat /
+    # lengths device buffers for scratch or output, so a steady pipelined
+    # stream stops accumulating one dead input buffer per in-flight batch
+    # in HBM. Host-side numpy arenas are unaffected (the donated buffer is
+    # the DEVICE copy), so arena reuse stays safe.
+    kw = {"donate_argnums": (0, 1)} if donate else {}
     if mesh is not None:
         # multi-chip: rows sharded over the 'sp' axis, the SAME program —
         # decode is elementwise over rows, so XLA partitions it with no
@@ -165,12 +216,12 @@ def _build_device_fn(specs, nibble: bool = False, use_pallas: bool = False,
         out_sharded = NamedSharding(mesh, P(None, "sp"))
         return jax.jit(build_device_program(specs, nibble),
                        in_shardings=(rows_sharded, rows_sharded),
-                       out_shardings=out_sharded)
+                       out_shardings=out_sharded, **kw)
     if use_pallas:
         from .pallas_kernel import build_pallas_program
 
-        return jax.jit(build_pallas_program(specs, nibble))
-    return jax.jit(build_device_program(specs, nibble))
+        return jax.jit(build_pallas_program(specs, nibble), **kw)
+    return jax.jit(build_device_program(specs, nibble), **kw)
 
 
 def _combine(kind: CellKind, rows: np.ndarray) -> np.ndarray:
@@ -400,34 +451,55 @@ class DeviceDecoder:
                 and len(self._dense) > 0)
 
     def _pack_host(self, staged: StagedBatch, widths: tuple[int, ...],
-                   allow_nibble: bool = True):
+                   allow_nibble: bool = True,
+                   arena: "ArenaLease | None" = None,
+                   row_capacity: int | None = None):
         """Gather all dense fields into one byte matrix: nibble-packed C
         fast path (halves the upload) when the column mix allows, raw C
         pass otherwise, numpy as the last resort. Returns
         (bmat, lengths, nibble, bad_rows). The host-backend path packs raw
         (allow_nibble=False): there is no upload to halve, and skipping the
-        nibble probe avoids a second compiled program per schema."""
+        nibble probe avoids a second compiled program per schema.
+
+        `arena` supplies reusable preallocated buffers (ops/pipeline.py's
+        pack stage); safe because every pack path overwrites all rows up
+        to capacity. `row_capacity` > staged.row_capacity allocates mesh
+        padding rows, zeroed after the pack (the C packers only write the
+        staged capacity)."""
         from ..native import pack_bmat, pack_bmat_nibble
 
-        R = staged.row_capacity
+        cap = staged.row_capacity
+        R = cap if row_capacity is None else row_capacity
+
+        def buf(shape, dtype):
+            return arena.take(shape, dtype) if arena is not None \
+                else np.empty(shape, dtype=dtype)
+
+        def zero_tail(*arrays):
+            if R > cap:
+                for a in arrays:
+                    a[cap:] = 0
+
         total_w = sum(widths)
         ldtype = np.uint8 if max(widths, default=0) <= 255 else np.int32
         if allow_nibble and ldtype is np.uint8 and self._can_nibble(widths):
-            bmat = np.empty((R, total_w // 2), dtype=np.uint8)
-            lengths = np.empty((R, len(self._dense)), dtype=np.uint8)
-            bad = np.empty(R, dtype=np.uint8)
+            bmat = buf((R, total_w // 2), np.uint8)
+            lengths = buf((R, len(self._dense)), np.uint8)
+            bad = buf((R,), np.uint8)
             if pack_bmat_nibble(
                     staged.data, np.ascontiguousarray(staged.offsets),
                     np.ascontiguousarray(staged.lengths),
                     [s.index for s in self._dense], list(widths), bmat,
                     lengths, bad):
+                zero_tail(bmat, lengths, bad)
                 return bmat, lengths, True, bad
-        bmat = np.empty((R, total_w), dtype=np.uint8)
-        lengths = np.empty((R, len(self._dense)), dtype=ldtype)
+        bmat = buf((R, total_w), np.uint8)
+        lengths = buf((R, len(self._dense)), ldtype)
         if ldtype is np.uint8 and pack_bmat(
                 staged.data, np.ascontiguousarray(staged.offsets),
                 np.ascontiguousarray(staged.lengths),
                 [s.index for s in self._dense], list(widths), bmat, lengths):
+            zero_tail(bmat, lengths)
             return bmat, lengths, False, None
         bmat[:] = 0
         lengths[:] = 0
@@ -437,26 +509,52 @@ class DeviceDecoder:
         for j, (spec, w) in enumerate(zip(self._dense, widths)):
             offs = staged.offsets[:, spec.index].astype(np.int64)
             lens = np.minimum(staged.lengths[:, spec.index], w)
-            lengths[:, j] = lens
+            lengths[:cap, j] = lens
             idx = offs[:, None] + np.arange(w, dtype=np.int64)[None, :]
             np.clip(idx, 0, max(n - 1, 0), out=idx)
             if n:
                 g = data[idx]
                 mask = np.arange(w, dtype=np.int32)[None, :] < lens[:, None]
-                bmat[:, w_off : w_off + w] = np.where(mask, g, 0)
+                bmat[:cap, w_off : w_off + w] = np.where(mask, g, 0)
             w_off += w
         return bmat, lengths, False, None
 
     def _use_mesh(self, row_capacity: int) -> bool:
+        # no divisibility requirement: the pack stage pads row capacity up
+        # to a mesh.size multiple with all-NULL rows (staging.pad_to_
+        # multiple), so odd buckets shard instead of silently falling back
+        # to single-device dispatch
         return (self.mesh is not None
-                and row_capacity >= self.mesh_min_rows
-                and row_capacity % self.mesh.size == 0)
+                and row_capacity >= self.mesh_min_rows)
 
-    def _device_call(self, staged: StagedBatch, specs: tuple,
-                     host: bool = False):
+    # -- pipeline stages (ops/pipeline.py runs pack on a worker thread,
+    # -- dispatch immediately after; _device_call composes them for the
+    # -- serial decode()/decode_async() path) -------------------------------
+
+    def _pack_stage(self, staged: StagedBatch, specs: tuple,
+                    host: bool = False,
+                    arena: "ArenaLease | None" = None) -> "_PackedInputs":
+        """Stage 1: host gather of all dense fields into (possibly pooled)
+        staging buffers. Pure numpy/C — no jax calls, safe on any thread."""
         widths = tuple(w for _, _, w, _ in specs)
+        use_mesh = not host and self._use_mesh(staged.row_capacity)
+        cap = pad_to_multiple(staged.row_capacity, self.mesh.size) \
+            if use_mesh else staged.row_capacity
         bmat, lengths, nibble, bad_rows = self._pack_host(
-            staged, widths, allow_nibble=not host)
+            staged, widths, allow_nibble=not host, arena=arena,
+            row_capacity=cap)
+        return _PackedInputs(bmat, lengths, nibble, bad_rows, cap, use_mesh)
+
+    @dispatch_stage
+    @hot_loop
+    def _dispatch_stage(self, staged: StagedBatch, specs: tuple,
+                        packed: "_PackedInputs", host: bool = False):
+        """Stage 2: start the device program on the packed inputs and
+        return the in-flight device value. @dispatch_stage: the host-path
+        `jax.device_put` is a committed UPLOAD riding the pipeline, not a
+        sync point — fetches still belong at `_PendingDecode.result()`."""
+        bmat, lengths = packed.bmat, packed.lengths
+        widths = tuple(w for _, _, w, _ in specs)
         if host:
             # committed CPU placement: jit compiles/executes this call on
             # the host CPU backend — same program, no accelerator round
@@ -482,25 +580,24 @@ class DeviceDecoder:
                     "(total gather width %d > %d); using the XLA program",
                     sum(widths), MAX_TOTAL_WIDTH)
                 self.use_pallas = False
-        use_mesh = not host and self._use_mesh(staged.row_capacity)
         # the program cache is MODULE-level: decoders are created per
         # table and per copy partition, and identical (bucket, specs)
         # programs across instances must not recompile — the engine flag
         # rides in the key, so a pallas fallback just stops selecting
         # the pallas entries instead of clearing anything
         pallas = self.use_pallas and not host
-        key = (staged.row_capacity, specs, nibble,
-               self.mesh if use_mesh else None, pallas, host)
-        fn = _SHARED_FN_CACHE.get(key)
+        key = (packed.row_capacity, specs, packed.nibble,
+               self.mesh if packed.use_mesh else None, pallas, host)
+        fn = _shared_fn_get(key)
         if fn is None:
-            fn = _build_device_fn(specs, nibble, pallas,
-                                  mesh=self.mesh if use_mesh else None)
-            _SHARED_FN_CACHE[key] = fn
-            while len(_SHARED_FN_CACHE) > _SHARED_FN_CACHE_MAX:
-                _SHARED_FN_CACHE.pop(next(iter(_SHARED_FN_CACHE)))
+            fn = _build_device_fn(
+                specs, packed.nibble, pallas,
+                mesh=self.mesh if packed.use_mesh else None,
+                donate=not host and _donation_supported())
+            _shared_fn_put(key, fn)
         self._fn_cache[key] = fn
         try:
-            return fn(bmat, lengths), bad_rows  # async dispatch
+            return fn(bmat, lengths)  # async dispatch
         except Exception:
             # host calls never run pallas — an error there is real, not a
             # Mosaic rejection; misrouting it would disable pallas AND send
@@ -509,14 +606,21 @@ class DeviceDecoder:
                 raise
             # Mosaic rejects some byte-wise lowerings on current libtpu
             # (interleave reshape, narrow truncations) — fall back to the
-            # XLA program permanently for this decoder
+            # XLA program permanently for this decoder; the packed inputs
+            # are engine-independent, so no re-pack
             import logging
 
             logging.getLogger("etl_tpu.ops").warning(
                 "pallas kernel failed to compile; falling back to XLA",
                 exc_info=True)
             self.use_pallas = False
-            return self._device_call(staged, specs)
+            return self._dispatch_stage(staged, specs, packed, host)
+
+    def _device_call(self, staged: StagedBatch, specs: tuple,
+                     host: bool = False):
+        packed = self._pack_stage(staged, specs, host)
+        return self._dispatch_stage(staged, specs, packed, host), \
+            packed.bad_rows
 
     def _gather_string_arrow(self, staged: StagedBatch, spec: _ColSpec,
                              valid: np.ndarray):
@@ -717,11 +821,10 @@ class DeviceDecoder:
 
     # -- public -------------------------------------------------------------
 
-    @hot_loop
-    def decode_async(self, staged: StagedBatch) -> _PendingDecode:
-        """Dispatch the device work and return immediately; stage the next
-        batch while this one is in flight. @hot_loop: dispatch-only — the
-        fetch happens at `_PendingDecode.result()` on the consumer."""
+    def _route(self, staged: StagedBatch) -> tuple[str, tuple]:
+        """Pick the decode path for this batch: ("device"|"host"|"oracle",
+        specs). Owns the routed-rows telemetry so the pipelined and serial
+        entry points count identically."""
         cols = self.schema.replicated_columns
         if len(cols) != staged.n_cols:
             raise ValueError(
@@ -733,24 +836,33 @@ class DeviceDecoder:
             ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL, registry)
 
         if self._dense and staged.n_rows >= self.device_min_rows:
-            specs = self._specs(staged, self._widths(staged))
-            packed, bad_rows = self._device_call(staged, specs)
             if self._telemetry:
                 registry.counter_inc(ETL_DECODE_ROUTED_DEVICE_ROWS_TOTAL,
                                      staged.n_rows)
-        elif self._dense and staged.n_rows >= self.host_min_rows \
+            return "device", self._specs(staged, self._widths(staged))
+        if self._dense and staged.n_rows >= self.host_min_rows \
                 and _host_cpu_device() is not None:
-            specs = self._host_specs()
-            packed, bad_rows = self._device_call(staged, specs, host=True)
             if self._telemetry:
                 registry.counter_inc(ETL_DECODE_ROUTED_HOST_ROWS_TOTAL,
                                      staged.n_rows)
-        else:
-            specs = ()
-            packed, bad_rows = None, None
-            if self._telemetry:
-                registry.counter_inc(ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL,
-                                     staged.n_rows)
+            return "host", self._host_specs()
+        if self._telemetry:
+            registry.counter_inc(ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL,
+                                 staged.n_rows)
+        return "oracle", ()
+
+    @hot_loop
+    def decode_async(self, staged: StagedBatch) -> _PendingDecode:
+        """Dispatch the device work and return immediately; stage the next
+        batch while this one is in flight. @hot_loop: dispatch-only — the
+        fetch happens at `_PendingDecode.result()` on the consumer.
+        (ops/pipeline.DecodePipeline runs the same route→pack→dispatch
+        chain with the pack stage on a worker thread and pooled arenas.)"""
+        mode, specs = self._route(staged)
+        if mode == "oracle":
+            return _PendingDecode(self, staged, (), None, None)
+        packed, bad_rows = self._device_call(staged, specs,
+                                             host=mode == "host")
         return _PendingDecode(self, staged, specs, packed, bad_rows)
 
     def decode(self, staged: StagedBatch) -> ColumnarBatch:

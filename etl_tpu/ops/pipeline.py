@@ -1,0 +1,350 @@
+"""Three-stage pipelined decode scheduler.
+
+Serial `decode_async` still runs `_pack_host` — the numpy/C gather — on
+the dispatch path, so per batch the host pack, the device compute, and
+the result fetch serialize and the accelerator idles between dispatches.
+This module overlaps them:
+
+    submit(decoder, staged)            consumer (in submit order)
+        │                                      ▲
+        ▼                                      │ fetch: _PendingDecode
+    [ pack worker thread ]                     │ .result() — unpack,
+    1. route (device/host/oracle)              │ combines, CPU fixup;
+    2. acquire in-flight window slot           │ releases the arena and
+    3. PACK into a pooled staging arena        │ the window slot
+    4. DISPATCH the jitted program ────────────┘
+       (device computes while the worker
+        packs the NEXT batch)
+
+  - pack — `DeviceDecoder._pack_stage` on a dedicated worker thread,
+    writing into reusable preallocated arenas (staging.ARENA_POOL,
+    bucketed by (row_capacity, widths) via exact buffer shape) instead of
+    fresh np.empty per batch;
+  - dispatch — `DeviceDecoder._dispatch_stage`; the jitted program is
+    built with donate_argnums on the packed buffers (TPU/GPU) so XLA
+    reuses device memory across batches;
+  - fetch — `_PendingDecode.result()` completion, driven by the caller
+    in submit order and bounded by an in-flight window
+    (runtime/backpressure.InFlightWindow, default 3; shrinks to 1 under
+    memory pressure) so host arenas + device buffers stay capped.
+
+One worker thread per pipeline keeps dispatch order == submit order, so
+call sites (runtime/copy.py per copy partition, runtime/assembler.py per
+apply loop) drain completions strictly in order with no cross-stream
+deadlock: the oldest submitted batch is always packed/dispatched before
+any younger batch can hold a window slot.
+
+Telemetry: per-stage histograms (pack/dispatch/fetch seconds), the
+overlap counters (seconds of pack time concurrent with another batch in
+flight — the pipelining win itself), and arena reuse hits
+(telemetry/metrics.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import TYPE_CHECKING
+
+from ..analysis.annotations import hot_loop
+from .staging import ARENA_POOL, StagedBatch, StagingArenaPool
+
+if TYPE_CHECKING:  # import cycle: runtime -> ops at module import time
+    from ..runtime.backpressure import MemoryMonitor
+    from .engine import DeviceDecoder
+
+#: default bounded in-flight window: 3 batches ≈ one packing, one on the
+#: device, one streaming back — deeper windows only add memory (the
+#: device serializes program executions anyway)
+DEFAULT_WINDOW = 3
+
+
+class _Interval:
+    """[start, end) of one batch's in-flight (dispatch→fetch) span;
+    end None while still in flight."""
+
+    __slots__ = ("start", "end")
+
+    def __init__(self, start: float):
+        self.start = start
+        self.end: float | None = None
+
+
+class PipelinedDecode:
+    """Handle for one submitted batch; duck-compatible with
+    `_PendingDecode` (`.result()`), so DecodedBatchEvent and destination
+    writers consume it unchanged. `result()` may be called out of submit
+    order — completion state is per-handle — but in-order draining is
+    what keeps the window from stalling the worker."""
+
+    __slots__ = ("_pipe", "_future", "_done", "_exc", "_windowed",
+                 "_demanded")
+
+    def __init__(self, pipe: "DecodePipeline"):
+        self._pipe = pipe
+        self._future: Future = Future()
+        self._done = None
+        self._exc: BaseException | None = None
+        self._windowed = False  # device/host route holds a window slot
+        self._demanded = False  # a consumer is blocked on this handle
+
+    def result(self):
+        """Complete the batch (idempotent). A failed fetch is permanent:
+        the first attempt already returned the arena to the pool, so a
+        retry could read buffers another batch has dirtied — re-raise the
+        recorded failure instead of re-completing."""
+        if self._done is None:
+            if self._exc is not None:
+                raise self._exc
+            try:
+                self._done = self._pipe._fetch(self)
+            except BaseException as e:
+                self._exc = e
+                raise
+        return self._done
+
+
+class DecodePipeline:
+    """The scheduler: one pack/dispatch worker thread + a bounded
+    in-flight window + stage telemetry. Decoder-agnostic per submit, so
+    one pipeline serves every table of an apply loop."""
+
+    def __init__(self, *, window: int = DEFAULT_WINDOW,
+                 monitor: "MemoryMonitor | None" = None,
+                 arena_pool: StagingArenaPool | None = None,
+                 name: str = "decode"):
+        from ..runtime.backpressure import InFlightWindow
+
+        self.window = InFlightWindow(max(1, window), monitor)
+        self.pool = arena_pool if arena_pool is not None else ARENA_POOL
+        # gauge label: several pipelines coexist (one per copy partition
+        # + the apply loop's); unlabeled globals would last-writer-win
+        self._name = name
+        self._jobs: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._closed = False
+        self._lock = threading.Lock()  # interval list + overlap counters
+        self._inflight: list[_Interval] = []
+        # handles submitted but not yet dispatched: the window's liveness
+        # valve — a consumer blocked on one of these means the worker must
+        # overshoot the window instead of deadlocking against it
+        self._undispatched: list[PipelinedDecode] = []
+        self._pack_seconds = 0.0
+        self._overlap_seconds = 0.0
+        self._published_pack = 0.0
+        self._published_overlap = 0.0
+        self._submitted = 0
+        self._completed = 0
+        self._worker = threading.Thread(
+            target=self._run, name=f"etl-{name}-pipeline", daemon=True)
+        self._worker.start()
+
+    # -- producer side ------------------------------------------------------
+
+    def submit(self, decoder: "DeviceDecoder",
+               staged: StagedBatch) -> PipelinedDecode:
+        """Schedule route→pack→dispatch on the worker; returns at once.
+        The worker blocks on the in-flight window, not the caller — the
+        submit queue itself is unbounded, bounded in practice by the
+        caller's own batching (flush windows / COPY chunk thresholds)."""
+        if self._closed:
+            raise RuntimeError("decode pipeline is closed")
+        handle = PipelinedDecode(self)
+        self._submitted += 1
+        with self._lock:
+            self._undispatched.append(handle)
+        self._jobs.put((decoder, staged, handle))
+        return handle
+
+    def _demand_waiting(self) -> bool:
+        with self._lock:
+            return any(h._demanded for h in self._undispatched)
+
+    @property
+    def in_flight(self) -> int:
+        return len(self.window)
+
+    @property
+    def effective_window(self) -> int:
+        return self.window.effective_limit
+
+    def close(self) -> None:
+        """Stop the worker. Handles already packed/dispatched stay
+        resolvable; jobs still queued fail fast with RuntimeError (their
+        events are re-streamed on resume — at-least-once). Close also
+        opens the window's bypass so a worker blocked on slots held by
+        abandoned handles (a failed copy partition that will never drain
+        them) runs the queue down and exits instead of leaking the
+        thread and everything queued behind it."""
+        if not self._closed:
+            self._closed = True
+            self._jobs.put(None)
+
+    # -- worker side --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._jobs.get()
+            if item is None:
+                return
+            decoder, staged, handle = item
+            try:
+                if self._closed:
+                    raise RuntimeError(
+                        "decode pipeline closed before this batch packed")
+                self._process(decoder, staged, handle)
+            # worker THREAD, not a coroutine: no asyncio cancellation can
+            # land here; every failure must reach the consumer's result()
+            except BaseException as e:  # etl-lint: ignore[cancellation-swallow]
+                if handle._windowed:
+                    handle._windowed = False
+                    self.window.release()
+                handle._future.set_exception(e)
+            finally:
+                with self._lock:
+                    if handle in self._undispatched:
+                        self._undispatched.remove(handle)
+
+    @hot_loop
+    def _process(self, decoder: "DeviceDecoder", staged: StagedBatch,
+                 handle: PipelinedDecode) -> None:
+        """Pack + dispatch one batch on the worker thread. @hot_loop: runs
+        once per batch on the dispatch path — fetches belong to _fetch."""
+        from ..telemetry.metrics import (ETL_DECODE_DISPATCH_SECONDS,
+                                         ETL_DECODE_PACK_SECONDS,
+                                         ETL_DECODE_PIPELINE_IN_FLIGHT,
+                                         registry)
+        from .engine import _PendingDecode
+
+        mode, specs = decoder._route(staged)
+        if mode == "oracle":
+            # no device work: nothing to overlap, no window slot — the
+            # consumer's result() runs the per-row oracle as before
+            handle._future.set_result(
+                (_PendingDecode(decoder, staged, (), None, None), None))
+            return
+        # window slot held from here until the fetch completes: caps the
+        # arenas + device buffers of all in-flight batches. The bypass
+        # keeps the pipeline live when a consumer blocks on a handle that
+        # hasn't dispatched yet (out-of-order draining) or when close()
+        # fires with abandoned slots outstanding: the window overshoots
+        # instead of deadlocking against its own consumer.
+        self.window.acquire(
+            bypass=lambda: self._closed or self._demand_waiting())
+        handle._windowed = True
+        host = mode == "host"
+        arena = self.pool.lease()
+        t0 = time.perf_counter()
+        try:
+            packed = decoder._pack_stage(staged, specs, host, arena=arena)
+            t1 = time.perf_counter()
+            packed_dev = decoder._dispatch_stage(staged, specs, packed, host)
+            t2 = time.perf_counter()
+        except BaseException:
+            arena.release()
+            raise
+        pending = _PendingDecode(decoder, staged, specs, packed_dev,
+                                 packed.bad_rows)
+        iv = _Interval(t2)
+        with self._lock:
+            self._inflight.append(iv)
+            # overlap: the part of THIS pack that ran while another batch
+            # was between dispatch and fetch — nonzero means the host
+            # packed batch N+1 while the device computed batch N
+            overlap = 0.0
+            for other in self._inflight:
+                if other is iv:
+                    continue
+                end = other.end if other.end is not None else t1
+                overlap += max(0.0, min(t1, end) - max(t0, other.start))
+            self._pack_seconds += t1 - t0
+            self._overlap_seconds += min(overlap, t1 - t0)
+            pack_total = self._pack_seconds
+            overlap_total = self._overlap_seconds
+        registry.histogram_observe(ETL_DECODE_PACK_SECONDS, t1 - t0)
+        registry.histogram_observe(ETL_DECODE_DISPATCH_SECONDS, t2 - t1)
+        registry.gauge_set(ETL_DECODE_PIPELINE_IN_FLIGHT, len(self.window),
+                           {"pipeline": self._name})
+        self._publish_overlap(pack_total, overlap_total)
+        handle._future.set_result((pending, arena, iv))
+
+    def _publish_overlap(self, pack_total: float,
+                         overlap_total: float) -> None:
+        from ..telemetry.metrics import (
+            ETL_DECODE_PIPELINE_OVERLAP_RATIO,
+            ETL_DECODE_PIPELINE_OVERLAP_SECONDS_TOTAL,
+            ETL_DECODE_PIPELINE_PACK_SECONDS_TOTAL, registry)
+
+        # counters are registry-global (monotonic across pipelines):
+        # publish the delta since this pipeline's last publication (only
+        # the worker thread calls this, so the delta math is race-free)
+        registry.counter_inc(ETL_DECODE_PIPELINE_PACK_SECONDS_TOTAL,
+                             pack_total - self._published_pack)
+        registry.counter_inc(ETL_DECODE_PIPELINE_OVERLAP_SECONDS_TOTAL,
+                             overlap_total - self._published_overlap)
+        self._published_pack = pack_total
+        self._published_overlap = overlap_total
+        if pack_total > 0:
+            registry.gauge_set(ETL_DECODE_PIPELINE_OVERLAP_RATIO,
+                               overlap_total / pack_total,
+                               {"pipeline": self._name})
+
+    # -- consumer side ------------------------------------------------------
+
+    def _fetch(self, handle: PipelinedDecode):
+        """Stage 3: wait out pack/dispatch if still running, fetch and
+        complete the batch, then return the arena and window slot."""
+        from ..telemetry.metrics import (ETL_DECODE_FETCH_SECONDS,
+                                         ETL_DECODE_PIPELINE_IN_FLIGHT,
+                                         registry)
+
+        handle._demanded = True  # window liveness valve, see _process
+        value = handle._future.result()
+        handle._demanded = False
+        if len(value) == 2:  # oracle route: (pending, None)
+            pending, _ = value
+            t0 = time.perf_counter()
+            try:
+                return pending.result()
+            finally:
+                with self._lock:
+                    self._completed += 1
+                registry.histogram_observe(ETL_DECODE_FETCH_SECONDS,
+                                           time.perf_counter() - t0)
+        pending, arena, iv = value
+        t0 = time.perf_counter()
+        try:
+            batch = pending.result()
+        finally:
+            now = time.perf_counter()
+            with self._lock:
+                iv.end = now
+                if iv in self._inflight:
+                    self._inflight.remove(iv)
+                self._completed += 1
+            arena.release()
+            if handle._windowed:
+                handle._windowed = False
+                self.window.release()
+            registry.gauge_set(ETL_DECODE_PIPELINE_IN_FLIGHT,
+                               len(self.window), {"pipeline": self._name})
+        registry.histogram_observe(ETL_DECODE_FETCH_SECONDS, now - t0)
+        return batch
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            pack = self._pack_seconds
+            overlap = self._overlap_seconds
+            return {
+                "submitted": self._submitted,
+                "completed": self._completed,
+                "in_flight": len(self.window),
+                "window": self.window.limit,
+                "pack_seconds_total": pack,
+                "overlap_seconds_total": overlap,
+                "overlap_ratio": overlap / pack if pack > 0 else 0.0,
+                "arena": self.pool.stats(),
+            }

@@ -18,6 +18,7 @@ containing escape sequences are flagged for the CPU fallback decoder.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Sequence
 
@@ -30,7 +31,20 @@ from ..postgres.codec.pgoutput import (TUPLE_NULL, TUPLE_TEXT,
 ROW_BUCKETS = (256, 1024, 4096, 16384, 65536, 131072, 262144)
 
 
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Round `n` up to a multiple of `multiple` (≥1). The mesh decode path
+    pads row capacity with all-NULL rows so `sp` sharding engages on
+    buckets the device count doesn't divide evenly."""
+    if multiple <= 1:
+        return n
+    return -(-n // multiple) * multiple
+
+
 def bucket_rows(n: int) -> int:
+    """Row-capacity bucket for `n` rows. Staging call sites don't know
+    the mesh, so mesh-divisibility padding happens at pack time
+    (engine._pack_stage via pad_to_multiple) — sharded dispatch never
+    silently rejects a bucket the device count doesn't divide."""
     for b in ROW_BUCKETS:
         if n <= b:
             return b
@@ -203,3 +217,92 @@ def stage_copy_chunk(chunk: bytes, n_cols: int) -> StagedBatch:
     lengths = np.where(nulls, 0, lengths)
     return StagedBatch(data, offsets, lengths, nulls, toast, n_rows,
                        cpu_fallback_rows=fallback, copy_escapes=True)
+
+
+# ---------------------------------------------------------------------------
+# staging arenas: reusable pack buffers
+# ---------------------------------------------------------------------------
+
+
+class ArenaLease:
+    """The set of pool buffers one in-flight decode holds. `take` hands
+    out a pooled (or fresh) array; `release` returns every taken buffer to
+    the pool at once — called by the pipeline's fetch stage after the
+    device result lands, the earliest point reuse cannot race the
+    host→device copy of the batch that packed into them."""
+
+    __slots__ = ("_pool", "_taken", "_released")
+
+    def __init__(self, pool: "StagingArenaPool"):
+        self._pool = pool
+        self._taken: list[np.ndarray] = []
+        self._released = False
+
+    def take(self, shape: tuple, dtype) -> np.ndarray:
+        a = self._pool._take(shape, dtype)
+        self._taken.append(a)
+        return a
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._pool._give_back(self._taken)
+        self._taken = []
+
+
+class StagingArenaPool:
+    """Preallocated pack-buffer pool, bucketed by (shape, dtype).
+
+    The pack stage writes the byte matrix + lengths (+ nibble bad flags)
+    for every batch; with per-batch `np.empty` the allocator churns tens of
+    MB per dispatch on the hot loop. Pack shapes are already coarse — row
+    capacities are bucketed (ROW_BUCKETS) and gather widths are bucketed
+    (bucket_width) — so a handful of arenas per (row_capacity, widths)
+    signature covers a steady-state stream, and the bounded in-flight
+    window (ops/pipeline.py) caps how many are ever out at once.
+
+    The C packers overwrite every row up to capacity (zero-padding each
+    field to its width — framer.c keeps device inputs deterministic), so a
+    reused dirty buffer is safe without re-zeroing.
+    """
+
+    def __init__(self, max_per_bucket: int = 4):
+        self.max_per_bucket = max_per_bucket
+        self._lock = threading.Lock()
+        self._free: dict[tuple, list[np.ndarray]] = {}
+
+    def lease(self) -> ArenaLease:
+        return ArenaLease(self)
+
+    def _take(self, shape: tuple, dtype) -> np.ndarray:
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            bucket = self._free.get(key)
+            arr = bucket.pop() if bucket else None
+        from ..telemetry.metrics import (ETL_STAGING_ARENA_REQUESTS_TOTAL,
+                                         registry)
+
+        registry.counter_inc(ETL_STAGING_ARENA_REQUESTS_TOTAL, 1.0,
+                             {"result": "hit" if arr is not None else "miss"})
+        return arr if arr is not None else np.empty(shape, dtype=dtype)
+
+    def _give_back(self, arrays: list[np.ndarray]) -> None:
+        with self._lock:
+            for a in arrays:
+                key = (a.shape, a.dtype.str)
+                bucket = self._free.setdefault(key, [])
+                if len(bucket) < self.max_per_bucket:
+                    bucket.append(a)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"buckets": len(self._free),
+                    "free_arrays": sum(len(v) for v in self._free.values()),
+                    "free_bytes": sum(a.nbytes for v in self._free.values()
+                                      for a in v)}
+
+
+#: process-wide pool shared by every decode pipeline (arenas are keyed by
+#: exact shape, so cross-table sharing is free and the bound is global)
+ARENA_POOL = StagingArenaPool()

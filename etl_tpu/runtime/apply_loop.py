@@ -142,7 +142,12 @@ class ApplyLoop:
         self.shutdown = shutdown
         self.monitor = monitor  # MemoryMonitor | None
         self._lease = budget.register_stream() if budget is not None else None
-        self.assembler = EventAssembler(config.batch.batch_engine)
+        # the assembler owns this loop's decode pipeline; the monitor
+        # shrinks its in-flight window to 1 under memory pressure
+        self.assembler = EventAssembler(config.batch.batch_engine,
+                                        monitor=monitor,
+                                        decode_window=config.batch
+                                        .decode_window)
         self.state = _LoopState(durable_lsn=start_lsn, received_lsn=start_lsn,
                                 last_status_flush_lsn=start_lsn)
         self._in_flight: _InFlight | None = None
@@ -361,6 +366,7 @@ class ApplyLoop:
                         pass
             if self._lease is not None:
                 self._lease.release()
+            self.assembler.close()  # stop the decode pipeline's worker
             await self.stream.close()
 
     # -- frame handling ---------------------------------------------------------

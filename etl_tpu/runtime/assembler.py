@@ -27,6 +27,7 @@ from ..models.event import DecodedBatchEvent, Event
 from ..models.lsn import Lsn
 from ..models.schema import ReplicatedTableSchema, TableId
 from ..ops.engine import DeviceDecoder
+from ..ops.pipeline import DecodePipeline
 from ..ops.wal import stage_wal_batch
 from ..postgres.codec import event as event_codec
 from ..postgres.codec import pgoutput
@@ -62,11 +63,19 @@ MEGA_SEAL_ROWS = 262_144
 
 
 class EventAssembler:
-    def __init__(self, engine: BatchEngine):
+    def __init__(self, engine: BatchEngine, monitor=None,
+                 decode_window: int = 3):
         self.engine = engine
         self._events: list[Event] = []
         self._run: _Run | None = None
         self._decoders: dict[TableId, DeviceDecoder] = {}
+        # one decode pipeline (worker thread + bounded in-flight window)
+        # serves every table this loop assembles; created lazily so the
+        # CPU engine never spawns the thread. The monitor shrinks the
+        # window to 1 under memory pressure (runtime/backpressure).
+        self._monitor = monitor
+        self._decode_window = decode_window
+        self._pipeline: DecodePipeline | None = None
         # dynamic: the apply loop grows it ×4 (one row bucket per step)
         # under sustained backlog and resets it when the stream idles
         self.seal_rows = RUN_SEAL_ROWS
@@ -200,11 +209,18 @@ class EventAssembler:
         if wal.bad_from >= 0:
             raise EtlError(ErrorKind.WAL_DECODE_FAILED,
                            f"malformed row message at run index {wal.bad_from}")
-        # async dispatch: the device decodes (and streams results back)
-        # while the apply loop keeps reading WAL; the DecodedBatchEvent
-        # resolves the batch lazily when the destination write consumes it
-        pending = decoder.decode_async(wal.staged)
-        old_pending = decoder.decode_async(wal.old_staged) \
+        # pipelined dispatch (ops/pipeline.py): the pack runs on the
+        # pipeline's worker thread into a pooled arena and the device
+        # decodes (and streams results back) while the apply loop keeps
+        # reading WAL; the DecodedBatchEvent resolves the batch lazily
+        # when the destination write consumes it, in submit order — the
+        # bounded in-flight window caps staged memory across flushes
+        if self._pipeline is None:
+            self._pipeline = DecodePipeline(window=self._decode_window,
+                                            monitor=self._monitor,
+                                            name="cdc")
+        pending = self._pipeline.submit(decoder, wal.staged)
+        old_pending = self._pipeline.submit(decoder, wal.old_staged) \
             if wal.old_staged is not None else None
         self._events.append(DecodedBatchEvent(
             Lsn(r.start_lsns[0]), Lsn(r.commit_lsns[-1]), r.schema,
@@ -224,3 +240,12 @@ class EventAssembler:
         self.size_bytes = 0
         self.row_events = 0
         return events
+
+    def close(self) -> None:
+        """Stop the decode pipeline's worker (apply-loop teardown).
+        Already-flushed DecodedBatchEvents stay resolvable — close only
+        fences new submits, and _seal_run re-creates the pipeline if a
+        resumed loop reuses this assembler."""
+        if self._pipeline is not None:
+            self._pipeline.close()
+            self._pipeline = None

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import threading
 import time
 from dataclasses import dataclass
 from typing import AsyncIterator, Awaitable, Callable, Generic, TypeVar
@@ -24,6 +25,61 @@ from typing import AsyncIterator, Awaitable, Callable, Generic, TypeVar
 from ..config.pipeline import MemoryBackpressureConfig
 
 T = TypeVar("T")
+
+
+class InFlightWindow:
+    """Bounded in-flight window for the decode pipeline, monitor-aware.
+
+    The pipeline's dispatch stage `acquire()`s one slot per batch before
+    packing/dispatching; the fetch stage `release()`s it after the result
+    lands. The limit caps host arenas + device buffers held by in-flight
+    batches; under memory pressure (`MemoryMonitor.pressure`) the
+    EFFECTIVE limit drops to 1 — the pipeline degrades to serial decode
+    until the monitor's hysteresis resumes, the same stance as the WAL
+    intake pause (BackpressureStream), applied to the decode stage.
+
+    Thread-based (not asyncio): acquire happens on the pipeline's pack
+    worker thread; release on whichever thread consumes the result. The
+    pressure flag is re-read on every wakeup AND on a short poll tick, so
+    a pressure transition never needs to signal the condition to be seen.
+    """
+
+    _POLL_S = 0.05
+
+    def __init__(self, limit: int, monitor: "MemoryMonitor | None" = None):
+        if limit < 1:
+            raise ValueError("in-flight window needs limit >= 1")
+        self.limit = limit
+        self.monitor = monitor
+        self._held = 0
+        self._cond = threading.Condition()
+
+    @property
+    def effective_limit(self) -> int:
+        if self.monitor is not None and self.monitor.pressure:
+            return 1
+        return self.limit
+
+    def __len__(self) -> int:
+        return self._held
+
+    def acquire(self, bypass: "Callable[[], bool] | None" = None) -> None:
+        """Block until a slot frees. `bypass` is a liveness valve: when it
+        returns True (the pipeline has a consumer blocked on a batch that
+        cannot dispatch until this acquire returns), the window overshoots
+        its limit rather than deadlocking — memory cap traded for
+        progress, only under out-of-order consumption. Re-checked on the
+        poll tick, so no extra signalling is needed."""
+        with self._cond:
+            while self._held >= self.effective_limit \
+                    and not (bypass is not None and bypass()):
+                self._cond.wait(timeout=self._POLL_S)
+            self._held += 1
+
+    def release(self) -> None:
+        with self._cond:
+            self._held = max(0, self._held - 1)
+            self._cond.notify_all()
 
 
 def read_memory_limit_bytes() -> int:

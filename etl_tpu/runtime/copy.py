@@ -22,6 +22,7 @@ from ..models.errors import ErrorKind, EtlError
 from ..models.schema import ReplicatedTableSchema, TableId
 from ..models.table_row import ColumnarBatch
 from ..ops.engine import DeviceDecoder
+from ..ops.pipeline import DecodePipeline
 from ..ops.staging import stage_copy_chunk
 from ..postgres.codec.copy_text import parse_copy_row
 from ..postgres.source import ReplicationSource
@@ -85,7 +86,8 @@ async def _copy_partition(source: ReplicationSource,
                           destination: Destination,
                           progress: CopyProgress,
                           max_batch_bytes: int, monitor=None,
-                          lease=None, pipeline_id: int = 0) -> None:
+                          lease=None, pipeline_id: int = 0,
+                          decode_window: int = 3) -> None:
     rng = None if part.end_page is None and part.start_page == 0 \
         else (part.start_page, part.end_page if part.end_page is not None
               else 1 << 30)
@@ -103,14 +105,25 @@ async def _copy_partition(source: ReplicationSource,
     pending: list[bytes] = []
     pending_len = 0
     acks: list[WriteAck] = []
-    # device-decode pipeline: dispatch decode of chunk N and keep reading
-    # COPY data for N+1..N+depth while the device works and streams results
-    # back (VERDICT r1 #1: the pending-handle pattern, now in production)
+    # three-stage decode pipeline (ops/pipeline.py): chunk N+1 packs on
+    # the pipeline's worker thread into a pooled arena while chunk N
+    # computes on the device and N-1 streams back — this partition keeps
+    # reading COPY data the whole time. One pipeline PER partition: each
+    # partition drains only its own handles in order, so a shared window
+    # could never be exhausted by another partition's undispatched work
+    # (the cross-partition deadlock the per-partition worker rules out).
     in_flight: list = []
-    PIPELINE_DEPTH = 4
+    # name carries the partition identity so concurrent partitions get
+    # distinct gauge series instead of last-writer-winning one label
+    pipe = DecodePipeline(window=decode_window, monitor=monitor,
+                          name=f"copy-p{part.start_page}") \
+        if decoder is not None else None
 
     async def drain_one() -> None:
-        batch = in_flight.pop(0).result()
+        handle = in_flight.pop(0)
+        # fetch on a thread: the event loop keeps serving the OTHER copy
+        # partitions while this one waits out its device round trip
+        batch = await asyncio.to_thread(handle.result)
         acks.append(await destination.write_table_rows(schema, batch))
         progress.total_rows += batch.num_rows
         registry.counter_inc(ETL_TABLE_COPY_ROWS_TOTAL, batch.num_rows)
@@ -131,8 +144,12 @@ async def _copy_partition(source: ReplicationSource,
         registry.counter_inc(ETL_TABLE_COPY_BYTES_TOTAL, len(chunk))
         if decoder is not None:
             staged = stage_copy_chunk(chunk, len(oids))
-            in_flight.append(decoder.decode_async(staged))
-            if len(in_flight) >= PIPELINE_DEPTH:
+            in_flight.append(pipe.submit(decoder, staged))
+            # drain ahead of the window so the destination write overlaps
+            # the pipeline instead of bunching at end-of-stream; the
+            # effective window shrinks to 1 under memory pressure, which
+            # drains eagerly and degrades the pipeline to serial decode
+            while len(in_flight) > pipe.effective_window:
                 await drain_one()
             return
         rows = [parse_copy_row(line, oids)
@@ -142,26 +159,31 @@ async def _copy_partition(source: ReplicationSource,
         progress.total_rows += batch.num_rows
         registry.counter_inc(ETL_TABLE_COPY_ROWS_TOTAL, batch.num_rows)
 
-    async for raw in stream:
-        if monitor is not None and monitor.pressure:
-            # stop pulling COPY data under memory pressure; the server-side
-            # cursor waits (reference TryBatchBackpressureStream pause)
-            await monitor.wait_until_resumed()
-        pending.append(raw)
-        pending_len += len(raw)
-        # budget-aware chunking: the per-stream share shrinks when many
-        # partitions copy concurrently (batch_budget.rs:72-96)
-        threshold = max_batch_bytes if lease is None \
-            else min(max_batch_bytes, lease.ideal_batch_bytes())
-        if pending_len >= threshold:
-            buf = b"".join(pending)
-            cut = buf.rfind(b"\n") + 1
-            await write_chunk(buf[:cut])
-            pending = [buf[cut:]] if cut < len(buf) else []
-            pending_len = len(buf) - cut
-    await write_chunk(b"".join(pending))
-    while in_flight:
-        await drain_one()
+    try:
+        async for raw in stream:
+            if monitor is not None and monitor.pressure:
+                # stop pulling COPY data under memory pressure; the
+                # server-side cursor waits (reference
+                # TryBatchBackpressureStream pause)
+                await monitor.wait_until_resumed()
+            pending.append(raw)
+            pending_len += len(raw)
+            # budget-aware chunking: the per-stream share shrinks when many
+            # partitions copy concurrently (batch_budget.rs:72-96)
+            threshold = max_batch_bytes if lease is None \
+                else min(max_batch_bytes, lease.ideal_batch_bytes())
+            if pending_len >= threshold:
+                buf = b"".join(pending)
+                cut = buf.rfind(b"\n") + 1
+                await write_chunk(buf[:cut])
+                pending = [buf[cut:]] if cut < len(buf) else []
+                pending_len = len(buf) - cut
+        await write_chunk(b"".join(pending))
+        while in_flight:
+            await drain_one()
+    finally:
+        if pipe is not None:
+            pipe.close()
     # durability barrier for this partition (mod.rs:360-378)
     for ack in acks:
         await ack.wait_durable()
@@ -218,7 +240,8 @@ async def parallel_table_copy(*, source_factory, primary_source,
                     src, schema, snapshot_id, config.publication_name, part,
                     decoder, destination, progress,
                     config.batch.max_size_bytes, monitor=monitor,
-                    lease=lease, pipeline_id=config.pipeline_id))
+                    lease=lease, pipeline_id=config.pipeline_id,
+                    decode_window=config.batch.decode_window))
         finally:
             if lease is not None:
                 lease.release()

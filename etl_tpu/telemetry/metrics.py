@@ -47,6 +47,23 @@ ETL_DECODE_ROUTED_DEVICE_ROWS_TOTAL = "etl_decode_routed_device_rows_total"
 ETL_DECODE_ROUTED_HOST_ROWS_TOTAL = "etl_decode_routed_host_rows_total"
 ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL = "etl_decode_routed_oracle_rows_total"
 ETL_PROCESSED_BYTES_TOTAL = "etl_processed_bytes_total"
+# decode pipeline stage timings (ops/pipeline.py): pack = host gather into
+# the staging arena, dispatch = jit call (device work starts), fetch =
+# result wait + unpack/combine. Overlap = seconds of pack time that ran
+# while another batch was in flight on the device — the whole point of the
+# three-stage scheduler; the ratio gauge is overlap/pack cumulatively.
+ETL_DECODE_PACK_SECONDS = "etl_decode_pack_seconds"
+ETL_DECODE_DISPATCH_SECONDS = "etl_decode_dispatch_seconds"
+ETL_DECODE_FETCH_SECONDS = "etl_decode_fetch_seconds"
+ETL_DECODE_PIPELINE_PACK_SECONDS_TOTAL = \
+    "etl_decode_pipeline_pack_seconds_total"
+ETL_DECODE_PIPELINE_OVERLAP_SECONDS_TOTAL = \
+    "etl_decode_pipeline_overlap_seconds_total"
+ETL_DECODE_PIPELINE_OVERLAP_RATIO = "etl_decode_pipeline_overlap_ratio"
+ETL_DECODE_PIPELINE_IN_FLIGHT = "etl_decode_pipeline_in_flight"
+# staging-arena pool (ops/staging.py): hit = a preallocated buffer was
+# reused, miss = a fresh allocation (labels: {"result": "hit"|"miss"})
+ETL_STAGING_ARENA_REQUESTS_TOTAL = "etl_staging_arena_requests_total"
 # pending catalog-inlined bytes per lake table (reference
 # ETL_DUCKLAKE_TABLE_ACTIVE_INLINED_DATA_BYTES, ducklake/inline_size.rs)
 ETL_LAKE_INLINED_DATA_BYTES = "etl_lake_inlined_data_bytes"
@@ -67,8 +84,15 @@ _HISTOGRAM_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
 # byte-scale series use byte-scale buckets (the default set is seconds)
 _BYTE_BUCKETS = (1 << 10, 16 << 10, 64 << 10, 256 << 10, 1 << 20, 4 << 20,
                  16 << 20, 64 << 20, 256 << 20, 1 << 30)
+# decode stages run sub-millisecond on warm paths; the default second-scale
+# buckets would collapse every observation into the first bucket
+_FINE_TIME_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                      0.05, 0.1, 0.25, 1.0, 5.0)
 _BUCKETS_BY_NAME = {
     "etl_transaction_size_bytes": _BYTE_BUCKETS,
+    ETL_DECODE_PACK_SECONDS: _FINE_TIME_BUCKETS,
+    ETL_DECODE_DISPATCH_SECONDS: _FINE_TIME_BUCKETS,
+    ETL_DECODE_FETCH_SECONDS: _FINE_TIME_BUCKETS,
 }
 
 LabelSet = tuple[tuple[str, str], ...]
@@ -132,6 +156,14 @@ class MetricsRegistry:
     def get_gauge(self, name: str,
                   labels: dict[str, str] | None = None) -> float | None:
         return self._gauges.get(name, {}).get(_labels(labels))
+
+    def get_histogram(self, name: str,
+                      labels: dict[str, str] | None = None
+                      ) -> tuple[int, float]:
+        """(count, sum) of one histogram series; (0, 0.0) when unseen —
+        benches and tests read stage totals without parsing exposition."""
+        h = self._histograms.get(name, {}).get(_labels(labels))
+        return (h.count, h.total) if h is not None else (0, 0.0)
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition format 0.0.4."""

@@ -1,0 +1,23 @@
+# etl-lint fixture: @dispatch_stage sanctions UPLOADS only — fetch-side
+# transfers (asarray / device_get / block_until_ready) inside the
+# dispatch stage still serialize the pipeline and must be flagged, and a
+# device_put in a plain @hot_loop function (no @dispatch_stage) is still
+# a finding.
+# expect: hot-loop-host-transfer=3
+import jax
+import numpy as np
+
+from etl_tpu.analysis.annotations import dispatch_stage, hot_loop
+
+
+@dispatch_stage
+@hot_loop
+def dispatch_then_fetch(fn, bmat, lengths, dev):
+    out = fn(jax.device_put(bmat, dev), lengths)  # upload: sanctioned
+    out.block_until_ready()  # fetch-side sync: flagged
+    return np.asarray(out)  # fetch: flagged
+
+
+@hot_loop
+def upload_outside_dispatch_stage(bmat, dev):
+    return jax.device_put(bmat, dev)  # no @dispatch_stage: flagged

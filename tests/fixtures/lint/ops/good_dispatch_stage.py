@@ -1,0 +1,15 @@
+# etl-lint fixture: the decode pipeline's dispatch stage — a @hot_loop
+# function where host→device UPLOADS (jax.device_put of a packed arena)
+# are sanctioned by @dispatch_stage; the rule must stay quiet.
+# (no expectations: zero findings)
+import jax
+
+from etl_tpu.analysis.annotations import dispatch_stage, hot_loop
+
+
+@dispatch_stage
+@hot_loop
+def dispatch_packed(fn, bmat, lengths, dev):
+    bmat = jax.device_put(bmat, dev)  # committed upload: rides the pipeline
+    lengths = jax.device_put(lengths, dev)
+    return fn(bmat, lengths)
