@@ -156,7 +156,13 @@ def _batches_identical(a, b) -> bool:
 def run_smoke() -> dict:
     """CI gate: CPU backend, small batches, pipelined decode must be
     byte-identical to serial decode() and the stage histograms must have
-    observations. Runs in seconds (no accelerator tunnel)."""
+    observations; then a short end-to-end `table_streaming` run is
+    compared against the checked-in floor (BENCH_FLOOR.json) — the A/B
+    regression gate that would have caught the round-5 3-4x CDC
+    throughput collapse before it shipped. Runs without the accelerator
+    tunnel."""
+    import os
+
     from etl_tpu.ops import DecodePipeline, DeviceDecoder
     from etl_tpu.ops.wal import concat_payloads, stage_wal_batch
     from etl_tpu.telemetry.metrics import (ETL_DECODE_DISPATCH_SECONDS,
@@ -184,11 +190,33 @@ def run_smoke() -> dict:
     stages_observed = all(registry.get_histogram(n)[0] > 0 for n in (
         ETL_DECODE_PACK_SECONDS, ETL_DECODE_DISPATCH_SECONDS,
         ETL_DECODE_FETCH_SECONDS))
+
+    # streaming A/B gate: a short saturation run through the FULL
+    # pipeline (fake walsender -> apply loop -> pipelined decode -> null
+    # destination), events/s vs the checked-in floor
+    import asyncio
+
+    from etl_tpu.benchmarks import harness
+
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_FLOOR.json")) as f:
+        floors = json.load(f)
+    floor = floors["table_streaming_events_per_sec_floor"]
+    stream = asyncio.run(harness.run_table_streaming(
+        n_events=floors.get("table_streaming_smoke_events", 30_000),
+        tx_size=floors.get("table_streaming_smoke_tx_size", 200),
+        engine="tpu", destination="null"))
+    stream_eps = stream["end_to_end_events_per_second"]
+    stream_ok = stream_eps >= floor
+
     return {
         "mode": "smoke",
-        "ok": bool(identical and stages_observed),
+        "ok": bool(identical and stages_observed and stream_ok),
         "pipelined_equals_serial": bool(identical),
         "stage_histograms_observed": bool(stages_observed),
+        "streaming_events_per_sec": stream_eps,
+        "streaming_floor_events_per_sec": floor,
+        "streaming_above_floor": bool(stream_ok),
         "rows_per_batch": n_rows,
         "batches": 3,
         "overlap_seconds": round(stats["overlap_seconds_total"], 5),

@@ -22,6 +22,11 @@ worked examples):
                                 functions; `@dispatch_stage` (the decode
                                 pipeline's dispatch stage) sanctions
                                 host→device uploads only
+  7. unbounded-retry          — `while True` retry loops whose handlers
+                                swallow exceptions and spin again with no
+                                backoff (no sleep / RetryPolicy delay):
+                                a failing dependency turns them into a
+                                busy-loop hammering it at CPU speed
 """
 
 from __future__ import annotations
@@ -332,6 +337,134 @@ class HotLoopHostTransfer(Rule):
             f"the consumer (_PendingDecode.result) instead")
 
 
+# -- rule 7 -------------------------------------------------------------------
+
+#: calls that count as backoff inside a retry loop: sleeps (direct or
+#: wrapped, e.g. or_shutdown(shutdown, asyncio.sleep(d))), the unified
+#: RetryPolicy's delay schedule, and the destination retry wrapper
+BACKOFF_TERMINALS = frozenset({"sleep", "delay", "delay_ms", "base_delay",
+                               "with_retries"})
+#: `.execute(...)` counts as backoff ONLY on a retry-policy receiver
+#: (`policy.execute`, `self.retry.execute`) — a bare `cursor.execute`
+#: inside a while-True hammer must NOT suppress the rule
+_EXECUTE_RECEIVER_HINTS = ("retry", "policy")
+
+
+class UnboundedRetry(Rule):
+    """`while True` loops that catch exceptions, keep looping, and never
+    back off. The swallowing handler turns a dead dependency into a
+    CPU-speed hammer (connect storms against a down Postgres, request
+    storms against a throttling destination). Fix: a RetryPolicy delay /
+    sleep in the handler or loop body, or re-raise / break out."""
+
+    name = "unbounded-retry"
+
+    @staticmethod
+    def _is_while_true(node: ast.While) -> bool:
+        return isinstance(node.test, ast.Constant) and node.test.value is True
+
+    @classmethod
+    def _region(cls, node: ast.AST, with_loop_depth: bool = False):
+        """The nodes belonging to ONE while-True's retry region: nested
+        callables are pruned (they run in a different activation — the
+        has_raise lesson, visitor._contains_raise), and nested while-True
+        loops are pruned too (each gets its own on_while analysis: an
+        inner hot spin must not be absolved by an outer loop's backoff,
+        and one handler must not be reported per level). With
+        `with_loop_depth`, yields (node, inside_inner_loop) so a
+        handler's `break` can be judged against the loop it would
+        actually exit."""
+        stack = [(n, False) for n in ast.iter_child_nodes(node)]
+        while stack:
+            n, in_loop = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.While) and cls._is_while_true(n):
+                continue
+            yield (n, in_loop) if with_loop_depth else n
+            nested = in_loop or isinstance(n, (ast.For, ast.AsyncFor,
+                                               ast.While))
+            stack.extend((c, nested) for c in ast.iter_child_nodes(n))
+
+    @staticmethod
+    def _exits_loop(handler: ast.ExceptHandler,
+                    try_in_inner_loop: bool) -> bool:
+        """Does the handler leave the retry loop? raise/return anywhere
+        (nested callables pruned, including a def as the handler's own
+        statement) exit the function; `break` counts only when it would
+        exit the RETRY loop — not when the try already sits inside an
+        inner loop (`try_in_inner_loop`) or the break is inside a loop
+        nested within the handler."""
+
+        def scan(node: ast.AST, in_nested_loop: bool) -> bool:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, (ast.Return, ast.Raise)):
+                    return True
+                if isinstance(child, ast.Break) and not in_nested_loop:
+                    return True
+                nested = in_nested_loop or isinstance(
+                    child, (ast.For, ast.AsyncFor, ast.While))
+                if scan(child, nested):
+                    return True
+            return False
+
+        for stmt in handler.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # a def IS the statement: its body never runs here
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                return True
+            if isinstance(stmt, ast.Break) and not try_in_inner_loop:
+                return True
+            if scan(stmt, try_in_inner_loop or isinstance(
+                    stmt, (ast.For, ast.AsyncFor, ast.While))):
+                return True
+        return False
+
+    @classmethod
+    def _has_backoff(cls, node: ast.While) -> bool:
+        for n in cls._region(node):
+            if not isinstance(n, ast.Call):
+                continue
+            term = terminal_name(n.func)
+            if term in BACKOFF_TERMINALS:
+                return True
+            if term == "execute":
+                dotted = (dotted_name(n.func) or "").lower()
+                receiver = dotted.rsplit(".", 1)[0]
+                if any(h in receiver for h in _EXECUTE_RECEIVER_HINTS):
+                    return True
+        return False
+
+    def on_while(self, ctx: LintContext, node: ast.While) -> None:
+        if not self._is_while_true(node):
+            return
+        swallowing = []
+        for n, in_inner_loop in self._region(node, with_loop_depth=True):
+            if not isinstance(n, ast.Try):
+                continue
+            for handler in n.handlers:
+                names = set(handler_type_names(handler))
+                broad = names & {"Exception", "BaseException", "<bare>",
+                                 "EtlError", "OSError", "ConnectionError",
+                                 "ClientError", "TimeoutError"}
+                if broad and not self._exits_loop(handler, in_inner_loop):
+                    swallowing.append((handler, sorted(broad)[0]))
+        if not swallowing or self._has_backoff(node):
+            return
+        handler, caught = min(swallowing,
+                              key=lambda hc: hc[0].lineno)
+        caught = "except" if caught == "<bare>" else f"except {caught}"
+        ctx.report(
+            self.name, handler, caught,
+            f"`while True` retry loop swallows `{caught}` and spins with "
+            f"no backoff — a failing dependency gets hammered at CPU "
+            f"speed; add a RetryPolicy delay / sleep, or re-raise")
+
+
 # -- entry points -------------------------------------------------------------
 
 def default_rules() -> list[Rule]:
@@ -342,6 +475,7 @@ def default_rules() -> list[Rule]:
         UnawaitedCoroutine(),
         CancellationSwallow(),
         HotLoopHostTransfer(),
+        UnboundedRetry(),
     ]
 
 

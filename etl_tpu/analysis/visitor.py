@@ -120,6 +120,9 @@ class Rule:
                     node: "ast.FunctionDef | ast.AsyncFunctionDef") -> None:
         pass
 
+    def on_while(self, ctx: "LintContext", node: ast.While) -> None:
+        pass
+
 
 class _Frame:
     __slots__ = ("name", "is_async", "is_hot", "is_dispatch")
@@ -286,6 +289,11 @@ class LintContext(ast.NodeVisitor):
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
         for rule in self.rules:
             rule.on_except_handler(self, node)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        for rule in self.rules:
+            rule.on_while(self, node)
         self.generic_visit(node)
 
 

@@ -1,0 +1,59 @@
+"""CLI: `python -m etl_tpu.chaos --seed N [--scenario NAME]`.
+
+Replays scenarios deterministically: the same (scenario, seed) pair
+produces the same workload and the same injection trace, so a failing
+run from CI reproduces locally from its two numbers. Prints one JSON
+object per scenario (sorted keys) and exits non-zero if any invariant
+was violated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m etl_tpu.chaos",
+        description="deterministic fault-injection scenario runner")
+    parser.add_argument("--seed", type=int, default=7,
+                        help="workload + injection RNG seed (default 7)")
+    parser.add_argument("--scenario", default=None,
+                        help="run one scenario by name (default: all)")
+    parser.add_argument("--list", action="store_true",
+                        help="list scenario names and exit")
+    parser.add_argument("--timeout", type=float, default=60.0,
+                        help="per-scenario timeout in seconds")
+    args = parser.parse_args(argv)
+
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") is None:
+        # chaos runs never need the accelerator tunnel; keep the CLI
+        # usable on hosts without one (same knob as tests/conftest.py)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from .corpus import SCENARIOS, get_scenario
+    from .runner import run_scenario
+
+    if args.list:
+        for s in SCENARIOS:
+            print(f"{s.name}: {s.description}")
+        return 0
+
+    scenarios = [get_scenario(args.scenario)] if args.scenario else \
+        list(SCENARIOS)
+    all_ok = True
+    for scenario in scenarios:
+        run = asyncio.run(run_scenario(scenario, args.seed,
+                                       timeout_s=args.timeout))
+        print(json.dumps(run.describe(), sort_keys=True))
+        all_ok = all_ok and run.ok
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""Failpoints: named crash/error injection sites across every layer.
+
+Grown from runtime/failpoints.py (the seven reference-parity sites,
+crates/etl/src/failpoints.rs:14-54) into the chaos subsystem's injection
+surface: decode-pipeline stages, copy partition boundaries, assembler
+seals, destination write/flush, store state/schema/progress commits, and
+a simulated device-OOM hook the decode pipeline degrades through.
+
+Design constraints:
+
+  - the registry stays a no-op dict lookup when nothing is armed — the
+    hot loop (per-row CDC pushes, per-chunk COPY writes) pays one `if not
+    dict` check;
+  - sites may be hit from the decode pipeline's WORKER THREAD as well as
+    the event loop, so the global registry is guarded by a lock and
+    actions must be thread-safe;
+  - per-pipeline scoping: `scope("name")` binds a contextvar that
+    asyncio tasks inherit, so two pipelines under test in one process can
+    arm the same site without cross-firing (satellite: parallel tests).
+    Worker-thread hits do not see contextvars of the arming task — sites
+    that fire on the pack/dispatch thread (pipeline.*) should be armed
+    globally in single-pipeline tests.
+
+`runtime/failpoints.py` re-exports this module, so existing call sites
+and tests keep importing from the runtime package unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+from typing import Callable, Iterator
+
+from ..models.errors import ErrorKind, EtlError
+
+# --- the reference's named sites (failpoints.rs:14-21) ----------------------
+
+BEFORE_SLOT_CREATION = "table_sync.before_slot_creation"
+DURING_COPY = "table_sync.during_copy"
+AFTER_FINISHED_COPY = "table_sync.after_finished_copy"
+BEFORE_STREAMING = "table_sync.before_streaming"
+ON_STATUS_UPDATE = "apply.on_status_update"
+ON_PROGRESS_STORE = "apply.on_progress_store"
+ON_SCHEMA_CLEANUP = "apply.on_schema_cleanup"
+
+REFERENCE_SITES = (
+    BEFORE_SLOT_CREATION, DURING_COPY, AFTER_FINISHED_COPY,
+    BEFORE_STREAMING, ON_STATUS_UPDATE, ON_PROGRESS_STORE,
+    ON_SCHEMA_CLEANUP,
+)
+
+# --- chaos-subsystem sites ---------------------------------------------------
+
+# decode pipeline stages (ops/pipeline.py _process/_fetch)
+PIPELINE_PACK = "pipeline.pack"
+PIPELINE_DISPATCH = "pipeline.dispatch"
+PIPELINE_FETCH = "pipeline.fetch"
+# simulated device OOM: the pipeline catches DEVICE_UNAVAILABLE /
+# MEMORY_PRESSURE_ABORT raised here and degrades the batch to the host
+# oracle instead of failing the stream (ops/pipeline.py)
+ENGINE_DEVICE_OOM = "engine.device_oom"
+# copy partition boundaries (runtime/copy.py)
+COPY_PARTITION_START = "copy.partition_start"
+COPY_PARTITION_END = "copy.partition_end"
+# assembler run seal (runtime/assembler.py)
+ASSEMBLER_SEAL = "assembler.seal"
+# destination ack layer (destinations/base.py): WRITE fires when a
+# destination constructs its ack (the write applied — an error here is
+# the lost-response ambiguity), FLUSH fires on wait_durable
+DESTINATION_WRITE = "destination.write"
+DESTINATION_FLUSH = "destination.flush"
+# store commit layer (store/memory.py, store/sql.py)
+STORE_STATE_COMMIT = "store.state_commit"
+STORE_SCHEMA_COMMIT = "store.schema_commit"
+STORE_PROGRESS_COMMIT = "store.progress_commit"
+
+CHAOS_SITES = (
+    PIPELINE_PACK, PIPELINE_DISPATCH, PIPELINE_FETCH, ENGINE_DEVICE_OOM,
+    COPY_PARTITION_START, COPY_PARTITION_END, ASSEMBLER_SEAL,
+    DESTINATION_WRITE, DESTINATION_FLUSH,
+    STORE_STATE_COMMIT, STORE_SCHEMA_COMMIT, STORE_PROGRESS_COMMIT,
+)
+
+ALL_SITES = REFERENCE_SITES + CHAOS_SITES
+
+# --- registry ----------------------------------------------------------------
+
+_lock = threading.Lock()
+_armed: dict[str, Callable[[], None]] = {}
+# scope name -> site -> action; consulted only when the contextvar is set
+_scoped: dict[str, dict[str, Callable[[], None]]] = {}
+_scope_var: contextvars.ContextVar[str | None] = \
+    contextvars.ContextVar("etl_failpoint_scope", default=None)
+
+
+@contextlib.contextmanager
+def scope(name: str) -> Iterator[str]:
+    """Bind a failpoint scope for the calling task (and every task it
+    spawns). Scoped armings fire only inside their scope."""
+    token = _scope_var.set(name)
+    try:
+        yield name
+    finally:
+        _scope_var.reset(token)
+        with _lock:
+            _scoped.pop(name, None)
+
+
+def arm(name: str, action: Callable[[], None],
+        scope_name: str | None = None) -> None:
+    """Arm a failpoint with an action (usually raising)."""
+    with _lock:
+        if scope_name is None:
+            _armed[name] = action
+        else:
+            _scoped.setdefault(scope_name, {})[name] = action
+
+
+def arm_error(name: str, kind: ErrorKind = ErrorKind.SOURCE_IO,
+              times: int = 1, detail: str = "",
+              scope_name: str | None = None) -> None:
+    """Arm to raise an EtlError of `kind` the next `times` hits."""
+    remaining = [times]
+
+    def action() -> None:
+        if remaining[0] > 0:
+            remaining[0] -= 1
+            raise EtlError(kind, detail or f"failpoint {name}")
+        disarm(name, scope_name)
+
+    arm(name, action, scope_name)
+
+
+def disarm(name: str, scope_name: str | None = None) -> None:
+    with _lock:
+        if scope_name is None:
+            _armed.pop(name, None)
+        else:
+            scoped = _scoped.get(scope_name)
+            if scoped is not None:
+                scoped.pop(name, None)
+
+
+def disarm_all() -> None:
+    with _lock:
+        _armed.clear()
+        _scoped.clear()
+
+
+def armed_sites() -> list[str]:
+    """Globally armed site names (introspection for tests/CLI)."""
+    with _lock:
+        return sorted(_armed)
+
+
+def fail_point(name: str) -> None:
+    """Hit a failpoint (no-op unless armed). Hot-path cost when disarmed:
+    two falsy dict checks, no lock."""
+    if not _armed and not _scoped:
+        return
+    action = None
+    if _scoped:
+        scope_name = _scope_var.get()
+        if scope_name is not None:
+            with _lock:
+                scoped = _scoped.get(scope_name)
+                action = scoped.get(name) if scoped else None
+    if action is None:
+        with _lock:
+            action = _armed.get(name)
+    if action is not None:
+        action()

@@ -1,0 +1,535 @@
+"""Scenario runner: arm faults, drive the workload, crash, restart,
+verify.
+
+One `run_scenario(scenario, seed)` call:
+
+  1. seeds `random.Random(seed)` — the ONLY randomness source — and
+     builds the fake walsender database, a recording store, and a
+     tracing MemoryDestination behind the fault-injecting wrapper;
+  2. arms every `FaultSpec` (failpoint errors, hard crashes, scripted
+     destination faults, wire severs) and records each firing into the
+     per-site injection trace;
+  3. runs the workload: initial copy → CDC transactions → drain. A
+     CRASH firing hard-kills the pipeline (every task cancelled, no
+     drain — process-death semantics) and restarts a fresh `Pipeline`
+     from the same store/destination, resuming the remaining workload;
+  4. checks the recovery invariants (chaos/invariants.py) and reports
+     chaos metrics (telemetry/metrics.py).
+
+Same (scenario, seed) → same workload bytes and same injection trace:
+the run is replayable from the CLI (`python -m etl_tpu.chaos`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+
+from ..config import BatchConfig, BatchEngine, PipelineConfig, RetryConfig
+from ..destinations import (FaultAction, FaultInjectingDestination, FaultKind
+                            as DestFaultKind, MemoryDestination)
+from ..models import ColumnSchema, Oid, TableName, TableSchema
+from ..models.lsn import Lsn
+from ..models.errors import EtlError
+from ..models.table_state import TableStateType
+from ..postgres.fake import FakeDatabase, FakeSource
+from ..postgres.slots import apply_slot_name
+from ..store import NotifyingStore
+from ..telemetry.metrics import (ETL_CHAOS_INJECTED_FAULTS_TOTAL,
+                                 ETL_CHAOS_RECOVERY_DURATION_SECONDS,
+                                 ETL_CHAOS_SCENARIOS_TOTAL, registry)
+from . import failpoints
+from .invariants import (InvariantReport, LeakProbe, check_invariants,
+                         reconstruct_final_view)
+from .scenario import FaultKind, FaultSpec, Scenario
+
+BASE_TABLE_ID = 16384
+_DEST_OPS = ("write_events", "write_table_rows", "truncate_table",
+             "drop_table")
+
+
+class SimulatedCrash(Exception):
+    """Raised at a CRASH site; the watcher hard-kills the pipeline before
+    any in-process retry can proceed."""
+
+
+class RecordingStore(NotifyingStore):
+    """NotifyingStore that records the stored durable-progress trajectory
+    per key (the monotonic-lsn invariant's evidence)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.progress_log: dict[str, list[Lsn]] = {}
+
+    async def update_durable_progress(self, key, lsn) -> bool:
+        stored = await super().update_durable_progress(key, lsn)
+        if stored:
+            self.progress_log.setdefault(key, []).append(lsn)
+        return stored
+
+
+class TracingDestination(MemoryDestination):
+    """MemoryDestination that remembers WHERE in the event timeline each
+    destination drop happened, so the invariant checker can exclude
+    events of abandoned (dropped-and-recopied) copy attempts."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.drop_seq_by_table: dict = {}
+        self.held_ack_count = 0  # set by the runner after shutdown
+
+    async def drop_table(self, table_id, schema=None) -> None:
+        self.drop_seq_by_table[table_id] = len(self.events)
+        await super().drop_table(table_id, schema)
+
+
+@dataclass
+class RestartRecord:
+    kind: str  # "crash" | "clean"
+    resume_lsn: int
+    at_tx: int
+    recovery_s: float = 0.0
+
+    def describe(self) -> dict:
+        return {"kind": self.kind, "resume_lsn": self.resume_lsn,
+                "at_tx": self.at_tx,
+                "recovery_s": round(self.recovery_s, 4)}
+
+
+@dataclass
+class ChaosRun:
+    scenario: Scenario
+    seed: int
+    trace: dict[str, list[dict]] = field(default_factory=dict)
+    restarts: list[RestartRecord] = field(default_factory=list)
+    report: InvariantReport = field(default_factory=InvariantReport)
+    fault_firings: int = 0  # every injection, for the trace
+    # only firings that can cause re-delivery (worker retry re-streams):
+    # the bounded-dup budget — OOM fallbacks, HOLDs, and crashes (already
+    # counted via restarts) must NOT loosen the exactly-once assertion
+    redelivery_firings: int = 0
+    duration_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.report.ok
+
+    def describe(self) -> dict:
+        return {
+            "scenario": self.scenario.name,
+            "seed": self.seed,
+            "ok": self.ok,
+            "trace": {site: list(fires)
+                      for site, fires in sorted(self.trace.items())},
+            "restarts": [r.describe() for r in self.restarts],
+            "fault_firings": self.fault_firings,
+            "redelivery_firings": self.redelivery_firings,
+            "invariants": self.report.describe(),
+            "duration_s": round(self.duration_s, 3),
+        }
+
+
+class _Workload:
+    """Deterministic workload state: per-table expected rows + next pk."""
+
+    def __init__(self, scenario: Scenario, rng: random.Random):
+        self.scenario = scenario
+        self.rng = rng
+        self.table_ids = [BASE_TABLE_ID + i for i in range(scenario.tables)]
+        self.expected: dict[int, dict[int, tuple]] = \
+            {tid: {} for tid in self.table_ids}
+        self._next_pk: dict[int, int] = {tid: 1 for tid in self.table_ids}
+        self.tx_index = 0
+
+    def build_db(self) -> FakeDatabase:
+        db = FakeDatabase()
+        for i, tid in enumerate(self.table_ids):
+            rows = []
+            for _ in range(self.scenario.rows_per_table):
+                pk = self._next_pk[tid]
+                self._next_pk[tid] += 1
+                v = self.rng.randrange(0, 1000)
+                note = f"seed-{self.rng.randrange(10**6)}"
+                rows.append([str(pk), str(v), note])
+                self.expected[tid][pk] = (pk, v, note)
+            db.create_table(TableSchema(
+                tid, TableName("public", f"chaos_t{i}"),
+                (ColumnSchema("id", Oid.INT8, nullable=False,
+                              primary_key_ordinal=1),
+                 ColumnSchema("v", Oid.INT4),
+                 ColumnSchema("note", Oid.TEXT))), rows=rows)
+        db.create_publication("pub", list(self.table_ids))
+        return db
+
+    async def run_tx(self, db: FakeDatabase) -> None:
+        """One CDC transaction: inserts, sometimes an update or delete."""
+        rng = self.rng
+        tid = self.table_ids[rng.randrange(len(self.table_ids))]
+        exp = self.expected[tid]
+        async with db.transaction() as tx:
+            for _ in range(self.scenario.rows_per_tx):
+                roll = rng.random()
+                existing = sorted(exp)
+                if roll < 0.15 and existing:  # delete
+                    pk = existing[rng.randrange(len(existing))]
+                    tx.delete(tid, [str(pk), None, None])
+                    del exp[pk]
+                elif roll < 0.40 and existing:  # update
+                    pk = existing[rng.randrange(len(existing))]
+                    v = rng.randrange(0, 1000)
+                    note = f"upd-{rng.randrange(10**6)}"
+                    tx.update(tid, [str(pk), None, None],
+                              [str(pk), str(v), note])
+                    exp[pk] = (pk, v, note)
+                else:  # insert
+                    pk = self._next_pk[tid]
+                    self._next_pk[tid] += 1
+                    v = rng.randrange(0, 1000)
+                    note = f"ins-{rng.randrange(10**6)}"
+                    tx.insert(tid, [str(pk), str(v), note])
+                    exp[pk] = (pk, v, note)
+        self.tx_index += 1
+
+    def delivered(self, dest: TracingDestination) -> bool:
+        view = reconstruct_final_view(dest, self.table_ids)
+        for tid, rows in self.expected.items():
+            got = view.get(tid, {})
+            if set(got) != set(rows):
+                return False
+            if any(got[pk] != vals for pk, vals in rows.items()):
+                return False
+        return True
+
+
+class _CrashState:
+    """Crash flag settable from ANY thread: failpoint sites on the decode
+    pipeline's worker thread (pipeline.*) trip it via
+    call_soon_threadsafe, sites on the event loop set it directly."""
+
+    def __init__(self) -> None:
+        self.event = asyncio.Event()
+        self._loop = asyncio.get_running_loop()
+
+    def trip(self) -> None:
+        try:
+            on_loop = asyncio.get_running_loop() is self._loop
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            self.event.set()
+        else:
+            self._loop.call_soon_threadsafe(self.event.set)
+
+
+async def _race_crash(crash: _CrashState, coro) -> None:
+    """Run `coro` unless/until the crash trips; on crash, cancel it and
+    raise SimulatedCrash to the caller's restart loop."""
+    task = asyncio.ensure_future(coro)
+    crash_task = asyncio.ensure_future(crash.event.wait())
+    try:
+        done, _ = await asyncio.wait({task, crash_task},
+                                     return_when=asyncio.FIRST_COMPLETED)
+        if crash_task in done and crash.event.is_set():
+            if task.done():
+                # both finished in the same round: retrieve the task's
+                # outcome so a real failure is not silently dropped as
+                # "exception was never retrieved" noise
+                task.exception()
+            raise SimulatedCrash()
+        return task.result()
+    finally:
+        for t in (task, crash_task):
+            if not t.done():
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):  # etl-lint: ignore[cancellation-swallow] — cancel-then-drain of our own helper tasks
+                    pass
+
+
+async def _hard_kill(pipeline) -> None:
+    """Process-death semantics: cancel every pipeline task with no drain
+    and no destination shutdown. In-process resources that a real crash
+    would free with the process (decode-pipeline threads, the memory
+    monitor's sampler) are closed via the tasks' finally blocks."""
+    tasks = []
+    if pipeline._apply_task is not None:
+        tasks.append(pipeline._apply_task)
+    pool = pipeline.pool
+    if pool is not None:
+        tasks += [h.task for h in pool._workers.values()
+                  if h.task is not None]
+        tasks += list(pool._retry_tasks.values())
+        pool._retry_tasks.clear()
+    for t in tasks:
+        if not t.done():
+            t.cancel()
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
+    if pipeline.memory_monitor is not None:
+        await pipeline.memory_monitor.stop()
+
+
+async def _wait_until(predicate, timeout: float, what: str,
+                      interval: float = 0.02) -> None:
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        if time.monotonic() >= deadline:
+            raise TimeoutError(what)
+        await asyncio.sleep(interval)
+
+
+async def run_scenario(scenario: Scenario, seed: int,
+                       timeout_s: float = 60.0) -> ChaosRun:
+    """Run one scenario to completion and verify invariants. Always
+    disarms every failpoint on the way out."""
+    failpoints.disarm_all()
+    run = ChaosRun(scenario=scenario, seed=seed)
+    t_start = time.monotonic()
+    try:
+        await asyncio.wait_for(_run_scenario_inner(scenario, seed, run),
+                               timeout_s)
+    except (TimeoutError, asyncio.TimeoutError) as e:
+        run.report.fail(f"scenario did not complete: {e or 'timeout'}")
+    except Exception as e:
+        # an unexpected error is a FAILED run, not a pass with an empty
+        # report — the metrics and run.ok must say so
+        run.report.fail(f"scenario crashed: {e!r}")
+    finally:
+        failpoints.disarm_all()
+        run.duration_s = time.monotonic() - t_start
+        registry.counter_inc(
+            ETL_CHAOS_SCENARIOS_TOTAL,
+            labels={"result": "pass" if run.ok else "fail"})
+    return run
+
+
+async def _run_scenario_inner(scenario: Scenario, seed: int,
+                              run: ChaosRun) -> None:
+    rng = random.Random(seed)
+    leak_probe = LeakProbe.capture()
+    workload = _Workload(scenario, rng)
+    db = workload.build_db()
+    store = RecordingStore()
+    inner = TracingDestination()
+    dest = FaultInjectingDestination(inner)
+    crash = _CrashState()
+    held_releases: list[tuple[asyncio.Event, int | None]] = []
+
+    def record_fire(spec: FaultSpec, action: str) -> None:
+        fires = run.trace.setdefault(spec.site, [])
+        fires.append({"fire": len(fires) + 1, "action": action,
+                      "error_kind": spec.error_kind.name})
+        run.fault_firings += 1
+        if spec.kind in (FaultKind.ERROR, FaultKind.DEST_REJECT,
+                         FaultKind.DEST_FAIL_AFTER_APPLY, FaultKind.SEVER) \
+                and spec.site != failpoints.ENGINE_DEVICE_OOM:
+            # faults the worker recovers from by re-streaming; crashes
+            # are accounted via restarts, OOM fallbacks and HOLDs never
+            # re-deliver
+            run.redelivery_firings += 1
+        registry.counter_inc(ETL_CHAOS_INJECTED_FAULTS_TOTAL,
+                             labels={"site": spec.site})
+
+    def arm_failpoint(spec: FaultSpec) -> None:
+        state = {"hits": 0, "fired": 0}
+
+        def action() -> None:
+            state["hits"] += 1
+            if state["hits"] <= spec.after_hits \
+                    or state["fired"] >= spec.times:
+                return
+            state["fired"] += 1
+            if spec.kind is FaultKind.CRASH:
+                record_fire(spec, "crash")
+                crash.trip()
+                raise SimulatedCrash(f"simulated crash at {spec.site}")
+            record_fire(spec, "error")
+            raise EtlError(spec.error_kind,
+                           f"chaos injection at {spec.site}")
+
+        failpoints.arm(spec.site, action)
+
+    # firings are recorded when the wrapper actually CONSUMES a scripted
+    # fault, not at scripting time — the trace must never claim an
+    # injection that didn't happen, and the bounded-dup budget must not
+    # be inflated by scripts the workload never reached. The per-op spec
+    # FIFO mirrors the wrapper's own FIFO action queue exactly.
+    scripted_specs: dict[str, list[FaultSpec]] = {}
+    _orig_next_fault = dest._next_fault
+
+    def _observing_next_fault(op: str):
+        fault = _orig_next_fault(op)
+        if fault is not None:
+            pending = scripted_specs.get(op)
+            if pending:
+                spec = pending.pop(0)
+                record_fire(spec, spec.kind.value)
+        return fault
+
+    dest._next_fault = _observing_next_fault
+
+    def script_dest_fault(spec: FaultSpec) -> None:
+        if spec.kind is FaultKind.DEST_REJECT:
+            kind = DestFaultKind.REJECT
+        elif spec.kind is FaultKind.DEST_FAIL_AFTER_APPLY:
+            kind = DestFaultKind.FAIL_AFTER_APPLY
+        else:
+            kind = DestFaultKind.HOLD
+        for _ in range(spec.times):
+            if kind is DestFaultKind.HOLD:
+                release = asyncio.Event()
+                held_releases.append((release, spec.hold_release_after_tx))
+                dest.script(spec.site, FaultAction(kind,
+                                                   release_event=release))
+            else:
+                dest.script(spec.site, FaultAction(kind))
+            scripted_specs.setdefault(spec.site, []).append(spec)
+
+    # arm everything without a tx trigger now; tx-triggered specs arm in
+    # the workload loop below
+    deferred: list[FaultSpec] = []
+    for spec in scenario.faults:
+        if spec.kind in (FaultKind.ERROR, FaultKind.CRASH):
+            arm_failpoint(spec)
+        elif spec.at_tx is None:
+            if spec.kind is FaultKind.SEVER:
+                deferred.append(spec)  # severing needs open streams
+            else:
+                script_dest_fault(spec)
+        else:
+            deferred.append(spec)
+
+    copy_started = asyncio.Event()
+    if scenario.tx_during_copy:
+        # non-destructive observer on the during-copy site (scenarios
+        # combining tx_during_copy with a fault at that same site would
+        # clobber each other's arming — none do)
+        failpoints.arm(failpoints.DURING_COPY, copy_started.set)
+
+    config = PipelineConfig(
+        pipeline_id=1, publication_name="pub",
+        batch=BatchConfig(max_size_bytes=64 * 1024, max_fill_ms=25,
+                          batch_engine=BatchEngine(scenario.engine)),
+        apply_retry=RetryConfig(max_attempts=10, initial_delay_ms=15,
+                                max_delay_ms=120),
+        table_retry=RetryConfig(max_attempts=10, initial_delay_ms=15,
+                                max_delay_ms=120),
+        lag_sample_interval_s=0)
+
+    def make_pipeline():
+        from ..runtime import Pipeline
+
+        return Pipeline(config=config, store=store, destination=dest,
+                        source_factory=lambda: FakeSource(db))
+
+    async def release_due_holds(tx_index: int | None) -> None:
+        for release, due in list(held_releases):
+            if due is None or tx_index is None or tx_index >= due:
+                release.set()
+                held_releases.remove((release, due))
+                await asyncio.sleep(0)  # let the release task run
+
+    async def drive() -> None:
+        """The workload phases; raises SimulatedCrash when a crash site
+        fires and the caller restarts."""
+        if scenario.tx_during_copy and workload.tx_index == 0:
+            await _race_crash(crash, copy_started.wait())
+            await _race_crash(crash, workload.run_tx(db))
+        await _race_crash(crash, _wait_until(
+            lambda: all(
+                (st := store._states.get(tid)) is not None
+                and st.type is TableStateType.READY
+                for tid in workload.table_ids), 30.0, "tables never ready"))
+        while workload.tx_index < scenario.txs:
+            for spec in list(deferred):
+                if (spec.at_tx or 0) <= workload.tx_index:
+                    deferred.remove(spec)
+                    if spec.kind is FaultKind.SEVER:
+                        record_fire(spec, "sever")
+                        await db.sever_streams()
+                    else:
+                        script_dest_fault(spec)
+            await _race_crash(crash, workload.run_tx(db))
+            await release_due_holds(workload.tx_index)
+        await release_due_holds(None)
+        await _race_crash(crash, _wait_until(
+            lambda: workload.delivered(inner), 30.0,
+            "workload never fully delivered"))
+
+    pipeline = make_pipeline()
+    try:
+        await pipeline.start()
+        max_restarts = scenario.expect_restarts + 2
+        t_phase = time.monotonic()
+        while True:
+            try:
+                await drive()
+                break
+            except SimulatedCrash:
+                crash.event.clear()
+                await _hard_kill(pipeline)
+                resume = await store.get_durable_progress(
+                    apply_slot_name(1))
+                rec = RestartRecord(kind="crash",
+                                    resume_lsn=int(resume or Lsn.ZERO),
+                                    at_tx=workload.tx_index)
+                run.restarts.append(rec)
+                if len(run.restarts) > max_restarts:
+                    run.report.fail(
+                        f"crash loop: {len(run.restarts)} restarts "
+                        f"exceeded the scenario budget {max_restarts}")
+                    return
+                t_phase = time.monotonic()
+                pipeline = make_pipeline()
+                await pipeline.start()
+        if run.restarts:
+            recovery = time.monotonic() - t_phase
+            run.restarts[-1].recovery_s = recovery
+            registry.histogram_observe(
+                ETL_CHAOS_RECOVERY_DURATION_SECONDS, recovery)
+
+        if scenario.clean_restart:
+            await pipeline.shutdown_and_wait()
+            resume = await store.get_durable_progress(apply_slot_name(1))
+            run.restarts.append(RestartRecord(
+                kind="clean", resume_lsn=int(resume or Lsn.ZERO),
+                at_tx=workload.tx_index))
+            t_phase = time.monotonic()
+            pipeline = make_pipeline()
+            await pipeline.start()
+            end = workload.tx_index + scenario.txs_after_restart
+            while workload.tx_index < end:
+                await _race_crash(crash, workload.run_tx(db))
+            await _race_crash(crash, _wait_until(
+                lambda: workload.delivered(inner), 30.0,
+                "post-restart workload never delivered"))
+            run.restarts[-1].recovery_s = time.monotonic() - t_phase
+
+        await pipeline.shutdown_and_wait()
+    finally:
+        # a failed scenario (timeout cancellation, unexpected error) must
+        # not leak a live pipeline into the next scenario/test: hard-kill
+        # whatever is still running and close the destination. After a
+        # clean shutdown both calls are idempotent no-ops.
+        await _hard_kill(pipeline)
+        await dest.shutdown()
+    # unresolved = still pending now (shutdown missed them) PLUS any the
+    # wrapper had to force-fail because no release ever came (shutdown
+    # clears _held_acks, so counting the list alone would always be 0)
+    inner.held_ack_count = dest.forced_held_acks + sum(
+        1 for f in dest._held_acks if not f.done())
+    # decode-pipeline worker threads exit asynchronously after close();
+    # give them a moment before the leak probe counts survivors
+    from .invariants import _pipeline_thread_count
+
+    await _wait_until(
+        lambda: _pipeline_thread_count() <= leak_probe.pipeline_threads,
+        2.0, "pipeline threads lingering")
+
+    check_invariants(
+        expected=workload.expected, dest=inner, store=store,
+        restarts=run.restarts, fault_firings=run.redelivery_firings,
+        leak_probe=leak_probe, report=run.report)

@@ -22,6 +22,7 @@ from typing import Iterable, Sequence
 
 import numpy as np
 
+from ..chaos import failpoints
 from ..models.event import (ChangeType, DecodedBatchEvent, DeleteEvent, Event,
                             InsertEvent, UpdateEvent)
 from ..models.lsn import Lsn
@@ -32,7 +33,13 @@ from ..models.table_row import ColumnarBatch, TableRow
 class WriteAck:
     """Acknowledgement of a write. `durable` may be True immediately;
     otherwise await `wait_durable()` (resolves when the destination reports
-    crash-safety, or raises if the write ultimately failed)."""
+    crash-safety, or raises if the write ultimately failed).
+
+    Chaos sites (chaos/failpoints.py): every destination constructs its
+    ack through `durable()`/`accepted()`, so DESTINATION_WRITE armed
+    there fires AFTER the write applied — the lost-response ambiguity —
+    and DESTINATION_FLUSH fires on the durability wait, regardless of
+    which destination implementation is under test."""
 
     __slots__ = ("_fut",)
 
@@ -41,12 +48,14 @@ class WriteAck:
 
     @classmethod
     def durable(cls) -> "WriteAck":
+        failpoints.fail_point(failpoints.DESTINATION_WRITE)
         fut = asyncio.get_event_loop().create_future()
         fut.set_result(None)
         return cls(fut)
 
     @classmethod
     def accepted(cls) -> "tuple[WriteAck, asyncio.Future[None]]":
+        failpoints.fail_point(failpoints.DESTINATION_WRITE)
         fut = asyncio.get_event_loop().create_future()
         return cls(fut), fut
 
@@ -55,6 +64,7 @@ class WriteAck:
         return self._fut.done() and self._fut.exception() is None
 
     async def wait_durable(self) -> None:
+        failpoints.fail_point(failpoints.DESTINATION_FLUSH)
         await asyncio.shield(self._fut)
 
 

@@ -177,6 +177,10 @@ class ClickHouseDestination(Destination):
                                            self.config.password)) as resp:
                 text = await resp.text()
                 if resp.status != 200:
+                    # HTTP status → ErrorKind; the unified RetryPolicy
+                    # classifies the kind (throttle/connection/timeout =
+                    # transient, rejected payloads = permanent → the
+                    # worker loop re-streams instead)
                     err = EtlError(
                         ErrorKind.DESTINATION_THROTTLED
                         if http_status_retryable(resp.status)
@@ -185,12 +189,7 @@ class ClickHouseDestination(Destination):
                     raise err
                 return text
 
-        def retryable(e: BaseException) -> bool:
-            if isinstance(e, EtlError):
-                return e.kind is ErrorKind.DESTINATION_THROTTLED
-            return isinstance(e, (aiohttp.ClientError, OSError))
-
-        return await with_retries(attempt, self.retry, retryable)
+        return await with_retries(attempt, self.retry)
 
     # -- Destination ------------------------------------------------------------
 

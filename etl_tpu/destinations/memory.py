@@ -85,6 +85,10 @@ class FaultInjectingDestination(Destination):
         self._tasks = TaskSet()
         self._held_acks: list[asyncio.Future] = []
         self._shut_down = False
+        # HOLD acks shutdown had to force-fail because nothing released
+        # them — the chaos no-leaks invariant reads this (counting
+        # _held_acks after shutdown would always see the cleared list)
+        self.forced_held_acks = 0
 
     def script(self, op: str, action: FaultAction) -> None:
         """op: one of write_table_rows / write_events / drop_table /
@@ -143,6 +147,9 @@ class FaultInjectingDestination(Destination):
         fut.exception()
 
     async def startup(self) -> None:
+        # a restarted pipeline reuses the wrapper: new HOLDs must be
+        # registrable again after a previous clean shutdown
+        self._shut_down = False
         await self.inner.startup()
 
     async def shutdown(self) -> None:
@@ -153,6 +160,7 @@ class FaultInjectingDestination(Destination):
         # ack — a consumer awaiting durability would hang forever
         for fut in self._held_acks:
             if not fut.done():
+                self.forced_held_acks += 1
                 self._fail_held(fut)
         self._held_acks.clear()
         await self.inner.shutdown()

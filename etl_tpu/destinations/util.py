@@ -9,13 +9,12 @@ CDC metadata conventions shared by the cloud writers (BigQuery
 from __future__ import annotations
 
 import asyncio
-import random
-from dataclasses import dataclass
 from typing import Awaitable, Callable, TypeVar
 
 from ..models.errors import ErrorKind, EtlError
 from ..models.event import ChangeType, EventSequenceKey
 from ..models.schema import TableName
+from ..retry import DESTINATION_TRANSIENT_KINDS, RetryPolicy
 
 T = TypeVar("T")
 
@@ -105,36 +104,21 @@ def http_status_retryable(status: int) -> bool:
     return status in _RETRYABLE_HTTP
 
 
-@dataclass(frozen=True)
-class DestinationRetryPolicy:
-    max_attempts: int = 5
-    initial_delay_s: float = 0.2
-    max_delay_s: float = 10.0
-    multiplier: float = 2.0
-    jitter: float = 0.2
-
-    def delay(self, attempt: int) -> float:
-        d = min(self.initial_delay_s * self.multiplier**attempt,
-                self.max_delay_s)
-        return d * (1 + random.random() * self.jitter)
+class DestinationRetryPolicy(RetryPolicy):
+    """Writer-scoped alias of the unified RetryPolicy (etl_tpu/retry.py):
+    in-place retries for transient transport/capacity errors only
+    (DESTINATION_TRANSIENT_KINDS) — rejected payloads escalate to the
+    worker retry loop, which re-streams from durable progress."""
 
 
 async def with_retries(op: Callable[[], Awaitable[T]],
-                       policy: DestinationRetryPolicy,
-                       retryable: Callable[[BaseException], bool]) -> T:
-    """Classify-and-backoff retry wrapper (reference retry.rs:classify)."""
-    last: BaseException | None = None
-    for attempt in range(policy.max_attempts):
-        try:
-            return await op()
-        except asyncio.CancelledError:
-            raise
-        except BaseException as e:
-            if not retryable(e) or attempt + 1 >= policy.max_attempts:
-                raise
-            last = e
-            await asyncio.sleep(policy.delay(attempt))
-    raise last  # pragma: no cover
+                       policy: RetryPolicy,
+                       retryable: "Callable[[BaseException], bool] | None"
+                       = None) -> T:
+    """Classify-and-backoff retry wrapper (reference retry.rs:classify).
+    Delegates to RetryPolicy.execute; `retryable=None` uses the policy's
+    own per-ErrorKind classification."""
+    return await policy.execute(op, retryable)
 
 
 class TaskSet:

@@ -195,8 +195,10 @@ class DecodePipeline:
                         "decode pipeline closed before this batch packed")
                 self._process(decoder, staged, handle)
             # worker THREAD, not a coroutine: no asyncio cancellation can
-            # land here; every failure must reach the consumer's result()
-            except BaseException as e:  # etl-lint: ignore[cancellation-swallow]
+            # land here; every failure must reach the consumer's result().
+            # Not a retry spin either: the loop blocks on _jobs.get(), so
+            # a failing batch is reported once, not hammered
+            except BaseException as e:  # etl-lint: ignore[cancellation-swallow,unbounded-retry]
                 if handle._windowed:
                     handle._windowed = False
                     self.window.release()
@@ -211,13 +213,30 @@ class DecodePipeline:
                  handle: PipelinedDecode) -> None:
         """Pack + dispatch one batch on the worker thread. @hot_loop: runs
         once per batch on the dispatch path — fetches belong to _fetch."""
-        from ..telemetry.metrics import (ETL_DECODE_DISPATCH_SECONDS,
-                                         ETL_DECODE_PACK_SECONDS,
-                                         ETL_DECODE_PIPELINE_IN_FLIGHT,
-                                         registry)
+        from ..chaos import failpoints
+        from ..models.errors import ErrorKind, EtlError
+        from ..telemetry.metrics import (
+            ETL_DECODE_DEVICE_OOM_FALLBACKS_TOTAL,
+            ETL_DECODE_DISPATCH_SECONDS, ETL_DECODE_PACK_SECONDS,
+            ETL_DECODE_PIPELINE_IN_FLIGHT, registry)
         from .engine import _PendingDecode
 
+        # chaos site: fires once per submitted batch at pack-stage entry
+        # (before routing, so small oracle-routed batches hit it too)
+        failpoints.fail_point(failpoints.PIPELINE_PACK)
         mode, specs = decoder._route(staged)
+        if mode != "oracle":
+            # simulated (or, one day, real) device allocation failure:
+            # degrade THIS batch to the host oracle instead of failing
+            # the stream — availability beats the device-decode win
+            try:
+                failpoints.fail_point(failpoints.ENGINE_DEVICE_OOM)
+            except EtlError as e:
+                if not set(e.kinds()) & {ErrorKind.DEVICE_UNAVAILABLE,
+                                         ErrorKind.MEMORY_PRESSURE_ABORT}:
+                    raise
+                registry.counter_inc(ETL_DECODE_DEVICE_OOM_FALLBACKS_TOTAL)
+                mode, specs = "oracle", ()
         if mode == "oracle":
             # no device work: nothing to overlap, no window slot — the
             # consumer's result() runs the per-row oracle as before
@@ -239,6 +258,7 @@ class DecodePipeline:
         try:
             packed = decoder._pack_stage(staged, specs, host, arena=arena)
             t1 = time.perf_counter()
+            failpoints.fail_point(failpoints.PIPELINE_DISPATCH)
             packed_dev = decoder._dispatch_stage(staged, specs, packed, host)
             t2 = time.perf_counter()
         except BaseException:
@@ -295,6 +315,7 @@ class DecodePipeline:
     def _fetch(self, handle: PipelinedDecode):
         """Stage 3: wait out pack/dispatch if still running, fetch and
         complete the batch, then return the arena and window slot."""
+        from ..chaos import failpoints
         from ..telemetry.metrics import (ETL_DECODE_FETCH_SECONDS,
                                          ETL_DECODE_PIPELINE_IN_FLIGHT,
                                          registry)
@@ -306,6 +327,7 @@ class DecodePipeline:
             pending, _ = value
             t0 = time.perf_counter()
             try:
+                failpoints.fail_point(failpoints.PIPELINE_FETCH)
                 return pending.result()
             finally:
                 with self._lock:
@@ -315,6 +337,7 @@ class DecodePipeline:
         pending, arena, iv = value
         t0 = time.perf_counter()
         try:
+            failpoints.fail_point(failpoints.PIPELINE_FETCH)
             batch = pending.result()
         finally:
             now = time.perf_counter()

@@ -271,6 +271,9 @@ class StagingArenaPool:
         self.max_per_bucket = max_per_bucket
         self._lock = threading.Lock()
         self._free: dict[tuple, list[np.ndarray]] = {}
+        # buffers handed out and not yet returned: the chaos subsystem's
+        # arena-leak invariant reads this before/after a scenario run
+        self.outstanding = 0
 
     def lease(self) -> ArenaLease:
         return ArenaLease(self)
@@ -280,6 +283,7 @@ class StagingArenaPool:
         with self._lock:
             bucket = self._free.get(key)
             arr = bucket.pop() if bucket else None
+            self.outstanding += 1
         from ..telemetry.metrics import (ETL_STAGING_ARENA_REQUESTS_TOTAL,
                                          registry)
 
@@ -289,6 +293,7 @@ class StagingArenaPool:
 
     def _give_back(self, arrays: list[np.ndarray]) -> None:
         with self._lock:
+            self.outstanding -= len(arrays)
             for a in arrays:
                 key = (a.shape, a.dtype.str)
                 bucket = self._free.setdefault(key, [])
@@ -300,7 +305,8 @@ class StagingArenaPool:
             return {"buckets": len(self._free),
                     "free_arrays": sum(len(v) for v in self._free.values()),
                     "free_bytes": sum(a.nbytes for v in self._free.values()
-                                      for a in v)}
+                                      for a in v),
+                    "outstanding": self.outstanding}
 
 
 #: process-wide pool shared by every decode pipeline (arenas are keyed by

@@ -13,7 +13,8 @@ import asyncio
 import logging
 
 from ..config.pipeline import InvalidatedSlotBehavior, PipelineConfig
-from ..models.errors import (ErrorKind, EtlError, RetryKind, retry_directive)
+from ..models.errors import ErrorKind, EtlError, RetryKind
+from ..retry import RetryPolicy
 from ..models.lsn import Lsn
 from ..postgres.slots import apply_slot_name
 from ..postgres.source import ReplicationSource
@@ -49,7 +50,9 @@ class ApplyWorker:
         return self._task
 
     async def _guarded_run(self) -> None:
-        """Timed-retry wrapper (reference worker.rs:237-281)."""
+        """Timed-retry wrapper (reference worker.rs:237-281), backoff via
+        the unified worker-scoped RetryPolicy (etl_tpu/retry.py)."""
+        policy = RetryPolicy.from_config(self.config.apply_retry)
         attempt = 0
         while not self.shutdown.is_triggered:
             try:
@@ -60,13 +63,12 @@ class ApplyWorker:
             except asyncio.CancelledError:
                 raise
             except EtlError as e:
-                directive = retry_directive(e)
-                if directive.kind is not RetryKind.TIMED \
-                        or attempt + 1 >= self.config.apply_retry.max_attempts:
+                if policy.classify(e) is not RetryKind.TIMED \
+                        or attempt + 1 >= policy.max_attempts:
                     logger.error("apply worker failed permanently: %s", e)
                     raise
                 attempt += 1
-                delay = self.config.apply_retry.delay_ms(attempt - 1) / 1000
+                delay = policy.delay(attempt - 1)
                 logger.warning("apply worker error (attempt %d, retry in "
                                "%.1fs): %s", attempt, delay, e)
                 try:
@@ -75,14 +77,12 @@ class ApplyWorker:
                     return
             except Exception as e:  # containment → timed retry
                 attempt += 1
-                if attempt >= self.config.apply_retry.max_attempts:
+                if attempt >= policy.max_attempts:
                     raise EtlError(ErrorKind.WORKER_PANICKED, repr(e))
                 try:
                     await or_shutdown(
                         self.shutdown,
-                        asyncio.sleep(
-                            self.config.apply_retry.delay_ms(attempt - 1)
-                            / 1000))
+                        asyncio.sleep(policy.delay(attempt - 1)))
                 except ShutdownRequested:
                     return
 

@@ -193,6 +193,10 @@ class EventAssembler:
         if self._run is None or not self._run.payloads:
             self._run = None
             return
+        from ..chaos import failpoints
+
+        # chaos site: fires once per sealed run (a decode batch is born)
+        failpoints.fail_point(failpoints.ASSEMBLER_SEAL)
         r = self._run
         self._run = None
         decoder = self._decoders.get(r.table_id)

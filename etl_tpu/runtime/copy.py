@@ -88,6 +88,7 @@ async def _copy_partition(source: ReplicationSource,
                           max_batch_bytes: int, monitor=None,
                           lease=None, pipeline_id: int = 0,
                           decode_window: int = 3) -> None:
+    failpoints.fail_point(failpoints.COPY_PARTITION_START)
     rng = None if part.end_page is None and part.start_page == 0 \
         else (part.start_page, part.end_page if part.end_page is not None
               else 1 << 30)
@@ -187,6 +188,9 @@ async def _copy_partition(source: ReplicationSource,
     # durability barrier for this partition (mod.rs:360-378)
     for ack in acks:
         await ack.wait_durable()
+    # chaos site: the window between a partition's durability barrier and
+    # its progress accounting — a crash here must recopy consistently
+    failpoints.fail_point(failpoints.COPY_PARTITION_END)
     if partition_bytes:
         record_egress(pipeline_id=pipeline_id,
                       destination=type(destination).__name__,

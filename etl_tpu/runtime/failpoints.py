@@ -1,59 +1,18 @@
-"""Failpoints: named crash/error injection sites for restart testing.
+"""Re-export shim: the failpoint registry moved to `etl_tpu.chaos`.
 
-Reference parity: crates/etl/src/failpoints.rs:14-54 — seven named sites
-with parameterized retry-kind errors, used inside the apply loop and the
-table-sync flow; driven by the failpoint test suite (SURVEY §4.3). Always
-compiled in (unlike the reference's `failpoints` feature, the registry is
-a no-op dict lookup when nothing is armed).
+Reference parity: crates/etl/src/failpoints.rs:14-54 — the seven named
+sites live on under chaos/failpoints.py alongside the chaos subsystem's
+expanded injection surface. Runtime call sites and existing tests keep
+importing from here unchanged.
 """
 
 from __future__ import annotations
 
-from typing import Callable
-
-from ..models.errors import ErrorKind, EtlError
-
-# the reference's named sites (failpoints.rs:14-21)
-BEFORE_SLOT_CREATION = "table_sync.before_slot_creation"
-DURING_COPY = "table_sync.during_copy"
-AFTER_FINISHED_COPY = "table_sync.after_finished_copy"
-BEFORE_STREAMING = "table_sync.before_streaming"
-ON_STATUS_UPDATE = "apply.on_status_update"
-ON_PROGRESS_STORE = "apply.on_progress_store"
-ON_SCHEMA_CLEANUP = "apply.on_schema_cleanup"
-
-_armed: dict[str, Callable[[], None]] = {}
-
-
-def arm(name: str, action: Callable[[], None]) -> None:
-    """Arm a failpoint with an action (usually raising)."""
-    _armed[name] = action
-
-
-def arm_error(name: str, kind: ErrorKind = ErrorKind.SOURCE_IO,
-              times: int = 1, detail: str = "") -> None:
-    """Arm to raise an EtlError of `kind` the next `times` hits."""
-    remaining = [times]
-
-    def action() -> None:
-        if remaining[0] > 0:
-            remaining[0] -= 1
-            raise EtlError(kind, detail or f"failpoint {name}")
-        disarm(name)
-
-    arm(name, action)
-
-
-def disarm(name: str) -> None:
-    _armed.pop(name, None)
-
-
-def disarm_all() -> None:
-    _armed.clear()
-
-
-def fail_point(name: str) -> None:
-    """Hit a failpoint (no-op unless armed)."""
-    action = _armed.get(name)
-    if action is not None:
-        action()
+from ..chaos.failpoints import (  # noqa: F401
+    AFTER_FINISHED_COPY, ALL_SITES, ASSEMBLER_SEAL, BEFORE_SLOT_CREATION,
+    BEFORE_STREAMING, CHAOS_SITES, COPY_PARTITION_END, COPY_PARTITION_START,
+    DESTINATION_FLUSH, DESTINATION_WRITE, DURING_COPY, ENGINE_DEVICE_OOM,
+    ON_PROGRESS_STORE, ON_SCHEMA_CLEANUP, ON_STATUS_UPDATE, PIPELINE_DISPATCH,
+    PIPELINE_FETCH, PIPELINE_PACK, REFERENCE_SITES, STORE_PROGRESS_COMMIT,
+    STORE_SCHEMA_COMMIT, STORE_STATE_COMMIT, arm, arm_error, armed_sites,
+    disarm, disarm_all, fail_point, scope)

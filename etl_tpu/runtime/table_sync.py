@@ -29,6 +29,7 @@ from ..models.lsn import Lsn
 from ..models.schema import ReplicatedTableSchema, TableId
 from ..postgres.slots import table_sync_slot_name
 from ..postgres.source import ReplicationSource
+from ..retry import RetryPolicy
 from ..store.base import PipelineStore
 from ..destinations.base import Destination
 from ..telemetry.metrics import (ETL_WORKER_ERRORS_TOTAL,
@@ -66,6 +67,10 @@ class TableSyncWorkerPool:
         self.monitor = monitor  # MemoryMonitor | None
         self.budget = budget  # BatchBudgetController | None
         self._permits = asyncio.Semaphore(config.max_table_sync_workers)
+        # unified worker-scoped backoff (etl_tpu/retry.py), built once:
+        # same schedule as the apply worker, jitter decorrelates herds
+        # of failed tables retrying in lockstep
+        self.retry_policy = RetryPolicy.from_config(config.table_retry)
         # pulsed on every cached state transition: the apply loop selects
         # on it so SyncWait/SyncDone handoffs process immediately instead
         # of waiting out the next keepalive (Postgres parity: tablesync
@@ -254,7 +259,7 @@ class TableSyncWorker:
 
     async def _timed_retry(self, attempt: int) -> None:
         try:
-            delay = self.config.table_retry.delay_ms(attempt - 1) / 1000
+            delay = self.pool.retry_policy.delay(attempt - 1)
             try:
                 await or_shutdown(self.pool.shutdown, asyncio.sleep(delay))
             except ShutdownRequested:

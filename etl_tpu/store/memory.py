@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 from collections import defaultdict
 
+from ..chaos import failpoints
 from ..models.errors import ErrorKind, EtlError
 from ..models.lsn import Lsn
 from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
@@ -39,6 +40,7 @@ class MemoryStore(PipelineStore):
         if not state.is_persistent:
             raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
                            f"{state.type.value} is memory-only, not storable")
+        failpoints.fail_point(failpoints.STORE_STATE_COMMIT)
         self._states[table_id] = state
 
     async def delete_table_state(self, table_id: TableId) -> None:
@@ -49,6 +51,7 @@ class MemoryStore(PipelineStore):
 
     async def update_durable_progress(self, key: ProgressKey,
                                       lsn: Lsn) -> bool:
+        failpoints.fail_point(failpoints.STORE_PROGRESS_COMMIT)
         cur = self._progress.get(key)
         if cur is not None and lsn < cur:
             return False
@@ -73,6 +76,7 @@ class MemoryStore(PipelineStore):
 
     async def store_table_schema(self, schema: ReplicatedTableSchema,
                                  snapshot_id: SnapshotId) -> None:
+        failpoints.fail_point(failpoints.STORE_SCHEMA_COMMIT)
         versions = self._schemas[schema.id]
         versions[:] = [(s, v) for s, v in versions if s != snapshot_id]
         versions.append((snapshot_id, schema))

@@ -71,6 +71,16 @@ ETL_LAKE_INLINED_DATA_BYTES = "etl_lake_inlined_data_bytes"
 # ETL_SNOWFLAKE_CHANNEL_RECOVERIES_TOTAL, snowflake/metrics.rs)
 ETL_SNOWPIPE_CHANNEL_RECOVERIES_TOTAL = \
     "etl_snowpipe_channel_recoveries_total"
+# chaos subsystem (etl_tpu/chaos): fault firings per site, per-scenario
+# pass/fail, and how long crash→restart recovery took until the workload
+# fully re-delivered
+ETL_CHAOS_INJECTED_FAULTS_TOTAL = "etl_chaos_injected_faults_total"
+ETL_CHAOS_SCENARIOS_TOTAL = "etl_chaos_scenarios_total"
+ETL_CHAOS_RECOVERY_DURATION_SECONDS = "etl_chaos_recovery_duration_seconds"
+# decode pipeline degraded a batch to the host oracle after a (simulated
+# or real) device allocation failure — the OOM-resilience path
+ETL_DECODE_DEVICE_OOM_FALLBACKS_TOTAL = \
+    "etl_decode_device_oom_fallbacks_total"
 
 # label keys
 LABEL_PIPELINE_ID = "pipeline_id"

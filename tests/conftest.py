@@ -22,6 +22,17 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _disarm_failpoints():
+    """Failpoint hygiene (chaos satellite): no test can leak an armed
+    site into the next test — global and scoped registries are cleared
+    after every test, pass or fail."""
+    yield
+    from etl_tpu.chaos import failpoints
+
+    failpoints.disarm_all()
+
+
 def pytest_pyfunc_call(pyfuncitem):
     """Run `async def` tests on a fresh event loop (no pytest-asyncio in the
     image)."""

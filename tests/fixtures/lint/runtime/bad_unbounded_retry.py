@@ -1,0 +1,91 @@
+# expect: unbounded-retry=8
+"""Positive fixture: `while True` retry loops that swallow exceptions and
+spin again with no backoff — the connect storm shape."""
+
+import asyncio
+
+
+async def connect_storm(source):
+    while True:
+        try:
+            return await source.connect()
+        except ConnectionError:
+            pass  # spins at CPU speed against a down server
+
+
+def sync_variant(op):
+    while True:
+        try:
+            return op()
+        except OSError:
+            continue
+
+
+def db_hammer(cursor, sql):
+    # a bare `.execute` is a DB call, NOT RetryPolicy.execute backoff
+    while True:
+        try:
+            return cursor.execute(sql)
+        except OSError:
+            continue
+
+
+def outer_backoff_does_not_absolve_inner_spin(op):
+    import time
+
+    # the OUTER loop's sleep paces only the outer region; the inner
+    # while-True hammers op() at CPU speed and is reported on its own
+    while True:
+        time.sleep(60)
+        while True:
+            try:
+                return op()
+            except OSError:
+                continue
+
+
+def break_only_exits_inner_for(op, items):
+    # the break leaves the for loop, not the retry loop — still a spin
+    while True:
+        try:
+            return op()
+        except OSError:
+            for _ in items:
+                break
+
+
+def handler_def_never_raises_here(op):
+    # the raise lives in a def the handler merely DEFINES — it does not
+    # exit the retry loop
+    while True:
+        try:
+            return op()
+        except OSError:
+            def cb():
+                raise
+
+
+def break_in_handler_of_inner_loop_try(op, conns):
+    # the try sits inside the for: the handler's break exits the FOR,
+    # and the retry loop spins on
+    while True:
+        for conn in conns:
+            try:
+                return op(conn)
+            except OSError:
+                break
+
+
+def nested_sleep_does_not_pace(op):
+    # the sleep lives in a nested def the loop never calls — it must not
+    # suppress the finding
+    while True:
+        def later():
+            import time
+
+            time.sleep(1)
+
+        try:
+            return op()
+        except OSError:
+            continue

@@ -1,0 +1,91 @@
+# expect: unbounded-retry=0
+"""Negative fixture: retry loops that back off, exit, or re-raise are
+not unbounded."""
+
+import asyncio
+
+
+async def with_backoff(source, policy):
+    attempt = 0
+    while True:
+        try:
+            return await source.connect()
+        except ConnectionError:
+            await asyncio.sleep(policy.delay(attempt))
+            attempt += 1
+
+
+async def reraises(source):
+    while True:
+        try:
+            return await source.connect()
+        except ConnectionError:
+            raise
+
+
+async def exits(source):
+    while True:
+        try:
+            return await source.connect()
+        except ConnectionError:
+            break
+
+
+async def bounded_loop(source):
+    # not `while True`: the loop condition bounds it
+    attempts = 0
+    while attempts < 5:
+        try:
+            return await source.connect()
+        except ConnectionError:
+            attempts += 1
+
+
+async def narrow_catch(queue):
+    # narrow, non-error control-flow exceptions are not retry swallows
+    while True:
+        try:
+            return queue.get_nowait()
+        except LookupError:
+            await waiters_changed(queue)
+
+
+async def waiters_changed(queue):
+    return queue
+
+
+async def policy_execute_is_backoff(policy, op):
+    # RetryPolicy.execute owns the backoff schedule itself
+    while True:
+        try:
+            return await policy.execute(op)
+        except ConnectionError:
+            continue
+
+
+def nested_callback_swallow_is_not_the_loop(q, handler):
+    # the swallowing handler lives in a nested def (a different
+    # activation): the loop itself blocks on q.get() and never spins
+    while True:
+        item = q.get()
+
+        def cb():
+            try:
+                handler(item)
+            except OSError:
+                pass
+
+        cb()
+
+
+async def raise_after_nested_def(source, wrap):
+    # the raise EXITS the loop even though a nested def precedes it in
+    # the same compound statement (walk-pruning regression)
+    while True:
+        try:
+            return await source.connect()
+        except ConnectionError:
+            if wrap:
+                def _note():
+                    return "failed"
+                raise

@@ -1,0 +1,358 @@
+"""etl-chaos: the scenario corpus in tier-1, the failpoint restart
+matrix, deterministic replay, registry scoping, RetryPolicy units, and a
+negative test proving the invariant checker can actually fail.
+
+Acceptance (ISSUE 3): the >=12-scenario corpus runs green with all
+recovery invariants (zero-loss, bounded-dup, monotonic LSN, no leaked
+tasks/arenas), including crash->restart mid-apply and mid-copy;
+`python -m etl_tpu.chaos --seed N` replays the same injection trace.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from etl_tpu.chaos import failpoints
+from etl_tpu.chaos.corpus import SCENARIOS, get_scenario
+from etl_tpu.chaos.invariants import InvariantReport, LeakProbe, \
+    check_invariants
+from etl_tpu.chaos.runner import RecordingStore, TracingDestination, \
+    run_scenario
+from etl_tpu.chaos.scenario import FaultKind, FaultSpec, Scenario
+from etl_tpu.models.errors import ErrorKind, EtlError
+
+SEED = 7
+
+
+class TestCorpus:
+    def test_corpus_covers_issue_layers(self):
+        """>=12 scenarios; every required layer appears; at least two
+        hard-crash scenarios (mid-apply and mid-copy)."""
+        assert len(SCENARIOS) >= 12
+        sites = {f.site for s in SCENARIOS for f in s.faults}
+        assert failpoints.PIPELINE_PACK in sites  # decode stages
+        assert failpoints.PIPELINE_DISPATCH in sites
+        assert failpoints.PIPELINE_FETCH in sites
+        assert failpoints.ENGINE_DEVICE_OOM in sites  # device OOM
+        assert failpoints.DURING_COPY in sites  # copy layer
+        assert failpoints.COPY_PARTITION_START in sites
+        assert failpoints.ON_PROGRESS_STORE in sites  # store progress
+        assert failpoints.STORE_STATE_COMMIT in sites
+        assert "write_events" in sites  # destination faults
+        assert any(f.kind is FaultKind.SEVER
+                   for s in SCENARIOS for f in s.faults)  # wire
+        crash_sites = {f.site for s in SCENARIOS for f in s.faults
+                       if f.kind is FaultKind.CRASH}
+        assert failpoints.ON_PROGRESS_STORE in crash_sites  # mid-apply
+        assert failpoints.DURING_COPY in crash_sites  # mid-copy
+        # compound: a scenario expecting more than one restart
+        assert any(s.expect_restarts >= 2 for s in SCENARIOS)
+
+    @pytest.mark.parametrize("scenario", SCENARIOS,
+                             ids=lambda s: s.name)
+    async def test_scenario_invariants_green(self, scenario):
+        run = await run_scenario(scenario, SEED)
+        assert run.ok, run.describe()
+        # crash scenarios actually crashed and recovered
+        crashes = sum(1 for r in run.restarts if r.kind == "crash")
+        expected_crashes = sum(
+            f.times for f in scenario.faults
+            if f.kind is FaultKind.CRASH)
+        assert crashes == expected_crashes, run.describe()
+
+    async def test_chaos_metrics_populated(self):
+        from etl_tpu.telemetry.metrics import (
+            ETL_CHAOS_INJECTED_FAULTS_TOTAL,
+            ETL_CHAOS_RECOVERY_DURATION_SECONDS,
+            ETL_CHAOS_SCENARIOS_TOTAL, registry)
+
+        before = registry.get_counter(ETL_CHAOS_SCENARIOS_TOTAL,
+                                      {"result": "pass"})
+        run = await run_scenario(get_scenario("crash_mid_apply"), SEED)
+        assert run.ok
+        assert registry.get_counter(ETL_CHAOS_SCENARIOS_TOTAL,
+                                    {"result": "pass"}) == before + 1
+        assert registry.get_counter(
+            ETL_CHAOS_INJECTED_FAULTS_TOTAL,
+            {"site": failpoints.ON_PROGRESS_STORE}) >= 1
+        count, total = registry.get_histogram(
+            ETL_CHAOS_RECOVERY_DURATION_SECONDS)
+        assert count >= 1 and total >= 0
+
+
+class TestDeterminism:
+    async def test_same_seed_same_trace(self):
+        scenario = get_scenario("crash_mid_apply")
+        a = await run_scenario(scenario, 42)
+        b = await run_scenario(scenario, 42)
+        assert a.ok and b.ok
+        assert a.trace == b.trace
+        assert [r.resume_lsn for r in a.restarts] == \
+            [r.resume_lsn for r in b.restarts]
+
+    def test_cli_replays_deterministically(self):
+        """`python -m etl_tpu.chaos --seed N` twice -> identical
+        injection trace (the acceptance criterion, via the real CLI)."""
+        repo = Path(__file__).resolve().parent.parent
+        outs = []
+        for _ in range(2):
+            proc = subprocess.run(
+                [sys.executable, "-m", "etl_tpu.chaos", "--seed", "3",
+                 "--scenario", "dest_fail_after_apply"],
+                capture_output=True, text=True, timeout=240, cwd=repo)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            d = json.loads(proc.stdout.strip().splitlines()[-1])
+            assert d["ok"] is True
+            outs.append((d["trace"],
+                         [{k: v for k, v in r.items() if k != "recovery_s"}
+                          for r in d["restarts"]]))
+        assert outs[0] == outs[1]
+
+
+class TestRestartMatrix:
+    """Satellite: each of the seven reference failpoint sites x
+    error-then-restart, asserting the invariant checker stays green.
+    The crash-between-write-and-progress-store case (the at-least-once
+    window) is the ON_PROGRESS_STORE crash scenario in the corpus; here
+    every site additionally gets an error followed by a clean
+    stop/start."""
+
+    # ON_STATUS_UPDATE / ON_SCHEMA_CLEANUP hit on idle/interval paths the
+    # short workload may not reach deterministically; they are armed but
+    # firing is not required for the invariants to hold
+    MUST_FIRE = {
+        failpoints.BEFORE_SLOT_CREATION, failpoints.DURING_COPY,
+        failpoints.AFTER_FINISHED_COPY, failpoints.BEFORE_STREAMING,
+        failpoints.ON_PROGRESS_STORE,
+    }
+
+    @pytest.mark.parametrize("site", failpoints.REFERENCE_SITES)
+    async def test_error_then_restart(self, site):
+        scenario = Scenario(
+            name=f"matrix_{site.replace('.', '_')}",
+            description=f"restart matrix: error at {site}, then a clean "
+                        f"restart",
+            faults=(FaultSpec(site, error_kind=ErrorKind.SOURCE_IO),),
+            txs=4, clean_restart=True,
+            # a catchup window makes before-streaming reachable; harmless
+            # for the other sites (skipped where the site itself is armed)
+            tx_during_copy=(site != failpoints.DURING_COPY))
+        run = await run_scenario(scenario, SEED)
+        assert run.ok, run.describe()
+        if site in self.MUST_FIRE:
+            assert site in run.trace, run.describe()
+        assert any(r.kind == "clean" for r in run.restarts)
+
+    async def test_crash_between_write_and_progress_store(self):
+        """The at-least-once window made explicit: the destination write
+        is durable, the progress store write never happens (crash), and
+        the restarted pipeline re-delivers exactly that window."""
+        run = await run_scenario(get_scenario("crash_mid_apply"), SEED)
+        assert run.ok, run.describe()
+        assert run.trace[failpoints.ON_PROGRESS_STORE][0]["action"] == \
+            "crash"
+        # the re-streamed window produced at least one accounted duplicate
+        # or a clean re-delivery; either way the budget held
+        assert run.report.stats["max_duplication"] <= \
+            run.report.stats["duplication_budget"]
+
+
+class TestRegistry:
+    def test_runtime_failpoints_is_a_shim(self):
+        from etl_tpu.runtime import failpoints as rt_fp
+
+        assert rt_fp.fail_point is failpoints.fail_point
+        assert rt_fp.BEFORE_STREAMING == failpoints.BEFORE_STREAMING
+
+    def test_scoped_arming_does_not_cross_fire(self):
+        """Per-pipeline scoping: a site armed in scope A never fires in
+        scope B or unscoped context."""
+        site = failpoints.ON_PROGRESS_STORE
+        with failpoints.scope("pipeline-a"):
+            failpoints.arm_error(site, ErrorKind.SOURCE_IO,
+                                 scope_name="pipeline-a")
+            with pytest.raises(EtlError):
+                failpoints.fail_point(site)
+        # scope exited: same site is silent again (scoped arm dropped)
+        failpoints.fail_point(site)
+        with failpoints.scope("pipeline-b"):
+            failpoints.fail_point(site)  # B never armed it
+
+    async def test_scope_inherited_by_child_tasks(self):
+        site = failpoints.ON_STATUS_UPDATE
+        hits = []
+
+        async def child():
+            try:
+                failpoints.fail_point(site)
+            except EtlError:
+                hits.append(True)
+
+        with failpoints.scope("pipeline-a"):
+            failpoints.arm_error(site, times=5, scope_name="pipeline-a")
+            await asyncio.ensure_future(child())
+        assert hits == [True]
+
+    def test_autouse_fixture_left_nothing_armed(self):
+        # relies on the conftest autouse fixture having cleaned up after
+        # every earlier test in this module
+        assert failpoints.armed_sites() == []
+
+    def test_disarmed_fail_point_is_noop(self):
+        failpoints.fail_point("never.armed")
+
+    def test_arm_error_exhausts_then_disarms(self):
+        failpoints.arm_error("x.y", ErrorKind.TIMEOUT, times=2)
+        for _ in range(2):
+            with pytest.raises(EtlError):
+                failpoints.fail_point("x.y")
+        failpoints.fail_point("x.y")  # 3rd hit disarms
+        assert "x.y" not in failpoints.armed_sites()
+
+
+class TestInvariantCheckerCanFail:
+    """The checker must be falsifiable — feed it fabricated loss/dup and
+    assert it reports violations (a checker that can't fail gates
+    nothing)."""
+
+    async def test_detects_loss_and_regression(self):
+        from etl_tpu.models import (ColumnSchema, Oid, TableName,
+                                    TableSchema)
+        from etl_tpu.models.schema import ReplicatedTableSchema
+
+        dest = TracingDestination()
+        store = RecordingStore()
+        store.progress_log["slot"] = [2, 1]  # fabricated regression
+        report = check_invariants(
+            expected={16384: {1: (1, "x")}},  # row never delivered
+            dest=dest, store=store, restarts=[], fault_firings=0,
+            leak_probe=LeakProbe.capture(), report=InvariantReport())
+        assert not report.ok
+        kinds = {v.split(":")[0] for v in report.violations}
+        assert "zero-loss" in kinds
+        assert "monotonic-lsn" in kinds
+        assert "store-consistency" in kinds
+
+    async def test_detects_unbudgeted_duplicates(self):
+        from etl_tpu.models import (ColumnSchema, InsertEvent, Lsn, Oid,
+                                    TableName, TableSchema)
+        from etl_tpu.models.schema import ReplicatedTableSchema
+        from etl_tpu.models.table_row import TableRow
+        from etl_tpu.models.table_state import TableState
+
+        schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+            16384, TableName("public", "t"),
+            (ColumnSchema("id", Oid.INT8, nullable=False,
+                          primary_key_ordinal=1),)))
+        dest = TracingDestination()
+        ev = InsertEvent(Lsn(1), Lsn(2), 0, schema, TableRow([1]))
+        dest.events.extend([ev, ev])  # same sequence key twice, no budget
+        store = RecordingStore()
+        store._states[16384] = TableState.ready()
+        await store.store_table_schema(schema, 0)
+        from etl_tpu.store.base import DestinationTableMetadata
+
+        await store.update_destination_metadata(
+            DestinationTableMetadata(16384, "t"))
+        report = check_invariants(
+            expected={16384: {1: (1,)}}, dest=dest, store=store,
+            restarts=[], fault_firings=0,
+            leak_probe=LeakProbe.capture(), report=InvariantReport())
+        assert not report.ok
+        assert any(v.startswith("bounded-dup") for v in report.violations)
+
+
+class TestRetryPolicy:
+    def test_backoff_schedule_and_jitter_bounds(self):
+        import random
+
+        from etl_tpu.retry import RetryPolicy
+
+        p = RetryPolicy(initial_delay_s=0.1, max_delay_s=1.0,
+                        multiplier=2.0, jitter=0.2)
+        assert p.base_delay(0) == pytest.approx(0.1)
+        assert p.base_delay(1) == pytest.approx(0.2)
+        assert p.base_delay(10) == 1.0  # capped
+        rng = random.Random(0)
+        for attempt in range(5):
+            d = p.delay(attempt, rng)
+            base = p.base_delay(attempt)
+            assert base <= d <= base * 1.2
+
+    def test_destination_vs_worker_classification(self):
+        from etl_tpu.models.errors import RetryKind
+        from etl_tpu.retry import (RetryPolicy, WORKER_TRANSIENT_KINDS)
+
+        writer = RetryPolicy()
+        worker = RetryPolicy(transient_kinds=WORKER_TRANSIENT_KINDS)
+        throttled = EtlError(ErrorKind.DESTINATION_THROTTLED)
+        failed = EtlError(ErrorKind.DESTINATION_FAILED)
+        schema = EtlError(ErrorKind.DESTINATION_SCHEMA_FAILED)
+        # writer: in-place retry only for transient transport/capacity
+        assert writer.classify(throttled) is RetryKind.TIMED
+        assert writer.classify(failed) is RetryKind.MANUAL
+        assert writer.classify(schema) is RetryKind.MANUAL
+        # worker: re-streaming may succeed after DESTINATION_FAILED
+        assert worker.classify(failed) is RetryKind.TIMED
+        assert worker.classify(schema) is RetryKind.MANUAL
+        assert worker.classify(
+            EtlError(ErrorKind.SHUTDOWN_REQUESTED)) is RetryKind.NO_RETRY
+
+    async def test_execute_retries_transient_then_succeeds(self):
+        from etl_tpu.retry import RetryPolicy
+
+        p = RetryPolicy(max_attempts=3, initial_delay_s=0.001)
+        calls = []
+
+        async def op():
+            calls.append(1)
+            if len(calls) < 3:
+                raise EtlError(ErrorKind.DESTINATION_THROTTLED)
+            return "ok"
+
+        assert await p.execute(op) == "ok"
+        assert len(calls) == 3
+
+    async def test_execute_permanent_raises_immediately(self):
+        from etl_tpu.retry import RetryPolicy
+
+        p = RetryPolicy(max_attempts=5, initial_delay_s=0.001)
+        calls = []
+
+        async def op():
+            calls.append(1)
+            raise EtlError(ErrorKind.DESTINATION_SCHEMA_FAILED)
+
+        with pytest.raises(EtlError):
+            await p.execute(op)
+        assert len(calls) == 1
+
+    def test_destination_retry_policy_is_the_unified_policy(self):
+        from etl_tpu.destinations.util import DestinationRetryPolicy
+        from etl_tpu.retry import RetryPolicy
+
+        assert issubclass(DestinationRetryPolicy, RetryPolicy)
+
+
+class TestDeviceOomFallback:
+    async def test_fallback_counter_and_delivery(self):
+        from etl_tpu.telemetry.metrics import (
+            ETL_DECODE_DEVICE_OOM_FALLBACKS_TOTAL, registry)
+
+        before = registry.get_counter(
+            ETL_DECODE_DEVICE_OOM_FALLBACKS_TOTAL)
+        run = await run_scenario(get_scenario("device_oom_fallback"), SEED)
+        assert run.ok, run.describe()
+        # the big-transaction workload routes past the oracle, so both
+        # simulated OOMs fired and degraded to host-oracle decode with
+        # zero delivery impact (the scenario's invariants stayed green)
+        assert len(run.trace.get(failpoints.ENGINE_DEVICE_OOM, [])) == 2, \
+            run.describe()
+        assert registry.get_counter(
+            ETL_DECODE_DEVICE_OOM_FALLBACKS_TOTAL) >= before + 2

@@ -404,3 +404,9 @@ class TestBenchSmoke:
         assert out["ok"] is True
         assert out["pipelined_equals_serial"] is True
         assert out["stage_histograms_observed"] is True
+        # streaming A/B regression gate (chaos satellite): a short
+        # end-to-end run must clear the checked-in floor so a round-5
+        # style CDC throughput collapse can never ship silently
+        assert out["streaming_above_floor"] is True, out
+        assert out["streaming_events_per_sec"] >= \
+            out["streaming_floor_events_per_sec"]
