@@ -27,6 +27,15 @@ worked examples):
                                 backoff (no sleep / RetryPolicy delay):
                                 a failing dependency turns them into a
                                 busy-loop hammering it at CPU speed
+  8. unbounded-await          — bare `await q.get()` / `await ev.wait()`
+                                in runtime/ and destinations/ without a
+                                timeout or shutdown race: a producer that
+                                dies (or an event nobody sets) wedges the
+                                worker forever with no error — exactly
+                                the silent-hang class the supervision
+                                watchdog exists for; bound the await
+                                (asyncio.wait_for / or_shutdown /
+                                beat_while_waiting) or justify inline
 """
 
 from __future__ import annotations
@@ -465,6 +474,54 @@ class UnboundedRetry(Rule):
             f"speed; add a RetryPolicy delay / sleep, or re-raise")
 
 
+# -- rule 8 -------------------------------------------------------------------
+
+#: directories whose workers must never park on an unbounded await: a
+#: wedged queue pop / event wait there stalls replication silently
+UNBOUNDED_AWAIT_SCOPES = ("runtime", "destinations")
+
+#: awaited zero-arg methods that park until someone else acts
+_PARKING_TERMINALS = frozenset({"get", "wait"})
+
+
+class UnboundedAwait(Rule):
+    """Bare `await X.get()` / `await X.wait()` with no timeout and no
+    shutdown race. The sanctioned shapes never produce the flagged AST:
+    `await asyncio.wait_for(q.get(), t)` and `await or_shutdown(sd,
+    ev.wait())` await the WRAPPER call, and `asyncio.wait(...)` takes
+    arguments. Receivers whose dotted path mentions shutdown are exempt —
+    the shutdown signal IS the escape hatch the rule demands."""
+
+    name = "unbounded-await"
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path.split("/", 1)[0] in UNBOUNDED_AWAIT_SCOPES
+
+    def on_call(self, ctx: LintContext, node: ast.Call) -> None:
+        ancestors = ctx.ancestors()
+        if not ancestors or not isinstance(ancestors[-1], ast.Await):
+            return
+        if node.args or node.keywords:
+            return  # q.get(timeout), asyncio.wait(tasks, ...) are bounded
+        term = terminal_name(node.func)
+        if term not in _PARKING_TERMINALS:
+            return
+        if not isinstance(node.func, ast.Attribute):
+            return  # bare get()/wait() name: not a parking receiver
+        receiver = dotted_name(node.func.value) or ""
+        if receiver in ("self", "cls"):
+            return  # a method on the worker itself, not an event/queue
+        if "shutdown" in receiver.lower():
+            return
+        subject = f"{receiver}.{term}" if receiver else term
+        ctx.report(
+            self.name, node, subject,
+            f"bare `await {subject}()` parks this worker until someone "
+            f"else acts — a dead producer wedges it forever with no "
+            f"error; bound it (asyncio.wait_for) or race it against "
+            f"shutdown (or_shutdown), or justify with an inline ignore")
+
+
 # -- entry points -------------------------------------------------------------
 
 def default_rules() -> list[Rule]:
@@ -476,6 +533,7 @@ def default_rules() -> list[Rule]:
         CancellationSwallow(),
         HotLoopHostTransfer(),
         UnboundedRetry(),
+        UnboundedAwait(),
     ]
 
 

@@ -194,6 +194,59 @@ SCENARIOS: tuple[Scenario, ...] = (
         faults=(FaultSpec(fp.ON_PROGRESS_STORE, kind=FaultKind.CRASH,
                           after_hits=1),),
         txs=5, expect_restarts=1, engine="cpu"),
+    # --- stall layer (ISSUE 4): silent hangs the watchdog must detect ------
+    Scenario(
+        name="stall_apply_frame_read",
+        description="the apply loop wedges mid-frame (stops beating "
+                    "entirely); the watchdog's hang detection cancels "
+                    "and restarts the apply worker from durable progress",
+        faults=(FaultSpec(fp.APPLY_FRAME_READ, kind=FaultKind.STALL,
+                          stall_s=20.0, after_hits=6),),
+        txs=6, fast_watchdog=True, expect_health_recovery=True),
+    Scenario(
+        name="stall_dest_write",
+        description="a destination write never returns; the per-op "
+                    "timeout bound (or the stall watchdog, whichever "
+                    "fires first) classifies it and the worker "
+                    "re-streams",
+        faults=(FaultSpec(fp.DESTINATION_WRITE, kind=FaultKind.STALL,
+                          stall_s=20.0, after_hits=2),),
+        txs=6, fast_watchdog=True, expect_health_recovery=True),
+    Scenario(
+        name="stall_dest_flush",
+        description="a destination flush (wait_durable) never resolves; "
+                    "the bounded ack times out and recovery re-streams "
+                    "the window",
+        faults=(FaultSpec(fp.DESTINATION_FLUSH, kind=FaultKind.STALL,
+                          stall_s=20.0, after_hits=2),),
+        txs=6, fast_watchdog=True, expect_health_recovery=True),
+    Scenario(
+        name="stall_store_progress_commit",
+        description="the durable-progress store write hangs INSIDE the "
+                    "apply loop (heartbeat goes stale); hang detection "
+                    "restarts the worker",
+        faults=(FaultSpec(fp.STORE_PROGRESS_COMMIT, kind=FaultKind.STALL,
+                          stall_s=20.0, after_hits=1),),
+        txs=6, fast_watchdog=True, expect_health_recovery=True),
+    Scenario(
+        name="stall_copy_partition",
+        description="a copy partition wedges before reading data; the "
+                    "table-sync worker is cancelled, parks Errored, and "
+                    "the timed retry recopies",
+        faults=(FaultSpec(fp.COPY_PARTITION_START, kind=FaultKind.STALL,
+                          stall_s=20.0),),
+        rows_per_table=6, txs=4, fast_watchdog=True,
+        expect_health_recovery=True),
+    Scenario(
+        name="stall_decode_fetch",
+        description="a decode-pipeline fetch blocks its thread mid-copy "
+                    "(the one stall that parks a REAL thread): the "
+                    "owning sync worker is restarted by hang detection "
+                    "while the thread unblocks on its own deadline",
+        faults=(FaultSpec(fp.PIPELINE_FETCH, kind=FaultKind.STALL,
+                          stall_s=3.0),),
+        rows_per_table=8, txs=4, fast_watchdog=True,
+        expect_health_recovery=True),
 )
 
 

@@ -65,6 +65,9 @@ COPY_PARTITION_START = "copy.partition_start"
 COPY_PARTITION_END = "copy.partition_end"
 # assembler run seal (runtime/assembler.py)
 ASSEMBLER_SEAL = "assembler.seal"
+# apply-loop frame handling (runtime/apply_loop.py): a stall here wedges
+# the loop itself — the watchdog's hang detection is the only way out
+APPLY_FRAME_READ = "apply.frame_read"
 # destination ack layer (destinations/base.py): WRITE fires when a
 # destination constructs its ack (the write applied — an error here is
 # the lost-response ambiguity), FLUSH fires on wait_durable
@@ -78,7 +81,18 @@ STORE_PROGRESS_COMMIT = "store.progress_commit"
 CHAOS_SITES = (
     PIPELINE_PACK, PIPELINE_DISPATCH, PIPELINE_FETCH, ENGINE_DEVICE_OOM,
     COPY_PARTITION_START, COPY_PARTITION_END, ASSEMBLER_SEAL,
+    APPLY_FRAME_READ,
     DESTINATION_WRITE, DESTINATION_FLUSH,
+    STORE_STATE_COMMIT, STORE_SCHEMA_COMMIT, STORE_PROGRESS_COMMIT,
+)
+
+#: sites that can stall asynchronously (an armed stall is consumed by the
+#: site's `await stall_point(...)`); PIPELINE_FETCH stalls synchronously
+#: on whichever THREAD drives the fetch (copy partitions fetch via
+#: asyncio.to_thread, so the block lands off the event loop)
+ASYNC_STALL_SITES = (
+    APPLY_FRAME_READ, DESTINATION_WRITE, DESTINATION_FLUSH,
+    COPY_PARTITION_START, COPY_PARTITION_END,
     STORE_STATE_COMMIT, STORE_SCHEMA_COMMIT, STORE_PROGRESS_COMMIT,
 )
 
@@ -143,6 +157,7 @@ def disarm(name: str, scope_name: str | None = None) -> None:
 
 
 def disarm_all() -> None:
+    release_stalls()
     with _lock:
         _armed.clear()
         _scoped.clear()
@@ -156,7 +171,15 @@ def armed_sites() -> list[str]:
 
 def fail_point(name: str) -> None:
     """Hit a failpoint (no-op unless armed). Hot-path cost when disarmed:
-    two falsy dict checks, no lock."""
+    three falsy dict checks, no lock. Armed STALLS fire here only when
+    the caller is OFF the event loop (worker threads, asyncio.to_thread
+    fetches) — a synchronous block on the loop would freeze the
+    supervisor that is supposed to detect it, so loop-side sites consume
+    stalls through `await stall_point(...)` instead."""
+    if _stalls and not _on_event_loop():
+        s = _consume_stall(name)
+        if s is not None:
+            s.release.wait(s.duration_s)
     if not _armed and not _scoped:
         return
     action = None
@@ -171,3 +194,115 @@ def fail_point(name: str) -> None:
             action = _armed.get(name)
     if action is not None:
         action()
+
+
+# --- stall mode --------------------------------------------------------------
+
+
+class _StallSpec:
+    """One armed stall: hang for `duration_s` or until released."""
+
+    __slots__ = ("name", "duration_s", "release", "times", "after_hits",
+                 "hits", "fired", "on_fire")
+
+    def __init__(self, name: str, duration_s: float, times: int,
+                 after_hits: int, on_fire: Callable[[], None] | None):
+        self.name = name
+        self.duration_s = duration_s
+        self.release = threading.Event()
+        self.times = times
+        self.after_hits = after_hits
+        self.hits = 0
+        self.fired = 0
+        self.on_fire = on_fire
+
+
+_stalls: dict[str, _StallSpec] = {}
+# every spec ever armed since the last release: a consumed spec leaves
+# `_stalls` but may still be blocking a thread on its release event
+_all_stall_specs: list[_StallSpec] = []
+
+
+def _on_event_loop() -> bool:
+    import asyncio
+
+    try:
+        asyncio.get_running_loop()
+        return True
+    except RuntimeError:
+        return False
+
+
+def arm_stall(name: str, duration_s: float = 5.0, times: int = 1,
+              after_hits: int = 0,
+              on_fire: Callable[[], None] | None = None) -> "_StallSpec":
+    """Arm a stall at `name`: the site hangs for `duration_s` (or until
+    `release_stalls()` / `disarm_all()`) instead of raising. Async sites
+    (`ASYNC_STALL_SITES`) stall cancellably via `stall_point`; thread
+    sites block in `fail_point`. Returns the spec so tests can release
+    it directly."""
+    spec = _StallSpec(name, duration_s, times, after_hits, on_fire)
+    with _lock:
+        _stalls[name] = spec
+        _all_stall_specs.append(spec)
+    return spec
+
+
+def _consume_stall(name: str) -> "_StallSpec | None":
+    """One stall firing attempt: counts the hit, honors after_hits/times,
+    self-disarms when exhausted."""
+    with _lock:
+        spec = _stalls.get(name)
+        if spec is None:
+            return None
+        spec.hits += 1
+        if spec.hits <= spec.after_hits:
+            return None
+        if spec.fired >= spec.times:
+            _stalls.pop(name, None)
+            return None
+        spec.fired += 1
+        if spec.fired >= spec.times:
+            _stalls.pop(name, None)
+    if spec.on_fire is not None:
+        spec.on_fire()
+    return spec
+
+
+def stalls_armed() -> bool:
+    """Per-frame hot paths guard their `await stall_point(...)` behind
+    this (one dict truthiness check) so the disarmed cost stays a sync
+    call, not a coroutine allocation per frame — the same contract as
+    fail_point's no-op lookup."""
+    return bool(_stalls)
+
+
+async def stall_point(name: str) -> None:
+    """Async stall site: hang (cancellably) while armed. Cost when
+    nothing is armed: one falsy dict check (hot paths pre-guard with
+    `stalls_armed()` to skip even the coroutine). Polling (20 ms)
+    rather than an executor wait so supervisor cancellation interrupts
+    the stall immediately without stranding an executor thread."""
+    if not _stalls:
+        return
+    s = _consume_stall(name)
+    if s is None:
+        return
+    import asyncio
+    import time
+
+    deadline = time.monotonic() + s.duration_s
+    while not s.release.is_set() and time.monotonic() < deadline:
+        await asyncio.sleep(0.02)
+
+
+def release_stalls() -> None:
+    """Unblock every stalled site, armed or mid-stall (consumed specs
+    keep blocking their thread until released) — scenario teardown must
+    never leave a thread parked on a chaos stall."""
+    with _lock:
+        specs = list(_all_stall_specs)
+        _stalls.clear()
+        _all_stall_specs.clear()
+    for s in specs:
+        s.release.set()

@@ -27,7 +27,8 @@ import random
 import time
 from dataclasses import dataclass, field
 
-from ..config import BatchConfig, BatchEngine, PipelineConfig, RetryConfig
+from ..config import (BatchConfig, BatchEngine, PipelineConfig, RetryConfig,
+                      SupervisionConfig)
 from ..destinations import (FaultAction, FaultInjectingDestination, FaultKind
                             as DestFaultKind, MemoryDestination)
 from ..models import ColumnSchema, Oid, TableName, TableSchema
@@ -110,6 +111,11 @@ class ChaosRun:
     # the bounded-dup budget — OOM fallbacks, HOLDs, and crashes (already
     # counted via restarts) must NOT loosen the exactly-once assertion
     redelivery_firings: int = 0
+    # supervision: health-state transitions observed across every
+    # pipeline incarnation, and watchdog cancel-and-restart escalations
+    # (each one re-streams a window, so each adds to the dup budget)
+    health_track: list[str] = field(default_factory=list)
+    supervision_restarts: int = 0
     duration_s: float = 0.0
 
     @property
@@ -126,6 +132,8 @@ class ChaosRun:
             "restarts": [r.describe() for r in self.restarts],
             "fault_firings": self.fault_firings,
             "redelivery_firings": self.redelivery_firings,
+            "health_track": list(self.health_track),
+            "supervision_restarts": self.supervision_restarts,
             "invariants": self.report.describe(),
             "duration_s": round(self.duration_s, 3),
         }
@@ -253,7 +261,10 @@ async def _hard_kill(pipeline) -> None:
     """Process-death semantics: cancel every pipeline task with no drain
     and no destination shutdown. In-process resources that a real crash
     would free with the process (decode-pipeline threads, the memory
-    monitor's sampler) are closed via the tasks' finally blocks."""
+    monitor's sampler, the supervision sweep) are closed via the tasks'
+    finally blocks."""
+    if pipeline.supervisor is not None:
+        await pipeline.supervisor.stop()
     tasks = []
     if pipeline._apply_task is not None:
         tasks.append(pipeline._apply_task)
@@ -328,7 +339,13 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
                 and spec.site != failpoints.ENGINE_DEVICE_OOM:
             # faults the worker recovers from by re-streaming; crashes
             # are accounted via restarts, OOM fallbacks and HOLDs never
-            # re-deliver
+            # re-deliver. STALL firings fund NOTHING here — a stall
+            # causes re-delivery only through its recovery mechanism,
+            # and both mechanisms are counted where they fire (a
+            # supervision restart via on_supervision_event, a
+            # destination-op timeout via the counter delta at the end) —
+            # funding the firing too would double the budget and loosen
+            # the exactly-once assertion.
             run.redelivery_firings += 1
         registry.counter_inc(ETL_CHAOS_INJECTED_FAULTS_TOTAL,
                              labels={"site": spec.site})
@@ -388,12 +405,20 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
                 dest.script(spec.site, FaultAction(kind))
             scripted_specs.setdefault(spec.site, []).append(spec)
 
+    def arm_stall_spec(spec: FaultSpec) -> None:
+        failpoints.arm_stall(
+            spec.site, duration_s=spec.stall_s, times=spec.times,
+            after_hits=spec.after_hits,
+            on_fire=lambda spec=spec: record_fire(spec, "stall"))
+
     # arm everything without a tx trigger now; tx-triggered specs arm in
     # the workload loop below
     deferred: list[FaultSpec] = []
     for spec in scenario.faults:
         if spec.kind in (FaultKind.ERROR, FaultKind.CRASH):
             arm_failpoint(spec)
+        elif spec.kind is FaultKind.STALL:
+            arm_stall_spec(spec)
         elif spec.at_tx is None:
             if spec.kind is FaultKind.SEVER:
                 deferred.append(spec)  # severing needs open streams
@@ -409,6 +434,30 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
         # clobber each other's arming — none do)
         failpoints.arm(failpoints.DURING_COPY, copy_started.set)
 
+    if scenario.fast_watchdog:
+        # stall scenarios: sweeps every 50 ms, sub-second stall deadline,
+        # ~2 s hang deadline — detection + recovery must land inside the
+        # scenario budget. wal_sender 1 s keeps an idle apply loop
+        # beating every 600 ms, safely under the hang deadline.
+        # stall deadline must clear the first-decode XLA compile
+        # (~0.5-1 s on the CPU backend) or a legitimately slow first
+        # fetch reads as a stall
+        sup_cfg = SupervisionConfig(
+            check_interval_s=0.05, stall_deadline_s=1.3,
+            hang_deadline_s=2.2, restart_backoff_s=0.3,
+            device_degrade_threshold=3, device_degrade_cooldown_s=1.0,
+            breaker_failure_threshold=5, breaker_cooldown_s=0.4)
+        dest_timeout_s = 1.5
+        wal_sender_ms = 1_000
+    else:
+        # fault scenarios: supervision stays LIVE (its false-positive
+        # rate under normal recovery churn is itself under test) but the
+        # deadlines sit far above any legitimate pause in these runs
+        sup_cfg = SupervisionConfig(
+            check_interval_s=0.25, stall_deadline_s=10.0,
+            hang_deadline_s=25.0, restart_backoff_s=1.0)
+        dest_timeout_s = 30.0
+        wal_sender_ms = 60_000
     config = PipelineConfig(
         pipeline_id=1, publication_name="pub",
         batch=BatchConfig(max_size_bytes=64 * 1024, max_fill_ms=25,
@@ -417,13 +466,32 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
                                 max_delay_ms=120),
         table_retry=RetryConfig(max_attempts=10, initial_delay_ms=15,
                                 max_delay_ms=120),
+        supervision=sup_cfg,
+        destination_op_timeout_s=dest_timeout_s,
+        wal_sender_timeout_ms=wal_sender_ms,
         lag_sample_interval_s=0)
+
+    def on_supervision_event(ev) -> None:
+        if ev.kind not in ("restart", "degrade", "breaker"):
+            return  # stall/hang detections precede a restart — count once
+        fires = run.trace.setdefault(f"supervision.{ev.kind}", [])
+        fires.append({"fire": len(fires) + 1, "component": ev.component})
+        if ev.kind == "restart":
+            # a cancel-and-restart re-streams the cancelled window: it
+            # funds the bounded-dup budget exactly like a worker retry
+            run.supervision_restarts += 1
+            run.redelivery_firings += 1
 
     def make_pipeline():
         from ..runtime import Pipeline
 
-        return Pipeline(config=config, store=store, destination=dest,
-                        source_factory=lambda: FakeSource(db))
+        p = Pipeline(config=config, store=store, destination=dest,
+                     source_factory=lambda: FakeSource(db))
+        if p.supervisor is not None:
+            p.supervisor.add_listener(on_supervision_event)
+            p.supervisor.health.add_listener(
+                lambda old, new, why: run.health_track.append(new.value))
+        return p
 
     async def release_due_holds(tx_index: int | None) -> None:
         for release, due in list(held_releases):
@@ -459,6 +527,16 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
             lambda: workload.delivered(inner), 30.0,
             "workload never fully delivered"))
 
+    def _dest_timeouts_total() -> float:
+        from ..telemetry.metrics import (ETL_DESTINATION_OP_TIMEOUTS_TOTAL,
+                                         registry)
+
+        return sum(registry.get_counter(ETL_DESTINATION_OP_TIMEOUTS_TOTAL,
+                                        {"op": op})
+                   for op in ("startup", "write_events", "write_table_rows",
+                              "drop_table", "truncate_table", "flush"))
+
+    timeouts_before = _dest_timeouts_total()
     pipeline = make_pipeline()
     try:
         await pipeline.start()
@@ -508,12 +586,42 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
                 "post-restart workload never delivered"))
             run.restarts[-1].recovery_s = time.monotonic() - t_phase
 
+        if scenario.expect_health_recovery and pipeline.supervisor is not None:
+            # the acceptance arc: /health's state machine must have gone
+            # healthy → degraded during the stall and settled back to
+            # healthy once the watchdog recovered the component
+            from ..supervision import HealthState
+
+            if "degraded" not in run.health_track:
+                run.report.fail(
+                    "health: state machine never left healthy during a "
+                    "stall scenario (watchdog detected nothing)")
+
+            def _settled() -> bool:
+                pipeline.supervisor.sweep_once()
+                return pipeline.supervisor.health.state \
+                    is HealthState.HEALTHY
+
+            try:
+                await _wait_until(_settled, 8.0, "health stuck degraded")
+            except TimeoutError:
+                run.report.fail(
+                    f"health: did not settle back to healthy after "
+                    f"recovery: {pipeline.supervisor.health.snapshot()}")
+
         await pipeline.shutdown_and_wait()
     finally:
         # a failed scenario (timeout cancellation, unexpected error) must
         # not leak a live pipeline into the next scenario/test: hard-kill
-        # whatever is still running and close the destination. After a
-        # clean shutdown both calls are idempotent no-ops.
+        # whatever is still running and close the destination; release
+        # any still-armed (or mid-stall) chaos stalls so no thread stays
+        # parked, and lift a supervision-forced host-oracle degrade so it
+        # cannot leak into the next scenario/test. After a clean shutdown
+        # every call is an idempotent no-op.
+        failpoints.release_stalls()
+        from ..ops import engine
+
+        engine.clear_forced_oracle()
         await _hard_kill(pipeline)
         await dest.shutdown()
     # unresolved = still pending now (shutdown missed them) PLUS any the
@@ -528,6 +636,19 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
     await _wait_until(
         lambda: _pipeline_thread_count() <= leak_probe.pipeline_threads,
         2.0, "pipeline threads lingering")
+    # a released thread-stall (decode fetch) finishes its fetch — and
+    # releases its staging arena — a beat after the release; give it the
+    # same grace as the worker threads before the leak probe counts
+    from ..ops.staging import ARENA_POOL
+
+    await _wait_until(
+        lambda: ARENA_POOL.outstanding <= leak_probe.arenas_outstanding,
+        3.0, "staging arenas lingering after stall release")
+
+    # each destination-op timeout classified one call as failed and sent
+    # the worker back through a re-stream: it funds the dup budget like
+    # any other recovery (counted by mechanism, not by injected firing)
+    run.redelivery_firings += int(_dest_timeouts_total() - timeouts_before)
 
     check_invariants(
         expected=workload.expected, dest=inner, store=store,

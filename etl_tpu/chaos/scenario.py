@@ -30,6 +30,10 @@ class FaultKind(enum.Enum):
     DEST_HOLD = "dest_hold"  # Destination): fail before / after apply,
     # or ack Accepted and turn durable only when the runner releases
     SEVER = "sever"  # postgres wire: drop every open walsender stream
+    STALL = "stall"  # hang at a failpoint site for `stall_s` (or until
+    # released) instead of raising: the silent-sickness mode the
+    # supervision watchdog / destination op timeout must detect and
+    # recover — the component never errors on its own
 
 
 @dataclass(frozen=True)
@@ -46,6 +50,11 @@ class FaultSpec:
     after_hits: int = 0  # trigger predicate: skip the first N hits
     at_tx: int | None = None  # SEVER / DEST_*: arm after this workload tx
     hold_release_after_tx: int | None = None  # DEST_HOLD: release point
+    # STALL: how long the site hangs. Async sites are cancelled the
+    # moment the watchdog restarts their worker, so a generous value only
+    # proves nothing else broke the hang; thread sites (decode fetch)
+    # block a real thread for the full duration — keep those short
+    stall_s: float = 8.0
 
     def describe(self) -> dict:
         return {
@@ -87,6 +96,14 @@ class Scenario:
     clean_restart: bool = False
     txs_after_restart: int = 2
     engine: str = "tpu"  # BatchConfig.batch_engine
+    # stall scenarios: tighten the watchdog (50 ms sweeps, sub-second
+    # stall deadline, ~2 s hang deadline, 1.5 s destination op timeout,
+    # 1 s wal_sender_timeout so an idle loop still beats often) so
+    # detection + recovery land inside the scenario budget
+    fast_watchdog: bool = False
+    # assert the health state machine visited DEGRADED during the run
+    # and settled back to HEALTHY before shutdown
+    expect_health_recovery: bool = False
 
     def describe(self) -> dict:
         return {

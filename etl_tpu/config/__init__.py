@@ -2,5 +2,5 @@
 
 from .pipeline import (BatchConfig, BatchEngine, InvalidatedSlotBehavior,
                        MemoryBackpressureConfig, PgConnectionConfig,
-                       PipelineConfig, RetryConfig, TableSyncCopyConfig,
-                       TlsConfig)
+                       PipelineConfig, RetryConfig, SupervisionConfig,
+                       TableSyncCopyConfig, TlsConfig)

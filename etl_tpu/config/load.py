@@ -21,8 +21,8 @@ import yaml
 from ..models.errors import ErrorKind, EtlError
 from .pipeline import (BatchConfig, BatchEngine, InvalidatedSlotBehavior,
                        MemoryBackpressureConfig, PgConnectionConfig,
-                       PipelineConfig, RetryConfig, TableSyncCopyConfig,
-                       TlsConfig)
+                       PipelineConfig, RetryConfig, SupervisionConfig,
+                       TableSyncCopyConfig, TlsConfig)
 
 ENV_PREFIX = "APP_"
 ENV_SEPARATOR = "__"
@@ -155,6 +155,7 @@ def pipeline_config_from_dict(doc: dict) -> PipelineConfig:
             table_sync_copy=lambda d: _build(TableSyncCopyConfig, d),
             apply_retry=lambda d: _build(RetryConfig, d),
             table_retry=lambda d: _build(RetryConfig, d),
+            supervision=lambda d: _build(SupervisionConfig, d),
             invalidated_slot_behavior=InvalidatedSlotBehavior,
         )
     except (TypeError, ValueError) as e:

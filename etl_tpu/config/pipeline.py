@@ -107,6 +107,43 @@ class TableSyncCopyConfig:
 
 
 @dataclass(frozen=True)
+class SupervisionConfig:
+    """Liveness supervision (etl_tpu/supervision): heartbeat deadlines,
+    escalation pacing, breaker thresholds. A component HANGS when its
+    heartbeat goes stale past `hang_deadline_s`; it STALLS when it keeps
+    beating with work in flight but its progress token freezes past
+    `stall_deadline_s`. Deadlines must comfortably exceed the apply
+    loop's keepalive pacing (60% of wal_sender_timeout) — an idle loop
+    beats only once per select timeout."""
+
+    enabled: bool = True
+    check_interval_s: float = 1.0
+    stall_deadline_s: float = 60.0
+    hang_deadline_s: float = 120.0
+    # minimum spacing between cancel-and-restart escalations of the same
+    # component (the restarted worker also rides RetryPolicy backoff)
+    restart_backoff_s: float = 5.0
+    # device-side decode stalls before the batch engine degrades to the
+    # host oracle, and for how long the degrade sticks
+    device_degrade_threshold: int = 3
+    device_degrade_cooldown_s: float = 60.0
+    # destination circuit breaker: consecutive failures to trip OPEN, and
+    # the cooldown before a HALF_OPEN trial call is admitted
+    breaker_failure_threshold: int = 5
+    breaker_cooldown_s: float = 15.0
+
+    def validate(self) -> None:
+        _require(self.check_interval_s > 0, "check_interval_s must be > 0")
+        _require(self.stall_deadline_s > 0, "stall_deadline_s must be > 0")
+        _require(self.hang_deadline_s > 0, "hang_deadline_s must be > 0")
+        _require(self.breaker_failure_threshold >= 1,
+                 "breaker_failure_threshold must be >= 1")
+        _require(self.breaker_cooldown_s > 0, "breaker_cooldown_s must be > 0")
+        _require(self.device_degrade_threshold >= 1,
+                 "device_degrade_threshold must be >= 1")
+
+
+@dataclass(frozen=True)
 class RetryConfig:
     max_attempts: int = 5
     initial_delay_ms: int = 1_000
@@ -130,6 +167,11 @@ class PipelineConfig:
         default_factory=TableSyncCopyConfig)
     apply_retry: RetryConfig = field(default_factory=RetryConfig)
     table_retry: RetryConfig = field(default_factory=RetryConfig)
+    supervision: SupervisionConfig = field(default_factory=SupervisionConfig)
+    # every Destination.startup/write/flush await is bounded by this (a
+    # destination that never returns surfaces as EtlError(TIMEOUT), not
+    # an eternal await); 0 disables the bound
+    destination_op_timeout_s: float = 60.0
     max_table_sync_workers: int = 4
     invalidated_slot_behavior: InvalidatedSlotBehavior = \
         InvalidatedSlotBehavior.ERROR
@@ -147,10 +189,13 @@ class PipelineConfig:
         _require(bool(self.publication_name), "publication_name required")
         _require(self.max_table_sync_workers >= 1,
                  "need >= 1 table sync worker")
+        _require(self.destination_op_timeout_s >= 0,
+                 "destination_op_timeout_s must be >= 0")
         self.pg_connection.validate()
         self.batch.validate()
         self.backpressure.validate()
         self.table_sync_copy.validate()
+        self.supervision.validate()
 
     @property
     def keepalive_deadline_ms(self) -> int:

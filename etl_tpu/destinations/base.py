@@ -65,6 +65,9 @@ class WriteAck:
 
     async def wait_durable(self) -> None:
         failpoints.fail_point(failpoints.DESTINATION_FLUSH)
+        # chaos stall mode: a flush that never acks (SupervisedDestination
+        # bounds this await; the watchdog sees frozen apply progress)
+        await failpoints.stall_point(failpoints.DESTINATION_FLUSH)
         await asyncio.shield(self._fut)
 
 

@@ -119,7 +119,7 @@ class FaultInjectingDestination(Destination):
         release = fault.release_event or asyncio.Event()
 
         async def _release() -> None:
-            await release.wait()
+            await release.wait()  # etl-lint: ignore[unbounded-await] — waiting for the test script's release IS the HOLD fault; the TaskSet cancels it at shutdown
             if not fut.done():
                 fut.set_result(None)
             if fut in self._held_acks:  # released: nothing to resolve at

@@ -66,6 +66,10 @@ class ErrorKind(enum.Enum):
     DESTINATION_SCHEMA_FAILED = enum.auto()
     DESTINATION_THROTTLED = enum.auto()
     DESTINATION_PAYLOAD_TOO_LARGE = enum.auto()
+    # circuit breaker open: load shed before the call reaches the sink
+    # (supervision/breaker.py) — retryable by the WORKER (whose backoff IS
+    # the backpressure), never in place by a writer
+    DESTINATION_UNAVAILABLE = enum.auto()
 
     # --- runtime class ---
     WORKER_PANICKED = enum.auto()
@@ -74,6 +78,11 @@ class ErrorKind(enum.Enum):
     TIMEOUT = enum.auto()
     MEMORY_PRESSURE_ABORT = enum.auto()
     BATCH_OVERFLOW = enum.auto()
+    # liveness watchdog: the supervisor cancelled a component whose
+    # heartbeat went stale (hang) or whose progress token froze while it
+    # claimed work in flight (stall) — retryable: the worker re-streams
+    # from durable progress like any transient failure
+    STALL_DETECTED = enum.auto()
 
     # --- device (TPU) class — no reference counterpart ---
     DEVICE_DECODE_FAILED = enum.auto()
@@ -149,7 +158,9 @@ _TIMED_KINDS = frozenset({
     ErrorKind.DESTINATION_FAILED,
     ErrorKind.DESTINATION_CONNECTION_FAILED,
     ErrorKind.DESTINATION_THROTTLED,
+    ErrorKind.DESTINATION_UNAVAILABLE,
     ErrorKind.TIMEOUT,
+    ErrorKind.STALL_DETECTED,
     ErrorKind.WORKER_PANICKED,
     ErrorKind.DEVICE_UNAVAILABLE,
     ErrorKind.UNKNOWN,

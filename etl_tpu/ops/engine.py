@@ -306,6 +306,35 @@ def _host_cpu_device():
     return _HOST_CPU_DEVICE[0]
 
 
+# -- supervision degrade hook -------------------------------------------------
+
+# monotonic deadline until which EVERY decoder routes to the host oracle
+# (supervision escalation after repeated device-side stalls); process-
+# global on purpose: a sick device link is a process-level condition,
+# like the per-process autotune cost model
+_ORACLE_FORCED_UNTIL = 0.0
+
+
+def force_host_oracle(duration_s: float) -> None:
+    """Route all decode batches to the host oracle for `duration_s`."""
+    import time
+
+    global _ORACLE_FORCED_UNTIL
+    _ORACLE_FORCED_UNTIL = time.monotonic() + duration_s
+
+
+def clear_forced_oracle() -> None:
+    global _ORACLE_FORCED_UNTIL
+    _ORACLE_FORCED_UNTIL = 0.0
+
+
+def host_oracle_forced() -> bool:
+    import time
+
+    return _ORACLE_FORCED_UNTIL > 0.0 \
+        and time.monotonic() < _ORACLE_FORCED_UNTIL
+
+
 class DeviceDecoder:
     """Schema-bound batch decoder. Jitted programs live in the
     module-level _SHARED_FN_CACHE keyed by (row_capacity, specs, nibble,
@@ -835,6 +864,16 @@ class DeviceDecoder:
             ETL_DECODE_ROUTED_HOST_ROWS_TOTAL,
             ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL, registry)
 
+        if host_oracle_forced():
+            # supervision escalation (supervisor._detected): repeated
+            # device-side stalls park EVERY batch on the host oracle
+            # until the degrade cooldown lapses — availability beats the
+            # device-decode win, same stance as the per-batch OOM
+            # fallback in ops/pipeline._process
+            if self._telemetry:
+                registry.counter_inc(ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL,
+                                     staged.n_rows)
+            return "oracle", ()
         if self._dense and staged.n_rows >= self.device_min_rows:
             if self._telemetry:
                 registry.counter_inc(ETL_DECODE_ROUTED_DEVICE_ROWS_TOTAL,

@@ -114,9 +114,14 @@ class DecodePipeline:
     def __init__(self, *, window: int = DEFAULT_WINDOW,
                  monitor: "MemoryMonitor | None" = None,
                  arena_pool: StagingArenaPool | None = None,
-                 name: str = "decode"):
+                 name: str = "decode", heartbeat=None):
         from ..runtime.backpressure import InFlightWindow
 
+        # supervision.Heartbeat | None: the worker thread publishes
+        # liveness + a completed-batch progress token; a frozen token
+        # with batches in flight is a device-side stall the supervisor
+        # escalates (host-oracle degrade)
+        self._hb = heartbeat
         self.window = InFlightWindow(max(1, window), monitor)
         self.pool = arena_pool if arena_pool is not None else ARENA_POOL
         # gauge label: several pipelines coexist (one per copy partition
@@ -180,6 +185,9 @@ class DecodePipeline:
         if not self._closed:
             self._closed = True
             self._jobs.put(None)
+        if self._hb is not None:
+            self._hb.close()
+            self._hb = None
 
     # -- worker side --------------------------------------------------------
 
@@ -207,6 +215,13 @@ class DecodePipeline:
                 with self._lock:
                     if handle in self._undispatched:
                         self._undispatched.remove(handle)
+                hb = self._hb
+                if hb is not None:
+                    # busy while batches are in flight: a frozen
+                    # completed-count past the stall deadline then reads
+                    # as a device-side stall
+                    hb.beat(progress=("completed", self._completed),
+                            busy=len(self.window) > 0)
 
     @hot_loop
     def _process(self, decoder: "DeviceDecoder", staged: StagedBatch,
@@ -346,6 +361,10 @@ class DecodePipeline:
                 if iv in self._inflight:
                     self._inflight.remove(iv)
                 self._completed += 1
+            hb = self._hb
+            if hb is not None:
+                hb.beat(progress=("completed", self._completed),
+                        busy=len(self.window) > 1)
             arena.release()
             if handle._windowed:
                 handle._windowed = False

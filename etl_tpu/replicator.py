@@ -35,21 +35,62 @@ from .telemetry.tracing import init_tracing
 logger = logging.getLogger("etl_tpu.replicator")
 
 
-async def serve_metrics(port: int) -> web.AppRunner | None:
-    if not port:
-        return None
+def build_observability_app(pipeline=None) -> web.Application:
+    """The replicator pod's /metrics + /health + /health/detail routes.
+
+    /health is a LIVE surface of the supervision health state machine
+    (docs/supervision.md), not a static ok: 503 with "starting" before
+    the pipeline has started, 200 with the state while healthy/degraded,
+    503 with the fatal detail once the apply worker failed permanently.
+    /health/detail adds per-component heartbeat ages, breaker states,
+    and recent supervision events."""
 
     async def metrics(_request: web.Request) -> web.Response:
         return web.Response(text=registry.render_prometheus(),
                             content_type="text/plain")
 
+    def _supervisor():
+        return pipeline.supervisor if pipeline is not None else None
+
     async def health(_request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        sup = _supervisor()
+        if sup is None:
+            # supervision disabled: liveness of the process is all we
+            # can honestly attest
+            return web.json_response({"status": "ok",
+                                      "supervision": "disabled"})
+        if not sup.started:
+            return web.json_response({"status": "starting"}, status=503)
+        from .supervision import HealthState
+
+        state = sup.health.state
+        body = {"status": state.value}
+        if state is HealthState.FAULTED:
+            body["fatal"] = sup.health.fatal
+            return web.json_response(body, status=503)
+        if state is HealthState.DEGRADED:
+            body["reasons"] = sup.health.reasons
+        return web.json_response(body)
+
+    async def health_detail(_request: web.Request) -> web.Response:
+        if pipeline is None:
+            return web.json_response({"state": "unsupervised"})
+        snap = pipeline.health_snapshot()
+        status = 503 if snap.get("health", {}).get("state") == "faulted" \
+            or not snap.get("started", True) else 200
+        return web.json_response(snap, status=status)
 
     app = web.Application()
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/health", health)
-    runner = web.AppRunner(app)
+    app.router.add_get("/health/detail", health_detail)
+    return app
+
+
+async def serve_metrics(port: int, pipeline=None) -> web.AppRunner | None:
+    if not port:
+        return None
+    runner = web.AppRunner(build_observability_app(pipeline))
     await runner.setup()
     await web.TCPSite(runner, "0.0.0.0", port).start()
     logger.info("metrics on :%d/metrics", port)
@@ -153,7 +194,7 @@ async def run_replicator(config_dir: str,
         config=config, store=store, destination=destination,
         source_factory=lambda: PgReplicationClient(config.pg_connection))
 
-    metrics_runner = await serve_metrics(metrics_port)
+    metrics_runner = await serve_metrics(metrics_port, pipeline)
     loop = asyncio.get_event_loop()
     # hold the shutdown-task handle: the loop keeps only a weak ref, so
     # a bare ensure_future in the handler could be GC'd mid-shutdown

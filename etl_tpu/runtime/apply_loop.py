@@ -132,7 +132,8 @@ class ApplyLoop:
                  stream: ReplicationStream, store: PipelineStore,
                  destination: Destination, table_cache: SharedTableCache,
                  config: PipelineConfig, shutdown: ShutdownSignal,
-                 start_lsn: Lsn, monitor=None, budget=None):
+                 start_lsn: Lsn, monitor=None, budget=None,
+                 heartbeat=None, supervisor=None):
         self.ctx = ctx
         self.stream = stream
         self.store = store
@@ -141,13 +142,20 @@ class ApplyLoop:
         self.config = config
         self.shutdown = shutdown
         self.monitor = monitor  # MemoryMonitor | None
+        # supervision wiring: this loop beats its owner's heartbeat on
+        # every select wakeup, progress token = (durable, received) LSNs;
+        # busy while a write is in flight or events are assembled — the
+        # supervisor reads a frozen token under busy as a stall
+        self._hb = heartbeat  # supervision.Heartbeat | None
+        self._supervisor = supervisor  # for the decode pipeline's beat
         self._lease = budget.register_stream() if budget is not None else None
         # the assembler owns this loop's decode pipeline; the monitor
         # shrinks its in-flight window to 1 under memory pressure
         self.assembler = EventAssembler(config.batch.batch_engine,
                                         monitor=monitor,
                                         decode_window=config.batch
-                                        .decode_window)
+                                        .decode_window,
+                                        supervisor=supervisor)
         self.state = _LoopState(durable_lsn=start_lsn, received_lsn=start_lsn,
                                 last_status_flush_lsn=start_lsn)
         self._in_flight: _InFlight | None = None
@@ -252,6 +260,16 @@ class ApplyLoop:
                 done, _ = await asyncio.wait(
                     waits, timeout=timeout,
                     return_when=asyncio.FIRST_COMPLETED)
+                if self._hb is not None:
+                    # one beat per wakeup (≤ keepalive cadence when idle):
+                    # cheap enough for the hot path, fresh enough for the
+                    # hang deadline. Progress = the durability frontier.
+                    self._hb.beat(
+                        progress=(int(self.state.durable_lsn),
+                                  int(self.state.received_lsn)),
+                        busy=self._in_flight is not None
+                        or self.state.batch_commit_end is not None
+                        or len(self.assembler) > 0)
 
                 # priority 1: shutdown
                 if shutdown_task in done:
@@ -357,13 +375,18 @@ class ApplyLoop:
                         + self.config.schema_cleanup_interval_s
                     await self._run_schema_cleanup()
         finally:
-            for t in (msg_task, shutdown_task, resume_task, coord_task):
-                if t is not None and not t.done():
-                    t.cancel()
-                    try:
-                        await t
-                    except (asyncio.CancelledError, Exception):
-                        pass
+            # an error/cancellation exit can leave the in-flight write
+            # running (a supervision restart cancels THIS loop while the
+            # write sits in a stalled destination call for seconds more)
+            # — cancel it with the select tasks; the window re-streams
+            # from durable progress on resume. drain_cancelled keeps a
+            # hard-kill cancel landing mid-drain lethal.
+            from .shutdown import drain_cancelled
+
+            inflight_task = self._in_flight.task \
+                if self._in_flight is not None else None
+            await drain_cancelled(msg_task, shutdown_task, resume_task,
+                                  coord_task, inflight_task)
             if self._lease is not None:
                 self._lease.release()
             self.assembler.close()  # stop the decode pipeline's worker
@@ -428,6 +451,12 @@ class ApplyLoop:
         return None
 
     async def _handle_frame(self, frame) -> ExitIntent | None:
+        # chaos stall mode: a wedged frame read — the loop stops beating
+        # entirely and only the watchdog's hang detection recovers it.
+        # Pre-guarded: this runs per frame, and the disarmed cost must
+        # stay one dict check, not a coroutine allocation.
+        if failpoints.stalls_armed():
+            await failpoints.stall_point(failpoints.APPLY_FRAME_READ)
         if isinstance(frame, pgoutput.PrimaryKeepalive):
             self.state.server_end_lsn = max(self.state.server_end_lsn,
                                             frame.end_lsn)
@@ -627,7 +656,9 @@ class ApplyLoop:
             await ack.wait_durable()
             # billing/egress accounting rides durable acks (egress.rs:1-20)
             record_egress(pipeline_id=self.config.pipeline_id,
-                          destination=type(self.destination).__name__,
+                          destination=getattr(
+                              self.destination, "telemetry_name",
+                              type(self.destination).__name__),
                           bytes_processed=batch_bytes, kind="streaming")
 
         registry.counter_inc(ETL_APPLY_LOOP_BATCHES_TOTAL)
@@ -731,7 +762,14 @@ class ApplyLoop:
             if st.type is TableStateType.SYNC_WAIT:
                 target = max(st.lsn or Lsn.ZERO, current_lsn)
                 await coord.set_catchup(tid, target)
-                result = await coord.wait_for_sync_done_or_errored(tid)
+                # the handoff wait parks this loop for as long as the
+                # sync worker needs to reach its catchup target — keep
+                # beating so the park never reads as a hang (the SYNC
+                # WORKER's own watchdog covers a stall inside it)
+                from ..supervision import beat_while_waiting
+
+                result = await beat_while_waiting(
+                    self._hb, coord.wait_for_sync_done_or_errored(tid))
                 if result.type is TableStateType.SYNC_DONE:
                     # became SyncDone; Ready happens after a durable flush
                     # covering its LSN (or immediately if already covered)

@@ -62,9 +62,14 @@ RUN_SEAL_ROWS = 16384
 MEGA_SEAL_ROWS = 262_144
 
 
+#: distinguishes concurrent assemblers' decode heartbeats (apply loop +
+#: table-sync catchup loops each own one)
+_ASSEMBLER_SEQ = [0]
+
+
 class EventAssembler:
     def __init__(self, engine: BatchEngine, monitor=None,
-                 decode_window: int = 3):
+                 decode_window: int = 3, supervisor=None):
         self.engine = engine
         self._events: list[Event] = []
         self._run: _Run | None = None
@@ -75,6 +80,9 @@ class EventAssembler:
         # window to 1 under memory pressure (runtime/backpressure).
         self._monitor = monitor
         self._decode_window = decode_window
+        self._supervisor = supervisor  # supervision.Supervisor | None
+        _ASSEMBLER_SEQ[0] += 1
+        self._seq = _ASSEMBLER_SEQ[0]
         self._pipeline: DecodePipeline | None = None
         # dynamic: the apply loop grows it ×4 (one row bucket per step)
         # under sustained backlog and resets it when the stream idles
@@ -220,9 +228,18 @@ class EventAssembler:
         # when the destination write consumes it, in submit order — the
         # bounded in-flight window caps staged memory across flushes
         if self._pipeline is None:
+            hb = None
+            if self._supervisor is not None:
+                # decode components are observe-only: recovery of a stuck
+                # pipeline rides the owning worker's restart, and repeated
+                # detections escalate to the host-oracle degrade
+                from ..supervision import DECODE_PREFIX
+
+                hb = self._supervisor.register(
+                    f"{DECODE_PREFIX}cdc-{self._seq}")
             self._pipeline = DecodePipeline(window=self._decode_window,
                                             monitor=self._monitor,
-                                            name="cdc")
+                                            name="cdc", heartbeat=hb)
         pending = self._pipeline.submit(decoder, wal.staged)
         old_pending = self._pipeline.submit(decoder, wal.old_staged) \
             if wal.old_staged is not None else None

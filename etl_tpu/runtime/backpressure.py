@@ -119,10 +119,16 @@ class MemoryMonitor:
 
     def __init__(self, config: MemoryBackpressureConfig,
                  limit_bytes: int | None = None,
-                 rss_reader: Callable[[], int] = read_rss_bytes):
+                 rss_reader: Callable[[], int] = read_rss_bytes,
+                 heartbeat=None):
         self.config = config
         self.limit_bytes = limit_bytes or read_memory_limit_bytes()
         self._rss_reader = rss_reader
+        # supervision.Heartbeat | None: each sample beats with a sample
+        # counter — a stale monitor heartbeat means the sampler died and
+        # backpressure is blind
+        self._hb = heartbeat
+        self._samples = 0
         self.pressure = False
         self.last_rss = 0
         self._mem_pressure = False
@@ -161,6 +167,9 @@ class MemoryMonitor:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        if self._hb is not None:
+            self._hb.close()
+            self._hb = None
 
     def sample_once(self) -> bool:
         """One sample + hysteresis update; returns current pressure. The
@@ -172,6 +181,9 @@ class MemoryMonitor:
             ETL_MEMORY_BACKPRESSURE_ACTIVE, registry)
 
         self.last_rss = self._rss_reader()
+        self._samples += 1
+        if self._hb is not None:
+            self._hb.beat(progress=("samples", self._samples))
         ratio = self.last_rss / max(1, self.limit_bytes)
         if not self._mem_pressure and ratio >= self.config.activate_ratio:
             self._mem_pressure = True
@@ -190,7 +202,7 @@ class MemoryMonitor:
             await asyncio.sleep(interval)
 
     async def wait_until_resumed(self) -> None:
-        await self._resumed.wait()
+        await self._resumed.wait()  # etl-lint: ignore[unbounded-await] — resume is hysteresis-driven by design; callers are cancellation-scoped (apply loop select, copy partitions under or_shutdown)
 
 
 class BatchBudgetController:

@@ -87,8 +87,12 @@ async def _copy_partition(source: ReplicationSource,
                           progress: CopyProgress,
                           max_batch_bytes: int, monitor=None,
                           lease=None, pipeline_id: int = 0,
-                          decode_window: int = 3) -> None:
+                          decode_window: int = 3, heartbeat=None,
+                          supervisor=None) -> None:
     failpoints.fail_point(failpoints.COPY_PARTITION_START)
+    # chaos stall mode: a copy partition that wedges before reading any
+    # data — recovered by the watchdog restarting the table-sync worker
+    await failpoints.stall_point(failpoints.COPY_PARTITION_START)
     rng = None if part.end_page is None and part.start_page == 0 \
         else (part.start_page, part.end_page if part.end_page is not None
               else 1 << 30)
@@ -116,8 +120,15 @@ async def _copy_partition(source: ReplicationSource,
     in_flight: list = []
     # name carries the partition identity so concurrent partitions get
     # distinct gauge series instead of last-writer-winning one label
+    pipe_hb = None
+    if supervisor is not None and decoder is not None:
+        from ..supervision import DECODE_PREFIX
+
+        pipe_hb = supervisor.register(
+            f"{DECODE_PREFIX}copy:{schema.id}:p{part.start_page}")
     pipe = DecodePipeline(window=decode_window, monitor=monitor,
-                          name=f"copy-p{part.start_page}") \
+                          name=f"copy-p{part.start_page}",
+                          heartbeat=pipe_hb) \
         if decoder is not None else None
 
     async def drain_one() -> None:
@@ -127,6 +138,9 @@ async def _copy_partition(source: ReplicationSource,
         batch = await asyncio.to_thread(handle.result)
         acks.append(await destination.write_table_rows(schema, batch))
         progress.total_rows += batch.num_rows
+        if heartbeat is not None:
+            heartbeat.beat(progress=("copy_rows", progress.total_rows),
+                           busy=True)
         registry.counter_inc(ETL_TABLE_COPY_ROWS_TOTAL, batch.num_rows)
 
     # per-PARTITION byte counter: progress.bytes_written is shared across
@@ -142,6 +156,11 @@ async def _copy_partition(source: ReplicationSource,
         failpoints.fail_point(failpoints.DURING_COPY)
         progress.bytes_written += len(chunk)
         partition_bytes += len(chunk)
+        if heartbeat is not None:
+            # the owning table-sync worker's liveness: bytes copied IS
+            # the progress token; a frozen counter mid-copy is a stall
+            heartbeat.beat(progress=("copy_bytes", progress.bytes_written),
+                           busy=True)
         registry.counter_inc(ETL_TABLE_COPY_BYTES_TOTAL, len(chunk))
         if decoder is not None:
             staged = stage_copy_chunk(chunk, len(oids))
@@ -182,6 +201,11 @@ async def _copy_partition(source: ReplicationSource,
         await write_chunk(b"".join(pending))
         while in_flight:
             await drain_one()
+        if heartbeat is not None:
+            # the chunk beats carry busy=True; without this the LAST
+            # chunk's frozen byte count reads as a stall while the
+            # worker legitimately sits in the durability barrier / park
+            heartbeat.beat(busy=False)
     finally:
         if pipe is not None:
             pipe.close()
@@ -193,7 +217,8 @@ async def _copy_partition(source: ReplicationSource,
     failpoints.fail_point(failpoints.COPY_PARTITION_END)
     if partition_bytes:
         record_egress(pipeline_id=pipeline_id,
-                      destination=type(destination).__name__,
+                      destination=getattr(destination, "telemetry_name",
+                                          type(destination).__name__),
                       bytes_processed=partition_bytes,
                       kind="table_copy")
     progress.partitions_done += 1
@@ -204,7 +229,8 @@ async def parallel_table_copy(*, source_factory, primary_source,
                               snapshot_id: str, config: PipelineConfig,
                               destination: Destination,
                               shutdown: ShutdownSignal, monitor=None,
-                              budget=None) -> CopyProgress:
+                              budget=None, heartbeat=None,
+                              supervisor=None) -> CopyProgress:
     """Copy one table through N snapshot-sharing connections."""
     leaves = await primary_source.get_partition_leaves(schema.id)
     if leaves:
@@ -245,7 +271,8 @@ async def parallel_table_copy(*, source_factory, primary_source,
                     decoder, destination, progress,
                     config.batch.max_size_bytes, monitor=monitor,
                     lease=lease, pipeline_id=config.pipeline_id,
-                    decode_window=config.batch.decode_window))
+                    decode_window=config.batch.decode_window,
+                    heartbeat=heartbeat, supervisor=supervisor))
         finally:
             if lease is not None:
                 lease.release()

@@ -9,10 +9,12 @@ importing from here unchanged.
 from __future__ import annotations
 
 from ..chaos.failpoints import (  # noqa: F401
-    AFTER_FINISHED_COPY, ALL_SITES, ASSEMBLER_SEAL, BEFORE_SLOT_CREATION,
+    AFTER_FINISHED_COPY, ALL_SITES, APPLY_FRAME_READ, ASSEMBLER_SEAL,
+    ASYNC_STALL_SITES, BEFORE_SLOT_CREATION,
     BEFORE_STREAMING, CHAOS_SITES, COPY_PARTITION_END, COPY_PARTITION_START,
     DESTINATION_FLUSH, DESTINATION_WRITE, DURING_COPY, ENGINE_DEVICE_OOM,
     ON_PROGRESS_STORE, ON_SCHEMA_CLEANUP, ON_STATUS_UPDATE, PIPELINE_DISPATCH,
     PIPELINE_FETCH, PIPELINE_PACK, REFERENCE_SITES, STORE_PROGRESS_COMMIT,
-    STORE_SCHEMA_COMMIT, STORE_STATE_COMMIT, arm, arm_error, armed_sites,
-    disarm, disarm_all, fail_point, scope)
+    STORE_SCHEMA_COMMIT, STORE_STATE_COMMIT, arm, arm_error, arm_stall,
+    armed_sites, disarm, disarm_all, fail_point, release_stalls, scope,
+    stall_point, stalls_armed)

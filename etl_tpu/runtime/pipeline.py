@@ -45,6 +45,29 @@ class Pipeline:
         self._apply_task: asyncio.Task | None = None
         self.memory_monitor: MemoryMonitor | None = None
         self.batch_budget: BatchBudgetController | None = None
+        # supervision tree (docs/supervision.md): liveness watchdogs over
+        # every long-running component + the pipeline health state
+        # machine the replicator's /health serves. Built here (not in
+        # start) so /health can answer "starting" before start() runs.
+        self.supervisor = None
+        if config.supervision.enabled:
+            from ..supervision import Supervisor
+
+            self.supervisor = Supervisor(config.supervision)
+        # what the workers actually write through: the configured
+        # destination behind the supervision wrapper (per-op timeout
+        # bound + circuit breaker + heartbeat); `destination` stays the
+        # raw inner for tests and the maintenance agent
+        self.active_destination: Destination = destination
+        if self.supervisor is not None:
+            from ..supervision import SupervisedDestination
+
+            self.active_destination = SupervisedDestination(
+                destination,
+                timeout_s=config.destination_op_timeout_s,
+                breaker=self.supervisor.breaker(
+                    type(destination).__name__),
+                heartbeat=self.supervisor.register("destination"))
 
     async def start(self) -> None:
         source = self.source_factory()
@@ -60,7 +83,9 @@ class Pipeline:
             await self._initialize_table_states(source)
         finally:
             await source.close()
-        await self.destination.startup()
+        if self.supervisor is not None:
+            self.supervisor.start()
+        await self.active_destination.startup()
         if self.config.batch.batch_engine is BatchEngine.TPU:
             # warm the per-process device cost model OFF the event loop
             # now: the probe jit-compiles and moves 2x8 MiB over the link
@@ -75,23 +100,28 @@ class Pipeline:
         # batch_budget.rs): the monitor pauses WAL/COPY intake under RSS
         # pressure; the budget controller sizes batches by the active
         # stream count so concurrent copies don't multiply peak memory
-        self.memory_monitor = MemoryMonitor(self.config.backpressure)
+        monitor_hb = self.supervisor.register("memory_monitor") \
+            if self.supervisor is not None else None
+        self.memory_monitor = MemoryMonitor(self.config.backpressure,
+                                            heartbeat=monitor_hb)
         self.memory_monitor.start()
         self.batch_budget = BatchBudgetController(
             self.config.backpressure, self.config.batch.max_size_bytes)
         self.pool = TableSyncWorkerPool(
             config=self.config, store=self.store,
-            destination=self.destination,
+            destination=self.active_destination,
             source_factory=self.source_factory,
             table_cache=self.table_cache, shutdown=self.shutdown_signal,
-            monitor=self.memory_monitor, budget=self.batch_budget)
+            monitor=self.memory_monitor, budget=self.batch_budget,
+            supervisor=self.supervisor)
         await self.pool.refresh_states()
         self.apply_worker = ApplyWorker(
             config=self.config, store=self.store,
-            destination=self.destination,
+            destination=self.active_destination,
             source_factory=self.source_factory, pool=self.pool,
             table_cache=self.table_cache, shutdown=self.shutdown_signal,
-            monitor=self.memory_monitor, budget=self.batch_budget)
+            monitor=self.memory_monitor, budget=self.batch_budget,
+            supervisor=self.supervisor)
         self._apply_task = self.apply_worker.spawn()
 
     async def _initialize_table_states(self,
@@ -115,6 +145,14 @@ class Pipeline:
         assert self._apply_task is not None, "pipeline not started"
         try:
             await self._apply_task
+        except BaseException as e:
+            # the apply worker exhausted its retries (or died on a
+            # permanent error): the health surface must say FAULTED, not
+            # keep serving the last degraded/healthy state
+            if self.supervisor is not None \
+                    and not isinstance(e, asyncio.CancelledError):
+                self.supervisor.health.fault(f"apply worker failed: {e}")
+            raise
         finally:
             # a fatal apply error must release table-sync workers parked on
             # catchup futures only the apply worker could resolve — trigger
@@ -124,7 +162,17 @@ class Pipeline:
                 await self.pool.wait_all()
             if self.memory_monitor is not None:
                 await self.memory_monitor.stop()
-            await self.destination.shutdown()
+            if self.supervisor is not None:
+                await self.supervisor.stop()
+            await self.active_destination.shutdown()
+
+    def health_snapshot(self) -> dict:
+        """The live supervision surface the replicator's /health/detail
+        serves; minimal shape when supervision is disabled."""
+        if self.supervisor is None:
+            return {"state": "unsupervised", "started":
+                    self._apply_task is not None}
+        return self.supervisor.snapshot()
 
     async def shutdown(self) -> None:
         self.shutdown_signal.trigger()

@@ -41,6 +41,7 @@ class MemoryStore(PipelineStore):
             raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
                            f"{state.type.value} is memory-only, not storable")
         failpoints.fail_point(failpoints.STORE_STATE_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_STATE_COMMIT)
         self._states[table_id] = state
 
     async def delete_table_state(self, table_id: TableId) -> None:
@@ -52,6 +53,7 @@ class MemoryStore(PipelineStore):
     async def update_durable_progress(self, key: ProgressKey,
                                       lsn: Lsn) -> bool:
         failpoints.fail_point(failpoints.STORE_PROGRESS_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_PROGRESS_COMMIT)
         cur = self._progress.get(key)
         if cur is not None and lsn < cur:
             return False
@@ -77,6 +79,7 @@ class MemoryStore(PipelineStore):
     async def store_table_schema(self, schema: ReplicatedTableSchema,
                                  snapshot_id: SnapshotId) -> None:
         failpoints.fail_point(failpoints.STORE_SCHEMA_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_SCHEMA_COMMIT)
         versions = self._schemas[schema.id]
         versions[:] = [(s, v) for s, v in versions if s != snapshot_id]
         versions.append((snapshot_id, schema))

@@ -149,6 +149,7 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
             raise EtlError(ErrorKind.STORE_SERIALIZATION_FAILED,
                            f"{state.type.value} is memory-only, not storable")
         failpoints.fail_point(failpoints.STORE_STATE_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_STATE_COMMIT)
         pid = self.pipeline_id
         # prev-pointer history chain (reference base.up.sql semantics)
         cur = await self._run(
@@ -178,6 +179,7 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
     async def update_durable_progress(self, key: ProgressKey,
                                       lsn: Lsn) -> bool:
         failpoints.fail_point(failpoints.STORE_PROGRESS_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_PROGRESS_COMMIT)
         cur = self._progress.get(key)
         if cur is not None and lsn < cur:
             return False
@@ -225,6 +227,7 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
     async def store_table_schema(self, schema: ReplicatedTableSchema,
                                  snapshot_id: SnapshotId) -> None:
         failpoints.fail_point(failpoints.STORE_SCHEMA_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_SCHEMA_COMMIT)
         await self._run(
             "INSERT INTO etl_table_schemas "
             "(pipeline_id, table_id, snapshot_id, schema_json) "

@@ -81,6 +81,19 @@ ETL_CHAOS_RECOVERY_DURATION_SECONDS = "etl_chaos_recovery_duration_seconds"
 # or real) device allocation failure — the OOM-resilience path
 ETL_DECODE_DEVICE_OOM_FALLBACKS_TOTAL = \
     "etl_decode_device_oom_fallbacks_total"
+# supervision subsystem (etl_tpu/supervision): watchdog detections by
+# kind+component, cancel-and-restart escalations, the pipeline health
+# state (0 healthy / 1 degraded / 2 faulted), the oldest heartbeat age
+# observed in the last sweep, per-destination breaker state (0 closed /
+# 1 half-open / 2 open) + open transitions, and destination calls the
+# per-op timeout bound had to cut off
+ETL_SUPERVISION_EVENTS_TOTAL = "etl_supervision_events_total"
+ETL_SUPERVISION_RESTARTS_TOTAL = "etl_supervision_restarts_total"
+ETL_PIPELINE_HEALTH_STATE = "etl_pipeline_health_state"
+ETL_HEARTBEAT_MAX_AGE_SECONDS = "etl_heartbeat_max_age_seconds"
+ETL_DESTINATION_BREAKER_STATE = "etl_destination_breaker_state"
+ETL_DESTINATION_BREAKER_OPENS_TOTAL = "etl_destination_breaker_opens_total"
+ETL_DESTINATION_OP_TIMEOUTS_TOTAL = "etl_destination_op_timeouts_total"
 
 # label keys
 LABEL_PIPELINE_ID = "pipeline_id"

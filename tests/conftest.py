@@ -25,12 +25,15 @@ import pytest
 @pytest.fixture(autouse=True)
 def _disarm_failpoints():
     """Failpoint hygiene (chaos satellite): no test can leak an armed
-    site into the next test — global and scoped registries are cleared
-    after every test, pass or fail."""
+    site (or a mid-stall block, or a supervision-forced host-oracle
+    degrade) into the next test — cleared after every test, pass or
+    fail."""
     yield
     from etl_tpu.chaos import failpoints
+    from etl_tpu.ops import engine
 
     failpoints.disarm_all()
+    engine.clear_forced_oracle()
 
 
 def pytest_pyfunc_call(pyfuncitem):

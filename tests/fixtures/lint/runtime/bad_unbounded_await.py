@@ -1,0 +1,24 @@
+# expect: unbounded-await=3
+"""Rule 8 positives: bare parking awaits with no timeout and no
+shutdown race — a dead producer wedges the worker silently."""
+
+import asyncio
+
+
+async def consume(queue: asyncio.Queue):
+    # a producer that crashed never puts again: this await never returns
+    item = await queue.get()
+    return item
+
+
+async def wait_for_flush(flushed: asyncio.Event):
+    await flushed.wait()
+
+
+class Worker:
+    def __init__(self):
+        self.done_event = asyncio.Event()
+
+    async def join(self):
+        # attribute-chain receiver: still a bare park
+        await self.done_event.wait()

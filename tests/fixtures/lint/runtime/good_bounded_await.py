@@ -1,0 +1,43 @@
+"""Rule 8 negatives: every parking await is bounded, raced against
+shutdown, or not a parking shape at all."""
+
+import asyncio
+
+
+async def or_shutdown(shutdown, aw):
+    return await asyncio.wait_for(aw, 30.0)
+
+
+async def consume_bounded(queue: asyncio.Queue):
+    # timeout-bounded: the wrapper call is what gets awaited
+    return await asyncio.wait_for(queue.get(), timeout=5.0)
+
+
+async def consume_raced(shutdown, queue: asyncio.Queue):
+    # shutdown-raced: same structural exemption
+    return await or_shutdown(shutdown, queue.get())
+
+
+async def wait_shutdown(shutdown_signal):
+    # the shutdown signal IS the escape hatch the rule demands
+    await shutdown_signal.wait()
+
+
+async def select_tasks(tasks):
+    # asyncio.wait takes arguments: not the zero-arg parking shape
+    done, _ = await asyncio.wait(tasks, timeout=1.0)
+    return done
+
+
+class Pipeline:
+    async def wait(self):
+        await asyncio.sleep(0)
+
+    async def shutdown_and_wait(self):
+        # a method on the worker itself (self receiver), not an event
+        await self.wait()
+
+
+def sync_get(q):
+    # not awaited: thread-queue pops are the InFlightWindow's business
+    return q.get()

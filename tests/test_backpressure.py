@@ -1,0 +1,224 @@
+"""MemoryMonitor hysteresis, external pause composition, and the
+decode-pipeline in-flight-window shrink-to-1 path under simulated RSS
+pressure — the untested edge paths ISSUE 4 names.
+
+The monitor is driven through an injected rss_reader (no real RSS
+dependence), so every hysteresis edge is deterministic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from etl_tpu.config import MemoryBackpressureConfig
+from etl_tpu.runtime.backpressure import InFlightWindow, MemoryMonitor
+
+CFG = MemoryBackpressureConfig(activate_ratio=0.85, resume_ratio=0.75,
+                               refresh_interval_ms=10)
+
+
+def make_monitor(readings: list[int]) -> MemoryMonitor:
+    """Monitor over a scripted RSS sequence (last value repeats)."""
+    seq = list(readings)
+
+    def reader() -> int:
+        return seq.pop(0) if len(seq) > 1 else seq[0]
+
+    return MemoryMonitor(CFG, limit_bytes=1000, rss_reader=reader)
+
+
+class TestHysteresis:
+    async def test_activates_at_085_resumes_only_below_075(self):
+        mon = make_monitor([800, 860, 800, 760, 740, 740])
+        assert mon.sample_once() is False  # 0.80: below activate
+        assert mon.sample_once() is True   # 0.86: activated
+        assert mon.sample_once() is True   # 0.80: inside the band — holds
+        assert mon.sample_once() is True   # 0.76: still above resume
+        assert mon.sample_once() is False  # 0.74: resumed
+        assert mon.sample_once() is False
+
+    async def test_activation_counted_once_per_episode(self):
+        from etl_tpu.telemetry.metrics import (
+            ETL_MEMORY_BACKPRESSURE_ACTIVATIONS_TOTAL, registry)
+
+        before = registry.get_counter(
+            ETL_MEMORY_BACKPRESSURE_ACTIVATIONS_TOTAL)
+        mon = make_monitor([900, 900, 900, 700, 900, 700])
+        for _ in range(6):
+            mon.sample_once()
+        assert registry.get_counter(
+            ETL_MEMORY_BACKPRESSURE_ACTIVATIONS_TOTAL) == before + 2
+
+    async def test_resumed_event_pulses_waiters(self):
+        mon = make_monitor([900, 900, 700, 700])
+        mon.sample_once()
+        assert mon.pressure
+        waited = []
+
+        async def waiter():
+            await mon.wait_until_resumed()
+            waited.append(True)
+
+        t = asyncio.ensure_future(waiter())
+        await asyncio.sleep(0)
+        assert not waited
+        mon.sample_once()  # still 900: no resume
+        mon.sample_once()  # 700: resumes
+        await asyncio.sleep(0.01)
+        assert waited == [True]
+        await t
+
+
+class TestExternalPause:
+    async def test_pause_composes_with_memory_pressure(self):
+        """Intake resumes only when BOTH the maintenance lease and the
+        memory hysteresis clear — in either order."""
+        mon = make_monitor([900, 900, 700, 700])
+        mon.set_external_pause(True)
+        assert mon.pressure  # paused with no memory pressure at all
+        mon.sample_once()  # 900: memory pressure too
+        mon.set_external_pause(False)
+        assert mon.pressure  # memory episode still active
+        mon.sample_once()  # 900
+        mon.sample_once()  # 700: memory resumes -> fully clear
+        assert not mon.pressure
+        # other order: memory clears first, pause holds
+        mon.set_external_pause(True)
+        assert mon.pressure
+        mon.set_external_pause(False)
+        assert not mon.pressure
+
+    async def test_pause_toggle_without_memory_pressure_pulses_event(self):
+        mon = make_monitor([100])
+        mon.sample_once()
+        mon.set_external_pause(True)
+        assert mon.pressure
+        mon.set_external_pause(False)
+        assert not mon.pressure
+        await asyncio.wait_for(mon.wait_until_resumed(), 1)
+
+
+class TestInFlightWindowUnderPressure:
+    async def test_effective_limit_shrinks_to_1_and_recovers(self):
+        mon = make_monitor([900, 700])
+        win = InFlightWindow(3, mon)
+        assert win.effective_limit == 3
+        mon.sample_once()  # 900: pressure
+        assert win.effective_limit == 1
+        mon.sample_once()  # 700: resumed
+        assert win.effective_limit == 3
+
+    async def test_acquire_blocks_at_shrunk_limit_until_resume(self):
+        """With one slot held under pressure, a second acquire must park
+        — and wake on the poll tick once the monitor resumes, with no
+        explicit signal."""
+        mon = make_monitor([900, 700])
+        mon.sample_once()
+        win = InFlightWindow(3, mon)
+        win.acquire()
+        acquired = threading.Event()
+        t = threading.Thread(target=lambda: (win.acquire(),
+                                             acquired.set()), daemon=True)
+        t.start()
+        assert not acquired.wait(0.15)  # parked at effective limit 1
+        mon.sample_once()  # resume: limit back to 3
+        assert acquired.wait(1.0)  # poll tick sees it, no notify needed
+        t.join(1.0)
+        assert len(win) == 2
+
+    async def test_release_wakes_blocked_acquirer_under_pressure(self):
+        mon = make_monitor([900])
+        mon.sample_once()
+        win = InFlightWindow(3, mon)
+        win.acquire()
+        acquired = threading.Event()
+        t = threading.Thread(target=lambda: (win.acquire(),
+                                             acquired.set()), daemon=True)
+        t.start()
+        assert not acquired.wait(0.1)
+        win.release()  # serial handoff: one in flight at a time
+        assert acquired.wait(1.0)
+        t.join(1.0)
+
+    async def test_bypass_valve_overshoots_instead_of_deadlocking(self):
+        mon = make_monitor([900])
+        mon.sample_once()
+        win = InFlightWindow(3, mon)
+        win.acquire()
+        # a demanded-but-undispatched consumer: the window must overshoot
+        win.acquire(bypass=lambda: True)
+        assert len(win) == 2
+
+
+class TestDecodePipelineShrinkPath:
+    async def test_pipeline_degrades_to_serial_under_pressure(self):
+        """End-to-end shrink: under scripted RSS pressure the pipeline's
+        effective window is 1 (serial decode), results stay correct, and
+        the window recovers after resume."""
+        from etl_tpu.models import (ColumnSchema, Oid,
+                                    ReplicatedTableSchema, TableName,
+                                    TableSchema)
+        from etl_tpu.ops import DecodePipeline, DeviceDecoder
+        from etl_tpu.ops.staging import stage_copy_chunk
+
+        mon = make_monitor([900, 700])
+        mon.sample_once()  # pressure on
+        schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+            1, TableName("etl", "bp_shrink"),
+            tuple(ColumnSchema(f"c{i}", Oid.INT8) for i in range(3))))
+        decoder = DeviceDecoder(schema, device_min_rows=1 << 30, mesh=None,
+                                telemetry=False)
+        line = b"\t".join(str(i).encode() for i in range(3))
+        pipe = DecodePipeline(window=3, monitor=mon)
+        try:
+            assert pipe.effective_window == 1
+            handles = [pipe.submit(decoder,
+                                   stage_copy_chunk((line + b"\n") * 32, 3))
+                       for _ in range(4)]
+            # serial drain (the copy path's stance when the window is 1)
+            for h in handles:
+                batch = await asyncio.to_thread(h.result)
+                assert batch.num_rows == 32
+            assert pipe.in_flight == 0
+            mon.sample_once()  # resume
+            assert pipe.effective_window == 3
+        finally:
+            pipe.close()
+
+    async def test_copy_drain_threshold_follows_effective_window(self):
+        """The copy path drains ahead of `pipe.effective_window`
+        (runtime/copy.py): under pressure that bound is 1, so at most one
+        batch rides the window while another is being fetched."""
+        from etl_tpu.models import (ColumnSchema, Oid,
+                                    ReplicatedTableSchema, TableName,
+                                    TableSchema)
+        from etl_tpu.ops import DecodePipeline, DeviceDecoder
+        from etl_tpu.ops.staging import stage_copy_chunk
+
+        mon = make_monitor([900])
+        mon.sample_once()
+        schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+            1, TableName("etl", "bp_copy"),
+            (ColumnSchema("c0", Oid.INT8),)))
+        decoder = DeviceDecoder(schema, device_min_rows=1 << 30, mesh=None,
+                                telemetry=False)
+        pipe = DecodePipeline(window=3, monitor=mon)
+        in_flight: list = []
+        max_seen = 0
+        try:
+            for _ in range(5):
+                in_flight.append(pipe.submit(
+                    decoder, stage_copy_chunk(b"1\n" * 16, 1)))
+                while len(in_flight) > pipe.effective_window:
+                    h = in_flight.pop(0)
+                    await asyncio.to_thread(h.result)
+                max_seen = max(max_seen, len(in_flight))
+            assert max_seen == 1  # shrunk: never more than one queued
+        finally:
+            for h in in_flight:
+                await asyncio.to_thread(h.result)
+            pipe.close()

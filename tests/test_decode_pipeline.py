@@ -410,3 +410,9 @@ class TestBenchSmoke:
         assert out["streaming_above_floor"] is True, out
         assert out["streaming_events_per_sec"] >= \
             out["streaming_floor_events_per_sec"]
+        # supervision satellite: heartbeat instrumentation must cost <1%
+        # of the floor's per-event budget even at one beat per event
+        # (the streaming run above already measured the REAL pipeline
+        # with supervision live against the same floor)
+        assert out["heartbeat_overhead_under_1pct"] is True, out
+        assert out["heartbeat_overhead_ratio_at_floor"] < 0.01

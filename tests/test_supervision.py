@@ -1,0 +1,523 @@
+"""Supervision tree (ISSUE 4): heartbeat contract, stall/hang detection,
+health state machine, circuit breaker, destination op timeouts, the
+host-oracle degrade escalation, and the replicator /health surface.
+
+E2e watchdog recovery rides the chaos stall scenarios
+(tests/test_chaos.py TestStallScenarios); this module pins the unit
+semantics those scenarios compose.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from etl_tpu.config import SupervisionConfig
+from etl_tpu.models.errors import ErrorKind, EtlError, RetryKind, \
+    retry_directive
+from etl_tpu.supervision import (BreakerState, CircuitBreaker, HealthState,
+                                 HealthStateMachine, SupervisedDestination,
+                                 Supervisor, beat_while_waiting)
+
+
+def fast_supervisor(**overrides) -> Supervisor:
+    cfg = dict(check_interval_s=0.01, stall_deadline_s=0.05,
+               hang_deadline_s=0.1, restart_backoff_s=0.05,
+               device_degrade_threshold=2, device_degrade_cooldown_s=0.3,
+               breaker_failure_threshold=3, breaker_cooldown_s=0.1)
+    cfg.update(overrides)
+    return Supervisor(SupervisionConfig(**cfg))
+
+
+class TestHeartbeat:
+    def test_beat_updates_progress_clock_only_on_change(self):
+        sup = fast_supervisor()
+        hb = sup.register("c")
+        hb.beat(progress=("lsn", 1), busy=True)
+        t1 = hb.progress_at
+        time.sleep(0.01)
+        hb.beat(progress=("lsn", 1), busy=True)  # same token
+        assert hb.progress_at == t1
+        hb.beat(progress=("lsn", 2), busy=True)
+        assert hb.progress_at > t1
+
+    def test_register_replaces_and_unregister_removes(self):
+        sup = fast_supervisor()
+        a = sup.register("c")
+        b = sup.register("c")
+        assert sup.registry.get("c") is b and a is not b
+        b.close()
+        assert sup.registry.get("c") is None
+
+    async def test_beat_while_waiting_keeps_fresh_and_returns(self):
+        sup = fast_supervisor()
+        hb = sup.register("c")
+
+        async def slow():
+            await asyncio.sleep(0.12)
+            return 42
+
+        assert await beat_while_waiting(hb, slow(), interval_s=0.02) == 42
+        assert hb.age() < 0.1  # beats happened during the park
+        assert sup.sweep_once() == []  # no hang despite the 0.1s deadline
+
+
+class TestDetection:
+    def test_hang_detected_on_stale_heartbeat(self):
+        sup = fast_supervisor()
+        sup.register("apply")
+        time.sleep(0.12)
+        events = sup.sweep_once()
+        assert [e.kind for e in events] == ["hang"]
+        assert sup.health.state is HealthState.DEGRADED
+
+    def test_stall_detected_only_when_busy(self):
+        sup = fast_supervisor(hang_deadline_s=10.0)
+        hb = sup.register("apply")
+        hb.beat(progress=("lsn", 7), busy=False)
+        time.sleep(0.07)
+        hb.beat(progress=("lsn", 7), busy=False)  # idle: parked clock
+        assert sup.sweep_once() == []
+        hb.beat(progress=("lsn", 7), busy=True)
+        time.sleep(0.07)
+        hb.beat(progress=("lsn", 7), busy=True)  # busy + frozen = stall
+        events = sup.sweep_once()
+        assert [e.kind for e in events] == ["stall"]
+
+    def test_progress_change_resets_stall_clock(self):
+        sup = fast_supervisor(hang_deadline_s=10.0)
+        hb = sup.register("apply")
+        hb.beat(progress=1, busy=True)
+        time.sleep(0.07)
+        hb.beat(progress=2, busy=True)  # advanced: no stall
+        assert sup.sweep_once() == []
+
+    def test_work_driven_component_idle_staleness_is_not_a_hang(self):
+        sup = fast_supervisor()
+        hb = sup.register("decode:cdc-1")  # hang_requires_busy default
+        hb.beat(progress=1, busy=False)
+        time.sleep(0.12)
+        assert sup.sweep_once() == []  # idle decode pipeline: fine
+        hb.beat(progress=1, busy=True)
+        time.sleep(0.12)
+        kinds = {e.kind for e in sup.sweep_once()}
+        assert "hang" in kinds  # busy + stale = wedged
+
+    def test_recovery_clears_reason_back_to_healthy(self):
+        sup = fast_supervisor()
+        hb = sup.register("apply")
+        time.sleep(0.12)
+        sup.sweep_once()
+        assert sup.health.state is HealthState.DEGRADED
+        hb.beat(progress=1)
+        assert sup.sweep_once() == []
+        assert sup.health.state is HealthState.HEALTHY
+
+    def test_unregistered_component_reason_is_dropped(self):
+        sup = fast_supervisor()
+        hb = sup.register("table_sync:1")
+        time.sleep(0.12)
+        sup.sweep_once()
+        assert sup.health.state is HealthState.DEGRADED
+        hb.close()  # worker exited; its anomaly leaves with it
+        sup.sweep_once()
+        assert sup.health.state is HealthState.HEALTHY
+
+
+class TestEscalation:
+    def test_restart_callback_fired_with_backoff(self):
+        sup = fast_supervisor(restart_backoff_s=0.2)
+        restarts = []
+        sup.register("apply", restartable=True,
+                     on_restart=lambda: restarts.append(1))
+        time.sleep(0.12)
+        events = sup.sweep_once()
+        assert [e.kind for e in events] == ["hang", "restart"]
+        assert restarts == [1]
+        # the restart reset the clocks: the next sweep is quiet, and even
+        # a re-detection within the backoff window must not re-fire
+        assert sup.sweep_once() == []
+        time.sleep(0.12)
+        events = sup.sweep_once()
+        assert [e.kind for e in events] == ["hang"]  # backoff: no restart
+        assert restarts == [1]
+
+    def test_stall_detected_classifies_timed_for_worker_retry(self):
+        e = EtlError(ErrorKind.STALL_DETECTED, "watchdog")
+        assert retry_directive(e).kind is RetryKind.TIMED
+
+    def test_device_degrade_after_repeated_decode_detections(self):
+        from etl_tpu.ops import engine
+
+        sup = fast_supervisor(device_degrade_threshold=2)
+        hb = sup.register("decode:cdc-9")
+        assert not engine.host_oracle_forced()
+        for _ in range(2):
+            hb.beat(progress=1, busy=True)
+            time.sleep(0.12)
+            sup.sweep_once()
+        assert engine.host_oracle_forced()
+        assert "device-degraded" in sup.health.reasons
+        engine.clear_forced_oracle()
+        sup.sweep_once()  # cooldown lapsed: reason lifts itself
+        assert "device-degraded" not in sup.health.reasons
+
+    def test_forced_oracle_reroutes_decode(self):
+        from etl_tpu.models import (ColumnSchema, Oid,
+                                    ReplicatedTableSchema, TableName,
+                                    TableSchema)
+        from etl_tpu.ops import engine
+        from etl_tpu.ops.staging import stage_copy_chunk
+
+        schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+            1, TableName("etl", "sup_degrade"),
+            tuple(ColumnSchema(f"c{i}", Oid.INT8) for i in range(3))))
+        line = b"\t".join(str(10 + i).encode() for i in range(3))
+        staged = stage_copy_chunk((line + b"\n") * 64, 3)
+        dec = engine.DeviceDecoder(schema, device_min_rows=1, mesh=None,
+                                   telemetry=False)
+        assert dec._route(staged)[0] != "oracle"
+        engine.force_host_oracle(30.0)
+        try:
+            assert dec._route(staged)[0] == "oracle"
+            # the degraded path still decodes correctly
+            batch = dec.decode(staged)
+            assert batch.num_rows == 64
+        finally:
+            engine.clear_forced_oracle()
+        assert dec._route(staged)[0] != "oracle"
+
+
+class TestHealthStateMachine:
+    def test_reason_driven_transitions_and_listeners(self):
+        m = HealthStateMachine()
+        seen = []
+        m.add_listener(lambda old, new, why: seen.append(new.value))
+        m.set_reason("x", "bad")
+        m.set_reason("y", "worse")
+        m.clear_reason("x")
+        assert m.state is HealthState.DEGRADED
+        m.clear_reason("y")
+        assert m.state is HealthState.HEALTHY
+        assert seen == ["degraded", "healthy"]
+
+    def test_fault_is_sticky_until_reset(self):
+        m = HealthStateMachine()
+        m.fault("apply worker failed permanently")
+        m.clear_reason("anything")
+        assert m.state is HealthState.FAULTED
+        m.set_reason("x", "bad")
+        assert m.state is HealthState.FAULTED
+        m.reset()
+        assert m.state is HealthState.HEALTHY
+
+    def test_snapshot_shape(self):
+        m = HealthStateMachine()
+        m.set_reason("component:apply", "stall")
+        snap = m.snapshot()
+        assert snap["state"] == "degraded"
+        assert snap["reasons"] == {"component:apply": "stall"}
+        assert snap["transitions"][-1]["state"] == "degraded"
+
+
+class TestCircuitBreaker:
+    def test_trips_open_after_consecutive_failures_and_half_opens(self):
+        b = CircuitBreaker("m", failure_threshold=3, cooldown_s=0.05)
+        for _ in range(2):
+            b.record_failure()
+        b.before_call()  # still closed
+        b.record_failure()
+        assert b.state is BreakerState.OPEN
+        with pytest.raises(EtlError) as ei:
+            b.before_call()
+        assert ErrorKind.DESTINATION_UNAVAILABLE in ei.value.kinds()
+        time.sleep(0.06)
+        b.before_call()  # cooldown lapsed: half-open trial admitted
+        assert b.state is BreakerState.HALF_OPEN
+        with pytest.raises(EtlError):
+            b.before_call()  # only ONE trial at a time
+        b.record_success()
+        assert b.state is BreakerState.CLOSED
+
+    def test_half_open_failure_reopens(self):
+        b = CircuitBreaker("m", failure_threshold=1, cooldown_s=0.05)
+        b.record_failure()
+        assert b.state is BreakerState.OPEN
+        time.sleep(0.06)
+        b.before_call()
+        b.record_failure()
+        assert b.state is BreakerState.OPEN
+
+    def test_cancelled_trial_releases_slot_instead_of_wedging(self):
+        """A half-open trial cancelled mid-flight (worker restart) must
+        release the trial slot — without abort_call the breaker stays
+        'trial in flight' forever and sheds every future call even after
+        the sink recovers (code-review finding)."""
+        b = CircuitBreaker("m", failure_threshold=1, cooldown_s=0.05)
+        b.record_failure()
+        time.sleep(0.06)
+        b.before_call()  # the admitted trial...
+        b.abort_call()   # ...is cancelled with no verdict
+        b.before_call()  # next call may trial again
+        b.record_success()
+        assert b.state is BreakerState.CLOSED
+
+    async def test_cancelled_supervised_write_aborts_trial(self):
+        b = CircuitBreaker("m", failure_threshold=1, cooldown_s=0.01)
+        b.record_failure()
+        time.sleep(0.02)
+
+        class Hang(_NeverReturns):
+            pass
+
+        dest = SupervisedDestination(Hang(), timeout_s=30.0, breaker=b)
+        task = asyncio.ensure_future(dest.write_events([]))
+        await asyncio.sleep(0.01)
+        assert b._trial_in_flight
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        assert not b._trial_in_flight  # slot released, not wedged
+
+    def test_success_resets_consecutive_count(self):
+        b = CircuitBreaker("m", failure_threshold=3)
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state is BreakerState.CLOSED
+
+    def test_breaker_open_is_worker_retryable_not_writer_retryable(self):
+        from etl_tpu.retry import RetryPolicy, WORKER_TRANSIENT_KINDS
+
+        e = EtlError(ErrorKind.DESTINATION_UNAVAILABLE, "open")
+        writer = RetryPolicy()
+        worker = RetryPolicy(transient_kinds=WORKER_TRANSIENT_KINDS)
+        assert writer.classify(e) is RetryKind.MANUAL
+        assert worker.classify(e) is RetryKind.TIMED
+
+
+class _NeverReturns:
+    """Destination whose write never resolves (the eternal-await bug the
+    op timeout bounds)."""
+
+    async def startup(self):
+        return None
+
+    async def write_events(self, events):
+        await asyncio.sleep(3600)
+
+    async def write_table_rows(self, schema, batch):
+        await asyncio.sleep(3600)
+
+    async def drop_table(self, table_id, schema=None):
+        return None
+
+    async def truncate_table(self, table_id):
+        return None
+
+    async def shutdown(self):
+        return None
+
+
+class TestSupervisedDestination:
+    async def test_op_timeout_surfaces_classified_etl_error(self):
+        sup = fast_supervisor()
+        dest = SupervisedDestination(_NeverReturns(), timeout_s=0.05,
+                                     breaker=sup.breaker("never"))
+        with pytest.raises(EtlError) as ei:
+            await dest.write_events([])
+        assert ErrorKind.TIMEOUT in ei.value.kinds()
+        from etl_tpu.telemetry.metrics import (
+            ETL_DESTINATION_OP_TIMEOUTS_TOTAL, registry)
+
+        assert registry.get_counter(ETL_DESTINATION_OP_TIMEOUTS_TOTAL,
+                                    {"op": "write_events"}) >= 1
+
+    async def test_flush_timeout_bounded(self):
+        from etl_tpu.destinations.base import WriteAck
+
+        class HeldAck:
+            async def startup(self):
+                return None
+
+            async def write_events(self, events):
+                ack, _fut = WriteAck.accepted()  # never resolved
+                return ack
+
+        dest = SupervisedDestination(HeldAck(), timeout_s=0.05)
+        ack = await dest.write_events([])
+        with pytest.raises(EtlError) as ei:
+            await ack.wait_durable()
+        assert ErrorKind.TIMEOUT in ei.value.kinds()
+
+    async def test_open_breaker_sheds_before_calling_inner(self):
+        calls = []
+
+        class Counting(_NeverReturns):
+            async def write_events(self, events):
+                calls.append(1)
+                raise EtlError(ErrorKind.DESTINATION_FAILED, "down")
+
+        sup = fast_supervisor(breaker_failure_threshold=2,
+                              breaker_cooldown_s=30.0)
+        dest = SupervisedDestination(Counting(), timeout_s=1.0,
+                                     breaker=sup.breaker("c"))
+        for _ in range(2):
+            with pytest.raises(EtlError):
+                await dest.write_events([])
+        assert sup.breaker("c").state is BreakerState.OPEN
+        with pytest.raises(EtlError) as ei:
+            await dest.write_events([])
+        assert ErrorKind.DESTINATION_UNAVAILABLE in ei.value.kinds()
+        assert len(calls) == 2  # the shed call never reached the sink
+        # non-closed breaker holds a degraded health reason each sweep
+        sup.sweep_once()
+        assert sup.health.state is HealthState.DEGRADED
+
+    async def test_durable_write_closes_breaker_and_passes_through(self):
+        from etl_tpu.destinations import MemoryDestination
+
+        sup = fast_supervisor()
+        inner = MemoryDestination()
+        dest = SupervisedDestination(inner, timeout_s=1.0,
+                                     breaker=sup.breaker("m"),
+                                     heartbeat=sup.register("destination"))
+        await dest.startup()
+        ack = await dest.write_events([])
+        await ack.wait_durable()
+        assert inner.started
+        assert sup.breaker("m").state is BreakerState.CLOSED
+        assert dest.telemetry_name == "MemoryDestination"
+
+
+class TestPipelineIntegration:
+    async def test_pipeline_wraps_destination_and_starts_supervisor(self):
+        from tests.test_pipeline_e2e import make_db, make_pipeline, \
+            wait_ready
+
+        db = make_db()
+        pipeline, store, dest = make_pipeline(db)
+        assert pipeline.supervisor is not None
+        assert not pipeline.supervisor.started
+        await pipeline.start()
+        assert pipeline.supervisor.started
+        assert pipeline.active_destination.inner is dest
+        await wait_ready(store, 16384)
+        snap = pipeline.health_snapshot()
+        assert snap["health"]["state"] in ("healthy", "degraded")
+        assert "apply" in snap["components"]
+        assert "memory_monitor" in snap["components"]
+        assert "MemoryDestination" in snap["breakers"]
+        await pipeline.shutdown_and_wait()
+
+    async def test_fatal_apply_error_faults_health(self):
+        from etl_tpu.postgres.fake import FakeDatabase, FakeSource
+        from etl_tpu.runtime import Pipeline
+        from etl_tpu.store import NotifyingStore
+        from etl_tpu.destinations import MemoryDestination
+        from etl_tpu.config import PipelineConfig
+
+        db = FakeDatabase()  # publication never created -> fatal at start
+        config = PipelineConfig(pipeline_id=1, publication_name="nope")
+        pipeline = Pipeline(config=config, store=NotifyingStore(),
+                            destination=MemoryDestination(),
+                            source_factory=lambda: FakeSource(db))
+        with pytest.raises(EtlError):
+            await pipeline.start()
+        # start() failed before the apply worker spawned: health surface
+        # still answers (starting), it just never started
+        assert not pipeline.supervisor.started
+
+    async def test_supervision_disabled_runs_unwrapped(self):
+        from tests.test_pipeline_e2e import make_db, make_pipeline, \
+            wait_ready
+        from etl_tpu.config import SupervisionConfig
+
+        db = make_db()
+        pipeline, store, dest = make_pipeline(
+            db, supervision=SupervisionConfig(enabled=False))
+        assert pipeline.supervisor is None
+        assert pipeline.active_destination is dest
+        await pipeline.start()
+        await wait_ready(store, 16384)
+        assert pipeline.health_snapshot()["state"] == "unsupervised"
+        await pipeline.shutdown_and_wait()
+
+
+class TestReplicatorHealthEndpoint:
+    async def _get(self, app, path):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get(path)
+            return resp.status, await resp.json()
+        finally:
+            await client.close()
+
+    async def test_health_before_start_is_503_starting(self):
+        from etl_tpu.replicator import build_observability_app
+        from tests.test_pipeline_e2e import make_db, make_pipeline
+
+        pipeline, _, _ = make_pipeline(make_db())
+        status, body = await self._get(
+            build_observability_app(pipeline), "/health")
+        assert status == 503 and body["status"] == "starting"
+
+    async def test_health_healthy_and_detail_after_start(self):
+        from etl_tpu.replicator import build_observability_app
+        from tests.test_pipeline_e2e import make_db, make_pipeline, \
+            wait_ready
+
+        pipeline, store, _ = make_pipeline(make_db())
+        await pipeline.start()
+        try:
+            await wait_ready(store, 16384)
+            pipeline.supervisor.sweep_once()
+            app = build_observability_app(pipeline)
+            status, body = await self._get(app, "/health")
+            assert status == 200 and body["status"] == "healthy"
+            status, detail = await self._get(app, "/health/detail")
+            assert status == 200
+            assert "apply" in detail["components"]
+            assert detail["components"]["apply"]["age_s"] < 60
+            assert detail["breakers"]["MemoryDestination"]["state"] \
+                == "closed"
+        finally:
+            await pipeline.shutdown_and_wait()
+
+    async def test_health_faulted_is_503_with_detail(self):
+        from etl_tpu.replicator import build_observability_app
+        from tests.test_pipeline_e2e import make_db, make_pipeline
+
+        pipeline, _, _ = make_pipeline(make_db())
+        pipeline.supervisor.start()
+        pipeline.supervisor.health.fault("apply worker failed: boom")
+        try:
+            status, body = await self._get(
+                build_observability_app(pipeline), "/health")
+            assert status == 503
+            assert body["status"] == "faulted"
+            assert "boom" in body["fatal"]
+        finally:
+            await pipeline.supervisor.stop()
+
+    async def test_health_degraded_stays_200_with_reasons(self):
+        from etl_tpu.replicator import build_observability_app
+        from tests.test_pipeline_e2e import make_db, make_pipeline
+
+        pipeline, _, _ = make_pipeline(make_db())
+        # started flag only — no sweep task, whose unregistered-component
+        # GC would (correctly) clear a hand-planted reason
+        pipeline.supervisor.started = True
+        pipeline.supervisor.health.set_reason("component:apply", "stall")
+        status, body = await self._get(
+            build_observability_app(pipeline), "/health")
+        assert status == 200
+        assert body["status"] == "degraded"
+        assert body["reasons"] == {"component:apply": "stall"}
