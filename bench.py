@@ -233,10 +233,29 @@ def run_smoke() -> dict:
     heartbeat_overhead_ratio = per_beat_s * floor
     heartbeat_ok = heartbeat_overhead_ratio < 0.01
 
+    # static-analysis budget gate (ISSUE 5 CI satellite): the full
+    # whole-program etl-lint pass (call graph + context propagation +
+    # CFG rules over every module) must stay cheap enough to gate every
+    # PR — the budget is wall-clock, generous vs the ~4s measured on the
+    # CI CPU so container noise doesn't flake it, but tight enough that
+    # an accidentally-quadratic traversal fails loudly here instead of
+    # silently doubling tier-1 time
+    from etl_tpu.analysis.rules import analyze_paths, repo_package_dir
+
+    lint_budget_s = float(floors.get("static_analysis_budget_s", 30.0))
+    t0 = time.perf_counter()
+    lint_findings = analyze_paths([str(repo_package_dir())])
+    lint_seconds = time.perf_counter() - t0
+    lint_ok = lint_seconds < lint_budget_s
+
     return {
         "mode": "smoke",
         "ok": bool(identical and stages_observed and stream_ok
-                   and heartbeat_ok),
+                   and heartbeat_ok and lint_ok),
+        "static_analysis_seconds": round(lint_seconds, 3),
+        "static_analysis_budget_s": lint_budget_s,
+        "static_analysis_under_budget": bool(lint_ok),
+        "static_analysis_findings": len(lint_findings),
         "pipelined_equals_serial": bool(identical),
         "stage_histograms_observed": bool(stages_observed),
         "streaming_events_per_sec": stream_eps,

@@ -5,11 +5,17 @@ exactly the ways a human reviewer keeps missing: a synchronous
 jit-compiling probe inside the asyncio apply loop, a dropped
 `asyncio.create_task` handle, a broad `except` that eats a
 `CancelledError` mid-shutdown. This package enforces those invariants by
-machinery instead of post-hoc advice:
+machinery instead of post-hoc advice — lexically per module AND
+interprocedurally over the whole program (wrapping the sink in a helper
+one file away no longer defeats a rule):
 
-  - `rules`      — the codebase-specific rule set (see docs/static-analysis.md)
+  - `rules`      — the per-module rule set (see docs/static-analysis.md)
   - `visitor`    — scope/context-tracking AST walk the rules plug into
-  - `findings`   — the finding model + stable fingerprints
+  - `callgraph`  — whole-program symbol tables + resolved call graph
+  - `contexts`   — async/hot-loop context propagation along call edges
+  - `cfg`        — per-function CFG + forward dataflow
+  - `interproc`  — transitive rule upgrades + resource/deadlock rules
+  - `findings`   — the finding model + stable fingerprints + chains
   - `baseline`   — suppression file I/O for grandfathered findings
   - `cli`        — `python -m etl_tpu.analysis [paths]`
   - `annotations`— the runtime-visible `@hot_loop` marker

@@ -1,6 +1,7 @@
 """`python -m etl_tpu.analysis [paths]` — run etl-lint.
 
-Exit codes: 0 clean (after baseline), 1 violations, 2 usage/parse error.
+Exit codes: 0 clean (after baseline), 1 violations (or, with
+`--check-baseline`, stale suppressions), 2 usage/parse error.
 """
 
 from __future__ import annotations
@@ -17,7 +18,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m etl_tpu.analysis",
         description="etl-lint: async-safety & device-sync static analysis "
-                    "for the etl_tpu codebase")
+                    "for the etl_tpu codebase (lexical + whole-program)")
     p.add_argument("paths", nargs="*",
                    help="files or directories to scan "
                         "(default: the etl_tpu package)")
@@ -29,8 +30,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the baseline to cover all current "
                         "findings, pruning fixed entries")
+    p.add_argument("--check-baseline", action="store_true",
+                   help="fail (exit 1) on stale baseline entries and on "
+                        "inline `# etl-lint: ignore[...]` comments that "
+                        "suppress nothing")
+    p.add_argument("--no-interproc", action="store_true",
+                   help="skip the whole-program pass (lexical rules only)")
+    p.add_argument("--callgraph", action="store_true",
+                   help="dump the resolved call graph edges and exit")
+    p.add_argument("--explain", action="store_true",
+                   help="print a resolvable file:line trace for each "
+                        "violation's call chain")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default="text", dest="fmt",
+                   help="output format; `github` emits workflow-command "
+                        "annotations (::error file=...) for CI")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="machine-readable output")
+                   help="alias for --format=json")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule names and exit")
     p.add_argument("-q", "--quiet", action="store_true",
@@ -38,17 +54,74 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _dump_callgraph(paths, as_json: bool) -> int:
+    from .callgraph import Project
+    from .rules import analyze_paths
+
+    # reuse the scanner so rel-path canonicalization (and therefore
+    # module keys) matches the analysis run exactly — parse-only, no
+    # rule pass (the findings would be discarded anyway)
+    units: list = []
+    analyze_paths(paths, interprocedural=False, lexical=False,
+                  units_out=units)
+    project = Project.build([(u.path, u.source, u.tree) for u in units])
+    edges = project.edges()
+    if as_json:
+        print(json.dumps({"edges": [list(e) for e in edges]}, indent=2))
+    else:
+        for src, dst in edges:
+            print(f"{src} -> {dst}")
+        print(f"etl-lint: {len(edges)} resolved call edges",
+              file=sys.stderr)
+    return 0
+
+
+def _annotation_path(path: str) -> str:
+    """Repo-relative path for a workflow annotation. Finding paths are
+    canonical (package-stripped), so package files need the `etl_tpu/`
+    prefix back; files from other scan roots (fixture trees) keep their
+    canonical path — anchoring to a nonexistent file helps nobody."""
+    import os
+
+    prefixed = os.path.join("etl_tpu", path)
+    return prefixed if os.path.exists(prefixed) else path
+
+
+def _render_github(f) -> str:
+    # workflow commands reject newlines in the message; title carries
+    # the rule so annotations group in the PR UI
+    msg = f.message.replace("\n", " ")
+    if f.chain:
+        msg += f" (via {f.chain_text()})"
+    return (f"::error file={_annotation_path(f.path)},line={f.line},"
+            f"col={f.col},title=etl-lint {f.rule}::{msg}")
+
+
 def main(argv: "list[str] | None" = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.as_json:
+        args.fmt = "json"
     if args.list_rules:
         print("\n".join(RULE_NAMES))
         return 0
     paths = args.paths or [str(repo_package_dir())]
+    if args.callgraph:
+        try:
+            return _dump_callgraph(paths, args.fmt == "json")
+        except (SyntaxError, OSError) as e:
+            print(f"etl-lint: {e}", file=sys.stderr)
+            return 2
     scanned: list[str] = []
+    units: list = []
     try:
-        findings = analyze_paths(paths, scanned=scanned)
+        findings = analyze_paths(paths, scanned=scanned,
+                                 interprocedural=not args.no_interproc,
+                                 units_out=units)
     except (SyntaxError, OSError) as e:
         print(f"etl-lint: {e}", file=sys.stderr)
+        return 2
+    except RecursionError as e:  # analyzer bug, not a lint result
+        print(f"etl-lint: analyzer error: {e}", file=sys.stderr)
         return 2
 
     if args.update_baseline:
@@ -76,16 +149,40 @@ def main(argv: "list[str] | None" = None) -> int:
     stale = {fp: n for fp, n in stale.items()
              if baseline_mod.fingerprint_path(fp) in scanned_set}
 
-    if args.as_json:
+    if args.check_baseline:
+        unused_ignores = [(u.path, line, rule) for u in units
+                          for line, rule in u.suppressions.unused()]
+        for fp, n in sorted(stale.items()):
+            print(f"etl-lint: stale baseline entry ({n} unused): {fp}")
+        for path, line, rule in sorted(unused_ignores):
+            print(f"etl-lint: stale inline ignore at {path}:{line}: "
+                  f"ignore[{rule}] suppresses nothing")
+        dirty = bool(stale) or bool(unused_ignores)
+        if not args.quiet:
+            print(f"etl-lint: --check-baseline: {len(stale)} stale "
+                  f"baseline entries, {len(unused_ignores)} stale "
+                  f"inline ignores")
+        return 1 if dirty else 0
+
+    if args.fmt == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in findings],
             "violations": [f.to_dict() for f in violations],
             "stale_baseline": stale,
             "baselined": len(findings) - len(violations),
         }, indent=2))
+    elif args.fmt == "github":
+        for f in violations:
+            print(_render_github(f))
+        if not args.quiet:
+            print(f"etl-lint: {len(violations)} violations "
+                  f"({len(findings) - len(violations)} baselined)",
+                  file=sys.stderr)
     else:
         for f in violations:
             print(f.render())
+            if args.explain:
+                print(f.explain())
         for fp, unused in sorted(stale.items()):
             print(f"etl-lint: stale baseline entry ({unused} unused): {fp}",
                   file=sys.stderr)

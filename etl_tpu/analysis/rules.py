@@ -36,6 +36,14 @@ worked examples):
                                 watchdog exists for; bound the await
                                 (asyncio.wait_for / or_shutdown /
                                 beat_while_waiting) or justify inline
+
+Rules 1, 2, and 6 additionally run INTERPROCEDURALLY (interproc.py): a
+blocking or host-transfer sink anywhere in the call closure of an
+event-loop `async def` / `@hot_loop` function is reported with the full
+call chain, and import aliases resolve (`from time import sleep`). Four
+whole-program rules (arena-lease-leak, donated-buffer-use,
+lock-held-across-await, lock-order-inversion) live there too — they
+need the call graph and per-function CFGs, not a lexical walk.
 """
 
 from __future__ import annotations
@@ -537,15 +545,41 @@ def default_rules() -> list[Rule]:
     ]
 
 
-RULE_NAMES = tuple(r.name for r in default_rules())
+#: whole-program rules (etl_tpu/analysis/interproc.py) — they have no
+#: per-module Rule class; listed here so --list-rules and suppression
+#: docs stay complete
+INTERPROC_RULE_NAMES = (
+    "arena-lease-leak",
+    "donated-buffer-use",
+    "lock-held-across-await",
+    "lock-order-inversion",
+)
+
+RULE_NAMES = tuple(r.name for r in default_rules()) + INTERPROC_RULE_NAMES
 
 
 def analyze_source(source: str, rel_path: str,
-                   rules: list[Rule] | None = None) -> list[Finding]:
+                   rules: list[Rule] | None = None,
+                   interprocedural: bool = True) -> list[Finding]:
     """Lint one module's source. `rel_path` drives path-scoped rules and
     fixture trees mirror the package layout, so `runtime/foo.py` gets the
-    runtime/ rule scoping whether it is real or a test snippet."""
-    return lint_module(source, rel_path, rules or default_rules())
+    runtime/ rule scoping whether it is real or a test snippet. The
+    whole-program pass runs over the single module (cross-module targets
+    stay unresolved, by design)."""
+    import ast as ast_mod
+
+    from .interproc import ModuleUnit, analyze_interprocedural
+    from .visitor import Suppressions
+
+    tree = ast_mod.parse(source, filename=rel_path)
+    supp = Suppressions(source)
+    findings = lint_module(source, rel_path, rules or default_rules(),
+                           tree=tree, suppressions=supp)
+    if interprocedural:
+        findings = findings + analyze_interprocedural(
+            [ModuleUnit(canonical_path(rel_path), source, tree, supp)])
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
 
 
 def iter_python_files(path: str | Path) -> "list[Path]":
@@ -557,14 +591,30 @@ def iter_python_files(path: str | Path) -> "list[Path]":
 
 
 def analyze_paths(paths, root: "str | None" = None,
-                  scanned: "list[str] | None" = None) -> list[Finding]:
+                  scanned: "list[str] | None" = None,
+                  interprocedural: bool = True,
+                  lexical: bool = True,
+                  units_out: "list | None" = None) -> list[Finding]:
     """Lint every .py under `paths`. Rel paths are computed against each
     argument (directory args act as scan roots), then canonicalized, so
     `analyze_paths(["etl_tpu"])` and `analyze_paths(["."])` fingerprint
     identically. When `scanned` is given, the canonical path of every
     file visited is appended to it (clean files included) — baseline
-    updates need the full scan scope, not just files with findings."""
-    findings: list[Finding] = []
+    updates need the full scan scope, not just files with findings.
+
+    All modules are parsed first, then the per-module lexical pass and
+    the whole-program interprocedural pass run over the same trees —
+    cross-module call chains resolve only within the scanned set, so a
+    scoped run sees a smaller closure (fingerprints of what it DOES see
+    are identical to the full run's). `units_out`, when given, receives
+    the interproc ModuleUnits (path, source, tree, suppressions) —
+    `--check-baseline` reads per-module suppression usage from them."""
+    import ast as ast_mod
+
+    from .interproc import ModuleUnit, analyze_interprocedural
+    from .visitor import Suppressions
+
+    units: list = []
     for arg in paths:
         if not Path(arg).exists():
             # a typo'd path silently scanning nothing would keep CI green
@@ -593,16 +643,28 @@ def analyze_paths(paths, root: "str | None" = None,
                     rel = resolved.relative_to(base)
                 except ValueError:
                     pass
+            canon = canonical_path(rel.as_posix())
             if scanned is not None:
-                scanned.append(canonical_path(rel.as_posix()))
+                scanned.append(canon)
             source = f.read_text(encoding="utf-8")
             try:
-                findings.extend(
-                    analyze_source(source, rel.as_posix(),
-                                   rules=default_rules()))
+                tree = ast_mod.parse(source, filename=str(f))
             except SyntaxError as e:
                 raise SyntaxError(
                     f"etl-lint: cannot parse {f}: {e}") from e
+            units.append(ModuleUnit(canon, source, tree,
+                                    Suppressions(source)))
+
+    findings: list[Finding] = []
+    if lexical:
+        for u in units:
+            findings.extend(lint_module(u.source, u.path, default_rules(),
+                                        tree=u.tree,
+                                        suppressions=u.suppressions))
+    if interprocedural:
+        findings.extend(analyze_interprocedural(units))
+    if units_out is not None:
+        units_out.extend(units)
     findings.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
     return findings
 

@@ -13,7 +13,9 @@ lexical facts rules key on:
     CancellationSwallow finds the governing `try` and enclosing function
     to recognize the cancel-then-drain idiom;
   - inline suppressions: `# etl-lint: ignore[rule-a,rule-b]` on the
-    finding's line drops the finding at collection time.
+    finding's line (or on the first line of its enclosing multi-line
+    statement) drops the finding at collection time; usage is tracked
+    so `--check-baseline` can flag ignores that suppress nothing.
 
 Rules subclass `Rule` and receive `on_*` callbacks with the visitor as
 context. They report via `ctx.report(...)`, which applies suppressions.
@@ -30,6 +32,95 @@ from typing import Callable
 from .findings import Finding, canonical_path
 
 _IGNORE_RE = re.compile(r"#\s*etl-lint:\s*ignore\[([a-z0-9_,\s-]+)\]")
+
+#: compound statements: only their HEADER lines (condition / with-items /
+#: signature) belong to the statement for suppression purposes — a
+#: suppression on `with ...:` must not blanket the whole body
+_COMPOUND_STMTS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                   ast.For, ast.AsyncFor, ast.While, ast.If, ast.With,
+                   ast.AsyncWith, ast.Try)
+
+
+class Suppressions:
+    """One module's inline `# etl-lint: ignore[...]` comments.
+
+    Three jobs:
+      - parse COMMENT tokens only (a docstring or log string QUOTING the
+        ignore syntax must not suppress findings on its line);
+      - map continuation lines of a multi-line statement back to the
+        statement's first line, so a suppression on the line a human
+        reads as "the statement" covers findings the AST anchors on a
+        continuation line (a nested call's own lineno);
+      - track which ignores actually suppressed something, so
+        `--check-baseline` can flag stale ones.
+    """
+
+    def __init__(self, source: str):
+        #: comment line -> set of rule names (or "all")
+        self.by_line: dict[int, set[str]] = {}
+        #: continuation line -> first line of its enclosing statement
+        self._stmt_first: dict[int, int] = {}
+        #: (comment line, rule) pairs that suppressed >=1 finding
+        self._used: set[tuple[int, str]] = set()
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _IGNORE_RE.search(tok.string)
+                if m:
+                    self.by_line[tok.start[0]] = {
+                        r.strip() for r in m.group(1).split(",")
+                        if r.strip()}
+        except (tokenize.TokenError, IndentationError):
+            pass  # unparseable source fails in ast.parse anyway
+
+    def attach_tree(self, tree: ast.Module) -> None:
+        """Build the continuation-line map. Simple statements span their
+        full extent; compound statements contribute only their header
+        (first line through the line before their first body statement)."""
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.stmt):
+                continue
+            end = getattr(node, "end_lineno", None) or node.lineno
+            if isinstance(node, _COMPOUND_STMTS):
+                body = getattr(node, "body", None)
+                if body:
+                    end = min(end, body[0].lineno - 1)
+            for line in range(node.lineno + 1, end + 1):
+                # innermost statement wins: walk yields outer before
+                # inner, so later (inner) writes override
+                self._stmt_first[line] = node.lineno
+
+    def _match_line(self, rule: str, line: int) -> "int | None":
+        rules = self.by_line.get(line)
+        if rules is not None and (rule in rules or "all" in rules):
+            return line
+        return None
+
+    def suppresses(self, rule: str, line: int) -> bool:
+        """True (and marks the ignore used) when an ignore on `line` or
+        on the first line of `line`'s enclosing statement names `rule`."""
+        hit = self._match_line(rule, line)
+        if hit is None:
+            first = self._stmt_first.get(line)
+            if first is not None:
+                hit = self._match_line(rule, first)
+        if hit is None:
+            return False
+        named = self.by_line[hit]
+        self._used.add((hit, rule if rule in named else "all"))
+        return True
+
+    def unused(self) -> list[tuple[int, str]]:
+        """(line, rule) of every ignore entry that suppressed nothing —
+        sorted, deterministic."""
+        out = []
+        for line, rules in self.by_line.items():
+            for rule in rules:
+                if (line, rule) not in self._used:
+                    out.append((line, rule))
+        return sorted(out)
 
 #: decorator names that mark a hot-path function (matched on the
 #: terminal name so `@hot_loop`, `@annotations.hot_loop`, and
@@ -138,26 +229,14 @@ class _Frame:
 class LintContext(ast.NodeVisitor):
     """One module's traversal state, shared by every active rule."""
 
-    def __init__(self, source: str, rel_path: str, rules: list[Rule]):
+    def __init__(self, source: str, rel_path: str, rules: list[Rule],
+                 suppressions: "Suppressions | None" = None):
         self.rel_path = canonical_path(rel_path)
         self.source = source
         self.rules = [r for r in rules if r.applies_to(self.rel_path)]
         self.findings: list[Finding] = []
-        self._suppressed: dict[int, set[str]] = {}
-        # COMMENT tokens only: a docstring or log string QUOTING the
-        # ignore syntax must not suppress findings on its line
-        try:
-            for tok in tokenize.generate_tokens(
-                    io.StringIO(source).readline):
-                if tok.type != tokenize.COMMENT:
-                    continue
-                m = _IGNORE_RE.search(tok.string)
-                if m:
-                    self._suppressed[tok.start[0]] = {
-                        r.strip() for r in m.group(1).split(",")
-                        if r.strip()}
-        except (tokenize.TokenError, IndentationError):
-            pass  # unparseable source fails in ast.parse anyway
+        self.suppressions = suppressions if suppressions is not None \
+            else Suppressions(source)
         # lexical scope stacks
         self._frames: list[_Frame] = []
         self._class_stack: list[str] = []
@@ -194,8 +273,7 @@ class LintContext(ast.NodeVisitor):
     def report(self, rule: str, node: ast.AST, detail: str,
                message: str) -> None:
         line = getattr(node, "lineno", 0)
-        suppressed = self._suppressed.get(line, set())
-        if rule in suppressed or "all" in suppressed:
+        if self.suppressions.suppresses(rule, line):
             return
         self.findings.append(Finding(
             rule=rule, path=self.rel_path, line=line,
@@ -205,6 +283,7 @@ class LintContext(ast.NodeVisitor):
     # -- traversal -----------------------------------------------------------
 
     def run(self, tree: ast.Module) -> list[Finding]:
+        self.suppressions.attach_tree(tree)
         for rule in self.rules:
             rule.before_module(self, tree)
         self.visit(tree)
@@ -333,7 +412,9 @@ def collect_async_defs(
 Visitor = Callable[[str, str, list[Rule]], list[Finding]]
 
 
-def lint_module(source: str, rel_path: str,
-                rules: list[Rule]) -> list[Finding]:
-    tree = ast.parse(source, filename=rel_path)
-    return LintContext(source, rel_path, rules).run(tree)
+def lint_module(source: str, rel_path: str, rules: list[Rule],
+                tree: "ast.Module | None" = None,
+                suppressions: "Suppressions | None" = None) -> list[Finding]:
+    if tree is None:
+        tree = ast.parse(source, filename=rel_path)
+    return LintContext(source, rel_path, rules, suppressions).run(tree)

@@ -253,8 +253,8 @@ async def _race_crash(crash: _CrashState, coro) -> None:
                 t.cancel()
                 try:
                     await t
-                except (asyncio.CancelledError, Exception):  # etl-lint: ignore[cancellation-swallow] — cancel-then-drain of our own helper tasks
-                    pass
+                except (asyncio.CancelledError, Exception):
+                    pass  # cancel-then-drain of our own helper tasks
 
 
 async def _hard_kill(pipeline) -> None:

@@ -250,6 +250,15 @@ class ArenaLease:
         self._pool._give_back(self._taken)
         self._taken = []
 
+    def __enter__(self) -> "ArenaLease":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # context-manager form: `with pool.lease() as lease:` releases on
+        # every path — the shape etl-lint's arena-lease-leak rule treats
+        # as inherently safe
+        self.release()
+
 
 class StagingArenaPool:
     """Preallocated pack-buffer pool, bucketed by (shape, dtype).

@@ -102,8 +102,11 @@ class Pipeline:
         # stream count so concurrent copies don't multiply peak memory
         monitor_hb = self.supervisor.register("memory_monitor") \
             if self.supervisor is not None else None
-        self.memory_monitor = MemoryMonitor(self.config.backpressure,
-                                            heartbeat=monitor_hb)
+        # the ctor's chain reads the cgroup limit via open(): a kernfs
+        # read (microseconds, never blocks on I/O), once, at startup,
+        # before any worker spawns
+        self.memory_monitor = MemoryMonitor(  # etl-lint: ignore[blocking-call-in-async]
+            self.config.backpressure, heartbeat=monitor_hb)
         self.memory_monitor.start()
         self.batch_budget = BatchBudgetController(
             self.config.backpressure, self.config.batch.max_size_bytes)

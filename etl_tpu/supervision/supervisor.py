@@ -131,7 +131,7 @@ class Supervisor:
         while True:
             try:
                 self.sweep_once()
-            except Exception:  # the watchdog must outlive its own bugs  # etl-lint: ignore[cancellation-swallow] — CancelledError is BaseException, passes through
+            except Exception:  # the watchdog must outlive its own bugs; CancelledError is BaseException, passes through
                 logger.exception("supervision sweep failed")
             await asyncio.sleep(interval)
 

@@ -1,9 +1,18 @@
 #!/usr/bin/env python
 """Fast standalone etl-lint run over the repo (CI/pre-push entry point).
 
-    python scripts/lint_repo.py            # human output, exit 1 on violations
-    python scripts/lint_repo.py --json     # machine-readable findings
-    python scripts/lint_repo.py --no-baseline   # include grandfathered debt
+    python scripts/lint_repo.py                  # human output
+    python scripts/lint_repo.py --json           # machine-readable findings
+    python scripts/lint_repo.py --format=github  # ::error annotations for PRs
+    python scripts/lint_repo.py --no-baseline    # include grandfathered debt
+    python scripts/lint_repo.py --check-baseline # fail on stale suppressions
+    python scripts/lint_repo.py --explain        # chain traces per violation
+
+Exit codes (the CI contract): 0 clean after baseline, 1 findings (or
+stale suppressions under --check-baseline), 2 analyzer error (parse
+failure, bad path, bad baseline file) — a gate can distinguish "the
+tree is dirty" from "the analyzer itself broke" and a workflow step can
+annotate PRs inline from the github format.
 
 Equivalent to `python -m etl_tpu.analysis etl_tpu/` but runnable from the
 repo root without installing the package (it prepends the repo to

@@ -1,0 +1,20 @@
+# expect: arena-lease-leak=2
+# Two leak shapes the CFG pass proves path-sensitively:
+#  - conditional release: the not-taken branch reaches EXIT holding it;
+#  - fall-through release with raising work in between and no finally:
+#    an exception escapes holding the lease.
+
+
+def conditional_release(pool, staged, ok):
+    lease = pool.lease()
+    buf = lease.take((staged.n_rows, 8), "uint8")
+    if ok:
+        lease.release()
+    return buf
+
+
+def release_after_raising_work(pool, decoder, staged):
+    lease = pool.lease()
+    packed = decoder.pack(staged, arena=lease)
+    lease.release()
+    return packed

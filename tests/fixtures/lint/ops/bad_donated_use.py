@@ -1,0 +1,19 @@
+# expect: donated-buffer-use=2
+# Reading a buffer after passing it in a donate_argnums position: the
+# device owns the allocation now (XLA reuses it for scratch/output on
+# TPU/GPU); the host read sees poisoned memory.
+import jax
+
+_DECODE = jax.jit(lambda b, w: b, donate_argnums=(0,))
+
+
+def module_level_donate(bmat, widths):
+    out = _DECODE(bmat, widths)
+    checksum = bmat.sum()  # bmat was donated
+    return out, checksum
+
+
+def local_donate(kernel, bmat, lengths):
+    fn = jax.jit(kernel, donate_argnums=(0, 1))
+    out = fn(bmat, lengths)
+    return out, lengths[0]  # lengths was donated

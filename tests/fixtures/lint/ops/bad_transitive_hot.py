@@ -1,0 +1,21 @@
+# expect: hot-loop-host-transfer=2
+# The decorator is import-ALIASED — the lexical rule (which matches the
+# terminal decorator name in-module) still sees `hl`, but the resolver
+# follows the import to analysis.annotations.hot_loop. Both hot
+# functions reach jax.device_get through helpers one file away.
+from etl_tpu.analysis.annotations import hot_loop as hl
+
+from .helpers_device import fetch_all
+
+
+@hl
+def dispatch_row(batch):
+    return fetch_all(batch.pending)
+
+
+@hl
+def dispatch_nested(batch):
+    def drain():
+        return fetch_all(batch.pending)
+
+    return drain()

@@ -1,0 +1,34 @@
+# The safe shapes: release in finally (covers exception paths), the
+# context-manager form, release in except-with-reraise plus fall-through,
+# and explicit ownership transfer (the lease ESCAPES via a call/return —
+# the pipeline hands it to the fetch stage, which releases it there).
+
+
+def finally_release(pool, decoder, staged):
+    lease = pool.lease()
+    try:
+        return decoder.pack(staged, arena=lease)
+    finally:
+        lease.release()
+
+
+def with_release(pool, decoder, staged):
+    with pool.lease() as lease:
+        return decoder.pack(staged, arena=lease)
+
+
+def except_release_and_fallthrough(pool, decoder, staged):
+    lease = pool.lease()
+    try:
+        packed = decoder.pack(staged, arena=lease)
+    except BaseException:
+        lease.release()
+        raise
+    lease.release()
+    return packed
+
+
+def ownership_transfer(pool, decoder, staged, handle):
+    lease = pool.lease()
+    packed = decoder.pack(staged, arena=lease)
+    handle.set_result((packed, lease))

@@ -1,0 +1,23 @@
+# Safe donation patterns: read BEFORE the donating call, rebind the name
+# after (fresh buffer), non-donated positions stay readable, and the
+# donated result itself is the output.
+import jax
+
+_DECODE = jax.jit(lambda b, w: b, donate_argnums=(0,))
+
+
+def read_before_dispatch(bmat, widths):
+    checksum = bmat.sum()
+    out = _DECODE(bmat, widths)
+    return out, checksum
+
+
+def rebind_after_dispatch(bmat, widths, fresh):
+    out = _DECODE(bmat, widths)
+    bmat = fresh()
+    return out, bmat.sum()
+
+
+def non_donated_positions(bmat, widths):
+    out = _DECODE(bmat, widths)
+    return out, widths[0]  # position 1 is not donated

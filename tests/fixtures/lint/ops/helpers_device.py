@@ -1,0 +1,12 @@
+# Clean in isolation: sync helpers around device transfers are legal —
+# the bug is reaching them from a @hot_loop function or the event loop
+# (bad_transitive_hot.py / bad_transitive_device.py).
+import jax
+
+
+def fetch_all(values):
+    return [jax.device_get(v) for v in values]
+
+
+def force_upload(arr, dev):
+    return jax.device_put(arr, dev)

@@ -1,0 +1,28 @@
+# expect: lock-held-across-await=3
+# Foreign awaitables under a held lock: every other waiter queues behind
+# an await that has nothing to do with the locked resource. The sync
+# threading.Lock case is worse — the mutex blocks the whole loop.
+import asyncio
+import threading
+
+RETRY_GATE = asyncio.Lock()
+
+
+class BatchWriter:
+    def __init__(self, queue):
+        self._lock = asyncio.Lock()
+        self._mu = threading.Lock()
+        self._queue = queue
+
+    async def flush_with_sleep(self):
+        async with self._lock:
+            await asyncio.sleep(0.5)  # backoff while serialized
+
+    async def sync_mutex_across_await(self, destination):
+        with self._mu:
+            await destination.flush()
+
+
+async def module_lock_foreign_wait(destination):
+    async with RETRY_GATE:
+        await destination.flush()

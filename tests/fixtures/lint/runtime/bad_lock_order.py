@@ -1,0 +1,20 @@
+# expect: lock-order-inversion=1
+# state_lock -> flush_lock on the apply path, flush_lock -> state_lock
+# on the shutdown path: two tasks interleaving these deadlock. One
+# finding per unordered pair, carrying both witness chains.
+import asyncio
+
+STATE_LOCK = asyncio.Lock()
+FLUSH_LOCK = asyncio.Lock()
+
+
+async def apply_path(events):
+    async with STATE_LOCK:
+        async with FLUSH_LOCK:
+            return len(events)
+
+
+async def shutdown_path():
+    async with FLUSH_LOCK:
+        async with STATE_LOCK:
+            return True

@@ -1,0 +1,21 @@
+# expect: blocking-call-in-async=3
+# The lexical rule misses every one of these:
+#  - do_backoff wraps time.sleep one file away (chain finding #1);
+#  - fetch_config wraps open() (chain finding #2);
+#  - `snooze` is time.sleep behind an import alias (depth-0 alias
+#    resolution, finding #3) — the hole annotations.py used to document.
+from time import sleep as snooze
+
+from .helpers_blocking import do_backoff, fetch_config
+
+
+async def pump_with_helper_sleep():
+    do_backoff(3)
+
+
+async def load_with_helper_open():
+    return fetch_config("/etc/etl.conf")
+
+
+async def aliased_sleep():
+    snooze(1)

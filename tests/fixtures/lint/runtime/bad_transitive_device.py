@@ -1,0 +1,9 @@
+# expect: device-sync-in-async=1
+# Cross-DIRECTORY chain: an event-loop coroutine in runtime/ reaches a
+# definite device sync (jax.device_get) through an ops/ helper. The
+# lexical rule only sees the helper call.
+from ..ops.helpers_device import fetch_all
+
+
+async def drain_results(pending):
+    return fetch_all(pending)

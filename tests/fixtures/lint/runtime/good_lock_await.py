@@ -1,0 +1,31 @@
+# The sanctioned own-resource idiom (docs/CONCURRENCY.md): a lock may be
+# held across awaits ON THE RESOURCE IT SERIALIZES — the owner's own
+# connection/channel, including locals derived from self and wait_for
+# wrappers. Mirrors PostgresStore._txn and the snowflake per-table locks.
+import asyncio
+
+
+class Store:
+    def __init__(self, conn):
+        self._lock = asyncio.Lock()
+        self._conn = conn
+
+    async def execute(self, sql):
+        async with self._lock:
+            return await self._conn.execute(sql)
+
+    async def txn(self, statements):
+        async with self._lock:
+            handle = self._conn.cursor()
+            for sql in statements:
+                await handle.execute(sql)
+            return await asyncio.wait_for(self._conn.commit(), 30)
+
+    async def outside_the_lock(self, destination):
+        async with self._lock:
+            sql = self._render()
+            await self._conn.execute(sql)
+        await destination.flush()  # foreign await AFTER release: fine
+
+    def _render(self):
+        return "SELECT 1"

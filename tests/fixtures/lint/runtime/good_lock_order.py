@@ -1,0 +1,23 @@
+# Consistent global order (state before flush, everywhere) — including
+# through a call: the interprocedural pass sees the same order on both
+# paths and stays quiet.
+import asyncio
+
+STATE_LOCK = asyncio.Lock()
+FLUSH_LOCK = asyncio.Lock()
+
+
+async def apply_path(events):
+    async with STATE_LOCK:
+        async with FLUSH_LOCK:
+            return len(events)
+
+
+async def shutdown_path():
+    async with STATE_LOCK:
+        return await _drain()
+
+
+async def _drain():
+    async with FLUSH_LOCK:
+        return True

@@ -1,0 +1,14 @@
+# Clean in isolation: a sync module-level helper may sleep — the bug is
+# CALLING it from the event loop (bad_transitive_blocking.py's entry).
+# The lexical rule can't see through the call; the interprocedural pass
+# anchors its finding in the CALLER's file, so this one expects zero.
+import time
+
+
+def do_backoff(attempt: int) -> None:
+    time.sleep(0.1 * attempt)
+
+
+def fetch_config(path: str) -> str:
+    with open(path) as f:
+        return f.read()

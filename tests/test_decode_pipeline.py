@@ -416,3 +416,9 @@ class TestBenchSmoke:
         # with supervision live against the same floor)
         assert out["heartbeat_overhead_under_1pct"] is True, out
         assert out["heartbeat_overhead_ratio_at_floor"] < 0.01
+        # static-analysis satellite: the whole-program etl-lint pass must
+        # complete inside its wall-clock budget so it stays cheap enough
+        # to gate every PR
+        assert out["static_analysis_under_budget"] is True, out
+        assert out["static_analysis_seconds"] < \
+            out["static_analysis_budget_s"]

@@ -1,10 +1,14 @@
 """etl-lint: fixture expectations, baseline round-trip, CLI contract,
-and the tier-1 repo-wide enforcement run.
+the interprocedural pass (call graph, contexts, CFG rules), and the
+tier-1 repo-wide enforcement + determinism runs.
 
 Fixture files under tests/fixtures/lint/ mirror the package layout
 (runtime/, ops/, destinations/) so path-scoped rules apply exactly as
 they do on the real tree. Each declares its expected finding counts in
-`# expect: <rule>=<n>` header lines; absent rules expect zero.
+`# expect: <rule>=<n>` header lines; absent rules expect zero. The tree
+is analyzed as ONE project (cross-module chains resolve, anchored in
+the ENTRY module's file), then findings are grouped per file against
+each file's own expectations.
 """
 
 from __future__ import annotations
@@ -19,11 +23,21 @@ import pytest
 from etl_tpu.analysis import analyze_source, baseline as baseline_mod
 from etl_tpu.analysis.cli import main as cli_main
 from etl_tpu.analysis.findings import Finding, canonical_path
-from etl_tpu.analysis.rules import (RULE_NAMES, analyze_paths,
-                                    repo_package_dir)
+from etl_tpu.analysis.rules import (INTERPROC_RULE_NAMES, RULE_NAMES,
+                                    analyze_paths, repo_package_dir)
 
 FIXTURES = Path(__file__).parent / "fixtures" / "lint"
 _EXPECT_RE = re.compile(r"^#\s*expect:\s*([a-z-]+)=(\d+)\s*$", re.M)
+
+#: path-head scopes of the whole-program rules (interproc.py has no
+#: per-module Rule objects, so negative coverage is computed from these)
+_INTERPROC_SCOPES = {
+    "arena-lease-leak": None,  # everywhere
+    "donated-buffer-use": None,
+    "lock-held-across-await": ("runtime", "destinations", "postgres",
+                               "store", "supervision", "api", "ops"),
+    "lock-order-inversion": None,
+}
 
 
 def fixture_files() -> list[Path]:
@@ -35,9 +49,21 @@ def expected_counts(source: str) -> Counter:
                     for rule, n in _EXPECT_RE.findall(source)})
 
 
+_PROJECT_RUN: "list[Finding] | None" = None
+
+
+def project_findings() -> list[Finding]:
+    """One whole-tree analysis of the fixture project, cached — the
+    cross-module fixtures only resolve when scanned together."""
+    global _PROJECT_RUN
+    if _PROJECT_RUN is None:
+        _PROJECT_RUN = analyze_paths([str(FIXTURES)])
+    return _PROJECT_RUN
+
+
 def lint_fixture(path: Path) -> list[Finding]:
     rel = path.relative_to(FIXTURES).as_posix()
-    return analyze_source(path.read_text(), rel)
+    return [f for f in project_findings() if f.path == canonical_path(rel)]
 
 
 class TestFixtures:
@@ -50,10 +76,10 @@ class TestFixtures:
             [f.render() for f in lint_fixture(path)]
 
     def test_every_rule_has_positive_and_negative_coverage(self) -> None:
-        """Acceptance criterion: rules 1-6 each have at least one fixture
-        that triggers them and at least one CLEAN fixture whose path the
-        rule actually applies to (a clean fixture outside a rule's path
-        scope proves nothing about that rule)."""
+        """Acceptance criterion: every rule — lexical AND whole-program —
+        has at least one fixture that triggers it and at least one CLEAN
+        fixture whose path the rule actually applies to (a clean fixture
+        outside a rule's path scope proves nothing about that rule)."""
         from etl_tpu.analysis.rules import default_rules
 
         positive: set[str] = set()
@@ -65,6 +91,9 @@ class TestFixtures:
             if sum(counts.values()) == 0:
                 negative |= {r.name for r in default_rules()
                              if r.applies_to(rel)}
+                head = rel.split("/", 1)[0]
+                negative |= {r for r, scopes in _INTERPROC_SCOPES.items()
+                             if scopes is None or head in scopes}
         assert positive == set(RULE_NAMES), \
             f"rules without a positive fixture: " \
             f"{set(RULE_NAMES) - positive}"
@@ -308,11 +337,26 @@ class TestCli:
 
 
 class TestAnalyzePaths:
-    def test_directory_scan_matches_per_file(self) -> None:
+    def test_directory_scan_supersets_per_file(self) -> None:
+        """Single-file runs see a subset of the project run: lexical
+        findings (and single-module chains) agree exactly; what the
+        directory run ADDS is precisely the cross-module chain findings
+        a per-file run cannot resolve."""
         per_dir = analyze_paths([str(FIXTURES)])
-        per_file = [f for p in fixture_files() for f in lint_fixture(p)]
-        assert sorted(f.fingerprint for f in per_dir) \
-            == sorted(f.fingerprint for f in per_file)
+        per_file = [
+            f for p in fixture_files()
+            for f in analyze_source(p.read_text(),
+                                    p.relative_to(FIXTURES).as_posix())]
+        dir_fps = Counter(f.fingerprint for f in per_dir)
+        file_fps = Counter(f.fingerprint for f in per_file)
+        assert all(dir_fps[fp] >= n for fp, n in file_fps.items()), \
+            "per-file findings missing from the directory run"
+        only_dir = +(dir_fps - file_fps)
+        cross_module = {f.fingerprint for f in per_dir
+                        if len(set(p for p, _l in f.chain_sites)) > 1}
+        assert set(only_dir) <= cross_module, \
+            "directory-only findings must all be cross-module chains"
+        assert only_dir, "cross-module fixtures must add chain findings"
 
     def test_single_file_arg_keeps_path_scope_and_fingerprint(self) -> None:
         """Scanning one file must apply the same path-scoped rules and
@@ -443,3 +487,333 @@ class TestRuntimeFixes:
         ack = await writer
         with pytest.raises(EtlError):
             await asyncio.wait_for(ack.wait_durable(), timeout=5)
+
+
+class TestInterproc:
+    """Call-graph / context-propagation edge cases (PR 5 satellite)."""
+
+    def test_nested_sync_in_async_in_sync(self) -> None:
+        """A sync def nested in an async def nested in a sync def: the
+        blocking call fires only when the async layer CALLS the inner
+        sync def directly (on the loop) — with the chain as proof."""
+        src = ("import time\n\n\n"
+               "def outer():\n"
+               "    async def middle():\n"
+               "        def inner():\n"
+               "            time.sleep(1)\n"
+               "        inner()\n"
+               "    return middle\n")
+        findings = analyze_source(src, "runtime/x.py")
+        chains = [f for f in findings
+                  if f.rule == "blocking-call-in-async" and f.chain]
+        assert len(chains) == 1, [f.render() for f in findings]
+        assert chains[0].chain == ("outer.middle", "outer.middle.inner")
+        assert chains[0].detail == "time.sleep"
+
+    def test_executor_lambda_is_not_an_edge(self) -> None:
+        """Handing a lambda/function REFERENCE to run_in_executor is the
+        sanctioned off-loop idiom — no call edge, no finding."""
+        src = ("import time\n\n\n"
+               "async def f(loop):\n"
+               "    def work():\n"
+               "        time.sleep(5)\n"
+               "    await loop.run_in_executor(None, work)\n"
+               "    await loop.run_in_executor(None, lambda: time.sleep(1))\n")
+        assert analyze_source(src, "runtime/x.py") == []
+
+    def test_import_aliased_decorator_resolves(self) -> None:
+        src = ("from etl_tpu.analysis.annotations import hot_loop as hl\n"
+               "import jax\n\n\n"
+               "@hl\n"
+               "def dispatch(v):\n"
+               "    return jax.device_get(v)\n")
+        findings = analyze_source(src, "ops/x.py")
+        assert [f.rule for f in findings] == ["hot-loop-host-transfer"], \
+            [f.render() for f in findings]
+
+    def test_cyclic_call_graph_terminates_with_shortest_chain(self) -> None:
+        src = ("import time\n\n\n"
+               "def a(n):\n"
+               "    time.sleep(1)\n"
+               "    return b(n - 1)\n\n\n"
+               "def b(n):\n"
+               "    return a(n) if n else 0\n\n\n"
+               "async def entry():\n"
+               "    return a(3)\n")
+        findings = analyze_source(src, "runtime/x.py")
+        chains = [f for f in findings if f.chain]
+        assert len(chains) == 1
+        assert chains[0].chain == ("entry", "a")  # shortest witness
+
+    def test_chain_trace_renders_resolvable_locations(self) -> None:
+        src = ("import time\n\n\n"
+               "def helper():\n"
+               "    time.sleep(1)\n\n\n"
+               "async def entry():\n"
+               "    helper()\n")
+        (finding,) = analyze_source(src, "runtime/x.py")
+        assert finding.chain == ("entry", "helper")
+        assert finding.chain_text() == "entry → helper: time.sleep"
+        explain = finding.explain()
+        # one resolvable path:line per hop: the entry's call site, then
+        # the sink's own line inside the helper
+        assert "runtime/x.py:9: entry" in explain
+        assert "runtime/x.py:5: helper" in explain
+        assert "sink: time.sleep" in explain
+        assert finding.line == 9  # anchored at the entry's call site
+
+    def test_self_method_resolution_through_base_class(self) -> None:
+        src = ("import time\n\n\n"
+               "class Base:\n"
+               "    def slow(self):\n"
+               "        time.sleep(1)\n\n\n"
+               "class Worker(Base):\n"
+               "    async def run(self):\n"
+               "        self.slow()\n")
+        findings = analyze_source(src, "runtime/x.py")
+        assert [f.rule for f in findings] == ["blocking-call-in-async"]
+        assert findings[0].chain == ("Worker.run", "Base.slow")
+
+    def test_constructor_edge_reaches_init(self) -> None:
+        src = ("import sqlite3\n\n\n"
+               "class Db:\n"
+               "    def __init__(self, path):\n"
+               "        self.conn = sqlite3.connect(path)\n\n\n"
+               "async def open_db(path):\n"
+               "    return Db(path)\n")
+        findings = analyze_source(src, "runtime/x.py")
+        assert [f.chain for f in findings] == [("open_db", "Db.__init__")]
+
+    def test_unresolved_receiver_is_not_traversed(self) -> None:
+        """obj.method() on an unknown receiver: no edge, no finding —
+        the documented precision limit."""
+        src = ("async def f(obj):\n"
+               "    obj.anything()\n")
+        assert analyze_source(src, "runtime/x.py") == []
+
+
+class TestMultilineSuppression:
+    def test_ignore_on_first_line_covers_continuation(self) -> None:
+        """Satellite: a suppression on the statement's first line covers
+        findings the AST anchors on continuation lines."""
+        src = ("import time\n\n\n"
+               "async def f(x):\n"
+               "    y = (x +\n"
+               "         time.sleep(1))\n")
+        findings = analyze_source(src, "runtime/x.py")
+        assert len(findings) == 1 and findings[0].line == 6
+        suppressed = src.replace(
+            "y = (x +", "y = (x +  # etl-lint: ignore[blocking-call-in-async]")
+        assert analyze_source(suppressed, "runtime/x.py") == []
+
+    def test_compound_header_suppression_does_not_blanket_body(self) -> None:
+        """An ignore on a `with`/`if` header line must NOT suppress
+        findings inside the body — only header continuation lines."""
+        src = ("import time\n\n\n"
+               "async def f(x):  # etl-lint: ignore[blocking-call-in-async]\n"
+               "    time.sleep(1)\n")
+        findings = analyze_source(src, "runtime/x.py")
+        assert [f.line for f in findings] == [5]
+
+    def test_suppression_on_continuation_line_still_works(self) -> None:
+        src = ("import time\n\n\n"
+               "async def f(x):\n"
+               "    y = (x +\n"
+               "         time.sleep(1))"
+               "  # etl-lint: ignore[blocking-call-in-async]\n")
+        assert analyze_source(src, "runtime/x.py") == []
+
+
+class TestCheckBaseline:
+    def test_detects_stale_baseline_entry(self, tmp_path, capsys) -> None:
+        target = tmp_path / "runtime"
+        target.mkdir()
+        (target / "clean.py").write_text("def f():\n    return 1\n")
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps({"version": 1, "entries": {
+            "blocking-call-in-async|runtime/clean.py|f|time.sleep":
+                {"count": 1, "reason": "gone"}}}))
+        rc = cli_main([str(tmp_path), "--baseline", str(bl),
+                       "--check-baseline", "-q"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "stale baseline entry" in out
+
+    def test_detects_unused_inline_ignore(self, tmp_path, capsys) -> None:
+        target = tmp_path / "runtime"
+        target.mkdir()
+        (target / "mod.py").write_text(
+            "def f():\n"
+            "    return 1  # etl-lint: ignore[orphaned-task]\n")
+        rc = cli_main([str(tmp_path), "--check-baseline", "--baseline",
+                       str(tmp_path / "none.json"), "-q"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "ignore[orphaned-task] suppresses nothing" in out
+
+    def test_used_ignore_and_live_baseline_pass(self, tmp_path,
+                                                capsys) -> None:
+        target = tmp_path / "runtime"
+        target.mkdir()
+        (target / "mod.py").write_text(
+            "import time\n\n\n"
+            "async def f():\n"
+            "    time.sleep(1)  # etl-lint: ignore[blocking-call-in-async]\n")
+        rc = cli_main([str(tmp_path), "--check-baseline", "--baseline",
+                       str(tmp_path / "none.json"), "-q"])
+        assert rc == 0, capsys.readouterr().out
+        capsys.readouterr()
+
+    def test_shipped_baseline_is_live(self, capsys) -> None:
+        """The committed baseline has no dead entries and every inline
+        ignore in the tree still suppresses something."""
+        rc = cli_main([str(repo_package_dir()), "--check-baseline", "-q"])
+        out = capsys.readouterr()
+        assert rc == 0, out.out + out.err
+
+
+class TestCliFormats:
+    def test_github_format_emits_workflow_commands(self, capsys) -> None:
+        rc = cli_main([str(FIXTURES), "--no-baseline",
+                       "--format=github", "-q"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert out.startswith("::error file=")
+        assert "title=etl-lint blocking-call-in-async" in out
+        assert "\n\n" not in out.strip()  # one annotation per line
+
+    def test_callgraph_dump(self, capsys) -> None:
+        rc = cli_main([str(FIXTURES), "--callgraph"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert ("runtime/bad_transitive_blocking.py::pump_with_helper_sleep"
+                " -> runtime/helpers_blocking.py::do_backoff") in out
+
+    def test_explain_prints_chain_hops(self, capsys) -> None:
+        rc = cli_main([str(FIXTURES), "--no-baseline", "--explain", "-q"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "sink: time.sleep" in out
+        assert "runtime/helpers_blocking.py:" in out
+
+    def test_json_includes_chain(self, capsys) -> None:
+        rc = cli_main([str(FIXTURES / "runtime"), "--no-baseline", "--json"])
+        assert rc == 1
+        data = json.loads(capsys.readouterr().out)
+        chained = [v for v in data["violations"] if v["chain"]]
+        assert chained and all("chain_sites" in v for v in chained)
+
+
+class TestTier1Enforcement:
+    def test_repo_wide_interproc_run_is_deterministic(self) -> None:
+        """Tier-1: two full interprocedural runs produce byte-identical
+        findings AND chain traces — BFS order, lock-pair ordering, and
+        dataflow worklists are all deterministic."""
+        pkg = str(repo_package_dir())
+        one = analyze_paths([pkg])
+        two = analyze_paths([pkg])
+        key = [(f.fingerprint, f.line, f.col, f.chain, f.chain_sites,
+                f.message) for f in one]
+        assert key == [(f.fingerprint, f.line, f.col, f.chain,
+                        f.chain_sites, f.message) for f in two]
+        assert one, "repo-wide run found nothing: analyzer broken"
+
+    def test_arena_lease_is_a_context_manager(self) -> None:
+        """Drive-by: the `with pool.lease()` form the arena-lease-leak
+        rule sanctions releases on exceptions for real."""
+        from etl_tpu.ops.staging import StagingArenaPool
+
+        pool = StagingArenaPool()
+        with pytest.raises(RuntimeError):
+            with pool.lease() as lease:
+                lease.take((8,), "uint8")
+                assert pool.outstanding == 1
+                raise RuntimeError("boom")
+        assert pool.outstanding == 0
+
+
+class TestReviewRegressions:
+    """Fixes from the PR-5 review pass, pinned."""
+
+    def test_donated_rebind_idiom_is_safe(self) -> None:
+        """`buf = step(buf)` rebinds the name to the jit OUTPUT buffer —
+        the canonical donation idiom must not stay tainted."""
+        src = ("import jax\n\n"
+               "step = jax.jit(lambda b: b, donate_argnums=(0,))\n\n\n"
+               "def loop(buf):\n"
+               "    buf = step(buf)\n"
+               "    return buf.sum()\n")
+        assert analyze_source(src, "ops/x.py") == []
+
+    def test_nested_finally_release_is_clean(self) -> None:
+        """An inner finally's exit must route through the OUTER finally,
+        not straight to EXIT past the release."""
+        src = ("def f(pool, work, log):\n"
+               "    lease = pool.lease()\n"
+               "    try:\n"
+               "        try:\n"
+               "            work()\n"
+               "        finally:\n"
+               "            log()\n"
+               "    finally:\n"
+               "        lease.release()\n")
+        assert analyze_source(src, "ops/x.py") == []
+
+    def test_wait_for_wrapped_await_keeps_the_edge(self, tmp_path) -> None:
+        """The unbounded-await rule tells authors to wrap awaits in
+        asyncio.wait_for — complying must not hide the callee from the
+        transitive blocking rule. The helper lives OUTSIDE the
+        event-loop scopes (it is not its own entry for rule 1), so the
+        sink is only reachable through the wrapped await edge."""
+        import ast as ast_mod
+
+        from etl_tpu.analysis.callgraph import Project
+
+        src = ("import asyncio\n"
+               "import time\n\n\n"
+               "async def helper():\n"
+               "    time.sleep(1)\n\n\n"
+               "async def entry():\n"
+               "    await asyncio.wait_for(helper(), 5)\n")
+        # the call-graph layer: helper() inside wait_for is awaited
+        proj = Project.build([("runtime/x.py", src, ast_mod.parse(src))])
+        entry = proj.modules["runtime/x.py"].functions["entry"]
+        helper_site = next(s for s in entry.calls if s.lexical == "helper")
+        assert helper_site.awaited and helper_site.resolved is not None
+        # end to end: an ops/ coroutine awaited via wait_for from
+        # runtime/ still produces the chain finding
+        (tmp_path / "ops").mkdir()
+        (tmp_path / "runtime").mkdir()
+        (tmp_path / "ops" / "helpers.py").write_text(
+            "import time\n\n\nasync def drain():\n    time.sleep(1)\n")
+        (tmp_path / "runtime" / "worker.py").write_text(
+            "import asyncio\n\nfrom ..ops.helpers import drain\n\n\n"
+            "async def entry():\n"
+            "    await asyncio.wait_for(drain(), 5)\n")
+        findings = analyze_paths([str(tmp_path)])
+        chains = [f for f in findings if f.chain]
+        assert [c.chain for c in chains] == [("entry", "drain")], \
+            [f.render() for f in findings]
+
+    def test_lease_container_handoff_escapes(self) -> None:
+        """`self._pending.append(lease)` / `q.put_nowait(lease)` hand
+        ownership to a later consumer — not leaks."""
+        src = ("def f(self, pool, q):\n"
+               "    lease = pool.lease()\n"
+               "    self._pending.append(lease)\n\n\n"
+               "def g(pool, q):\n"
+               "    lease = pool.lease()\n"
+               "    q.put_nowait(lease)\n")
+        assert analyze_source(src, "ops/x.py") == []
+
+    def test_github_annotation_path_only_prefixes_package_files(
+            self, capsys, monkeypatch) -> None:
+        import os
+
+        monkeypatch.chdir(Path(__file__).resolve().parent.parent)
+        rc = cli_main([str(FIXTURES), "--no-baseline",
+                       "--format=github", "-q"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        # fixture files are NOT under etl_tpu/ — no bogus prefix
+        assert "file=etl_tpu/runtime/bad_" not in out
+        assert "file=runtime/bad_" in out
