@@ -230,8 +230,31 @@ def run_smoke() -> dict:
         engine="tpu", destination="null"))
     stream_eps = stream["end_to_end_events_per_second"]
     stream_ok = stream_eps >= floor
-    heartbeat_overhead_ratio = per_beat_s * floor
+    # the heartbeat budget keeps its own calibration (PR 4's 12k ev/s
+    # per-event budget) instead of riding the streaming floor: the floor
+    # tripled for EGRESS reasons (columnar fetch-to-wire), and pricing
+    # one pessimistic beat-per-event against the tightened budget would
+    # fail the gate with zero instrumentation change (the loop actually
+    # beats once per drained window, ≤1 per 4096 events under saturation)
+    hb_budget = floors.get("heartbeat_budget_events_per_sec", 12_000)
+    heartbeat_overhead_ratio = per_beat_s * hb_budget
     heartbeat_ok = heartbeat_overhead_ratio < 0.01
+
+    # columnar-egress gates (ISSUE 6): (a) ZERO TableRow constructions on
+    # the streamed CDC hot path — the decode engine's batches must reach
+    # the destination columnar, the row path creeping back fails here
+    # before it costs 10x in production; (b) each destination encoder in
+    # isolation (ColumnarBatch → wire bytes) above its per-encoder floor,
+    # so a regression names the guilty encoder
+    rows_constructed = stream.get("table_rows_constructed", -1)
+    no_row_path = rows_constructed == 0
+    egress = harness.run_egress(
+        n_rows=floors.get("egress_smoke_rows", 4096),
+        n_iters=floors.get("egress_smoke_iters", 3))
+    egress_floors = floors.get("egress_floors", {})
+    egress_failures = [k for k, v in egress_floors.items()
+                      if egress.get(k, 0) < v]
+    egress_ok = not egress_failures
 
     # static-analysis budget gate (ISSUE 5 CI satellite): the full
     # whole-program etl-lint pass (call graph + context propagation +
@@ -251,7 +274,13 @@ def run_smoke() -> dict:
     return {
         "mode": "smoke",
         "ok": bool(identical and stages_observed and stream_ok
-                   and heartbeat_ok and lint_ok),
+                   and heartbeat_ok and lint_ok and no_row_path
+                   and egress_ok),
+        "streaming_table_rows_constructed": rows_constructed,
+        "streaming_zero_row_materialization": bool(no_row_path),
+        "egress_encoders_above_floor": bool(egress_ok),
+        "egress_failures": egress_failures,
+        **{k: v for k, v in egress.items() if k.endswith("_per_sec")},
         "static_analysis_seconds": round(lint_seconds, 3),
         "static_analysis_budget_s": lint_budget_s,
         "static_analysis_under_budget": bool(lint_ok),
@@ -347,7 +376,12 @@ def main():
     parser = argparse.ArgumentParser(prog="bench.py")
     parser.add_argument("--mode", default="decode",
                         choices=["decode", "table_copy", "table_streaming",
-                                 "wide_row", "lag"])
+                                 "wide_row", "lag", "egress"])
+    parser.add_argument("--egress", dest="egress", action="store_true",
+                        help="alias for --mode egress: measure each "
+                             "destination encoder in isolation "
+                             "(ColumnarBatch → wire bytes) against the "
+                             "egress_floors in BENCH_FLOOR.json")
     parser.add_argument("--engine", default="tpu",
                         choices=["tpu", "cpu", "pallas"])
     parser.add_argument("--smoke", action="store_true",
@@ -355,6 +389,24 @@ def main():
                              "pipelined decode == serial decode; exit 1 on "
                              "mismatch")
     args = parser.parse_args()
+    if args.egress:
+        args.mode = "egress"
+    if args.mode == "egress":
+        # encoder isolation runs on the CPU backend by definition — the
+        # encoders are host code; never touch the accelerator tunnel
+        jax.config.update("jax_platforms", "cpu")
+        from etl_tpu.benchmarks import harness
+
+        out = harness.run_egress()
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_FLOOR.json")) as f:
+            efloors = json.load(f).get("egress_floors", {})
+        out["floors"] = efloors
+        out["failures"] = [k for k, v in efloors.items()
+                           if out.get(k, 0) < v]
+        out["ok"] = not out["failures"]
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
     if args.smoke:
         # force the CPU backend — the smoke gate must never touch the
         # accelerator tunnel (same config-knob dance as tests/conftest.py)

@@ -530,6 +530,48 @@ class UnboundedAwait(Rule):
             f"shutdown (or_shutdown), or justify with an inline ignore")
 
 
+# -- rule 13 ------------------------------------------------------------------
+
+#: lexical row-path sinks: constructing row objects, expanding a batch
+#: into per-row events, or transposing rows into/out of a ColumnarBatch.
+#: Inside a @hot_loop batch-encode entry point any of these means the
+#: columnar egress path has fallen back to per-row Python — the exact
+#: regression the fetch-to-wire refactor (ROADMAP item 2) removed.
+ROW_MATERIALIZATION_CTORS = frozenset({"TableRow", "PartialTableRow"})
+ROW_MATERIALIZATION_FREE_CALLS = frozenset({"expand_batch_events"})
+ROW_MATERIALIZATION_METHODS = frozenset({"to_rows", "from_rows"})
+
+
+class HotLoopRowMaterialization(Rule):
+    """`TableRow(...)` / `.to_rows()` / `.from_rows(...)` /
+    `expand_batch_events(...)` inside a `@hot_loop` function: the columnar
+    egress hot path is materializing Python row objects. Intentional
+    compatibility-shim uses carry an inline ignore with a justification
+    (they are the row fallback, not the hot path)."""
+
+    name = "hot-loop-row-materialization"
+
+    def on_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not ctx.in_hot_loop:
+            return
+        term = terminal_name(node.func)
+        subject = None
+        if term in ROW_MATERIALIZATION_CTORS \
+                or term in ROW_MATERIALIZATION_FREE_CALLS:
+            subject = f"{term}(…)"
+        elif term in ROW_MATERIALIZATION_METHODS \
+                and isinstance(node.func, ast.Attribute):
+            subject = f".{term}(…)"
+        if subject is None:
+            return
+        ctx.report(
+            self.name, node, subject,
+            f"row materialization `{subject}` inside a @hot_loop "
+            f"batch-encode entry point: encode from the ColumnarBatch "
+            f"column-at-a-time instead, or justify the compatibility "
+            f"shim with an inline ignore")
+
+
 # -- entry points -------------------------------------------------------------
 
 def default_rules() -> list[Rule]:
@@ -542,6 +584,7 @@ def default_rules() -> list[Rule]:
         HotLoopHostTransfer(),
         UnboundedRetry(),
         UnboundedAwait(),
+        HotLoopRowMaterialization(),
     ]
 
 

@@ -360,6 +360,13 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
 
     routed0 = _routed()
     stages0 = _pipeline_metrics()
+    # row-materialization gate input: zero constructions over the measured
+    # window = the egress path stayed columnar fetch-to-wire (the smoke
+    # gate asserts this on the null destination; 'memory' exercises the
+    # row-expansion shim and reports its cost honestly)
+    from ..telemetry.metrics import publish_table_rows_constructed
+
+    rows_constructed0 = publish_table_rows_constructed()
 
     t_prod0 = time.perf_counter()
     produced = 0
@@ -451,6 +458,8 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
         "replication_lag_p95_ms":
             round(pct(0.95), 2) if lags_ms else None,
         "replication_lag_max_ms": round(lags_ms[-1], 2) if lags_ms else None,
+        "table_rows_constructed":
+            publish_table_rows_constructed() - rows_constructed0,
     }
 
 
@@ -501,6 +510,105 @@ async def run_lag_vs_rate(engine: str = "tpu",
         "max_fill_ms": max_fill_ms,
         "rates": rows,
     }
+
+
+# ---------------------------------------------------------------------------
+# egress (per-destination encoder isolation: ColumnarBatch → wire bytes)
+# ---------------------------------------------------------------------------
+
+
+def _egress_batch(n_rows: int):
+    """A decode-engine-shaped ColumnarBatch (dense ints + Arrow strings)
+    on the pgbench-CDC column mix, produced through the REAL staging +
+    decode path so the encoders see production column storage."""
+    from ..models import (ColumnSchema, Oid, ReplicatedTableSchema,
+                          TableName, TableSchema)
+    from ..ops.engine import DeviceDecoder
+    from ..ops.wal import concat_payloads, stage_wal_batch
+    from ..postgres.codec.pgoutput import encode_insert
+
+    tid = 16390
+    schema = ReplicatedTableSchema.with_all_columns(TableSchema(
+        tid, TableName("public", "bench_egress"),
+        (ColumnSchema("id", Oid.INT8, nullable=False, primary_key_ordinal=1),
+         ColumnSchema("bucket", Oid.INT4),
+         ColumnSchema("val", Oid.FLOAT8),
+         ColumnSchema("note", Oid.TEXT))))
+    payloads = [encode_insert(tid, [str(i).encode(), str(i % 97).encode(),
+                                    (b"%d.5" % i), b"note-%d" % i])
+                for i in range(n_rows)]
+    buf, offs, lens = concat_payloads(payloads)
+    wal = stage_wal_batch(buf, offs, lens, 4)
+    batch = DeviceDecoder(schema).decode(wal.staged)
+    return schema, batch
+
+
+def run_egress(n_rows: int = 16_384, n_iters: int = 5) -> dict:
+    """Measure each destination encoder in ISOLATION (ColumnarBatch →
+    wire bytes): rows/s and bytes/s for the BigQuery proto encoder, the
+    ClickHouse TSV renderer, and the Parquet row-group writer — so an
+    egress regression names the guilty encoder instead of hiding inside
+    the end-to-end streaming number. Floors: BENCH_FLOOR.json
+    `egress_floors` (rows/s, min over encoders asserted by --smoke)."""
+    import io
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ..destinations import bq_proto
+    from ..destinations.clickhouse import render_batch_tsv_columnar
+    from ..destinations.util import (CHANGE_SEQUENCE_COLUMN,
+                                     CHANGE_TYPE_COLUMN, change_type_arrow,
+                                     change_type_batch,
+                                     sequence_number_arrow,
+                                     sequence_number_batch)
+
+    schema, batch = _egress_batch(n_rows)
+    cts = np.zeros(n_rows, dtype=np.int64)
+    lsns = np.arange(n_rows, dtype=np.uint64) + (1 << 40)
+    txos = np.arange(n_rows, dtype=np.uint64)
+    ords = np.arange(n_rows, dtype=np.uint64)
+
+    def timed(fn):
+        times = []
+        nbytes = 0
+        for _ in range(n_iters):
+            t0 = time.perf_counter()
+            nbytes = fn()
+            times.append(time.perf_counter() - t0)
+        # min over iters: shared-host noise is one-sided (bench.py policy)
+        dt = min(times)
+        return round(n_rows / dt), round(nbytes / dt)
+
+    def bq():
+        labels = change_type_batch(cts).tolist()
+        seqs = sequence_number_batch(lsns, txos, ords)
+        rows = bq_proto.encode_batch(schema, batch, labels, seqs)
+        return sum(len(r) for r in rows)
+
+    def clickhouse():
+        labels = [t.decode() for t in change_type_batch(cts).tolist()]
+        seqs = [s.decode()
+                for s in sequence_number_batch(lsns, txos, ords)]
+        return len(render_batch_tsv_columnar(schema, batch, labels, seqs))
+
+    def parquet():
+        rb = batch.to_arrow()
+        rb = rb.append_column(CHANGE_TYPE_COLUMN, change_type_arrow(cts))
+        rb = rb.append_column(CHANGE_SEQUENCE_COLUMN,
+                              sequence_number_arrow(lsns, txos, ords))
+        sink = io.BytesIO()
+        pq.write_table(pa.Table.from_batches([rb]), sink)
+        return sink.tell()
+
+    out: dict = {"mode": "egress", "rows": n_rows, "iters": n_iters}
+    for name, fn in (("bq_proto", bq), ("clickhouse_tsv", clickhouse),
+                     ("parquet", parquet)):
+        rps, bps = timed(fn)
+        out[f"{name}_rows_per_sec"] = rps
+        out[f"{name}_bytes_per_sec"] = bps
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,7 @@ from typing import Iterable, Sequence
 
 import numpy as np
 
+from ..analysis.annotations import hot_loop
 from ..chaos import failpoints
 from ..models.event import (ChangeType, DecodedBatchEvent, DeleteEvent, Event,
                             InsertEvent, UpdateEvent)
@@ -87,6 +88,28 @@ class Destination(abc.ABC):
     async def write_events(self, events: Sequence[Event]) -> WriteAck:
         """CDC path: ordered events (possibly spanning tables)."""
 
+    # -- columnar write seam (ROADMAP item 2) ---------------------------------
+    #
+    # The decode engine emits ColumnarBatches; these entry points let them
+    # reach the wire without materializing Python TableRow objects. Both
+    # default to the legacy row-oriented path so third-party / in-memory
+    # destinations keep working unchanged — columnar-native writers
+    # (BigQuery proto, ClickHouse TSV, lake/Iceberg Parquet) override them.
+
+    async def write_table_batch(self, schema: ReplicatedTableSchema,
+                                batch: ColumnarBatch) -> WriteAck:
+        """Initial-copy path, columnar seam: append one decoded batch.
+        Default: the existing `write_table_rows` implementation (which may
+        row-expand internally — the compatibility shim)."""
+        return await self.write_table_rows(schema, batch)
+
+    async def write_event_batches(self, events: Sequence[Event]) -> WriteAck:
+        """CDC path, columnar seam: ordered events where row changes may
+        arrive as `DecodedBatchEvent`s. Default: hand the events to the
+        legacy `write_events` path unchanged (destinations there expand
+        batches to per-row events themselves — the compatibility shim)."""
+        return await self.write_events(events)
+
     @abc.abstractmethod
     async def drop_table(self, table_id: TableId,
                          schema: ReplicatedTableSchema | None = None) -> None:
@@ -111,6 +134,93 @@ class _RowChange:
     change: ChangeType
     key: tuple
     row: TableRow | None
+
+
+def batch_event_columnar_ok(e: DecodedBatchEvent) -> bool:
+    """True when a batch event can be encoded column-at-a-time with row-path
+    semantics preserved: no old tuples (TOAST back-fill and the
+    key-changing-update split both need the old image, expand_batch_events
+    territory) and no TOAST-unchanged cells (which become column-wise PATCH
+    rows on the row path). Resolves the lazy decode — the consumer needs
+    the batch either way."""
+    if len(e.old_rows) > 0 or e.old_batch is not None:
+        return False
+    for c in e.batch.columns:
+        if c.toast_unchanged is not None and c.toast_unchanged.any():
+            return False
+    return True
+
+
+class CoalescedBatch:
+    """A contiguous same-table run of simple DecodedBatchEvents merged into
+    ONE columnar write: concatenated batch + per-row CDC identity arrays.
+    The unit the columnar destination encoders consume."""
+
+    __slots__ = ("schema", "batch", "change_types", "commit_lsns",
+                 "tx_ordinals")
+
+    def __init__(self, events: "list[DecodedBatchEvent]"):
+        self.schema = events[0].schema
+        self.batch = ColumnarBatch.concat([e.batch for e in events]) \
+            if len(events) > 1 else events[0].batch
+        if len(events) == 1:
+            self.change_types = events[0].change_types
+            self.commit_lsns = events[0].commit_lsns
+            self.tx_ordinals = events[0].tx_ordinals
+        else:
+            self.change_types = np.concatenate(
+                [e.change_types for e in events])
+            self.commit_lsns = np.concatenate(
+                [np.asarray(e.commit_lsns, dtype=np.uint64) for e in events])
+            self.tx_ordinals = np.concatenate(
+                [np.asarray(e.tx_ordinals, dtype=np.uint64) for e in events])
+
+    @property
+    def num_rows(self) -> int:
+        return self.batch.num_rows
+
+
+@hot_loop
+def sequential_batch_program(events: Iterable[Event]):
+    """Order-preserving destination program over the columnar seam: yields
+    ("batch", schema, CoalescedBatch) for runs of consecutive same-table
+    simple DecodedBatchEvents, plus whatever the legacy program yields for
+    everything in between — ("rows", schema, [row events…]) runs and
+    ("truncate", ev) / ("schema_change", ev) barriers. Events that cannot
+    take the columnar fast path (old tuples, TOAST-unchanged cells,
+    per-row events from the CPU engine) drop to the row path in place, so
+    WAL order is preserved across the two encodings.
+
+    @hot_loop: one call per CDC flush — etl-lint rule 13 keeps row
+    materialization out of it except the sanctioned fallback below."""
+    from .util import sequential_event_program
+
+    legacy: list[Event] = []
+    run: list[DecodedBatchEvent] = []
+
+    def flush_legacy():
+        if legacy:
+            yield from sequential_event_program(
+                expand_batch_events(legacy))  # etl-lint: ignore[hot-loop-row-materialization] — the sanctioned compatibility shim: events that CANNOT encode columnar (old tuples / TOAST / per-row) take the row path here by design
+            legacy.clear()
+
+    def flush_run():
+        if run:
+            yield ("batch", run[0].schema, CoalescedBatch(run))
+            run.clear()
+
+    for e in events:
+        if isinstance(e, DecodedBatchEvent) and batch_event_columnar_ok(e):
+            if run and (run[0].schema.id != e.schema.id
+                        or run[0].schema != e.schema):
+                yield from flush_run()
+            yield from flush_legacy()
+            run.append(e)
+        else:
+            yield from flush_run()
+            legacy.append(e)
+    yield from flush_run()
+    yield from flush_legacy()
 
 
 def expand_batch_events(events: Iterable[Event]) -> list[Event]:

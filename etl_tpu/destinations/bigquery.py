@@ -267,6 +267,102 @@ class BigQueryDestination(Destination):
         self._tasks.spawn(execute())
         return ack
 
+    # -- columnar seam --------------------------------------------------------
+
+    async def write_table_batch(self, schema: ReplicatedTableSchema,
+                                batch: ColumnarBatch) -> WriteAck:
+        """Copy path, columnar: proto rows serialized column-at-a-time
+        (bq_proto.encode_batch), byte-identical to the row path."""
+        import numpy as np
+
+        from .util import sequence_number_batch
+
+        table = await self._ensure_table(schema)
+        require_full_batch("bigquery", schema, batch)
+        n = batch.num_rows
+        zeros = np.zeros(n, dtype=np.uint64)
+        seqs = sequence_number_batch(zeros, zeros,
+                                     np.arange(n, dtype=np.uint64))
+        encoded = bq_proto.encode_batch(schema, batch, [b"UPSERT"] * n, seqs)
+        ack, fut = WriteAck.accepted()
+        self._tasks.spawn(self._append_encoded_and_resolve(
+            table, schema, encoded, fut))
+        return ack
+
+    async def write_event_batches(self, events: Sequence[Event]) -> WriteAck:
+        """CDC path, columnar: the ordered program executes in one
+        background task like write_events, but simple decoded batch runs
+        encode column-at-a-time; the global ordinal keeps
+        `_CHANGE_SEQUENCE_NUMBER` identical to the expanded row path."""
+        import numpy as np
+
+        from .base import sequential_batch_program
+        from .util import change_type_batch, sequence_number_batch
+
+        program = list(sequential_batch_program(events))
+        if not program:
+            return WriteAck.durable()
+        ack, fut = WriteAck.accepted()
+
+        async def execute() -> None:
+            try:
+                ordinal = 0
+                for op in program:
+                    if op[0] == "batch":
+                        _, schema, cb = op
+                        table = await self._ensure_table(schema)
+                        require_full_batch("bigquery", schema, cb.batch,
+                                           cb.change_types)
+                        n = cb.num_rows
+                        seqs = sequence_number_batch(
+                            cb.commit_lsns, cb.tx_ordinals,
+                            np.arange(ordinal, ordinal + n, dtype=np.uint64))
+                        labels = change_type_batch(cb.change_types).tolist()
+                        ordinal += n
+                        encoded = bq_proto.encode_batch(schema, cb.batch,
+                                                        labels, seqs)
+                        await self._append_encoded(table, schema, encoded)
+                    elif op[0] == "rows":
+                        _, schema, evs = op
+                        table = await self._ensure_table(schema)
+                        rows = []
+                        for e in evs:
+                            if isinstance(e, DeleteEvent):
+                                rows.append(self._row_tuple(
+                                    schema, e.old_row, ChangeType.DELETE,
+                                    e.sequence_key.with_ordinal(ordinal)))
+                            else:
+                                rows.append(self._row_tuple(
+                                    schema, e.row, ChangeType.INSERT,
+                                    e.sequence_key.with_ordinal(ordinal)))
+                            ordinal += 1
+                        await self._append_rows(table, schema, rows)
+                    elif op[0] == "truncate":
+                        for sch in op[1].schemas:
+                            await self.truncate_table(sch.id)
+                    else:
+                        await self._apply_schema_change(op[1])
+                if not fut.done():
+                    fut.set_result(None)
+            except BaseException as e:  # etl-lint: ignore[cancellation-swallow] — transferred to the ack future, not dropped
+                if not fut.done():
+                    fut.set_exception(e)
+
+        self._tasks.spawn(execute())
+        return ack
+
+    async def _append_encoded_and_resolve(self, table: str,
+                                          schema: ReplicatedTableSchema,
+                                          encoded: list[bytes],
+                                          fut: asyncio.Future) -> None:
+        try:
+            await self._append_encoded(table, schema, encoded)
+            if not fut.done():
+                fut.set_result(None)
+        except BaseException as e:  # etl-lint: ignore[cancellation-swallow] — transferred to the ack future, not dropped
+            if not fut.done():
+                fut.set_exception(e)
+
     async def _append_and_resolve(self, table: str,
                                   schema: ReplicatedTableSchema,
                                   rows: list[tuple],
@@ -373,14 +469,21 @@ class BigQueryDestination(Destination):
         Write errors (schema propagation; NOT_FOUND while the table exists)
         within a bounded window — exponential backoff with equal jitter
         (client.rs:197-216,1224-1285). Row-level errors are permanent."""
+        encoded = [bq_proto.encode_row(schema, values, ct, seq)
+                   for values, ct, seq in rows]
+        await self._append_encoded(table, schema, encoded)
+
+    async def _append_encoded(self, table: str,
+                              schema: ReplicatedTableSchema,
+                              encoded: list[bytes]) -> None:
+        """Append pre-serialized proto rows (the columnar encoder's output
+        or encode_row's) under the bounded Storage Write retry loop."""
         import random
         import time as _time
 
         assert self._append_sem is not None
         cfg = self.config
         descriptor = bq_proto.row_descriptor(schema)
-        encoded = [bq_proto.encode_row(schema, values, ct, seq)
-                   for values, ct, seq in rows]
         stream = self._write_stream(table)
         started = _time.monotonic()
         delay = cfg.storage_write_retry_delay_s

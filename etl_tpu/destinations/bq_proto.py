@@ -233,16 +233,25 @@ def _text(v) -> str:
     return str(v)
 
 
+_EPOCH_UTC = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
+_US = dt.timedelta(microseconds=1)
+
+
 def _tstz_micros(v) -> int:
     """Instant micros for a TIMESTAMPTZ proto field (declared TYPE_INT64 in
     the descriptor). Values with no instant representation — 'infinity' /
     '-infinity' specials — fail fast with a typed error, the reference's
     validate-then-encode stance (validation.rs): emitting a string here
-    would violate the carried writer schema."""
+    would violate the carried writer schema.
+
+    Integer arithmetic, not `timestamp()*1e6`: float64 seconds resolve to
+    ~0.2 µs at the 2024 epoch, so the float round-trip can flip the last
+    microsecond — and the columnar encoder emits the decode engine's EXACT
+    stored micros, which the row path must match bit-for-bit."""
     if isinstance(v, dt.datetime):
         if v.tzinfo is None:  # decode always attaches a zone; be safe
             v = v.replace(tzinfo=dt.timezone.utc)
-        return int(v.timestamp() * 1_000_000)
+        return (v - _EPOCH_UTC) // _US
     raise EtlError(
         ErrorKind.ROW_CONVERSION_FAILED,
         f"timestamptz value {v!r} has no instant representation for "
@@ -324,6 +333,168 @@ def encode_row(schema: ReplicatedTableSchema, values,
     out += f_string(n + 1, change_type)
     out += f_string(n + 2, change_sequence)
     return bytes(out)
+
+
+# -- columnar batch encoding (egress hot path) --------------------------------
+#
+# encode_row materializes a Python value per cell (Column.value boxes dense
+# numpy scalars into datetimes/ints) and re-dispatches on CellKind per cell.
+# encode_batch serializes column-at-a-time: one kind dispatch per COLUMN,
+# dense numpy data encoded straight from the array (ints via tolist —
+# already Python ints, no _from_dense boxing; floats sliced out of one
+# astype().tobytes() blob; Arrow string columns sliced out of their value
+# buffer without creating str objects). Output is byte-identical to
+# encode_row over the expanded rows — asserted by the parity suite.
+
+# dense timestamptz sentinels/bounds — the SAME objects _from_dense
+# decodes with, so detection can never drift from Column.value()
+from ..models.table_row import (MAX_TS_US as _MAX_TS_US,
+                                MIN_TS_US as _MIN_TS_US,
+                                TS_INFINITY_US as _TS_INF,
+                                TS_NEG_INFINITY_US as _TS_NEG_INF)
+
+
+from ..analysis.annotations import hot_loop
+
+
+@hot_loop
+def _column_cells(col, tag: int) -> list:
+    """Encoded proto field bytes per row for one column (None = absent:
+    NULL / TOAST-unchanged cells are omitted, proto3 absence).
+    @hot_loop: runs per column per CDC flush — row materialization here
+    would undo the columnar egress win (etl-lint rule 13)."""
+    import numpy as np
+
+    n = len(col)
+    kind = col.schema.kind
+    valid = col.validity
+    if col.toast_unchanged is not None:
+        valid = valid & ~col.toast_unchanged
+    cells: list = [None] * n
+    present = np.flatnonzero(valid)
+    if present.size == 0:
+        return cells
+    if col.is_dense and kind is CellKind.BOOL:
+        t1 = _key(tag, _WIRE_VARINT) + b"\x01"
+        t0 = _key(tag, _WIRE_VARINT) + b"\x00"
+        data = col.data
+        for i in present.tolist():
+            cells[i] = t1 if data[i] else t0
+        return cells
+    if col.is_dense and kind in (CellKind.I16, CellKind.I32, CellKind.I64):
+        prefix = _key(tag, _WIRE_VARINT)
+        data = col.data.tolist()
+        for i in present.tolist():
+            cells[i] = prefix + _varint(data[i] & 0xFFFFFFFFFFFFFFFF)
+        return cells
+    if col.is_dense and kind is CellKind.U32:
+        prefix = _key(tag, _WIRE_VARINT)
+        data = col.data.tolist()
+        for i in present.tolist():
+            cells[i] = prefix + _varint(data[i])
+        return cells
+    if col.is_dense and kind is CellKind.F64:
+        prefix = _key(tag, _WIRE_FIXED64)
+        blob = col.data.astype("<f8", copy=False).tobytes()
+        for i in present.tolist():
+            cells[i] = prefix + blob[8 * i : 8 * i + 8]
+        return cells
+    if col.is_dense and kind is CellKind.F32:
+        prefix = _key(tag, _WIRE_FIXED32)
+        blob = col.data.astype("<f4", copy=False).tobytes()
+        for i in present.tolist():
+            cells[i] = prefix + blob[4 * i : 4 * i + 4]
+        return cells
+    if col.is_dense and kind is CellKind.TIMESTAMPTZ:
+        data = col.data
+        sel = data[present]
+        bad = ((sel == _TS_INF) | (sel == _TS_NEG_INF)
+               | (sel < _MIN_TS_US) | (sel > _MAX_TS_US))
+        if bad.any():
+            i = int(present[np.flatnonzero(bad)[0]])
+            _tstz_micros(col.value(i))  # raises the typed error
+        prefix = _key(tag, _WIRE_VARINT)
+        vals = data.tolist()
+        for i in present.tolist():
+            cells[i] = prefix + _varint(vals[i] & 0xFFFFFFFFFFFFFFFF)
+        return cells
+    if col.is_arrow and kind is CellKind.STRING and col.lazy_text_oid is None:
+        cells_from_arrow = _arrow_string_cells(col.data, tag, n)
+        if cells_from_arrow is not None:
+            for i in present.tolist():
+                cells[i] = cells_from_arrow[i]
+            return cells
+    # generic fallback: box the value and reuse the row-path encoders
+    # (NUMERIC/DATE/TIME/TIMESTAMP/JSON/ARRAY/lazy-text columns — exotic
+    # kinds keep exact row-path semantics)
+    elem = array_element(col.schema.type_oid) if kind is CellKind.ARRAY \
+        else None
+    for i in present.tolist():
+        v = col.value(i)
+        if v is None or v is TOAST_UNCHANGED:
+            continue
+        out = bytearray()
+        if kind is CellKind.ARRAY:
+            _encode_array(tag, elem[1] if elem else CellKind.STRING, v, out,
+                          col.schema.name)
+        else:
+            _encode_scalar(tag, kind, v, out)
+        cells[i] = bytes(out)
+    return cells
+
+
+def _arrow_string_cells(arr, tag: int, n: int):
+    """Encoded f_string cells straight from an Arrow StringArray's value
+    buffer (no per-row str objects). None when the array layout isn't the
+    simple offset-0 form (sliced arrays fall back to the generic path)."""
+    import numpy as np
+
+    if arr.offset != 0 or len(arr) != n:
+        return None
+    bufs = arr.buffers()
+    if len(bufs) < 3 or bufs[1] is None or bufs[2] is None:
+        return None
+    offsets = np.frombuffer(bufs[1], dtype=np.int32, count=n + 1)
+    data = bytes(bufs[2])
+    cells = [None] * n
+    key = _key(tag, _WIRE_LEN)
+    o = offsets.tolist()
+    for i in range(n):
+        lo, hi = o[i], o[i + 1]
+        cells[i] = key + _varint(hi - lo) + data[lo:hi]
+    return cells
+
+
+@hot_loop
+def encode_batch(schema: ReplicatedTableSchema, batch,
+                 change_types: list, change_sequences: list) -> list[bytes]:
+    """Columnar AppendRows encoding: one serialized proto row per batch
+    row, fields in column order then the two CDC pseudo-columns —
+    byte-identical to per-row `encode_row` over the same values.
+    `change_types` / `change_sequences` are per-row ASCII bytes (see
+    util.change_type_batch / util.sequence_number_batch).
+    @hot_loop: the BigQuery egress hot path (etl-lint rule 13 guards the
+    row path out of it)."""
+    n = batch.num_rows
+    cols = schema.replicated_columns
+    bufs = [bytearray() for _ in range(n)]
+    for j, col in enumerate(batch.columns):
+        cells = _column_cells(col, j + 1)
+        for i, cell in enumerate(cells):
+            if cell is not None:
+                bufs[i] += cell
+    nc = len(cols)
+    ct_key = _key(nc + 1, _WIRE_LEN)
+    seq_key = _key(nc + 2, _WIRE_LEN)
+    out = []
+    for i in range(n):
+        b = bufs[i]
+        ct = change_types[i]
+        seq = change_sequences[i]
+        b += ct_key + _varint(len(ct)) + ct
+        b += seq_key + _varint(len(seq)) + seq
+        out.append(bytes(b))
+    return out
 
 
 # -- AppendRows request/response ---------------------------------------------

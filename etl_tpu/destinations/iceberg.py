@@ -35,6 +35,7 @@ import aiohttp
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from ..analysis.annotations import hot_loop
 from ..models.errors import ErrorKind, EtlError
 from ..models.event import ChangeType, DeleteEvent, Event
 from ..models.pgtypes import CellKind
@@ -387,6 +388,73 @@ class IcebergDestination(Destination):
             f = self._write_data_file(st, rb)
             await self._commit_snapshot(st, [f])
         return WriteAck.durable()
+
+    # -- columnar seam --------------------------------------------------------
+
+    async def write_table_batch(self, schema: ReplicatedTableSchema,
+                                batch: ColumnarBatch) -> WriteAck:
+        """Copy path, columnar: Arrow-native with vectorized CDC metadata
+        (the row path's per-row f-string sequence suffixes were measurable
+        at copy rates)."""
+        from .util import hex16_arrow
+
+        st = await self._ensure_table(schema)
+        if batch.num_rows:
+            import numpy as np
+
+            rb = batch.to_arrow()
+            n = batch.num_rows
+            rb = rb.append_column(CHANGE_TYPE_COLUMN,
+                                  pa.array(["UPSERT"] * n, pa.string()))
+            rb = rb.append_column(
+                CHANGE_SEQUENCE_COLUMN,
+                hex16_arrow(np.arange(n, dtype=np.uint64)))
+            f = self._write_data_file(st, rb)
+            await self._commit_snapshot(st, [f])
+        return WriteAck.durable()
+
+    async def write_event_batches(self, events: Sequence[Event]) -> WriteAck:
+        """CDC path, columnar: decoded batch runs commit as Parquet +
+        snapshot without row expansion; old-tuple/TOAST batches and
+        per-row events drop to the row path in place."""
+        from .base import sequential_batch_program
+
+        for op in sequential_batch_program(events):
+            if op[0] == "batch":
+                _, schema, cb = op
+                await self._write_cdc_batch(schema, cb)
+            elif op[0] == "rows":
+                _, schema, evs = op
+                await self._write_cdc_run(schema, evs)
+            elif op[0] == "truncate":
+                for sch in op[1].schemas:
+                    await self._ensure_table(sch)
+                    await self.truncate_table(sch.id)
+            else:
+                await self._apply_schema_change(op[1])
+        return WriteAck.durable()
+
+    @hot_loop
+    async def _write_cdc_batch(self, schema: ReplicatedTableSchema,
+                               cb) -> None:
+        """@hot_loop: the Iceberg CDC egress hot path (etl-lint rule 13)."""
+        from .util import (change_type_arrow, require_full_batch,
+                           sequence_number_arrow)
+
+        import numpy as np
+
+        st = await self._ensure_table(schema)
+        require_full_batch("iceberg", schema, cb.batch, cb.change_types)
+        n = cb.num_rows
+        rb = cb.batch.to_arrow()
+        rb = rb.append_column(CHANGE_TYPE_COLUMN,
+                              change_type_arrow(cb.change_types))
+        rb = rb.append_column(
+            CHANGE_SEQUENCE_COLUMN,
+            sequence_number_arrow(cb.commit_lsns, cb.tx_ordinals,
+                                  np.arange(n, dtype=np.uint64)))
+        f = self._write_data_file(st, rb)
+        await self._commit_snapshot(st, [f])
 
     async def write_events(self, events: Sequence[Event]) -> WriteAck:
         for op in sequential_event_program(expand_batch_events(events)):

@@ -177,6 +177,22 @@ class FaultInjectingDestination(Destination):
         return await self._apply_fault(
             "write_events", lambda: self.inner.write_events(events))
 
+    # columnar seam: SAME fault-script keys as the row entry points, so
+    # every chaos scenario scripted against write_table_rows/write_events
+    # exercises the batch-granularity seam unchanged
+    async def write_table_batch(self, schema: ReplicatedTableSchema,
+                                batch: ColumnarBatch) -> WriteAck:
+        self.write_rows_calls += 1
+        return await self._apply_fault(
+            "write_table_rows",
+            lambda: self.inner.write_table_batch(schema, batch))
+
+    async def write_event_batches(self, events: Sequence[Event]) -> WriteAck:
+        self.write_events_calls += 1
+        return await self._apply_fault(
+            "write_events",
+            lambda: self.inner.write_event_batches(events))
+
     async def drop_table(self, table_id: TableId,
                          schema=None) -> None:
         async def run():

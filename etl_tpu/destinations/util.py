@@ -83,6 +83,89 @@ def sequence_number(key: EventSequenceKey, ordinal: int) -> str:
     return key.with_ordinal(ordinal)
 
 
+# -- batch-granularity CDC metadata (columnar egress) -------------------------
+#
+# The row path renders `_CHANGE_SEQUENCE_NUMBER` with an f-string per row —
+# at 41k ev/s that formatting was measurable in the streamed-CDC profile.
+# These build the same `%016x/%016x/%016x` keys for a WHOLE batch as numpy
+# nibble-lookup ops: one (n, 50)-byte buffer, no per-row Python.
+
+import numpy as np
+
+_HEX_DIGITS = np.frombuffer(b"0123456789abcdef", dtype=np.uint8)
+_SEQ_WIDTH = 50  # 16 hex + '/' + 16 hex + '/' + 16 hex
+
+
+def _hex16(arr: np.ndarray, out: np.ndarray) -> None:
+    """(n,) uint64 → 16 lowercase ASCII hex bytes per value, into `out`
+    (an (n, 16) uint8 view)."""
+    b = np.ascontiguousarray(arr, dtype=">u8").view(np.uint8).reshape(-1, 8)
+    out[:, 0::2] = _HEX_DIGITS[b >> 4]
+    out[:, 1::2] = _HEX_DIGITS[b & 0x0F]
+
+
+def sequence_number_buffer(commit_lsns, tx_ordinals, ordinals) -> np.ndarray:
+    """Vectorized CDC sequence keys: (n, 50) uint8 buffer of
+    `{commit:016x}/{tx_ordinal:016x}/{ordinal:016x}` rows — byte-identical
+    to `EventSequenceKey.with_ordinal` output."""
+    commit_lsns = np.asarray(commit_lsns, dtype=np.uint64)
+    n = len(commit_lsns)
+    out = np.empty((n, _SEQ_WIDTH), dtype=np.uint8)
+    _hex16(commit_lsns, out[:, 0:16])
+    out[:, 16] = ord("/")
+    _hex16(np.asarray(tx_ordinals, dtype=np.uint64), out[:, 17:33])
+    out[:, 33] = ord("/")
+    _hex16(np.asarray(ordinals, dtype=np.uint64), out[:, 34:50])
+    return out
+
+
+def sequence_number_batch(commit_lsns, tx_ordinals, ordinals) -> list[bytes]:
+    """Per-row sequence keys as a list of ASCII bytes (TSV/proto form)."""
+    buf = sequence_number_buffer(commit_lsns, tx_ordinals, ordinals)
+    return buf.reshape(-1).view(f"S{_SEQ_WIDTH}").tolist()
+
+
+def sequence_number_arrow(commit_lsns, tx_ordinals, ordinals):
+    """Per-row sequence keys as a pyarrow StringArray built straight from
+    the fixed-width buffer (no per-row Python strings)."""
+    import pyarrow as pa
+
+    buf = sequence_number_buffer(commit_lsns, tx_ordinals, ordinals)
+    n = buf.shape[0]
+    offsets = np.arange(0, (n + 1) * _SEQ_WIDTH, _SEQ_WIDTH, dtype=np.int32)
+    return pa.StringArray.from_buffers(
+        n, pa.py_buffer(offsets.tobytes()), pa.py_buffer(buf.tobytes()))
+
+
+def hex16_arrow(values):
+    """Vectorized `{v:016x}` strings as a pyarrow StringArray (the
+    Iceberg copy path's per-row sequence suffix)."""
+    import pyarrow as pa
+
+    arr = np.asarray(values, dtype=np.uint64)
+    n = len(arr)
+    out = np.empty((n, 16), dtype=np.uint8)
+    _hex16(arr, out)
+    offsets = np.arange(0, (n + 1) * 16, 16, dtype=np.int32)
+    return pa.StringArray.from_buffers(
+        n, pa.py_buffer(offsets.tobytes()), pa.py_buffer(out.tobytes()))
+
+
+def change_type_batch(change_types) -> np.ndarray:
+    """Vectorized `_CHANGE_TYPE` labels for a batch: (n,) bytes array
+    (S6) of UPSERT/DELETE matching `change_type_label` per row."""
+    cts = np.asarray(change_types)
+    return np.where(cts == int(ChangeType.DELETE),
+                    np.bytes_(CDC_DELETE), np.bytes_(CDC_UPSERT))
+
+
+def change_type_arrow(change_types):
+    """Vectorized `_CHANGE_TYPE` labels as a pyarrow StringArray."""
+    import pyarrow as pa
+
+    return pa.array(change_type_batch(change_types).astype("U6"))
+
+
 def escaped_table_name(name: TableName) -> str:
     """`schema_table` with underscores in parts doubled so the mapping is
     injective (reference table_name.rs)."""

@@ -51,6 +51,20 @@ def value_size_hint(v: Any) -> int:
     return 64
 
 
+#: process-wide count of TableRow constructions (mutable cell so the hot
+#: path pays one list-index increment, no attribute lookup on a registry).
+#: The columnar egress path never builds rows, so bench.py --smoke asserts
+#: this counter's delta over the streamed CDC window is ZERO — the row
+#: path creeping back into egress fails CI instead of silently eating the
+#: decode speedups (ROADMAP item 2).
+_ROWS_CONSTRUCTED = [0]
+
+
+def rows_constructed() -> int:
+    """Monotonic count of TableRow/PartialTableRow constructions."""
+    return _ROWS_CONSTRUCTED[0]
+
+
 class TableRow:
     """One decoded row: positional values matching a ReplicatedTableSchema's
     replicated columns (reference TableRow, data/table_row.rs:15)."""
@@ -58,6 +72,7 @@ class TableRow:
     __slots__ = ("values", "_size_hint")
 
     def __init__(self, values: Sequence[Any]):
+        _ROWS_CONSTRUCTED[0] += 1
         self.values = list(values)
         self._size_hint: int | None = None
 
@@ -188,11 +203,23 @@ class ColumnarBatch:
     def from_rows(cls, schema: ReplicatedTableSchema, rows: Sequence[TableRow]) -> "ColumnarBatch":
         """CPU transpose: list-of-rows → columns (the fallback for what the
         device path produces directly)."""
+        return cls.from_cells(
+            schema,
+            [[r.values[j] for r in rows]
+             for j in range(len(schema.replicated_columns))],
+            len(rows))
+
+    @classmethod
+    def from_cells(cls, schema: ReplicatedTableSchema,
+                   cells: Sequence[Sequence[Any]],
+                   n: int) -> "ColumnarBatch":
+        """Build a batch from per-COLUMN value lists (`cells[j][i]` = column
+        j, row i) without ever materializing TableRow objects — the columnar
+        form of `from_rows` used by the CPU-engine COPY path."""
         cols_schema = schema.replicated_columns
-        n = len(rows)
         columns: list[Column] = []
         for j, cs in enumerate(cols_schema):
-            vals = [r.values[j] for r in rows]
+            vals = cells[j]
             toast = np.asarray([v is TOAST_UNCHANGED for v in vals], dtype=np.bool_)
             validity = np.asarray(
                 [v is not None and v is not TOAST_UNCHANGED for v in vals],
@@ -210,6 +237,55 @@ class ColumnarBatch:
                     cs, [v if validity[i] else None for i, v in enumerate(vals)],
                     validity, toast_arr))
         return cls(schema, columns)
+
+    @classmethod
+    def concat(cls, batches: "Sequence[ColumnarBatch]") -> "ColumnarBatch":
+        """Concatenate same-schema batches column-wise (the coalescing step
+        of the columnar CDC write seam: consecutive same-table
+        DecodedBatchEvents become ONE destination write). Dense columns
+        concatenate as numpy arrays, Arrow text columns as chunk-combined
+        Arrow arrays, object columns as list extend — no row objects."""
+        if not batches:
+            raise ValueError("concat of zero batches")
+        if len(batches) == 1:
+            return batches[0]
+        first = batches[0]
+        for b in batches[1:]:
+            if b.schema is not first.schema and b.schema != first.schema:
+                raise ValueError("concat across schemas")
+        n = sum(b.num_rows for b in batches)
+        columns: list[Column] = []
+        for j, cs in enumerate(first.schema.replicated_columns):
+            parts = [b.columns[j] for b in batches]
+            validity = np.concatenate([c.validity for c in parts])
+            toast = None
+            if any(c.toast_unchanged is not None for c in parts):
+                toast = np.concatenate([
+                    c.toast_unchanged if c.toast_unchanged is not None
+                    else np.zeros(len(c), dtype=np.bool_) for c in parts])
+            if all(c.is_dense for c in parts):
+                data: Any = np.concatenate([c.data for c in parts])
+                lazy = None
+            elif all(c.is_arrow for c in parts) and len(
+                    {c.lazy_text_oid for c in parts}) == 1:
+                import pyarrow as pa
+
+                data = pa.chunked_array([c.data for c in parts]).combine_chunks()
+                lazy = parts[0].lazy_text_oid
+            else:
+                # mixed storage (e.g. a fixed-up batch next to an Arrow
+                # one): degrade to object values via each column's own
+                # accessor — correctness over speed on this rare edge
+                data = [c.value(i) for c in parts for i in range(len(c))]
+                lazy = None
+                validity = np.asarray(
+                    [v is not None and v is not TOAST_UNCHANGED
+                     for v in data], dtype=np.bool_)
+            columns.append(Column(cs, data, validity, toast,
+                                  lazy_text_oid=lazy))
+        out = cls(first.schema, columns)
+        assert out.num_rows == n
+        return out
 
     def to_rows(self) -> list[TableRow]:
         return [TableRow([c.value(i) for c in self.columns])
@@ -277,11 +353,23 @@ _EPOCH_UTC = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
 
 _US = dt.timedelta(microseconds=1)
 
-# exact bounds of Python's datetime range in epoch microseconds / days
-_MIN_TS_US = -62_135_596_800_000_000  # 0001-01-01 00:00:00
-_MAX_TS_US = 253_402_300_799_999_999  # 9999-12-31 23:59:59.999999
-_MIN_DATE_DAYS = -719_162
-_MAX_DATE_DAYS = 2_932_896
+# Dense sentinel encodings and exact bounds of Python's datetime range in
+# epoch microseconds / days. PUBLIC: the columnar destination encoders
+# (bq_proto._column_cells, clickhouse._column_texts) import these so their
+# special-value detection can never drift from what _from_dense decodes.
+TS_INFINITY_US = 2**63 - 1
+TS_NEG_INFINITY_US = -(2**63)
+DATE_INFINITY_DAYS = 2**31 - 1
+DATE_NEG_INFINITY_DAYS = -(2**31)
+MIN_TS_US = -62_135_596_800_000_000  # 0001-01-01 00:00:00
+MAX_TS_US = 253_402_300_799_999_999  # 9999-12-31 23:59:59.999999
+MIN_DATE_DAYS = -719_162
+MAX_DATE_DAYS = 2_932_896
+# former private spellings (kept: ops/engine's CPU fixup imports one)
+_MIN_TS_US = MIN_TS_US
+_MAX_TS_US = MAX_TS_US
+_MIN_DATE_DAYS = MIN_DATE_DAYS
+_MAX_DATE_DAYS = MAX_DATE_DAYS
 
 
 def _to_dense(kind: CellKind, v: Any):
@@ -305,9 +393,9 @@ def _to_dense(kind: CellKind, v: Any):
 def _from_dense(kind: CellKind, v):
     if kind is CellKind.DATE:
         days = int(v)
-        if days == 2**31 - 1:
+        if days == DATE_INFINITY_DAYS:
             return PgSpecialDate(days, "infinity")
-        if days == -(2**31):
+        if days == DATE_NEG_INFINITY_DAYS:
             return PgSpecialDate(days, "-infinity")
         if not _MIN_DATE_DAYS <= days <= _MAX_DATE_DAYS:
             return PgSpecialDate(days, f"<out-of-range date {days}d>")
@@ -321,9 +409,9 @@ def _from_dense(kind: CellKind, v):
     if kind in (CellKind.TIMESTAMP, CellKind.TIMESTAMPTZ):
         us = int(v)
         tz_aware = kind is CellKind.TIMESTAMPTZ
-        if us == 2**63 - 1:
+        if us == TS_INFINITY_US:
             return PgSpecialTimestamp(us, "infinity", tz_aware=tz_aware)
-        if us == -(2**63):
+        if us == TS_NEG_INFINITY_US:
             return PgSpecialTimestamp(us, "-infinity", tz_aware=tz_aware)
         if not _MIN_TS_US <= us <= _MAX_TS_US:
             return PgSpecialTimestamp(us, f"<out-of-range timestamp {us}us>",
@@ -353,6 +441,13 @@ def _json_text(v: Any) -> str:
 
 
 def _arrow_scalar(v: Any):
+    import uuid as _uuid
+
+    if isinstance(v, _uuid.UUID):
+        # host-parsed UUID objects (the device path carries UUIDs as lazy
+        # Arrow text and never reaches here): canonical string form, the
+        # same rendering every destination uses
+        return str(v)
     if isinstance(v, (PgSpecialDate, PgSpecialTimestamp)):
         return v.pg_text()
     if isinstance(v, PgTimeTz):

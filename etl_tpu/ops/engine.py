@@ -197,6 +197,23 @@ def _donation_supported() -> bool:
     return jax.default_backend() in ("tpu", "gpu")
 
 
+_ACCEL_BACKEND: "bool | None" = None
+
+
+def accelerator_backend() -> bool:
+    """True when jax's default backend is a real accelerator (TPU/GPU).
+    Cached — the backend choice is fixed per process. Gates policies that
+    only pay off with a device across the transfer link: backlog
+    mega-batching grows seals to clear the DEVICE routing threshold, but
+    on the host-CPU backend every grown bucket is a fresh multi-hundred-ms
+    XLA compile and a larger host program — measured 5× WORSE end-to-end
+    streaming (~41k vs ~200k ev/s) than staying at the standard seal."""
+    global _ACCEL_BACKEND
+    if _ACCEL_BACKEND is None:
+        _ACCEL_BACKEND = jax.default_backend() in ("tpu", "gpu")
+    return _ACCEL_BACKEND
+
+
 def _build_device_fn(specs, nibble: bool = False, use_pallas: bool = False,
                      mesh=None, donate: bool = False):
     # donate_argnums on the packed inputs: XLA reuses the uploaded bmat /

@@ -100,6 +100,32 @@ def parse_copy_row(line: bytes, type_oids: Sequence[int]) -> TableRow:
     return TableRow(values)
 
 
+def parse_copy_chunk_columns(chunk: bytes, type_oids: Sequence[int]):
+    """COPY text chunk → per-COLUMN typed value lists + row count (the
+    columnar form of `parse_copy_row` over every line): the CPU-engine
+    copy path feeds these straight into `ColumnarBatch.from_cells`,
+    skipping the TableRow materialization + from_rows re-transpose that
+    used to sit between the parse and the destination write
+    (runtime/copy.py:177 row round-trip)."""
+    n_cols = len(type_oids)
+    cells: list[list[Any]] = [[] for _ in range(n_cols)]
+    n = 0
+    for line in chunk.split(b"\n"):
+        if not line:
+            continue
+        fields = split_copy_line(line)
+        if len(fields) != n_cols:
+            raise EtlError(
+                ErrorKind.COPY_FORMAT_INVALID,
+                f"COPY row has {len(fields)} fields, schema expects {n_cols}")
+        for j, (raw, oid) in enumerate(zip(fields, type_oids)):
+            cells[j].append(
+                None if raw is None
+                else parse_cell_text(raw.decode("utf-8"), oid))
+        n += 1
+    return cells, n
+
+
 def encode_copy_field(text: str | None) -> bytes:
     if text is None:
         return NULL_FIELD

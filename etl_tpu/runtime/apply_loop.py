@@ -38,6 +38,7 @@ from ..models.event import (BeginEvent, CommitEvent, RelationEvent,
                             SchemaChangeEvent, TruncateEvent)
 from ..models.lsn import Lsn
 from ..models.schema import TableId
+from ..ops.engine import accelerator_backend
 from ..postgres.codec import event as event_codec
 from ..postgres.codec import pgoutput
 from ..postgres.source import FrameSpan, ReplicationStream
@@ -339,7 +340,13 @@ class ApplyLoop:
                         self._backlog_now = drained >= 4096
                         if self._backlog_now:
                             backlog_streak += 1
-                            if backlog_streak >= 2:
+                            # mega-batching only pays where a DEVICE exists
+                            # to route the grown batch to: on the host-CPU
+                            # backend each grown bucket is a fresh XLA
+                            # compile + a larger host program — measured
+                            # 5× e2e streaming LOSS (ops/engine
+                            # .accelerator_backend)
+                            if backlog_streak >= 2 and accelerator_backend():
                                 self.assembler.grow_seal()
                         else:
                             backlog_streak = 0
@@ -652,7 +659,11 @@ class ApplyLoop:
         async def write() -> None:
             if not events:
                 return  # commit-boundary-only flush: no destination call
-            ack = await self.destination.write_events(events)
+            # columnar write seam: DecodedBatchEvents reach the
+            # destination as batches (columnar-native writers encode them
+            # column-at-a-time; others fall back to the row path via the
+            # base-class shim)
+            ack = await self.destination.write_event_batches(events)
             await ack.wait_durable()
             # billing/egress accounting rides durable acks (egress.rs:1-20)
             record_egress(pipeline_id=self.config.pipeline_id,

@@ -193,6 +193,18 @@ class SupervisedDestination(Destination):
         return await self._bounded(
             "write_events", self.inner.write_events(events))
 
+    # columnar seam: bounded + breaker-gated like the row entry points
+    # (same op labels — the timeout metric and breaker verdicts must not
+    # fork per encoding); the INNER destination decides whether it
+    # implements the batch write natively or falls back to rows
+    async def write_table_batch(self, schema, batch) -> WriteAck:
+        return await self._bounded(
+            "write_table_rows", self.inner.write_table_batch(schema, batch))
+
+    async def write_event_batches(self, events: Sequence) -> WriteAck:
+        return await self._bounded(
+            "write_events", self.inner.write_event_batches(events))
+
     async def drop_table(self, table_id, schema=None) -> None:
         await self._bounded("drop_table",
                             self.inner.drop_table(table_id, schema))

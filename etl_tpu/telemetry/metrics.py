@@ -16,6 +16,11 @@ from dataclasses import dataclass, field
 # --- metric names (reference observability.rs) ------------------------------
 
 ETL_TABLE_COPY_ROWS_TOTAL = "etl_table_copy_rows_total"
+# TableRow/PartialTableRow constructions (models/table_row keeps the hot
+# counter; publish_table_rows_constructed() mirrors it here). Zero over a
+# streamed-CDC window = the egress path stayed columnar fetch-to-wire —
+# bench.py --smoke gates on exactly that.
+ETL_TABLE_ROWS_CONSTRUCTED_TOTAL = "etl_table_rows_constructed_total"
 ETL_TABLE_COPY_BYTES_TOTAL = "etl_table_copy_bytes_total"
 ETL_TABLE_COPY_DURATION_SECONDS = "etl_table_copy_duration_seconds"
 ETL_TABLE_COPY_END_TO_END_LAG_BYTES = "etl_table_copy_end_to_end_lag_bytes"
@@ -227,3 +232,14 @@ class MetricsRegistry:
 
 # process-global registry (reference: once-only Prometheus recorder)
 registry = MetricsRegistry()
+
+
+def publish_table_rows_constructed() -> int:
+    """Mirror the models/table_row construction counter into the registry
+    (the hot path pays a bare list-index increment, not a registry lock;
+    scrapes and the bench gates read through here) and return it."""
+    from ..models.table_row import rows_constructed
+
+    n = rows_constructed()
+    registry.gauge_set(ETL_TABLE_ROWS_CONSTRUCTED_TOTAL, n)
+    return n

@@ -1,0 +1,22 @@
+# etl-lint fixture: row materialization inside @hot_loop batch-encode
+# entry points — the columnar egress path rebuilding per-row Python
+# objects (TableRow construction, batch expansion, row transposes).
+# expect: hot-loop-row-materialization=4
+from etl_tpu.analysis.annotations import hot_loop
+from etl_tpu.destinations.base import expand_batch_events
+from etl_tpu.models.table_row import ColumnarBatch, TableRow
+
+
+@hot_loop
+def encode_batch_via_rows(schema, batch, labels, seqs):
+    rows = batch.to_rows()  # per-row boxing on the hot path
+    rebuilt = ColumnarBatch.from_rows(schema, rows)  # and back again
+    out = []
+    for i, row in enumerate(rows):
+        out.append(TableRow(list(row.values)))  # a third copy per row
+    return rebuilt, out
+
+
+@hot_loop
+def write_batches_by_expansion(events):
+    return expand_batch_events(events)  # the row path wearing a batch hat

@@ -1,0 +1,19 @@
+# etl-lint fixture: the sanctioned shapes around rule 13 — a @hot_loop
+# encoder that stays columnar, and row materialization in UNDECORATED
+# fallback/compat functions (the shim lives outside the hot path).
+# (no expectations: zero findings)
+from etl_tpu.analysis.annotations import hot_loop
+from etl_tpu.destinations.base import expand_batch_events
+from etl_tpu.models.table_row import ColumnarBatch
+
+
+@hot_loop
+def encode_batch_columnar(schema, batch, labels, seqs):
+    cells = [c.data for c in batch.columns]  # column storage, no rows
+    return cells, labels, seqs
+
+
+def legacy_row_fallback(schema, events, rows):
+    # not @hot_loop: the compatibility shim expands and transposes freely
+    expanded = expand_batch_events(events)
+    return ColumnarBatch.from_rows(schema, rows), expanded

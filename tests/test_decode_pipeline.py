@@ -422,3 +422,12 @@ class TestBenchSmoke:
         assert out["static_analysis_under_budget"] is True, out
         assert out["static_analysis_seconds"] < \
             out["static_analysis_budget_s"]
+        # columnar-egress satellites (ISSUE 6): ZERO TableRow
+        # constructions on the streamed CDC hot path (the decode engine's
+        # batches must reach the destination columnar fetch-to-wire), and
+        # every destination encoder above its isolation floor so an
+        # egress regression names the guilty encoder
+        assert out["streaming_zero_row_materialization"] is True, out
+        assert out["streaming_table_rows_constructed"] == 0
+        assert out["egress_encoders_above_floor"] is True, out
+        assert out["egress_failures"] == []
