@@ -256,6 +256,29 @@ def run_smoke() -> dict:
                       if egress.get(k, 0) < v]
     egress_ok = not egress_failures
 
+    # workload-diversity gate (ISSUE 7): a fast mixed-profile slice
+    # (update-heavy + truncate-storm by default) through the FULL
+    # pipeline with end-state verification, against the per-workload
+    # floors — so a regression that only bites non-insert traffic (an
+    # old-tuple path, the truncate barrier, a decode stall-spiral) fails
+    # CI instead of hiding behind the insert-CDC floor
+    workload_failures = []
+    workload_rates = {}
+    wfloors = floors.get("workload_floors", {})
+    for prof in floors.get("workload_smoke_profiles",
+                           ["update_heavy_default", "truncate_storm"]):
+        wrun = asyncio.run(harness.run_workload_streaming(
+            prof, target_ops=floors.get("workload_smoke_ops", 400)))
+        workload_rates[prof] = wrun["events_per_second"]
+        if not wrun["verified"]:
+            workload_failures.append(f"{prof}: end state not verified")
+        elif prof in wfloors \
+                and wrun["events_per_second"] < wfloors[prof]:
+            workload_failures.append(
+                f"{prof}: {wrun['events_per_second']} ev/s under floor "
+                f"{wfloors[prof]}")
+    workload_ok = not workload_failures
+
     # static-analysis budget gate (ISSUE 5 CI satellite): the full
     # whole-program etl-lint pass (call graph + context propagation +
     # CFG rules over every module) must stay cheap enough to gate every
@@ -275,7 +298,10 @@ def run_smoke() -> dict:
         "mode": "smoke",
         "ok": bool(identical and stages_observed and stream_ok
                    and heartbeat_ok and lint_ok and no_row_path
-                   and egress_ok),
+                   and egress_ok and workload_ok),
+        "workload_events_per_sec": workload_rates,
+        "workload_profiles_above_floor": bool(workload_ok),
+        "workload_failures": workload_failures,
         "streaming_table_rows_constructed": rows_constructed,
         "streaming_zero_row_materialization": bool(no_row_path),
         "egress_encoders_above_floor": bool(egress_ok),
@@ -376,12 +402,21 @@ def main():
     parser = argparse.ArgumentParser(prog="bench.py")
     parser.add_argument("--mode", default="decode",
                         choices=["decode", "table_copy", "table_streaming",
-                                 "wide_row", "lag", "egress"])
+                                 "wide_row", "lag", "egress", "workload"])
     parser.add_argument("--egress", dest="egress", action="store_true",
                         help="alias for --mode egress: measure each "
                              "destination encoder in isolation "
                              "(ColumnarBatch → wire bytes) against the "
                              "egress_floors in BENCH_FLOOR.json")
+    parser.add_argument("--workload", default=None, metavar="PROFILE",
+                        help="workload matrix mode: run the named workload "
+                             "profile (etl_tpu/workloads; 'all' = every "
+                             "profile) through the full pipeline with "
+                             "end-state verification, and gate each "
+                             "measured profile against workload_floors in "
+                             "BENCH_FLOOR.json")
+    parser.add_argument("--seed", type=int, default=7,
+                        help="workload generator seed (--workload mode)")
     parser.add_argument("--engine", default="tpu",
                         choices=["tpu", "cpu", "pallas"])
     parser.add_argument("--smoke", action="store_true",
@@ -391,6 +426,37 @@ def main():
     args = parser.parse_args()
     if args.egress:
         args.mode = "egress"
+    if args.workload is not None:
+        args.mode = "workload"
+    if args.mode == "workload":
+        if args.engine == "pallas":
+            parser.error("--engine pallas applies to wide_row only")
+        # the matrix verifies END STATE per profile, so it always runs
+        # on the host CPU platform the way the smoke gate does — the
+        # same pipeline code paths, no accelerator tunnel dependency.
+        # --engine selects the DECODE PATH only (tpu = the XLA engine
+        # compiled for host CPU, cpu = the oracle codecs); the floors in
+        # BENCH_FLOOR.json are calibrated for this host backend
+        import asyncio
+
+        jax.config.update("jax_platforms", "cpu")
+        from etl_tpu.benchmarks import harness
+        from etl_tpu.workloads import profile_names
+
+        names = profile_names() if args.workload in (None, "all") \
+            else [args.workload]
+        out = asyncio.run(harness.run_workload_matrix(
+            names, seed=args.seed, engine=args.engine))
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_FLOOR.json")) as f:
+            wfloors = json.load(f).get("workload_floors", {})
+        out["floors"] = wfloors
+        out["failures"] = [
+            n for n, v in out["events_per_second"].items()
+            if n in wfloors and v < wfloors[n]]
+        out["ok"] = bool(out["all_verified"]) and not out["failures"]
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
     if args.mode == "egress":
         # encoder isolation runs on the CPU backend by definition — the
         # encoders are host code; never touch the accelerator tunnel

@@ -17,6 +17,21 @@ import statistics
 import time
 
 
+async def _wait_background_compiles(timeout_s: float = 240.0) -> None:
+    """Poll engine.background_compiles_inflight() to zero before opening a
+    measured window, failing loudly instead of hanging the bench when a
+    build wedges (or a spawn failure leaks a key)."""
+    from ..ops import engine as _engine
+
+    deadline = time.monotonic() + timeout_s
+    while _engine.background_compiles_inflight():
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                "background host-program compiles still in flight after "
+                f"{timeout_s:.0f}s; refusing to open a measured window")
+        await asyncio.sleep(0.05)
+
+
 def _median(xs):
     return statistics.median(xs)
 
@@ -309,6 +324,11 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
         await tx.commit()
         warmup_rows += wave
         await asyncio.wait_for(wait_delivered_at_least(warmup_rows), 120)
+    # the streaming decoders compile cold host programs on BACKGROUND
+    # threads (engine.nonblocking_compile) and serve the triggering
+    # batches from the oracle — wait the builds out so the measured
+    # window runs the warm programs, not the transient fallback
+    await _wait_background_compiles()
     arrivals.clear()
     commit_times.clear()
     # baseline BEFORE production starts: measured rows deliver concurrently
@@ -509,6 +529,166 @@ async def run_lag_vs_rate(engine: str = "tpu",
         "max_events_per_second": max_rate,
         "max_fill_ms": max_fill_ms,
         "rates": rows,
+    }
+
+
+# ---------------------------------------------------------------------------
+# workload matrix (ISSUE 7: per-profile CDC throughput beyond insert-CDC)
+# ---------------------------------------------------------------------------
+
+
+async def run_workload_streaming(profile: str = "update_heavy_default",
+                                 seed: int = 7, steps: int | None = None,
+                                 engine: str = "tpu",
+                                 target_ops: int = 3_000,
+                                 verify_timeout_s: float = 240.0) -> dict:
+    """CDC throughput for ONE workload profile (etl_tpu/workloads) through
+    the full pipeline, with end-state verification: the destination's
+    reconstructed final view must equal the generator's committed source
+    truth (the same collapse rules the chaos invariant checker applies) —
+    a throughput number over silently-wrong deliveries would be worse
+    than no number.
+
+    The memory destination is deliberate: non-insert profiles need the
+    delivered events retained for verification, and every profile pays
+    the same row-expansion cost, so per-profile numbers stay comparable.
+    `steps` defaults to whatever reaches ~`target_ops` row ops for the
+    profile's transaction shape."""
+    from ..config import BatchConfig, BatchEngine, PipelineConfig
+    from ..models.table_state import TableStateType
+    from ..postgres.fake import FakeSource
+    from ..runtime import Pipeline
+    from ..store import NotifyingStore
+    from ..workloads import WorkloadGenerator, get_profile
+
+    p = get_profile(profile)
+    gen = WorkloadGenerator(p, seed=seed)
+    db = gen.build_db()
+    store = NotifyingStore()
+    from ..chaos.runner import TracingDestination
+
+    dest = TracingDestination()
+    pipeline = Pipeline(
+        config=PipelineConfig(
+            pipeline_id=1, publication_name="pub",
+            batch=BatchConfig(max_fill_ms=30,
+                              batch_engine=BatchEngine(engine))),
+        store=store, destination=dest,
+        source_factory=lambda: FakeSource(db))
+    async def wait_delivered():
+        # `delivered()` reconstructs the destination's full final view —
+        # O(events × columns) of synchronous work ON the event loop — so
+        # run it only when the event stream has QUIESCED (no new events
+        # across a poll interval); while deliveries are still flowing the
+        # wait costs nothing but a length check (a 20 ms reconstruct
+        # cadence measurably starved the apply loop on the 120-column
+        # profile)
+        seen = -1
+        while True:
+            n = len(dest.events)
+            if n == seen and gen.delivered(dest):
+                return
+            seen = n
+            if pipeline._apply_task is not None \
+                    and pipeline._apply_task.done():
+                pipeline._apply_task.result()
+                raise RuntimeError("pipeline stopped before delivering")
+            await asyncio.sleep(0.1)
+
+    try:
+        # start + READY wait inside the try: a copy-path regression that
+        # keeps a table from READY must still shut the pipeline down, not
+        # leak its tasks past asyncio.run()
+        await pipeline.start()
+        for tid in gen.table_ids:
+            await asyncio.wait_for(
+                store.notify_on(tid, TableStateType.READY), 120)
+        # warmup OFF the clock: the decode engine compiles one program per
+        # (schema, row bucket, width signature) — on the 120-column mix a
+        # single compile costs tens of seconds on the host backend, so an
+        # unwarmed window measures XLA compile amortization, not throughput
+        # (the same stance as run_table_streaming's warmup waves)
+        # the warmup wait keeps the full budget regardless of
+        # verify_timeout_s: a slow first delivery is compile/stall
+        # headroom, not the end-state verification the knob bounds
+        warm_target = max(100, target_ops // 5)
+        while gen.row_ops < warm_target:
+            await gen.run_tx(db)
+        await asyncio.wait_for(wait_delivered(), timeout=240)
+        # wait out background host-program builds (see
+        # run_table_streaming's warmup) so the measured window runs warm
+        # programs
+        await _wait_background_compiles()
+
+        # explicit `steps` runs exactly that many generator steps (the
+        # smoke slice); otherwise step until ~target_ops row ops
+        # committed — ops per step vary wildly across profiles (a DDL
+        # backfill updates every live row), so a step-count heuristic
+        # alone would run away
+        ops0 = gen.row_ops
+        t0 = time.perf_counter()
+        steps_run = 0
+        while (steps_run < steps if steps is not None
+               else gen.row_ops - ops0 < target_ops):
+            await gen.run_tx(db)
+            steps_run += 1
+        t_prod = time.perf_counter()
+        # wait_delivered only returns once gen.delivered(dest) held on
+        # the quiesced stream; recomputing the O(events x columns)
+        # reconstruction here would just repeat it
+        try:
+            await asyncio.wait_for(wait_delivered(),
+                                   timeout=verify_timeout_s)
+            verified = True
+        except asyncio.TimeoutError:
+            # the stream either quiesced with a destination view that
+            # never matched the generator's committed truth, or stalled
+            # outright — both are delivery correctness failures the
+            # caller gates on, not harness errors worth a traceback
+            verified = False
+        t_done = time.perf_counter()
+    finally:
+        # guard: wait() asserts a started pipeline, and a start() that
+        # raised mid-way has nothing for shutdown_and_wait to join
+        if pipeline._apply_task is not None:
+            await pipeline.shutdown_and_wait()
+    measured = gen.row_ops - ops0
+    return {
+        "profile": profile,
+        "seed": seed,
+        "steps": steps_run,
+        "row_ops": measured,
+        "warmup_ops": ops0,
+        "producer_events_per_second":
+            round(measured / max(t_prod - t0, 1e-9)),
+        "events_per_second": round(measured / max(t_done - t0, 1e-9)),
+        "verified": bool(verified),
+        "expected_rows": sum(len(v) for v in gen.expected.values()),
+    }
+
+
+async def run_workload_matrix(profiles=None, seed: int = 7,
+                              engine: str = "tpu",
+                              target_ops: int = 3_000) -> dict:
+    """`run_workload_streaming` across the whole profile catalog (or a
+    selected subset): the per-workload throughput matrix published as
+    `workload_floors` in BENCH_FLOOR.json."""
+    from ..workloads import profile_names
+
+    names = list(profiles) if profiles else profile_names()
+    rows = {}
+    ok = True
+    for name in names:
+        out = await run_workload_streaming(name, seed=seed, engine=engine,
+                                           target_ops=target_ops)
+        rows[name] = out
+        ok = ok and out["verified"]
+    return {
+        "mode": "workload_matrix", "engine": engine, "seed": seed,
+        "profiles": rows,
+        "events_per_second": {n: r["events_per_second"]
+                              for n, r in rows.items()},
+        "all_verified": bool(ok),
     }
 
 

@@ -23,6 +23,17 @@ def main(argv: list[str] | None = None) -> int:
                         help="workload + injection RNG seed (default 7)")
     parser.add_argument("--scenario", default=None,
                         help="run one scenario by name (default: all)")
+    parser.add_argument("--workload", default=None,
+                        help="drive the selected scenario(s) with a named "
+                             "workload profile (etl_tpu/workloads) instead "
+                             "of the default mixed-insert traffic; the "
+                             "run manifest and injection trace identify "
+                             "the profile and replay bit-identically per "
+                             "(scenario, workload, seed)")
+    parser.add_argument("--matrix", action="store_true",
+                        help="run the curated chaos x workload matrix "
+                             "(corpus.WORKLOAD_MATRIX) instead of the "
+                             "base corpus")
     parser.add_argument("--list", action="store_true",
                         help="list scenario names and exit")
     parser.add_argument("--timeout", type=float, default=60.0,
@@ -36,16 +47,48 @@ def main(argv: list[str] | None = None) -> int:
         # usable on hosts without one (same knob as tests/conftest.py)
         os.environ["JAX_PLATFORMS"] = "cpu"
 
-    from .corpus import SCENARIOS, get_scenario
+    from .corpus import SCENARIOS, WORKLOAD_MATRIX, get_scenario
     from .runner import run_scenario
 
     if args.list:
-        for s in SCENARIOS:
+        for s in SCENARIOS + WORKLOAD_MATRIX:
             print(f"{s.name}: {s.description}")
         return 0
 
-    scenarios = [get_scenario(args.scenario)] if args.scenario else \
-        list(SCENARIOS)
+    if args.matrix:
+        # the matrix entries carry their profile in their NAME
+        # (base__profile); overriding it with --workload (or narrowing
+        # with --scenario, which already selects matrix entries by name
+        # on its own) would make the manifest name a run that didn't
+        # happen
+        if args.workload or args.scenario:
+            parser.error("--matrix cannot be combined with --workload or "
+                         "--scenario (use --scenario <base>__<profile> to "
+                         "run one matrix entry)")
+        scenarios = list(WORKLOAD_MATRIX)
+    elif args.scenario:
+        scenarios = [get_scenario(args.scenario)]
+    else:
+        scenarios = list(SCENARIOS)
+    if args.workload:
+        from dataclasses import replace
+
+        from ..workloads import get_profile
+
+        get_profile(args.workload)  # fail fast on a typo'd profile name
+        # matrix entries embed their profile in their NAME
+        # (base__profile); rewriting the workload underneath one would
+        # produce a manifest whose name claims traffic that didn't run —
+        # the same hazard the --matrix guard above blocks
+        clash = [s.name for s in scenarios
+                 if s.workload is not None and s.workload != args.workload]
+        if clash:
+            parser.error(f"--workload conflicts with matrix entr"
+                         f"{'ies' if len(clash) > 1 else 'y'} "
+                         f"{', '.join(clash)} (the name pins the profile; "
+                         "pick --scenario <base> --workload <profile> "
+                         "instead)")
+        scenarios = [replace(s, workload=args.workload) for s in scenarios]
     all_ok = True
     for scenario in scenarios:
         run = asyncio.run(run_scenario(scenario, args.seed,

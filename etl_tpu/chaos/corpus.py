@@ -251,8 +251,47 @@ SCENARIOS: tuple[Scenario, ...] = (
 
 
 def get_scenario(name: str) -> Scenario:
-    for s in SCENARIOS:
+    for s in SCENARIOS + WORKLOAD_MATRIX:
         if s.name == name:
             return s
     raise KeyError(f"unknown scenario {name!r}; known: "
-                   f"{', '.join(s.name for s in SCENARIOS)}")
+                   f"{', '.join(s.name for s in SCENARIOS + WORKLOAD_MATRIX)}")
+
+
+# --- chaos × workload matrix (ISSUE 7) --------------------------------------
+#
+# A curated subset of the corpus re-run under adversarial workload
+# profiles (etl_tpu/workloads): the invariant checker must hold for
+# update/delete/TOAST/truncate/DDL/partitioned traffic through the same
+# fault schedules, not just insert-CDC. Curated rather than full
+# cross-product to stay inside the tier-1 wall-clock budget — the
+# crash→restart base runs against every profile (the at-least-once window
+# is where non-insert semantics bite hardest); the stall and wire bases
+# sample the profiles whose recovery differs most (truncate barriers,
+# full-identity re-streams, DDL mid-recovery, partition fan-in).
+
+#: the non-insert profiles the matrix proves out (≥4 required by the
+#: acceptance criteria)
+WORKLOAD_MATRIX_PROFILES = (
+    "update_heavy_default", "update_heavy_full", "delete_heavy_default",
+    "toast_heavy_full", "truncate_storm", "ddl_churn",
+)
+
+
+def _with_workload(base_name: str, profile: str) -> Scenario:
+    from dataclasses import replace
+
+    base = next(s for s in SCENARIOS if s.name == base_name)
+    return replace(
+        base, name=f"{base_name}__{profile}", workload=profile,
+        description=f"{base.description} [workload={profile}]")
+
+
+WORKLOAD_MATRIX: tuple[Scenario, ...] = tuple(
+    [_with_workload("crash_mid_apply", p) for p in WORKLOAD_MATRIX_PROFILES]
+    + [_with_workload("stall_dest_write", p)
+       for p in ("update_heavy_full", "truncate_storm")]
+    + [_with_workload("wire_disconnect_mid_cdc", p)
+       for p in ("delete_heavy_default", "ddl_churn", "partitioned_root",
+                 "tiny_txs")]
+)

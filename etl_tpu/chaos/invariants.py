@@ -29,7 +29,8 @@ import asyncio
 import threading
 from dataclasses import dataclass, field
 
-from ..models.event import (DeleteEvent, InsertEvent, UpdateEvent)
+from ..models.event import (DeleteEvent, InsertEvent, TruncateEvent,
+                            UpdateEvent)
 from ..models.table_state import TableStateType
 
 
@@ -81,41 +82,94 @@ def _row_pk(row) -> object:
 
 
 def reconstruct_final_view(dest, table_ids) -> dict:
-    """{table_id: {pk: tuple(values)}} from copied rows + row events.
+    """{table_id: {pk: tuple(values)}} from copied rows + delivered
+    events, replayed in WAL order.
 
     Events delivered before a table's LAST destination drop belong to an
     abandoned copy attempt (the drop-and-recopy crash-consistency path)
-    and are excluded. Among the surviving events each pk takes the one
-    with the highest (commit_lsn, tx_ordinal) — at-least-once
-    re-delivery then collapses to the final value, the same collapse
-    rule upsert destinations apply (_CHANGE_SEQUENCE_NUMBER)."""
+    and are excluded. The survivors are sorted by their WAL rank
+    (commit_lsn, tx_ordinal) — at-least-once re-delivery then collapses
+    naturally, because applying the same ranked event twice is idempotent
+    — and applied as a destination would apply them:
+
+      insert/update — upsert by pk; an update carrying an old image whose
+                      identity differs from the new row (a PK-changing
+                      update) also removes the OLD pk (the delete+upsert
+                      split key-aware destinations perform); a new value
+                      that is TOAST-unchanged patches column-wise,
+                      keeping the stored value (the PATCH path);
+      delete        — remove the pk (the old image under replica identity
+                      DEFAULT carries only identity columns — the pk is
+                      all that is consulted);
+      truncate      — clear every listed table, including its copied
+                      baseline rows (the barrier the coalesced columnar
+                      write path must order correctly).
+    """
+    from ..models.cell import TOAST_UNCHANGED
+
     view: dict = {}
     last_drop = getattr(dest, "drop_seq_by_table", {})
     event_seqs = getattr(dest, "event_seqs", None)
+    wanted = set(table_ids)
     for tid in table_ids:
         view[tid] = {_row_pk(r): tuple(r.values)
                      for r in dest.table_rows.get(tid, [])}
-    best: dict = {}  # (tid, pk) -> (commit_lsn, tx_ordinal, event)
+    # (rank, delivery order, table, event) for every surviving event that
+    # touches a wanted table; truncates fan out to each listed table
+    ordered: list = []
     for i, e in enumerate(dest.events):
-        if not isinstance(e, (InsertEvent, UpdateEvent, DeleteEvent)):
-            continue
-        tid = e.schema.id
-        if tid not in view:
-            continue
         seq = event_seqs[i] if event_seqs is not None else i
-        if seq < last_drop.get(tid, -1):
-            continue
-        row = e.old_row if isinstance(e, DeleteEvent) else e.row
-        key = (tid, _row_pk(row))
-        rank = (int(e.commit_lsn), e.tx_ordinal)
-        if key not in best or rank >= best[key][0]:
-            best[key] = (rank, e)
-    for (tid, pk), (_, e) in best.items():
-        if isinstance(e, DeleteEvent):
-            view[tid].pop(pk, None)
+        if isinstance(e, (InsertEvent, UpdateEvent, DeleteEvent)):
+            tid = e.schema.id
+            if tid not in wanted or seq < last_drop.get(tid, -1):
+                continue
+            ordered.append(((int(e.commit_lsn), e.tx_ordinal), i, tid, e))
+        elif isinstance(e, TruncateEvent):
+            for sch in e.schemas:
+                if sch.id not in wanted \
+                        or seq < last_drop.get(sch.id, -1):
+                    continue
+                ordered.append(((int(e.commit_lsn), e.tx_ordinal), i,
+                                sch.id, e))
+    ordered.sort(key=lambda t: (t[0], t[1]))
+    for _, _, tid, e in ordered:
+        table = view[tid]
+        if isinstance(e, TruncateEvent):
+            table.clear()
+        elif isinstance(e, DeleteEvent):
+            table.pop(_row_pk(e.old_row), None)
         else:
-            view[tid][pk] = tuple(e.row.values)
+            pk = _row_pk(e.row)
+            prev = table.get(pk)
+            if isinstance(e, UpdateEvent) and e.old_row is not None:
+                old_pk = _row_pk(e.old_row)
+                if old_pk != pk:
+                    # a PK-changing update: the stored row (and so the
+                    # TOAST patch source) lives under the OLD key
+                    popped = table.pop(old_pk, None)
+                    if popped is not None:
+                        prev = popped
+            values = tuple(
+                (prev[k] if prev is not None and k < len(prev) else v)
+                if v is TOAST_UNCHANGED else v
+                for k, v in enumerate(e.row.values))
+            table[pk] = values
     return view
+
+
+def view_matches(dest, table_ids, expected: dict) -> bool:
+    """True when the destination's reconstructed final view equals the
+    committed source truth — the shared quiescence/verification test used
+    by both the chaos runner and the workload bench harness, so the
+    collapse rules above can never silently diverge between them."""
+    view = reconstruct_final_view(dest, table_ids)
+    for tid, rows in expected.items():
+        got = view.get(tid, {})
+        if set(got) != set(rows):
+            return False
+        if any(got[pk] != vals for pk, vals in rows.items()):
+            return False
+    return True
 
 
 def check_invariants(*, expected: dict, dest, store,

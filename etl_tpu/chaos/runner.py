@@ -43,7 +43,7 @@ from ..telemetry.metrics import (ETL_CHAOS_INJECTED_FAULTS_TOTAL,
                                  ETL_CHAOS_SCENARIOS_TOTAL, registry)
 from . import failpoints
 from .invariants import (InvariantReport, LeakProbe, check_invariants,
-                         reconstruct_final_view)
+                         view_matches)
 from .scenario import FaultKind, FaultSpec, Scenario
 
 BASE_TABLE_ID = 16384
@@ -125,6 +125,7 @@ class ChaosRun:
     def describe(self) -> dict:
         return {
             "scenario": self.scenario.name,
+            "workload": self.scenario.workload or "default",
             "seed": self.seed,
             "ok": self.ok,
             "trace": {site: list(fires)
@@ -137,6 +138,21 @@ class ChaosRun:
             "invariants": self.report.describe(),
             "duration_s": round(self.duration_s, 3),
         }
+
+
+def _make_workload(scenario: Scenario, rng: random.Random):
+    """The scenario's traffic source: a named workload profile
+    (etl_tpu/workloads — update/delete/TOAST/truncate/DDL/partitioned
+    shapes) when `scenario.workload` is set, else the default mixed-insert
+    workload below. Both expose the same interface (build_db / run_tx /
+    table_ids / expected / tx_index / delivered) and draw from the
+    scenario's single seeded RNG, so the injection interleaving replays
+    bit-identically either way."""
+    if scenario.workload:
+        from ..workloads import make_chaos_workload
+
+        return make_chaos_workload(scenario.workload, rng)
+    return _Workload(scenario, rng)
 
 
 class _Workload:
@@ -201,14 +217,7 @@ class _Workload:
         self.tx_index += 1
 
     def delivered(self, dest: TracingDestination) -> bool:
-        view = reconstruct_final_view(dest, self.table_ids)
-        for tid, rows in self.expected.items():
-            got = view.get(tid, {})
-            if set(got) != set(rows):
-                return False
-            if any(got[pk] != vals for pk, vals in rows.items()):
-                return False
-        return True
+        return view_matches(dest, self.table_ids, self.expected)
 
 
 class _CrashState:
@@ -321,7 +330,7 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
                               run: ChaosRun) -> None:
     rng = random.Random(seed)
     leak_probe = LeakProbe.capture()
-    workload = _Workload(scenario, rng)
+    workload = _make_workload(scenario, rng)
     db = workload.build_db()
     store = RecordingStore()
     inner = TracingDestination()

@@ -81,6 +81,12 @@ class Scenario:
     rows_per_table: int = 3
     txs: int = 6
     rows_per_tx: int = 4
+    # named workload profile (etl_tpu/workloads) driving the traffic
+    # instead of the default mixed-insert workload; the profile then owns
+    # the table shape (tables/rows_per_table/rows_per_tx above are
+    # ignored) while `txs` still counts generator steps. One (scenario,
+    # workload, seed) triple replays bit-identically.
+    workload: str | None = None
     # crash handling: how many hard restarts the runner should survive
     # (must be >= number of CRASH spec firings; compound crash-during-
     # recovery scenarios re-arm a crash after the first restart)
@@ -109,6 +115,7 @@ class Scenario:
         return {
             "name": self.name,
             "description": self.description,
+            "workload": self.workload or "default",
             "tables": self.tables,
             "rows_per_table": self.rows_per_table,
             "txs": self.txs,

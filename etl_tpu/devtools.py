@@ -8,7 +8,11 @@ server, and chaos is driven through its connection-severing hooks.
 Commands:
   serve-source   start a fake PG server with N generated rows (the
                  pg-fill-table + `cargo x postgres start` analogue);
-                 prints the port and streams CDC traffic if requested
+                 prints the port and streams CDC traffic if requested.
+                 `--workload <profile>` serves a named adversarial
+                 profile from etl_tpu/workloads instead (update/delete/
+                 TOAST/truncate/DDL/partitioned traffic, deterministic
+                 per (profile, --seed))
   chaos          run a pipeline over real TCP against the fake server
                  while repeatedly severing every replication stream
                  (NetworkChaos partition analogue), then verify exactly-
@@ -53,11 +57,33 @@ def _make_filled_db(n_rows: int, n_tables: int = 1):
 async def serve_source(args) -> int:
     from .testing.fake_pg_server import FakePgServer
 
-    db, tids = _make_filled_db(args.rows, args.tables)
+    gen = None
+    if args.workload:
+        from .workloads import WorkloadGenerator
+
+        gen = WorkloadGenerator(args.workload, seed=args.seed)
+        db, tids = gen.build_db(), gen.table_ids
+    else:
+        db, tids = _make_filled_db(args.rows, args.tables)
     server = FakePgServer(db)
     await server.start()
-    print(json.dumps({"port": server.port, "publication": "pub",
-                      "tables": tids, "rows_per_table": args.rows}))
+    info = {"port": server.port, "publication": "pub"}
+    if gen is not None:
+        info.update(gen.describe())
+        info["seed"] = args.seed
+    else:
+        info["rows_per_table"] = args.rows
+    info["tables"] = tids  # the published table OIDs (roots when partitioned)
+    print(json.dumps(info))
+    if args.cdc_rate > 0 and gen is not None:
+        # profile-shaped CDC: generator steps until ~cdc_rate row ops
+        # landed this second (a step's op count varies by profile — a
+        # giant_tx step alone is 512 ops)
+        while True:
+            ops0 = gen.row_ops
+            while gen.row_ops - ops0 < args.cdc_rate:
+                await gen.run_tx(db)
+            await asyncio.sleep(1.0)
     if args.cdc_rate > 0:
         i = args.rows
         while True:
@@ -396,7 +422,19 @@ def main(argv=None) -> int:
     sp.add_argument("--rows", type=int, default=10_000)
     sp.add_argument("--tables", type=int, default=1)
     sp.add_argument("--cdc-rate", type=int, default=0,
-                    help="rows/second of continuous CDC traffic")
+                    help="rows/second of continuous CDC traffic (with "
+                         "--workload: row OPS/second of profile-shaped "
+                         "traffic)")
+    sp.add_argument("--workload", default=None, metavar="PROFILE",
+                    help="serve a named workload profile from "
+                         "etl_tpu/workloads (update/delete/TOAST/"
+                         "truncate/DDL/partitioned shapes; see "
+                         "docs/workloads.md) instead of generated "
+                         "filler rows; --rows/--tables are then owned "
+                         "by the profile. Deterministic per "
+                         "(profile, --seed)")
+    sp.add_argument("--seed", type=int, default=7,
+                    help="workload generator seed (with --workload)")
 
     cp = sub.add_parser("chaos", help="chaos scenario matrix")
     cp.add_argument("--rows", type=int, default=2_000)

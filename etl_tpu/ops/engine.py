@@ -190,6 +190,89 @@ def _shared_fn_put(key: tuple, fn: Callable) -> None:
             _SHARED_FN_CACHE.popitem(last=False)
 
 
+# keys whose host program is compiling on a background thread right now:
+# `_route` keeps sending matching batches to the oracle until the compile
+# lands, so neither the triggering batch nor its followers block on jax's
+# per-signature compile lock
+_BG_COMPILE_KEYS: set = set()
+# keys whose background build raised: decode stays on the oracle for the
+# stream's lifetime rather than respawning a doomed compile thread (and
+# re-logging) on every subsequent batch of that signature
+_BG_COMPILE_FAILED: set = set()
+_BG_COMPILE_LOCK = threading.Lock()
+
+
+def _host_fn_key(row_capacity: int, specs: tuple) -> tuple:
+    """The module-level program-cache key of the HOST decode path for one
+    (row bucket, specs) signature: host packs force nibble compression
+    off, never shard on the mesh, and never select pallas. The dispatch
+    stage builds its keys through this same helper, so the probe in
+    `_host_fn_ready` can never drift from the cache it is probing."""
+    return (row_capacity, specs, False, None, False, True)
+
+
+def _host_fn_ready(decoder: "DeviceDecoder", staged: "StagedBatch",
+                   specs: tuple) -> bool:
+    """True when the host program for this (bucket, specs) is compiled and
+    callable without blocking. On a cold key, start the build+compile on a
+    background thread (executing the decoder's own dispatch path against
+    the triggering batch, so the key and shapes match exactly) and report
+    not ready."""
+    key = _host_fn_key(staged.row_capacity, specs)
+    with _BG_COMPILE_LOCK:
+        if key in _BG_COMPILE_KEYS or key in _BG_COMPILE_FAILED:
+            return False
+        if _shared_fn_get(key) is not None:
+            return True
+        _BG_COMPILE_KEYS.add(key)
+
+    def work() -> None:
+        try:
+            value, _ = decoder._device_call(staged, specs, host=True)
+            jax.block_until_ready(value)
+        except Exception:
+            import logging
+
+            with _BG_COMPILE_LOCK:
+                _BG_COMPILE_FAILED.add(key)
+            logging.getLogger("etl_tpu.ops").warning(
+                "background host-program compile failed; batches of this "
+                "signature keep decoding on the oracle", exc_info=True)
+        finally:
+            with _BG_COMPILE_LOCK:
+                _BG_COMPILE_KEYS.discard(key)
+
+    from ..telemetry.metrics import (ETL_DECODE_BACKGROUND_COMPILES_TOTAL,
+                                     registry)
+
+    registry.counter_inc(ETL_DECODE_BACKGROUND_COMPILES_TOTAL)
+    # non-daemon: a daemon thread killed mid-XLA-build at interpreter
+    # teardown aborts the whole process from C++ ("terminate called
+    # without an active exception"); non-daemon means process exit joins
+    # an in-flight compile instead — rare in practice, compiles happen in
+    # a stream's first seconds
+    try:
+        threading.Thread(target=work, name="etl-decode-bg-compile",
+                         daemon=False).start()
+    except RuntimeError:
+        # thread limit / interpreter shutdown: work()'s finally never runs,
+        # so release the key here and pin the signature to the oracle
+        # rather than raising into the decode path
+        with _BG_COMPILE_LOCK:
+            _BG_COMPILE_KEYS.discard(key)
+            _BG_COMPILE_FAILED.add(key)
+    return False
+
+
+def background_compiles_inflight() -> int:
+    """How many host-program builds are currently running on background
+    threads. Bench warmups poll this to zero before opening a measured
+    window — otherwise the window measures the transient oracle-fallback
+    period instead of the warm steady state."""
+    with _BG_COMPILE_LOCK:
+        return len(_BG_COMPILE_KEYS)
+
+
 def _donation_supported() -> bool:
     """Buffer donation is implemented on TPU/GPU only; on the CPU backend
     jax warns per call and keeps both buffers alive, so donating there
@@ -385,9 +468,19 @@ class DeviceDecoder:
                  host_min_rows: int | None = None,
                  mesh: "object | str | None" = "auto",
                  mesh_min_rows: int | None = None,
-                 telemetry: bool = True):
+                 telemetry: bool = True,
+                 nonblocking_compile: bool = False):
         self.schema = schema
         self.use_pallas = use_pallas
+        # streaming decoders (assembler / copy) must never block a worker
+        # on a first-touch XLA build: a 120-column host program compiles
+        # for tens of seconds (measured 32s on this container), which
+        # freezes apply progress past the stall deadline and sends the
+        # supervision watchdog into a cancel→re-stream→re-wedge loop.
+        # With nonblocking_compile the cold (bucket, specs) batch decodes
+        # on the oracle while the program compiles on a background
+        # thread; warm batches route to the host program as usual.
+        self.nonblocking_compile = nonblocking_compile
         # telemetry=False keeps synthetic decodes (the autotune host-rate
         # probe) out of the routed-rows/decode counters so the device-share
         # metric reflects real replication traffic only
@@ -632,8 +725,9 @@ class DeviceDecoder:
         # rides in the key, so a pallas fallback just stops selecting
         # the pallas entries instead of clearing anything
         pallas = self.use_pallas and not host
-        key = (packed.row_capacity, specs, packed.nibble,
-               self.mesh if packed.use_mesh else None, pallas, host)
+        key = _host_fn_key(packed.row_capacity, specs) if host else \
+            (packed.row_capacity, specs, packed.nibble,
+             self.mesh if packed.use_mesh else None, pallas, False)
         fn = _shared_fn_get(key)
         if fn is None:
             fn = _build_device_fn(
@@ -898,10 +992,22 @@ class DeviceDecoder:
             return "device", self._specs(staged, self._widths(staged))
         if self._dense and staged.n_rows >= self.host_min_rows \
                 and _host_cpu_device() is not None:
+            specs = self._host_specs()
+            if self.nonblocking_compile \
+                    and not _host_fn_ready(self, staged, specs):
+                # cold program: decode THIS batch on the oracle while the
+                # build runs on a background thread — a synchronous
+                # first-touch compile here (tens of seconds on wide
+                # schemas) would freeze apply progress past the stall
+                # deadline and spiral the watchdog into restarts
+                if self._telemetry:
+                    registry.counter_inc(ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL,
+                                         staged.n_rows)
+                return "oracle", ()
             if self._telemetry:
                 registry.counter_inc(ETL_DECODE_ROUTED_HOST_ROWS_TOTAL,
                                      staged.n_rows)
-            return "host", self._host_specs()
+            return "host", specs
         if self._telemetry:
             registry.counter_inc(ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL,
                                  staged.n_rows)

@@ -111,6 +111,17 @@ class FakeDatabase:
         self.applied_migrations: list[str] = []
         self.ddl_trigger_installed = False
         self.standbys: list["FakeStandby"] = []  # physical replicas
+        # deterministic commit clock: when set, commit timestamps advance
+        # from this value instead of reading the wall clock — one
+        # (workload, seed) pair then replays a byte-identical WAL stream
+        # (workloads/generator.py determinism contract)
+        self.clock_us: int | None = None
+
+    def commit_clock_us(self) -> int:
+        if self.clock_us is not None:
+            self.clock_us += 1_000
+            return self.clock_us
+        return _now_us()
 
     # -- test-facing setup ----------------------------------------------------
 
@@ -323,7 +334,7 @@ class FakeTransaction:
 
     async def commit(self) -> Lsn:
         db = self.db
-        ts = _now_us()
+        ts = db.commit_clock_us()
         begin_at = db.current_lsn + 8
 
         # Relation messages are emitted lazily before a table's first row
@@ -449,7 +460,23 @@ class FakeTransaction:
                         db.tables[tid].encoded.clear()
             elif kind == "A":
                 _, tid, new_schema, _ = op
-                db.tables[tid].schema = new_schema
+                t = db.tables[tid]
+                old_names = [c.name for c in t.schema.columns]
+                new_names = [c.name for c in new_schema.columns]
+                if new_names != old_names:
+                    # ALTER with column changes rewrites storage: existing
+                    # rows are projected onto the new column list by name
+                    # (added columns NULL, dropped columns gone) — without
+                    # this, a later update/delete's old image would carry
+                    # the pre-ALTER column count against the post-ALTER
+                    # RELATION message, which a real walsender can never
+                    # produce
+                    idx = {n: i for i, n in enumerate(old_names)}
+                    t.rows[:] = [
+                        [row[idx[n]] if n in idx else None
+                         for n in new_names] for row in t.rows]
+                    t.invalidate_encoded()
+                t.schema = new_schema
                 relation_sent.discard(tid)
                 published = any(tid in tids
                                 for tids in db.publications.values())

@@ -209,7 +209,12 @@ class EventAssembler:
         self._run = None
         decoder = self._decoders.get(r.table_id)
         if decoder is None or decoder.schema is not r.schema:
-            decoder = DeviceDecoder(r.schema)
+            # nonblocking: a cold (bucket, specs) program compiles on a
+            # background thread while its batches decode on the oracle —
+            # a synchronous first-touch build of a wide schema (measured
+            # 32s at 120 columns) would wedge the apply loop past the
+            # stall deadline and spiral the watchdog into restarts
+            decoder = DeviceDecoder(r.schema, nonblocking_compile=True)
             self._decoders[r.table_id] = decoder
         lens = np.fromiter((len(p) for p in r.payloads), dtype=np.int32,
                            count=len(r.payloads))

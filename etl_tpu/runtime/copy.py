@@ -253,7 +253,11 @@ async def parallel_table_copy(*, source_factory, primary_source,
             await primary_source.estimate_table_stats(schema.id)
         parts = plan_copy_partitions(est_rows, heap_pages, config)
     n_conns = min(config.table_sync_copy.max_connections, len(parts))
-    decoder = DeviceDecoder(schema) \
+    # nonblocking: cold decode programs compile off-thread while their
+    # chunks decode on the oracle — an inline first-touch build of a wide
+    # schema would freeze this sync worker past its stall deadline (see
+    # runtime/assembler._seal_run)
+    decoder = DeviceDecoder(schema, nonblocking_compile=True) \
         if config.batch.batch_engine is BatchEngine.TPU else None
     progress = CopyProgress()
     queue: asyncio.Queue[CopyPartition] = asyncio.Queue()

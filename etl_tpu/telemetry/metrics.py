@@ -86,6 +86,12 @@ ETL_CHAOS_RECOVERY_DURATION_SECONDS = "etl_chaos_recovery_duration_seconds"
 # or real) device allocation failure — the OOM-resilience path
 ETL_DECODE_DEVICE_OOM_FALLBACKS_TOTAL = \
     "etl_decode_device_oom_fallbacks_total"
+# a nonblocking decoder found its host-path program uncompiled and kicked
+# the compile to a background thread, decoding the triggering batches on
+# the oracle meanwhile (wide schemas compile for tens of seconds — inline
+# that would wedge the apply loop into a stall-restart cycle)
+ETL_DECODE_BACKGROUND_COMPILES_TOTAL = \
+    "etl_decode_background_compiles_total"
 # supervision subsystem (etl_tpu/supervision): watchdog detections by
 # kind+component, cancel-and-restart escalations, the pipeline health
 # state (0 healthy / 1 degraded / 2 faulted), the oldest heartbeat age

@@ -431,3 +431,12 @@ class TestBenchSmoke:
         assert out["streaming_table_rows_constructed"] == 0
         assert out["egress_encoders_above_floor"] is True, out
         assert out["egress_failures"] == []
+        # workload-diversity satellite (ISSUE 7): the mixed-profile slice
+        # (update-heavy + truncate-storm) must deliver a VERIFIED end
+        # state above its per-workload floor, so a regression that only
+        # bites non-insert traffic fails CI instead of hiding behind the
+        # insert-CDC streaming floor
+        assert out["workload_profiles_above_floor"] is True, out
+        assert out["workload_failures"] == []
+        assert set(out["workload_events_per_sec"]) >= \
+            {"update_heavy_default", "truncate_storm"}
