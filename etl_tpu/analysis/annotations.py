@@ -37,6 +37,26 @@ def hot_loop(fn: _F) -> _F:
 #: matching caveat as HOT_LOOP_ATTR)
 DISPATCH_STAGE_ATTR = "__etl_dispatch_stage__"
 
+#: attribute set by @admission_path (runtime-introspectable, same lexical
+#: matching caveat as HOT_LOOP_ATTR)
+ADMISSION_PATH_ATTR = "__etl_admission_path__"
+
+
+def admission_path(fn: _F) -> _F:
+    """Mark `fn` as part of the batch-admission scheduler's grant path
+    (ops/pipeline.AdmissionScheduler): code that runs UNDER the
+    scheduler's condition lock or between a tenant's acquire and the
+    dispatch it gates. etl-lint's `admission-blocking-fetch` rule forbids
+    blocking device fetches here (`jax.device_get`, `.block_until_ready`,
+    `np.asarray` on device values, and `jax.device_put` uploads too — no
+    device traffic of any kind belongs in an admission decision): a fetch
+    inside the grant path would serialize EVERY tenant's admission behind
+    one tenant's device round trip, turning the fairness lock into a
+    head-of-line blocker. Weight/lag providers must read host state
+    (LSN deltas, counters), never device values."""
+    setattr(fn, ADMISSION_PATH_ATTR, True)
+    return fn
+
 
 def dispatch_stage(fn: _F) -> _F:
     """Mark `fn` as the decode pipeline's DISPATCH stage (ops/pipeline.py

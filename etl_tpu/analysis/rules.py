@@ -572,6 +572,54 @@ class HotLoopRowMaterialization(Rule):
             f"shim with an inline ignore")
 
 
+# -- rule 14 ------------------------------------------------------------------
+
+#: device traffic forbidden on the admission grant path: the fetch set
+#: from rule 6 PLUS `jax.device_put` — the @dispatch_stage upload
+#: sanction does NOT extend here, because an admission decision holds the
+#: scheduler's condition lock (or gates every tenant's dispatch), so ANY
+#: device call head-of-line-blocks all tenants, uploads included
+ADMISSION_DEVICE_DOTTED = HOT_TRANSFER_DOTTED | {"jax.device_put"}
+ADMISSION_DEVICE_METHODS = HOT_TRANSFER_METHODS
+
+
+class AdmissionBlockingFetch(Rule):
+    """Blocking device traffic inside the batch-admission scheduler's
+    grant path (`@admission_path`, ops/pipeline.AdmissionScheduler): a
+    `jax.device_get` / `.block_until_ready` / `np.asarray`-on-device-value
+    under the scheduler lock serializes EVERY tenant's admission behind
+    one tenant's device round trip — the fairness lock becomes a
+    head-of-line blocker and a lagging tenant's weight can't help it.
+    Lag/weight providers must read host state (LSN deltas, counters).
+    Lexical, same sanctioning machinery as @dispatch_stage: the frame
+    flag inherits into nested defs and lambdas (inline lag providers),
+    not across call edges — keep helpers called from the grant path
+    device-free or annotate them too."""
+
+    name = "admission-blocking-fetch"
+
+    def on_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not ctx.in_admission_path:
+            return
+        dotted = dotted_name(node.func)
+        subject = None
+        if dotted in ADMISSION_DEVICE_DOTTED:
+            subject = dotted
+        else:
+            term = terminal_name(node.func)
+            if term in ADMISSION_DEVICE_METHODS \
+                    and isinstance(node.func, ast.Attribute):
+                subject = f".{term}"
+        if subject is None:
+            return
+        ctx.report(
+            self.name, node, subject,
+            f"device call `{subject}` inside an @admission_path function "
+            f"head-of-line-blocks every tenant's admission; read host "
+            f"state in grant decisions and keep device traffic in the "
+            f"dispatch/fetch stages")
+
+
 # -- entry points -------------------------------------------------------------
 
 def default_rules() -> list[Rule]:
@@ -585,6 +633,7 @@ def default_rules() -> list[Rule]:
         UnboundedRetry(),
         UnboundedAwait(),
         HotLoopRowMaterialization(),
+        AdmissionBlockingFetch(),
     ]
 
 

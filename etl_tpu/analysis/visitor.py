@@ -133,6 +133,14 @@ HOT_LOOP_DECORATORS = frozenset({"hot_loop"})
 #: `jax.device_put` there while still forbidding fetch-side transfers
 DISPATCH_STAGE_DECORATORS = frozenset({"dispatch_stage"})
 
+#: decorator marking the admission scheduler's grant path
+#: (annotations.admission_path): the admission-blocking-fetch rule
+#: forbids ALL device traffic there — a fetch under the scheduler lock
+#: head-of-line-blocks every tenant's admission. Same sanctioning
+#: machinery as @dispatch_stage: a lexical frame flag inherited by
+#: nested defs/lambdas (lag/weight providers defined inline).
+ADMISSION_PATH_DECORATORS = frozenset({"admission_path"})
+
 
 def dotted_name(node: ast.AST) -> str | None:
     """`a.b.c` for a Name/Attribute chain, else None."""
@@ -216,14 +224,16 @@ class Rule:
 
 
 class _Frame:
-    __slots__ = ("name", "is_async", "is_hot", "is_dispatch")
+    __slots__ = ("name", "is_async", "is_hot", "is_dispatch",
+                 "is_admission")
 
     def __init__(self, name: str, is_async: bool, is_hot: bool,
-                 is_dispatch: bool = False):
+                 is_dispatch: bool = False, is_admission: bool = False):
         self.name = name
         self.is_async = is_async
         self.is_hot = is_hot
         self.is_dispatch = is_dispatch
+        self.is_admission = is_admission
 
 
 class LintContext(ast.NodeVisitor):
@@ -255,6 +265,10 @@ class LintContext(ast.NodeVisitor):
     @property
     def in_dispatch_stage(self) -> bool:
         return bool(self._frames) and self._frames[-1].is_dispatch
+
+    @property
+    def in_admission_path(self) -> bool:
+        return bool(self._frames) and self._frames[-1].is_admission
 
     @property
     def current_class(self) -> "str | None":
@@ -303,6 +317,8 @@ class LintContext(ast.NodeVisitor):
         is_hot = bool(decorators & HOT_LOOP_DECORATORS) or self.in_hot_loop
         is_dispatch = bool(decorators & DISPATCH_STAGE_DECORATORS) \
             or self.in_dispatch_stage
+        is_admission = bool(decorators & ADMISSION_PATH_DECORATORS) \
+            or self.in_admission_path
         for rule in self.rules:
             rule.on_function(self, node)
         # decorators, default args, and annotations execute ONCE at def
@@ -317,7 +333,7 @@ class LintContext(ast.NodeVisitor):
             if node.returns is not None:
                 self.visit(node.returns)
             self._frames.append(_Frame(node.name, is_async, is_hot,
-                                       is_dispatch))
+                                       is_dispatch, is_admission))
             try:
                 for stmt in node.body:
                     self.visit(stmt)
@@ -340,7 +356,8 @@ class LintContext(ast.NodeVisitor):
         try:
             self.visit(node.args)
             self._frames.append(_Frame("<lambda>", False, self.in_hot_loop,
-                                       self.in_dispatch_stage))
+                                       self.in_dispatch_stage,
+                                       self.in_admission_path))
             try:
                 self.visit(node.body)
             finally:

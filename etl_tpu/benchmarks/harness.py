@@ -59,6 +59,37 @@ def _pipeline_metrics() -> dict:
     return out
 
 
+def _admission_metrics() -> dict:
+    """Snapshot of the fair batch-admission scheduler and mesh-sharded
+    decode counters (ops/pipeline.AdmissionScheduler, ops/engine mesh
+    path). Per-tenant labels roll up via sum_* — benches report the delta
+    over their measured window."""
+    from ..telemetry.metrics import (
+        ETL_DECODE_ADMISSION_BYPASS_GRANTS_TOTAL,
+        ETL_DECODE_ADMISSION_GRANTS_TOTAL,
+        ETL_DECODE_ADMISSION_STARVATION_GRANTS_TOTAL,
+        ETL_DECODE_ADMISSION_WAIT_SECONDS, ETL_DECODE_MESH_BATCHES_TOTAL,
+        ETL_DECODE_MESH_PADDED_ROWS_TOTAL, ETL_DECODE_MESH_ROWS_TOTAL,
+        registry)
+
+    waits, wait_seconds = registry.sum_histogram(
+        ETL_DECODE_ADMISSION_WAIT_SECONDS)
+    return {
+        "admission_grants": registry.sum_counter(
+            ETL_DECODE_ADMISSION_GRANTS_TOTAL),
+        "admission_starvation_grants": registry.sum_counter(
+            ETL_DECODE_ADMISSION_STARVATION_GRANTS_TOTAL),
+        "admission_bypass_grants": registry.sum_counter(
+            ETL_DECODE_ADMISSION_BYPASS_GRANTS_TOTAL),
+        "admission_waits": waits,
+        "admission_wait_seconds": wait_seconds,
+        "mesh_batches": registry.get_counter(ETL_DECODE_MESH_BATCHES_TOTAL),
+        "mesh_rows": registry.get_counter(ETL_DECODE_MESH_ROWS_TOTAL),
+        "mesh_padded_rows": registry.get_counter(
+            ETL_DECODE_MESH_PADDED_ROWS_TOTAL),
+    }
+
+
 # ---------------------------------------------------------------------------
 # table_copy (reference table_copy.rs:74-183)
 # ---------------------------------------------------------------------------
@@ -380,6 +411,7 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
 
     routed0 = _routed()
     stages0 = _pipeline_metrics()
+    adm0 = _admission_metrics()
     # row-materialization gate input: zero constructions over the measured
     # window = the egress path stayed columnar fetch-to-wire (the smoke
     # gate asserts this on the null destination; 'memory' exercises the
@@ -440,6 +472,8 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
     routed_total = sum(routed.values())
     stages1 = _pipeline_metrics()
     stages = {k: stages1[k] - stages0[k] for k in stages1}
+    adm1 = _admission_metrics()
+    adm = {k: adm1[k] - adm0[k] for k in adm1}
     pack_s = stages["pipeline_pack_seconds"]
     lags_ms = [(t - commit_times[lsn]) * 1000 for lsn, t in arrivals
                if lsn in commit_times]
@@ -473,6 +507,16 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
         "decode_overlap_seconds": round(stages["overlap_seconds"], 4),
         "decode_overlap_ratio":
             round(stages["overlap_seconds"] / pack_s, 3) if pack_s else 0.0,
+        # fair-admission + mesh activity over the measured window: a lone
+        # stream should see zero wait time (uncontended grants), and
+        # mesh_* stay zero off-mesh — nonzero padded_rows/mesh_rows is
+        # the padding waste the operator tunes batch sizes against
+        "admission_grants": int(adm["admission_grants"]),
+        "admission_starvation_grants":
+            int(adm["admission_starvation_grants"]),
+        "admission_wait_seconds": round(adm["admission_wait_seconds"], 4),
+        "mesh_batches": int(adm["mesh_batches"]),
+        "mesh_padded_rows": int(adm["mesh_padded_rows"]),
         "replication_lag_p50_ms":
             round(pct(0.50), 2) if lags_ms else None,
         "replication_lag_p95_ms":
@@ -689,6 +733,171 @@ async def run_workload_matrix(profiles=None, seed: int = 7,
         "events_per_second": {n: r["events_per_second"]
                               for n, r in rows.items()},
         "all_verified": bool(ok),
+    }
+
+
+async def run_multi_pipeline(profiles=None, seed: int = 7,
+                             engine: str = "tpu",
+                             target_ops: int = 1_000,
+                             admission_capacity: int = 0,
+                             verify_timeout_s: float = 240.0) -> dict:
+    """N concurrent replication streams — one full Pipeline per workload
+    profile (the tenancy mix) — sharing ONE device set through the fair
+    batch-admission scheduler (ops/pipeline.AdmissionScheduler): the
+    one-device-set-serves-many-streams shape. Every stream runs the whole
+    path (fake walsender → apply loop → pipelined decode → memory
+    destination) with end-state verification, so the aggregate number
+    can't hide a tenant whose deliveries went wrong while the others
+    kept the scheduler busy.
+
+    Reports per-stream and AGGREGATE events/s over one shared measured
+    window, the scheduler's per-tenant grant/weight stats captured while
+    the tenants were still registered, the admission wait/grant counter
+    deltas, and whether the scheduler drained clean (no tickets or
+    tenants left after shutdown — the leak half of the chaos satellite,
+    asserted here on the happy path)."""
+    from ..chaos.runner import TracingDestination
+    from ..config import BatchConfig, BatchEngine, PipelineConfig
+    from ..models.table_state import TableStateType
+    from ..ops.pipeline import global_admission, reset_global_admission
+    from ..postgres.fake import FakeSource
+    from ..runtime import Pipeline
+    from ..store import NotifyingStore
+    from ..workloads import WorkloadGenerator, get_profile
+
+    # default mix pairs a small-flush tenant with a 512-row-transaction
+    # tenant: giant_tx flushes cross the host-XLA row threshold, so the
+    # run provably takes admission tickets (sub-threshold flushes decode
+    # on the per-row oracle, which holds no device capacity by design)
+    names = list(profiles) if profiles \
+        else ["insert_heavy", "giant_tx"]
+    # fresh process-wide scheduler: THIS run's capacity knob wins, and a
+    # previous bench/test can't leave a different capacity behind
+    reset_global_admission()
+
+    streams = []
+    for i, name in enumerate(names):
+        label = name if names.count(name) == 1 else f"{name}-{i}"
+        gen = WorkloadGenerator(get_profile(name), seed=seed + i)
+        db = gen.build_db()
+        pipeline = Pipeline(
+            config=PipelineConfig(
+                pipeline_id=i + 1, publication_name="pub",
+                batch=BatchConfig(max_fill_ms=30,
+                                  batch_engine=BatchEngine(engine),
+                                  admission_capacity=admission_capacity)),
+            store=(store := NotifyingStore()),
+            destination=(dest := TracingDestination()),
+            source_factory=lambda db=db: FakeSource(db))
+        streams.append({"label": label, "gen": gen, "db": db,
+                        "store": store, "dest": dest, "pipeline": pipeline})
+
+    async def wait_verified(s) -> None:
+        # same quiesce-then-reconstruct stance as run_workload_streaming:
+        # the O(events × columns) final-view rebuild runs only when the
+        # stream stops moving, so verification can't starve the loop
+        seen = -1
+        while True:
+            n = len(s["dest"].events)
+            if n == seen and s["gen"].delivered(s["dest"]):
+                return
+            seen = n
+            task = s["pipeline"]._apply_task
+            if task is not None and task.done():
+                task.result()
+                raise RuntimeError(
+                    f"stream {s['label']} stopped before delivering")
+            await asyncio.sleep(0.1)
+
+    started = []
+    verified: dict[str, bool] = {}
+    try:
+        for s in streams:
+            await s["pipeline"].start()
+            started.append(s)
+        await asyncio.gather(*(
+            asyncio.wait_for(
+                s["store"].notify_on(tid, TableStateType.READY), 120)
+            for s in streams for tid in s["gen"].table_ids))
+
+        # warmup off the clock (per-schema decode-program compiles — the
+        # same stance as every other harness mode), CONCURRENTLY: the
+        # warmup traffic itself runs through the shared scheduler
+        async def warm(s) -> None:
+            warm_target = max(60, target_ops // 5)
+            while s["gen"].row_ops < warm_target:
+                await s["gen"].run_tx(s["db"])
+            # full budget regardless of verify_timeout_s (the
+            # run_workload_streaming stance): a slow first delivery is
+            # compile/stall headroom, not the end-state verification
+            # the knob bounds
+            await asyncio.wait_for(wait_verified(s), 240)
+
+        await asyncio.gather(*(warm(s) for s in streams))
+        await _wait_background_compiles()
+
+        adm0 = _admission_metrics()
+        ops0 = {s["label"]: s["gen"].row_ops for s in streams}
+        t0 = time.perf_counter()
+
+        async def produce(s) -> None:
+            base = s["gen"].row_ops
+            while s["gen"].row_ops - base < target_ops:
+                await s["gen"].run_tx(s["db"])
+
+        await asyncio.gather(*(produce(s) for s in streams))
+        t_prod = time.perf_counter()
+
+        async def settle(s) -> None:
+            try:
+                await asyncio.wait_for(wait_verified(s), verify_timeout_s)
+                verified[s["label"]] = True
+            except asyncio.TimeoutError:
+                verified[s["label"]] = False
+
+        await asyncio.gather(*(settle(s) for s in streams))
+        t_done = time.perf_counter()
+        # tenant stats BEFORE shutdown deregisters them
+        sched = global_admission(admission_capacity or None)
+        sched_stats = sched.stats()
+        adm1 = _admission_metrics()
+    finally:
+        for s in started:
+            if s["pipeline"]._apply_task is not None:
+                await s["pipeline"].shutdown_and_wait()
+
+    adm = {k: adm1[k] - adm0[k] for k in adm1}
+    per_stream = {}
+    total_ops = 0
+    for s in streams:
+        measured = s["gen"].row_ops - ops0[s["label"]]
+        total_ops += measured
+        per_stream[s["label"]] = {
+            "profile": s["gen"].profile.name,
+            "row_ops": measured,
+            "events_per_second": round(measured / max(t_done - t0, 1e-9)),
+            "verified": bool(verified.get(s["label"], False)),
+        }
+    drained = sched.stats()
+    return {
+        "mode": "multi_pipeline", "engine": engine, "seed": seed,
+        "streams": len(streams),
+        "per_stream": per_stream,
+        "aggregate_row_ops": total_ops,
+        "aggregate_events_per_second":
+            round(total_ops / max(t_done - t0, 1e-9)),
+        "producer_events_per_second":
+            round(total_ops / max(t_prod - t0, 1e-9)),
+        "all_verified": all(per_stream[k]["verified"] for k in per_stream),
+        "admission_capacity": sched_stats["capacity"],
+        "admission_tenants": sched_stats["tenants"],
+        "admission_grants": int(adm["admission_grants"]),
+        "admission_starvation_grants":
+            int(adm["admission_starvation_grants"]),
+        "admission_bypass_grants": int(adm["admission_bypass_grants"]),
+        "admission_wait_seconds": round(adm["admission_wait_seconds"], 4),
+        "scheduler_drained": drained["in_flight"] == 0
+                             and not drained["tenants"],
     }
 
 

@@ -34,6 +34,15 @@ def main(argv: list[str] | None = None) -> int:
                         help="run the curated chaos x workload matrix "
                              "(corpus.WORKLOAD_MATRIX) instead of the "
                              "base corpus")
+    parser.add_argument("--multi-pipeline", dest="multi_pipeline",
+                        action="store_true",
+                        help="run the multi-pipeline scenario instead of "
+                             "the corpus: two replication streams share "
+                             "the batch-admission scheduler, one is "
+                             "hard-killed mid-stream and restarted; the "
+                             "survivor must keep decoding, invariants "
+                             "must hold for both, and the scheduler must "
+                             "drain without leaking tickets or tenants")
     parser.add_argument("--list", action="store_true",
                         help="list scenario names and exit")
     parser.add_argument("--timeout", type=float, default=60.0,
@@ -54,6 +63,17 @@ def main(argv: list[str] | None = None) -> int:
         for s in SCENARIOS + WORKLOAD_MATRIX:
             print(f"{s.name}: {s.description}")
         return 0
+
+    if args.multi_pipeline:
+        if args.matrix or args.workload or args.scenario:
+            parser.error("--multi-pipeline runs its own two-stream "
+                         "scenario and cannot be combined with "
+                         "--matrix/--workload/--scenario")
+        from .multi import run_multi_pipeline_scenario
+
+        run = asyncio.run(run_multi_pipeline_scenario(seed=args.seed))
+        print(json.dumps(run.describe(), sort_keys=True))
+        return 0 if run.ok else 1
 
     if args.matrix:
         # the matrix entries carry their profile in their NAME

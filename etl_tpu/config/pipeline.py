@@ -70,11 +70,19 @@ class BatchConfig:
     # batches packed/dispatched but not yet fetched. 3 ≈ one packing, one
     # on the device, one streaming back; drops to 1 under memory pressure
     decode_window: int = 3
+    # shared-capacity cap of the fair batch-admission scheduler
+    # (ops/pipeline.AdmissionScheduler): maximum device/host batches in
+    # flight across EVERY pipeline sharing this process's device set.
+    # 0 = auto (max(4, 2 × device count)); the FIRST pipeline to start
+    # fixes the process-wide value. Drops to 1 under memory pressure.
+    admission_capacity: int = 0
 
     def validate(self) -> None:
         _require(self.max_size_bytes > 0, "max_size_bytes must be > 0")
         _require(self.max_fill_ms > 0, "max_fill_ms must be > 0")
         _require(self.decode_window >= 1, "decode_window must be >= 1")
+        _require(self.admission_capacity >= 0,
+                 "admission_capacity must be >= 0 (0 = auto)")
 
 
 @dataclass(frozen=True)

@@ -2,6 +2,7 @@
 three-stage pipelined decode scheduler."""
 
 from .engine import DEVICE_KINDS, DeviceDecoder
-from .pipeline import DecodePipeline
+from .pipeline import (AdmissionScheduler, DecodePipeline, TenantAdmission,
+                       global_admission, reset_global_admission)
 from .staging import (ARENA_POOL, StagedBatch, StagingArenaPool, bucket_pow2,
                       bucket_rows, stage_copy_chunk, stage_tuples)

@@ -208,13 +208,27 @@ def pack_device(layout: BitLayout, columns) -> jnp.ndarray:
     return jnp.stack(words, axis=0)
 
 
-def parse_and_pack(bmat, lengths, specs, nibble: bool):
+def parse_and_pack(bmat, lengths, specs, nibble: bool,
+                   n_shards: int | None = None):
     """THE device program body shared by the XLA path and the Pallas
     kernel: per-column parse (parsers.parse_column) + bit-pack
     (pack_device). One definition — a divergence between the two lowering
-    paths would silently corrupt columns."""
+    paths would silently corrupt columns.
+
+    With `n_shards` (the mesh path: rows block-sharded over 'sp'), also
+    returns int32[n_shards] per-shard counts of fallback-CANDIDATE rows —
+    rows where some nonempty field failed its device parse — reduced ON
+    DEVICE inside each row shard (the reshape groups rows exactly along
+    the block sharding, so XLA keeps the reduction shard-local). Zero-
+    length fields are not failures (NULL / TOAST / the all-NULL padding
+    rows pad_to_multiple appends), so padding never inflates the counts.
+    The host aggregates these for shard-health telemetry only: the exact
+    per-row fallback set still comes from the unpacked ok bits masked by
+    host-side validity (a zero-length field of a non-null row IS a real
+    fallback there, invisible to this length-gated device mask)."""
     layout = layout_for_specs(specs)
     columns = []
+    row_ok = None
     w_off = 0
     for j, (_col_idx, kind, width, _bw) in enumerate(specs):
         if nibble:
@@ -225,7 +239,17 @@ def parse_and_pack(bmat, lengths, specs, nibble: bool):
         w_off += width
         comp, ok = parsers.parse_column(kind, b, lengths[:, j])
         columns.append((ok, comp))
-    return pack_device(layout, columns)
+        if n_shards is not None:
+            col_ok = ok | (lengths[:, j] == 0)
+            row_ok = col_ok if row_ok is None else (row_ok & col_ok)
+    words = pack_device(layout, columns)
+    if n_shards is None:
+        return words
+    nonempty = (lengths > 0).any(axis=1)
+    bad = jnp.zeros_like(nonempty) if row_ok is None \
+        else ((~row_ok) & nonempty)
+    shard_bad = bad.reshape(n_shards, -1).sum(axis=1, dtype=jnp.int32)
+    return words, shard_bad
 
 
 def unpack_host(layout: BitLayout, words: np.ndarray, col: int,

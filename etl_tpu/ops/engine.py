@@ -136,7 +136,8 @@ class _PackedInputs:
 
 
 def build_device_program(specs: tuple[tuple[int, CellKind, int, int], ...],
-                         nibble: bool = False):
+                         nibble: bool = False,
+                         n_shards: int | None = None):
     """The (unjitted) single-chip forward step for one width-signature.
 
     Inputs:  bmat u8[R, ΣW] packed field bytes (or u8[R, ΣW/2] nibble pairs
@@ -148,13 +149,19 @@ def build_device_program(specs: tuple[tuple[int, CellKind, int, int], ...],
              text-width-bounded magnitudes allow. ONE array, minimal
              bytes: the device→host fetch link (latency-bound AND ~40MB/s)
              is the binding resource of the whole decode pipeline.
+             With `n_shards` (the mesh-sharded path) the program ALSO
+             returns int32[n_shards] per-shard fallback-candidate counts,
+             reduced on device inside each row shard (bitpack.
+             parse_and_pack) — 4 bytes per shard of extra fetch, and the
+             host learns shard health without unpacking anything.
 
     specs: (col_index, kind, gather_width, bit_width) per dense column.
     """
     from .bitpack import parse_and_pack
 
     def fn(bmat, lengths):
-        return parse_and_pack(bmat, lengths.astype(jnp.int32), specs, nibble)
+        return parse_and_pack(bmat, lengths.astype(jnp.int32), specs, nibble,
+                              n_shards=n_shards)
 
     return fn
 
@@ -309,14 +316,20 @@ def _build_device_fn(specs, nibble: bool = False, use_pallas: bool = False,
         # multi-chip: rows sharded over the 'sp' axis, the SAME program —
         # decode is elementwise over rows, so XLA partitions it with no
         # cross-device collectives on the forward path; the bit-packed
-        # output keeps its row shards until the host fetch gathers them
+        # output keeps its row shards until the host fetch gathers them,
+        # and the per-shard fallback-candidate counts stay sharded too
+        # (one i32 per device). The packed staging buffers are donated
+        # (TPU/GPU) exactly as on the single-device path — donation is
+        # per-shard, so each device reuses its own input block.
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         rows_sharded = NamedSharding(mesh, P("sp", None))
         out_sharded = NamedSharding(mesh, P(None, "sp"))
-        return jax.jit(build_device_program(specs, nibble),
+        shard_red = NamedSharding(mesh, P("sp"))
+        return jax.jit(build_device_program(specs, nibble,
+                                            n_shards=mesh.size),
                        in_shardings=(rows_sharded, rows_sharded),
-                       out_shardings=out_sharded, **kw)
+                       out_shardings=(out_sharded, shard_red), **kw)
     if use_pallas:
         from .pallas_kernel import build_pallas_program
 
@@ -364,7 +377,8 @@ class _PendingDecode:
     The device→host copy of the packed result is started at construction
     (`copy_to_host_async`), so the transfer rides the link while the host
     stages and packs the next batches — `result()` mostly finds the bytes
-    already landed."""
+    already landed. Mesh-sharded dispatches carry a (packed, shard_bad)
+    tuple; both values start their host copies here."""
 
     __slots__ = ("_decoder", "_staged", "_specs", "_packed", "_bad_rows",
                  "_done")
@@ -377,11 +391,13 @@ class _PendingDecode:
         self._packed = packed
         self._bad_rows = bad_rows
         self._done: ColumnarBatch | None = None
-        if packed is not None:
-            try:
-                packed.copy_to_host_async()
-            except AttributeError:
-                pass  # non-jax array (tests may inject numpy)
+        values = packed if isinstance(packed, tuple) else (packed,)
+        for v in values:
+            if v is not None:
+                try:
+                    v.copy_to_host_async()
+                except AttributeError:
+                    pass  # non-jax array (tests may inject numpy)
 
     def result(self) -> ColumnarBatch:
         if self._done is None:
@@ -723,11 +739,21 @@ class DeviceDecoder:
         # table and per copy partition, and identical (bucket, specs)
         # programs across instances must not recompile — the engine flag
         # rides in the key, so a pallas fallback just stops selecting
-        # the pallas entries instead of clearing anything
+        # the pallas entries instead of clearing anything. The mesh slot
+        # holds a canonical FINGERPRINT (axis names, shape, device ids —
+        # parallel/mesh.mesh_cache_key), never the Mesh object: equal
+        # meshes recreated across decoders share the program, while
+        # decoders on different meshes (or mesh vs none) can never
+        # collide on the same (specs, nibble) signature — the sharded
+        # program returns (packed, shard_bad), a different output
+        # STRUCTURE than the single-device array
+        from ..parallel.mesh import mesh_cache_key
+
         pallas = self.use_pallas and not host
         key = _host_fn_key(packed.row_capacity, specs) if host else \
             (packed.row_capacity, specs, packed.nibble,
-             self.mesh if packed.use_mesh else None, pallas, False)
+             mesh_cache_key(self.mesh) if packed.use_mesh else None,
+             pallas, False)
         fn = _shared_fn_get(key)
         if fn is None:
             fn = _build_device_fn(
@@ -736,6 +762,26 @@ class DeviceDecoder:
                 donate=not host and _donation_supported())
             _shared_fn_put(key, fn)
         self._fn_cache[key] = fn
+        if packed.use_mesh and self._telemetry:
+            from ..telemetry.metrics import (
+                ETL_DECODE_MESH_BATCHES_TOTAL, ETL_DECODE_MESH_PAD_WASTE_RATIO,
+                ETL_DECODE_MESH_PADDED_ROWS_TOTAL, ETL_DECODE_MESH_ROWS_TOTAL,
+                ETL_DECODE_MESH_SHARDS, registry)
+
+            registry.gauge_set(ETL_DECODE_MESH_SHARDS, self.mesh.size)
+            registry.counter_inc(ETL_DECODE_MESH_BATCHES_TOTAL)
+            registry.counter_inc(ETL_DECODE_MESH_ROWS_TOTAL,
+                                 packed.row_capacity)
+            # MESH padding only (cap − bucket capacity): bucket padding
+            # below staged.row_capacity exists identically on the
+            # single-device path and must not read as mesh waste
+            pad = packed.row_capacity - staged.row_capacity
+            if pad:
+                registry.counter_inc(ETL_DECODE_MESH_PADDED_ROWS_TOTAL, pad)
+            rows_total = registry.get_counter(ETL_DECODE_MESH_ROWS_TOTAL)
+            pad_total = registry.get_counter(ETL_DECODE_MESH_PADDED_ROWS_TOTAL)
+            registry.gauge_set(ETL_DECODE_MESH_PAD_WASTE_RATIO,
+                               pad_total / rows_total if rows_total else 0.0)
         try:
             return fn(bmat, lengths)  # async dispatch
         except Exception:
@@ -888,6 +934,14 @@ class DeviceDecoder:
         n = staged.n_rows
         cols = self.schema.replicated_columns
         valid_full = ~staged.nulls & ~staged.toast
+        shard_bad = None
+        if isinstance(packed, tuple):
+            # mesh-sharded dispatch: (packed words, per-shard fallback-
+            # candidate counts reduced on device). The counts are HOST-
+            # aggregated into shard-health telemetry below; the exact
+            # fallback set still comes from the unpacked ok bits, so
+            # sharded and single-device decodes stay byte-identical.
+            packed, shard_bad = packed
         packed_np = np.asarray(packed) if packed is not None else None
 
         columns: list[Column] = [None] * len(cols)  # type: ignore[list-item]
@@ -939,12 +993,27 @@ class DeviceDecoder:
                 lazy_text_oid=lazy_oid)
 
         from ..telemetry.metrics import (
+            ETL_DECODE_MESH_FALLBACK_CANDIDATE_ROWS_TOTAL,
+            ETL_DECODE_MESH_SHARD_FALLBACK_CANDIDATES,
             ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL,
             ETL_DEVICE_DECODE_ROWS_TOTAL, ETL_DEVICE_DECODE_SECONDS,
             registry)
 
         if self._telemetry:
+            # n = staged.n_rows: bucket- and mesh-padding tail rows are
+            # excluded from every error/telemetry counter by construction
             registry.counter_inc(ETL_DEVICE_DECODE_ROWS_TOTAL, n)
+        if shard_bad is not None and self._telemetry:
+            sb = np.asarray(shard_bad)
+            total_bad = float(sb.sum())
+            if total_bad:
+                registry.counter_inc(
+                    ETL_DECODE_MESH_FALLBACK_CANDIDATE_ROWS_TOTAL, total_bad)
+            # last-batch shard-health snapshot: a single sick shard (one
+            # device corrupting its block) shows up here as skew
+            for s in range(sb.shape[0]):
+                registry.gauge_set(ETL_DECODE_MESH_SHARD_FALLBACK_CANDIDATES,
+                                   float(sb[s]), {"shard": str(s)})
         if fallback:
             rows_arr = np.asarray(sorted(r for r in fallback if r < n),
                                   dtype=np.int64)

@@ -48,7 +48,7 @@ import time
 from concurrent.futures import Future
 from typing import TYPE_CHECKING
 
-from ..analysis.annotations import hot_loop
+from ..analysis.annotations import admission_path, hot_loop
 from .staging import ARENA_POOL, StagedBatch, StagingArenaPool
 
 if TYPE_CHECKING:  # import cycle: runtime -> ops at module import time
@@ -59,6 +59,329 @@ if TYPE_CHECKING:  # import cycle: runtime -> ops at module import time
 #: device, one streaming back — deeper windows only add memory (the
 #: device serializes program executions anyway)
 DEFAULT_WINDOW = 3
+
+
+# ---------------------------------------------------------------------------
+# fair batch admission: N pipelines sharing one device set / mesh
+# ---------------------------------------------------------------------------
+
+
+class TenantAdmission:
+    """One tenant's (pipeline's) handle on a shared AdmissionScheduler.
+
+    Exactly ONE thread — the owning pipeline's pack/dispatch worker —
+    calls `acquire`; `release` may come from whichever thread drains the
+    fetch. `close` releases every ticket the tenant still holds and
+    deregisters it: a crashed/abandoned pipeline can never strand shared
+    device capacity behind handles nobody will drain."""
+
+    __slots__ = ("_sched", "name", "_lag_bytes", "_monitor", "_pass",
+                 "_held", "_grants", "_wait_since", "_closed")
+
+    def __init__(self, sched: "AdmissionScheduler", name: str,
+                 lag_bytes, monitor):
+        self._sched = sched
+        self.name = name
+        self._lag_bytes = lag_bytes  # () -> lag in bytes, or None
+        self._monitor = monitor  # MemoryMonitor | None
+        self._pass = 0.0  # stride-scheduling virtual time
+        self._held = 0
+        self._grants = 0
+        self._wait_since: float | None = None
+        self._closed = False
+
+    @property
+    def held(self) -> int:
+        return self._held
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def acquire(self, bypass=None) -> None:
+        self._sched._acquire(self, bypass)
+
+    def release(self) -> None:
+        self._sched._release(self)
+
+    def close(self) -> None:
+        self._sched._close_tenant(self)
+
+
+class AdmissionScheduler:
+    """Fair batch admission across N decode pipelines sharing one device
+    set (single chip or an 'sp' mesh): at most `capacity` device/host
+    batches are in flight across ALL tenants, and when tenants contend
+    the grant order is weighted stride scheduling.
+
+      weight   = 1 + lag_bytes / lag_scale_bytes (clamped to max_weight):
+                 a tenant whose replication stream is behind (the
+                 SlotLagMetrics / apply-loop flush-lag shape) gets
+                 proportionally more batch admissions, so one device set
+                 drains the laggard first instead of round-robining;
+      stride   = 1 / weight; on every grant the tenant's virtual pass
+                 advances by its stride and the scheduler picks the
+                 waiter with the minimum pass — proportional share with
+                 no tenant ever starved (a weight-1 tenant still lands
+                 every max_weight'th grant);
+      aging    = a waiter past `starvation_s` is granted next regardless
+                 of pass (counted as a starvation grant): even a
+                 pathological lag provider (stuck at +∞ for one tenant)
+                 cannot lock another tenant out for longer than the
+                 deadline;
+      idle cap = a tenant's pass is floored to the global virtual time
+                 when it starts waiting, so a long-idle tenant gets its
+                 fair share going forward, not an unbounded burst of
+                 back-credit.
+
+    Memory pressure rides the existing machinery: when ANY registered
+    tenant's MemoryMonitor reports pressure the effective capacity drops
+    to 1 (the same stance as InFlightWindow — RSS is process-level, so
+    one pressured monitor throttles every tenant). The `bypass` valve
+    mirrors InFlightWindow.acquire's: when the caller's consumer is
+    blocked on a batch that cannot dispatch until this acquire returns,
+    the scheduler overshoots capacity instead of deadlocking.
+
+    Purely passive (a Condition, no threads of its own): shutdown cannot
+    leak tasks — the chaos leak probe asserts in_flight and waiters
+    return to zero once the sharing pipelines close."""
+
+    _POLL_S = 0.05
+    STRIDE = 1.0
+
+    def __init__(self, capacity: int, *,
+                 lag_scale_bytes: float = 64 * 1024 * 1024,
+                 max_weight: float = 32.0,
+                 starvation_s: float = 0.5):
+        if capacity < 1:
+            raise ValueError("admission capacity must be >= 1")
+        self.capacity = capacity
+        self._lag_scale = max(1.0, float(lag_scale_bytes))
+        self._max_weight = max(1.0, float(max_weight))
+        self._starvation_s = starvation_s
+        self._cond = threading.Condition()
+        self._tenants: list[TenantAdmission] = []
+        self._held_total = 0
+        self._vt = 0.0  # global virtual time (max pass ever granted)
+
+    def register(self, name: str, lag_bytes=None,
+                 monitor: "MemoryMonitor | None" = None) -> TenantAdmission:
+        """New tenant. `lag_bytes` is read at every grant decision — pass
+        the live replication-lag reader (e.g. the apply loop's
+        received−durable delta), not a snapshot."""
+        t = TenantAdmission(self, name, lag_bytes, monitor)
+        with self._cond:
+            self._tenants.append(t)
+            n_tenants = len(self._tenants)
+        from ..telemetry.metrics import ETL_DECODE_ADMISSION_TENANTS, registry
+
+        registry.gauge_set(ETL_DECODE_ADMISSION_TENANTS, n_tenants)
+        return t
+
+    @property
+    def effective_capacity(self) -> int:
+        if any(t._monitor is not None and t._monitor.pressure
+               for t in self._tenants):
+            return 1
+        return self.capacity
+
+    @property
+    def in_flight(self) -> int:
+        return self._held_total
+
+    @property
+    def waiters(self) -> int:
+        with self._cond:
+            return sum(1 for t in self._tenants
+                       if t._wait_since is not None)
+
+    @admission_path
+    def _weight(self, tenant: TenantAdmission) -> float:
+        if tenant._lag_bytes is None:
+            return 1.0
+        try:
+            lag = max(0.0, float(tenant._lag_bytes()))
+        except Exception:  # a dying lag reader must not kill admission
+            lag = 0.0
+        return min(1.0 + lag / self._lag_scale, self._max_weight)
+
+    @admission_path
+    def _pick(self, now: float) -> "tuple[TenantAdmission, bool] | None":
+        """Next waiter to admit: aged-out waiter (FIFO among starved)
+        first, else minimum virtual pass. Caller holds the lock."""
+        waiters = [t for t in self._tenants if t._wait_since is not None]
+        if not waiters:
+            return None
+        starved = [t for t in waiters
+                   if now - t._wait_since >= self._starvation_s]
+        if starved:
+            return min(starved, key=lambda t: t._wait_since), True
+        return min(waiters, key=lambda t: t._pass), False
+
+    @admission_path
+    def _acquire(self, tenant: TenantAdmission, bypass=None) -> None:
+        from ..telemetry.metrics import (
+            ETL_DECODE_ADMISSION_BYPASS_GRANTS_TOTAL,
+            ETL_DECODE_ADMISSION_GRANTS_TOTAL,
+            ETL_DECODE_ADMISSION_IN_FLIGHT,
+            ETL_DECODE_ADMISSION_STARVATION_GRANTS_TOTAL,
+            ETL_DECODE_ADMISSION_WAIT_SECONDS, ETL_DECODE_ADMISSION_WAITERS,
+            registry)
+
+        t0 = time.perf_counter()
+        starved_grant = False
+        bypass_grant = False
+        granted = False
+        with self._cond:
+            if tenant._closed:
+                raise RuntimeError(
+                    f"admission tenant {tenant.name!r} is closed")
+            tenant._wait_since = time.monotonic()
+            # idle cap: fair share from NOW, no banked burst credit
+            tenant._pass = max(tenant._pass, self._vt)
+            registry.gauge_set(
+                ETL_DECODE_ADMISSION_WAITERS,
+                sum(1 for t in self._tenants if t._wait_since is not None))
+            try:
+                while True:
+                    if bypass is not None and bypass():
+                        bypass_grant = True
+                        break
+                    if tenant._closed:
+                        raise RuntimeError(
+                            f"admission tenant {tenant.name!r} closed "
+                            f"while waiting")
+                    if self._held_total < self.effective_capacity:
+                        picked = self._pick(time.monotonic())
+                        if picked is not None and picked[0] is tenant:
+                            starved_grant = picked[1]
+                            break
+                    # poll tick: pressure transitions, lag drift, and the
+                    # bypass predicate are all re-read without signalling
+                    self._cond.wait(timeout=self._POLL_S)
+            finally:
+                tenant._wait_since = None
+                # this waiter is done (granted, closed, or raising) —
+                # re-derive the gauge from live state so it can't stick
+                # at a stale count
+                registry.gauge_set(
+                    ETL_DECODE_ADMISSION_WAITERS,
+                    sum(1 for t in self._tenants
+                        if t._wait_since is not None))
+            if not tenant._closed:
+                self._vt = max(self._vt, tenant._pass)
+                tenant._pass += self.STRIDE / self._weight(tenant)
+                tenant._held += 1
+                tenant._grants += 1
+                self._held_total += 1
+                granted = True
+            held_total = self._held_total
+            # a freed-then-granted slot may leave capacity for the next
+            # waiter; wake the others to re-pick
+            self._cond.notify_all()
+        # grant telemetry only for REAL grants: a tenant closed during
+        # the wait wakes without a ticket, and counting it would skew
+        # the per-tenant fairness evidence the bench reports
+        if granted:
+            labels = {"pipeline": tenant.name}
+            registry.counter_inc(ETL_DECODE_ADMISSION_GRANTS_TOTAL,
+                                 labels=labels)
+            if starved_grant:
+                registry.counter_inc(
+                    ETL_DECODE_ADMISSION_STARVATION_GRANTS_TOTAL,
+                    labels=labels)
+            if bypass_grant:
+                registry.counter_inc(
+                    ETL_DECODE_ADMISSION_BYPASS_GRANTS_TOTAL, labels=labels)
+            registry.histogram_observe(ETL_DECODE_ADMISSION_WAIT_SECONDS,
+                                       time.perf_counter() - t0, labels)
+        registry.gauge_set(ETL_DECODE_ADMISSION_IN_FLIGHT, held_total)
+
+    @admission_path
+    def _release(self, tenant: TenantAdmission) -> None:
+        from ..telemetry.metrics import (ETL_DECODE_ADMISSION_IN_FLIGHT,
+                                         registry)
+
+        with self._cond:
+            if tenant._held <= 0:
+                return  # ticket already reclaimed by close()
+            tenant._held -= 1
+            self._held_total = max(0, self._held_total - 1)
+            held_total = self._held_total
+            self._cond.notify_all()
+        registry.gauge_set(ETL_DECODE_ADMISSION_IN_FLIGHT, held_total)
+
+    @admission_path
+    def _close_tenant(self, tenant: TenantAdmission) -> None:
+        with self._cond:
+            if tenant._closed:
+                return
+            tenant._closed = True
+            self._held_total = max(0, self._held_total - tenant._held)
+            tenant._held = 0
+            if tenant in self._tenants:
+                self._tenants.remove(tenant)
+            n_tenants = len(self._tenants)
+            held_total = self._held_total
+            self._cond.notify_all()
+        from ..telemetry.metrics import (ETL_DECODE_ADMISSION_IN_FLIGHT,
+                                         ETL_DECODE_ADMISSION_TENANTS,
+                                         registry)
+
+        registry.gauge_set(ETL_DECODE_ADMISSION_TENANTS, n_tenants)
+        registry.gauge_set(ETL_DECODE_ADMISSION_IN_FLIGHT, held_total)
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "capacity": self.capacity,
+                "effective_capacity": self.effective_capacity,
+                "in_flight": self._held_total,
+                "waiters": sum(1 for t in self._tenants
+                               if t._wait_since is not None),
+                "tenants": {t.name: {"held": t._held, "grants": t._grants,
+                                     "weight": round(self._weight(t), 3)}
+                            for t in self._tenants},
+            }
+
+
+_GLOBAL_ADMISSION: "AdmissionScheduler | None" = None
+_GLOBAL_ADMISSION_LOCK = threading.Lock()
+
+
+def reset_global_admission() -> None:
+    """Drop the process-wide scheduler so the NEXT global_admission()
+    caller fixes a fresh capacity (bench harness / test isolation). Only
+    safe with no production pipelines running: live tenants keep their
+    seats on the old scheduler object until they close, so a reset under
+    traffic splits capacity accounting across two schedulers."""
+    global _GLOBAL_ADMISSION
+    with _GLOBAL_ADMISSION_LOCK:
+        _GLOBAL_ADMISSION = None
+
+
+def global_admission(capacity: int | None = None) -> AdmissionScheduler:
+    """The process-wide scheduler every production decode pipeline
+    registers with — one device set serving many replication streams
+    (apply loops, table-sync catchups, copy partitions). The FIRST caller
+    fixes the capacity; `None` defaults to max(4, 2 × device count) — two
+    in-flight batches per device keeps the mesh fed while one batch
+    streams back, and the floor keeps single-device hosts pipelined.
+    Uncontended tenants are never throttled below their own in-flight
+    window, so a lone pipeline behaves exactly as before."""
+    global _GLOBAL_ADMISSION
+    with _GLOBAL_ADMISSION_LOCK:
+        if _GLOBAL_ADMISSION is None:
+            if capacity is None or capacity <= 0:
+                try:
+                    import jax
+
+                    n_dev = max(1, len(jax.devices()))
+                except Exception:
+                    n_dev = 1
+                capacity = max(4, 2 * n_dev)
+            _GLOBAL_ADMISSION = AdmissionScheduler(capacity)
+        return _GLOBAL_ADMISSION
 
 
 class _Interval:
@@ -80,7 +403,7 @@ class PipelinedDecode:
     what keeps the window from stalling the worker."""
 
     __slots__ = ("_pipe", "_future", "_done", "_exc", "_windowed",
-                 "_demanded")
+                 "_demanded", "_admitted")
 
     def __init__(self, pipe: "DecodePipeline"):
         self._pipe = pipe
@@ -89,6 +412,7 @@ class PipelinedDecode:
         self._exc: BaseException | None = None
         self._windowed = False  # device/host route holds a window slot
         self._demanded = False  # a consumer is blocked on this handle
+        self._admitted = False  # holds a shared admission ticket
 
     def result(self):
         """Complete the batch (idempotent). A failed fetch is permanent:
@@ -114,7 +438,8 @@ class DecodePipeline:
     def __init__(self, *, window: int = DEFAULT_WINDOW,
                  monitor: "MemoryMonitor | None" = None,
                  arena_pool: StagingArenaPool | None = None,
-                 name: str = "decode", heartbeat=None):
+                 name: str = "decode", heartbeat=None,
+                 admission: "TenantAdmission | None" = None):
         from ..runtime.backpressure import InFlightWindow
 
         # supervision.Heartbeat | None: the worker thread publishes
@@ -122,6 +447,10 @@ class DecodePipeline:
         # with batches in flight is a device-side stall the supervisor
         # escalates (host-oracle degrade)
         self._hb = heartbeat
+        # TenantAdmission | None: this pipeline's seat at the shared
+        # AdmissionScheduler. Ownership transfers here — close() closes
+        # it, releasing any tickets still held by undrained handles
+        self._admission = admission
         self.window = InFlightWindow(max(1, window), monitor)
         self.pool = arena_pool if arena_pool is not None else ARENA_POOL
         # gauge label: several pipelines coexist (one per copy partition
@@ -185,6 +514,13 @@ class DecodePipeline:
         if not self._closed:
             self._closed = True
             self._jobs.put(None)
+        if self._admission is not None:
+            # deregister from the shared scheduler and reclaim any
+            # tickets still held by undrained handles: an abandoned
+            # pipeline must not strand shared device capacity. Handles
+            # still resolvable after close release into the closed
+            # tenant, which is a guarded no-op.
+            self._admission.close()
         if self._hb is not None:
             self._hb.close()
             self._hb = None
@@ -207,6 +543,9 @@ class DecodePipeline:
             # Not a retry spin either: the loop blocks on _jobs.get(), so
             # a failing batch is reported once, not hammered
             except BaseException as e:  # etl-lint: ignore[cancellation-swallow,unbounded-retry]
+                if handle._admitted:
+                    handle._admitted = False
+                    self._admission.release()
                 if handle._windowed:
                     handle._windowed = False
                     self.window.release()
@@ -267,6 +606,15 @@ class DecodePipeline:
         self.window.acquire(
             bypass=lambda: self._closed or self._demand_waiting())
         handle._windowed = True
+        if self._admission is not None and not self._admission.closed:
+            # shared-capacity seat AFTER the pipeline's own window: a
+            # tenant blocked on its self-imposed window must not sit on a
+            # ticket other tenants could use. Same liveness valve as the
+            # window — a demanded-but-undispatched handle (or close)
+            # overshoots rather than deadlocking the consumer.
+            self._admission.acquire(
+                bypass=lambda: self._closed or self._demand_waiting())
+            handle._admitted = True
         host = mode == "host"
         arena = self.pool.lease()
         t0 = time.perf_counter()
@@ -366,6 +714,9 @@ class DecodePipeline:
                 hb.beat(progress=("completed", self._completed),
                         busy=len(self.window) > 1)
             arena.release()
+            if handle._admitted:
+                handle._admitted = False
+                self._admission.release()
             if handle._windowed:
                 handle._windowed = False
                 self.window.release()
@@ -380,7 +731,7 @@ class DecodePipeline:
         with self._lock:
             pack = self._pack_seconds
             overlap = self._overlap_seconds
-            return {
+            out = {
                 "submitted": self._submitted,
                 "completed": self._completed,
                 "in_flight": len(self.window),
@@ -390,3 +741,8 @@ class DecodePipeline:
                 "overlap_ratio": overlap / pack if pack > 0 else 0.0,
                 "arena": self.pool.stats(),
             }
+        if self._admission is not None:
+            out["admission"] = {"tenant": self._admission.name,
+                                "held": self._admission.held,
+                                "closed": self._admission.closed}
+        return out

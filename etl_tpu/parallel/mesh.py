@@ -26,6 +26,22 @@ from jax.sharding import Mesh
 
 
 
+def mesh_cache_key(mesh: "Mesh | None") -> tuple | None:
+    """Canonical, hashable fingerprint of a mesh for program-cache keys
+    (ops/engine._SHARED_FN_CACHE): axis names, shape, and the flat device
+    ids. Two Mesh OBJECTS over the same devices/axes fingerprint equal (a
+    recreated mesh must reuse the compiled program), while meshes over
+    different device sets — or a mesh vs none — never collide even for
+    identical (row_capacity, specs, nibble) signatures: the sharded
+    program's output signature (packed words + per-shard fallback counts)
+    differs from the single-device program's, so a collision would hand a
+    caller the wrong result STRUCTURE, not just a misplaced shard."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
 def decode_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh | None:
     """1D row-sharding mesh over all devices for the PRODUCTION decoder
     (DeviceDecoder(mesh=…)): decode is embarrassingly parallel over rows,

@@ -151,12 +151,19 @@ class ApplyLoop:
         self._supervisor = supervisor  # for the decode pipeline's beat
         self._lease = budget.register_stream() if budget is not None else None
         # the assembler owns this loop's decode pipeline; the monitor
-        # shrinks its in-flight window to 1 under memory pressure
-        self.assembler = EventAssembler(config.batch.batch_engine,
-                                        monitor=monitor,
-                                        decode_window=config.batch
-                                        .decode_window,
-                                        supervisor=supervisor)
+        # shrinks its in-flight window to 1 under memory pressure. The
+        # lag reader feeds the fair-admission weight: received−durable is
+        # this stream's replication lag in WAL bytes (the
+        # SlotLagMetrics.confirmed_flush_lag shape, read in-process), so
+        # when several streams share the device set the one furthest
+        # behind wins proportionally more decode admissions
+        self.assembler = EventAssembler(
+            config.batch.batch_engine, monitor=monitor,
+            decode_window=config.batch.decode_window,
+            supervisor=supervisor,
+            lag_bytes=lambda: max(
+                0, int(self.state.received_lsn) - int(self.state.durable_lsn)),
+            admission_capacity=config.batch.admission_capacity)
         self.state = _LoopState(durable_lsn=start_lsn, received_lsn=start_lsn,
                                 last_status_flush_lsn=start_lsn)
         self._in_flight: _InFlight | None = None

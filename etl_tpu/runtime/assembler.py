@@ -69,8 +69,17 @@ _ASSEMBLER_SEQ = [0]
 
 class EventAssembler:
     def __init__(self, engine: BatchEngine, monitor=None,
-                 decode_window: int = 3, supervisor=None):
+                 decode_window: int = 3, supervisor=None,
+                 lag_bytes=None, admission_capacity: int = 0):
         self.engine = engine
+        # fair-admission wiring (ops/pipeline.AdmissionScheduler): this
+        # loop's decode pipeline takes one tenant seat on the process-
+        # wide scheduler, weighted by `lag_bytes` (the apply loop's
+        # received−durable delta — the SlotLagMetrics shape) so a
+        # lagging stream wins more batch admissions when several streams
+        # share the device set
+        self._lag_bytes = lag_bytes
+        self._admission_capacity = admission_capacity
         self._events: list[Event] = []
         self._run: _Run | None = None
         self._decoders: dict[TableId, DeviceDecoder] = {}
@@ -242,9 +251,16 @@ class EventAssembler:
 
                 hb = self._supervisor.register(
                     f"{DECODE_PREFIX}cdc-{self._seq}")
+            from ..ops.pipeline import global_admission
+
+            admission = global_admission(
+                self._admission_capacity or None).register(
+                    f"cdc-{self._seq}", lag_bytes=self._lag_bytes,
+                    monitor=self._monitor)
             self._pipeline = DecodePipeline(window=self._decode_window,
                                             monitor=self._monitor,
-                                            name="cdc", heartbeat=hb)
+                                            name="cdc", heartbeat=hb,
+                                            admission=admission)
         pending = self._pipeline.submit(decoder, wal.staged)
         old_pending = self._pipeline.submit(decoder, wal.old_staged) \
             if wal.old_staged is not None else None

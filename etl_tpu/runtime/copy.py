@@ -88,7 +88,8 @@ async def _copy_partition(source: ReplicationSource,
                           max_batch_bytes: int, monitor=None,
                           lease=None, pipeline_id: int = 0,
                           decode_window: int = 3, heartbeat=None,
-                          supervisor=None) -> None:
+                          supervisor=None,
+                          admission_capacity: int = 0) -> None:
     failpoints.fail_point(failpoints.COPY_PARTITION_START)
     # chaos stall mode: a copy partition that wedges before reading any
     # data — recovered by the watchdog restarting the table-sync worker
@@ -126,10 +127,20 @@ async def _copy_partition(source: ReplicationSource,
 
         pipe_hb = supervisor.register(
             f"{DECODE_PREFIX}copy:{schema.id}:p{part.start_page}")
-    pipe = DecodePipeline(window=decode_window, monitor=monitor,
-                          name=f"copy-p{part.start_page}",
-                          heartbeat=pipe_hb) \
-        if decoder is not None else None
+    pipe = None
+    if decoder is not None:
+        # every copy partition is one tenant on the process-wide
+        # admission scheduler: backfill batches contend fairly with the
+        # CDC streams' (lag-weighted — lag 0 here, so a lagging CDC
+        # tenant outranks bulk backfill) and the shared capacity caps
+        # how many partition batches sit on the device at once
+        from ..ops.pipeline import global_admission
+
+        admission = global_admission(admission_capacity or None).register(
+            f"copy:{schema.id}:p{part.start_page}", monitor=monitor)
+        pipe = DecodePipeline(window=decode_window, monitor=monitor,
+                              name=f"copy-p{part.start_page}",
+                              heartbeat=pipe_hb, admission=admission)
 
     async def drain_one() -> None:
         handle = in_flight.pop(0)
@@ -281,7 +292,8 @@ async def parallel_table_copy(*, source_factory, primary_source,
                     config.batch.max_size_bytes, monitor=monitor,
                     lease=lease, pipeline_id=config.pipeline_id,
                     decode_window=config.batch.decode_window,
-                    heartbeat=heartbeat, supervisor=supervisor))
+                    heartbeat=heartbeat, supervisor=supervisor,
+                    admission_capacity=config.batch.admission_capacity))
         finally:
             if lease is not None:
                 lease.release()

@@ -69,6 +69,39 @@ ETL_DECODE_PIPELINE_IN_FLIGHT = "etl_decode_pipeline_in_flight"
 # staging-arena pool (ops/staging.py): hit = a preallocated buffer was
 # reused, miss = a fresh allocation (labels: {"result": "hit"|"miss"})
 ETL_STAGING_ARENA_REQUESTS_TOTAL = "etl_staging_arena_requests_total"
+# mesh-sharded decode (ops/engine.py mesh path): shard count of the last
+# sharded dispatch, batches/rows routed through the mesh program, padding
+# rows appended by pad_to_multiple so odd buckets shard (the waste-ratio
+# gauge is cumulative padded/uploaded — upload bytes are the binding
+# resource, so sustained waste above a few percent means the row buckets
+# and the mesh size disagree), and the device-reduced per-shard
+# fallback-candidate counts (total + a per-shard last-batch gauge; skew
+# across shards points at a sick device, not bad data)
+ETL_DECODE_MESH_SHARDS = "etl_decode_mesh_shards"
+ETL_DECODE_MESH_BATCHES_TOTAL = "etl_decode_mesh_batches_total"
+ETL_DECODE_MESH_ROWS_TOTAL = "etl_decode_mesh_rows_total"
+ETL_DECODE_MESH_PADDED_ROWS_TOTAL = "etl_decode_mesh_padded_rows_total"
+ETL_DECODE_MESH_PAD_WASTE_RATIO = "etl_decode_mesh_pad_waste_ratio"
+ETL_DECODE_MESH_FALLBACK_CANDIDATE_ROWS_TOTAL = \
+    "etl_decode_mesh_fallback_candidate_rows_total"
+ETL_DECODE_MESH_SHARD_FALLBACK_CANDIDATES = \
+    "etl_decode_mesh_shard_fallback_candidates"
+# fair batch-admission scheduler (ops/pipeline.AdmissionScheduler): N
+# decode pipelines sharing one device set. Wait histogram + grant
+# counters are labeled per pipeline tenant; starvation grants count the
+# aging valve overriding the lag-weighted pick (a tenant waited past the
+# starvation deadline); bypass grants count the liveness valve
+# (consumer blocked on an undispatched batch, or close) overshooting the
+# capacity instead of deadlocking
+ETL_DECODE_ADMISSION_WAIT_SECONDS = "etl_decode_admission_wait_seconds"
+ETL_DECODE_ADMISSION_GRANTS_TOTAL = "etl_decode_admission_grants_total"
+ETL_DECODE_ADMISSION_STARVATION_GRANTS_TOTAL = \
+    "etl_decode_admission_starvation_grants_total"
+ETL_DECODE_ADMISSION_BYPASS_GRANTS_TOTAL = \
+    "etl_decode_admission_bypass_grants_total"
+ETL_DECODE_ADMISSION_WAITERS = "etl_decode_admission_waiters"
+ETL_DECODE_ADMISSION_IN_FLIGHT = "etl_decode_admission_in_flight"
+ETL_DECODE_ADMISSION_TENANTS = "etl_decode_admission_tenants"
 # pending catalog-inlined bytes per lake table (reference
 # ETL_DUCKLAKE_TABLE_ACTIVE_INLINED_DATA_BYTES, ducklake/inline_size.rs)
 ETL_LAKE_INLINED_DATA_BYTES = "etl_lake_inlined_data_bytes"
@@ -127,6 +160,9 @@ _BUCKETS_BY_NAME = {
     ETL_DECODE_PACK_SECONDS: _FINE_TIME_BUCKETS,
     ETL_DECODE_DISPATCH_SECONDS: _FINE_TIME_BUCKETS,
     ETL_DECODE_FETCH_SECONDS: _FINE_TIME_BUCKETS,
+    # admission waits are sub-millisecond when uncontended and only reach
+    # the coarse buckets under real multi-tenant contention
+    ETL_DECODE_ADMISSION_WAIT_SECONDS: _FINE_TIME_BUCKETS,
 }
 
 LabelSet = tuple[tuple[str, str], ...]
@@ -198,6 +234,22 @@ class MetricsRegistry:
         benches and tests read stage totals without parsing exposition."""
         h = self._histograms.get(name, {}).get(_labels(labels))
         return (h.count, h.total) if h is not None else (0, 0.0)
+
+    def sum_counter(self, name: str) -> float:
+        """Sum of a counter over EVERY label set (per-tenant admission
+        counters roll up to a fleet total without the caller enumerating
+        tenant names)."""
+        with self._lock:
+            return sum(self._counters.get(name, {}).values())
+
+    def sum_histogram(self, name: str) -> tuple[int, float]:
+        """(count, sum) of a histogram summed over every label set."""
+        count, total = 0, 0.0
+        with self._lock:
+            for h in self._histograms.get(name, {}).values():
+                count += h.count
+                total += h.total
+        return count, total
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition format 0.0.4."""

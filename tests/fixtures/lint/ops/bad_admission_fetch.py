@@ -1,0 +1,37 @@
+# etl-lint fixture: blocking device traffic inside the batch-admission
+# scheduler's grant path (@admission_path) — a fetch (device_get /
+# block_until_ready / asarray) OR an upload (device_put: the
+# @dispatch_stage sanction does NOT extend here) under the scheduler
+# lock head-of-line-blocks every tenant's admission. The inline lag
+# provider (a nested def/lambda) inherits the frame flag.
+# expect: admission-blocking-fetch=5
+import jax
+import numpy as np
+
+from etl_tpu.analysis.annotations import admission_path
+
+
+@admission_path
+def weight_from_device_counter(tenant, counter_dev):
+    lag = jax.device_get(counter_dev)  # fetch under the lock: flagged
+    return 1.0 + float(np.asarray(counter_dev)) + lag  # asarray: flagged
+
+
+@admission_path
+def grant_after_sync(tenant, pending):
+    pending.block_until_ready()  # sync in the grant path: flagged
+    return tenant
+
+
+@admission_path
+def admit_with_upload(tenant, weights, dev):
+    # even an UPLOAD blocks every waiter behind this tenant's transfer
+    return jax.device_put(weights, dev)  # flagged
+
+
+@admission_path
+def make_lag_provider(counter_dev):
+    def lag_bytes():
+        return float(jax.device_get(counter_dev))  # nested def: flagged
+
+    return lag_bytes

@@ -398,7 +398,7 @@ class TestBenchSmoke:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         proc = subprocess.run(
             [sys.executable, str(repo / "bench.py"), "--smoke"],
-            capture_output=True, text=True, timeout=420, cwd=repo, env=env)
+            capture_output=True, text=True, timeout=600, cwd=repo, env=env)
         assert proc.returncode == 0, proc.stderr[-2000:]
         out = json.loads(proc.stdout.strip().splitlines()[-1])
         assert out["ok"] is True
@@ -438,5 +438,22 @@ class TestBenchSmoke:
         # insert-CDC streaming floor
         assert out["workload_profiles_above_floor"] is True, out
         assert out["workload_failures"] == []
+        # mesh satellite (ISSUE 8): sharded decode on the FORCED 8-way
+        # host-platform mesh must be byte-identical to single-device
+        # decode (the subprocess gate — this process's backend stays at
+        # one device)
+        assert out["mesh_check_ok"] is True, out
+        assert out["mesh_sharded_equals_single"] is True
+        assert out["mesh_shards"] == 8
+        # multi-pipeline tenancy gate (ISSUE 8): ≥2 concurrent verified
+        # streams through the shared admission scheduler, aggregate
+        # above the floor, scheduler drained with no leaked tickets
+        assert out["multi_pipeline_ok"] is True, out
+        assert out["multi_pipeline_streams"] >= 2
+        assert out["multi_pipeline_all_verified"] is True
+        assert out["multi_pipeline_scheduler_drained"] is True
+        assert out["multi_pipeline_events_per_sec"] >= \
+            out["multi_pipeline_floor_events_per_sec"]
+        assert out["multi_pipeline_admission_grants"] > 0
         assert set(out["workload_events_per_sec"]) >= \
             {"update_heavy_default", "truncate_storm"}

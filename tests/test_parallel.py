@@ -79,8 +79,12 @@ class TestMeshDecode:
         dec = DeviceDecoder(schema, device_min_rows=0, mesh=decode_mesh(),
                             mesh_min_rows=0)
         specs = dec._specs(staged, dec._widths(staged))
-        packed, _ = dec._device_call(staged, specs)
+        value, _ = dec._device_call(staged, specs)
+        packed, shard_bad = value  # mesh program: (words, per-shard counts)
         assert packed.sharding.spec == jax.sharding.PartitionSpec(None, "sp")
+        # the device-side reduction stays sharded: one count per device
+        assert shard_bad.shape == (8,)
+        assert shard_bad.sharding.spec == jax.sharding.PartitionSpec("sp")
 
     def test_mesh_threshold_routes_small_batches_single_device(self):
         schema = make_schema([Oid.INT4])
@@ -88,3 +92,63 @@ class TestMeshDecode:
         staged = stage_tuples(tuples_from_texts([["1"]]), 1)
         assert not dec._use_mesh(staged.row_capacity)
         assert dec.decode(staged).columns[0].data[0] == 1
+
+
+class TestSharedFnCacheKeying:
+    """Regression: _SHARED_FN_CACHE keys carry a canonical mesh
+    FINGERPRINT (parallel/mesh.mesh_cache_key), so decoders on different
+    meshes — or mesh vs none — can never collide on the same
+    (row_capacity, specs, nibble) signature, while equal meshes recreated
+    across decoders share the compiled program."""
+
+    @staticmethod
+    def _staged():
+        return stage_tuples(
+            tuples_from_texts([[str(i)] for i in range(256)]), 1)
+
+    def test_mesh_and_single_device_programs_never_collide(self):
+        schema = make_schema([Oid.INT4])
+        staged = self._staged()
+        meshed = DeviceDecoder(schema, device_min_rows=0, mesh=decode_mesh(),
+                               mesh_min_rows=0)
+        plain = DeviceDecoder(schema, device_min_rows=0, mesh=None)
+        assert_batches_equal(meshed.decode(staged), plain.decode(staged))
+        key_m = next(k for k in meshed._fn_cache
+                     if isinstance(k, tuple) and len(k) == 6
+                     and k[3] is not None)
+        key_p = next(k for k in plain._fn_cache
+                     if isinstance(k, tuple) and len(k) == 6)
+        # identical signature up to the mesh slot — the slot alone keeps
+        # the (packed, shard_bad) mesh program from shadowing the
+        # single-array single-device program
+        assert key_m[:3] == key_p[:3]
+        assert key_p[3] is None
+        assert key_m != key_p
+
+    def test_recreated_equal_mesh_shares_the_program(self):
+        from etl_tpu.parallel.mesh import mesh_cache_key
+
+        # (jax may intern equal Mesh objects; the fingerprint contract
+        # must hold whether or not the two calls return the same object)
+        m1, m2 = decode_mesh(), decode_mesh()
+        assert mesh_cache_key(m1) == mesh_cache_key(m2)
+        schema = make_schema([Oid.INT4])
+        staged = self._staged()
+        d1 = DeviceDecoder(schema, device_min_rows=0, mesh=m1,
+                           mesh_min_rows=0)
+        d2 = DeviceDecoder(schema, device_min_rows=0, mesh=m2,
+                           mesh_min_rows=0)
+        d1.decode(staged)
+        d2.decode(staged)
+        # same fingerprint → same shared-cache key → no recompile
+        assert set(d1._fn_cache) & set(d2._fn_cache)
+
+    def test_different_device_sets_fingerprint_differently(self):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from etl_tpu.parallel.mesh import mesh_cache_key
+
+        m4 = Mesh(np.array(jax.devices()[:4]), ("sp",))
+        assert mesh_cache_key(m4) != mesh_cache_key(decode_mesh())
+        assert mesh_cache_key(None) is None
