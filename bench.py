@@ -419,6 +419,33 @@ def run_smoke() -> dict:
                            "never exercised")
     mp_ok = not mp_failures
 
+    # sharded scale-out gates (ISSUE 9): (a) the K=2 pod-kill chaos
+    # scenario — kill one of two shard replicators mid-stream; the
+    # survivor must deliver its whole slice during the outage, the
+    # victim must reconverge from durable state, and the per-shard AND
+    # cross-shard-union invariants must hold; (b) a K=2 sharded bench
+    # slice (one worker PROCESS per shard, the pod resource model)
+    # against the sharded aggregate floor
+    from etl_tpu.chaos.sharded import run_sharded_scenario
+
+    sharded_chaos = asyncio.run(run_sharded_scenario(seed=7))
+    sharded_chaos_ok = sharded_chaos.ok
+    sharded = asyncio.run(harness.run_sharded_processes(
+        shards=2, target_ops=floors.get("sharded_smoke_ops", 8_000)))
+    sharded_floor = floors.get("sharded_events_per_sec_floor", 0)
+    sharded_failures = []
+    if not sharded["all_verified"]:
+        sharded_failures.append("a shard's slice failed end-state "
+                                "verification")
+    if not sharded["union_covers_all_tables"]:
+        sharded_failures.append("shard slices do not cover every table "
+                                "exactly once")
+    if sharded["aggregate_events_per_second"] < sharded_floor:
+        sharded_failures.append(
+            f"aggregate {sharded['aggregate_events_per_second']} ev/s "
+            f"under floor {sharded_floor}")
+    sharded_ok = not sharded_failures
+
     # static-analysis budget gate (ISSUE 5 CI satellite): the full
     # whole-program etl-lint pass (call graph + context propagation +
     # CFG rules over every module) must stay cheap enough to gate every
@@ -438,7 +465,19 @@ def run_smoke() -> dict:
         "mode": "smoke",
         "ok": bool(identical and stages_observed and stream_ok
                    and heartbeat_ok and lint_ok and no_row_path
-                   and egress_ok and workload_ok and mesh_ok and mp_ok),
+                   and egress_ok and workload_ok and mesh_ok and mp_ok
+                   and sharded_chaos_ok and sharded_ok),
+        "sharded_chaos_ok": bool(sharded_chaos_ok),
+        "sharded_chaos": sharded_chaos.describe(),
+        "sharded_events_per_sec":
+            sharded["aggregate_events_per_second"],
+        "sharded_floor_events_per_sec": sharded_floor,
+        "sharded_shards": sharded["shards"],
+        "sharded_all_verified": bool(sharded["all_verified"]),
+        "sharded_union_covers_all_tables":
+            bool(sharded["union_covers_all_tables"]),
+        "sharded_ok": bool(sharded_ok),
+        "sharded_failures": sharded_failures,
         "mesh_sharded_equals_single":
             bool(mesh_out.get("sharded_equals_single")),
         "mesh_shards": mesh_out.get("mesh_shards", 0),
@@ -568,6 +607,17 @@ def main():
                              "scheduler; gates the aggregate events/s "
                              "against multi_pipeline_events_per_sec_floor "
                              "in BENCH_FLOOR.json")
+    parser.add_argument("--sharded", dest="sharded", type=int, default=None,
+                        metavar="K",
+                        help="horizontal scale-out mode: run the same "
+                             "publication workload through K shard "
+                             "replicator PROCESSES (one per shard, the "
+                             "pod resource model) and through one "
+                             "unsharded baseline process; gates the "
+                             "K-shard aggregate events/s against "
+                             "sharded_events_per_sec_floor in "
+                             "BENCH_FLOOR.json AND strictly above the "
+                             "single-shard run")
     parser.add_argument("--streams", default=None, metavar="P1,P2,...",
                         help="comma-separated workload profiles for "
                              "--multi-pipeline (default: the "
@@ -617,6 +667,64 @@ def main():
         # the CPU platform so the check never touches the tunnel
         jax.config.update("jax_platforms", "cpu")
         out = run_mesh_check(n_rows=args.mesh_rows)
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
+    if args.sharded is not None:
+        # K shard worker processes + the single-shard baseline, CPU
+        # platform (memory destinations + end-state verification per
+        # shard — the workload-matrix stance); the parent never inits a
+        # backend itself
+        import asyncio
+
+        jax.config.update("jax_platforms", "cpu")
+        from etl_tpu.benchmarks import harness
+
+        if args.sharded < 2:
+            parser.error("--sharded needs K >= 2 (the single-shard "
+                         "baseline runs automatically)")
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_FLOOR.json")) as f:
+            floors = json.load(f)
+        target = floors.get("sharded_bench_ops", 12_000)
+
+        async def both():
+            sharded = await harness.run_sharded_processes(
+                shards=args.sharded, seed=args.seed, target_ops=target)
+            single = await harness.run_sharded_processes(
+                shards=1, seed=args.seed, target_ops=target)
+            return sharded, single
+
+        sharded, single = asyncio.run(both())
+        floor = floors.get("sharded_events_per_sec_floor", 0)
+        out = dict(sharded)
+        out["single_shard_events_per_second"] = \
+            single["aggregate_events_per_second"]
+        out["single_shard_verified"] = single["all_verified"]
+        out["speedup_vs_single"] = round(
+            sharded["aggregate_events_per_second"]
+            / max(single["aggregate_events_per_second"], 1), 3)
+        out["floor_events_per_second"] = floor
+        out["failures"] = []
+        if not out["all_verified"]:
+            out["failures"].append("a shard's slice failed end-state "
+                                   "verification")
+        if not out["union_covers_all_tables"]:
+            out["failures"].append("shard slices do not cover every "
+                                   "table exactly once")
+        if not out["single_shard_verified"]:
+            out["failures"].append("the single-shard baseline failed "
+                                   "verification")
+        if out["aggregate_events_per_second"] < floor:
+            out["failures"].append(
+                f"aggregate {out['aggregate_events_per_second']} ev/s "
+                f"under floor {floor}")
+        if out["aggregate_events_per_second"] <= \
+                out["single_shard_events_per_second"]:
+            out["failures"].append(
+                f"sharded aggregate {out['aggregate_events_per_second']} "
+                f"not strictly above the single-shard run "
+                f"{out['single_shard_events_per_second']}")
+        out["ok"] = not out["failures"]
         print(json.dumps(out))
         sys.exit(0 if out["ok"] else 1)
     if args.mode == "multi_pipeline":

@@ -58,6 +58,28 @@ def admission_path(fn: _F) -> _F:
     return fn
 
 
+#: attribute set by @shard_scoped (runtime-introspectable, same lexical
+#: matching caveat as HOT_LOOP_ATTR)
+SHARD_SCOPED_ATTR = "__etl_shard_scoped__"
+
+
+def shard_scoped(fn: _F) -> _F:
+    """Mark `fn` as operating inside ONE shard's slice of a sharded
+    publication (etl_tpu/sharding): code that reads replication state on
+    behalf of a single shard replicator. etl-lint's
+    `cross-shard-table-access` rule forbids unfiltered full-table-list
+    store reads here (`get_table_states()` with no arguments): against a
+    SHARED store that call returns every shard's tables, and acting on
+    the full list silently re-copies, re-owns, or purges tables a
+    sibling pod owns — the exact corruption the shard fence exists to
+    stop. Read through the shard view instead
+    (`ShardScopedStore.owned_table_states()`), or justify a deliberate
+    cross-shard read (the coordinator's global sweeps) with an inline
+    ignore."""
+    setattr(fn, SHARD_SCOPED_ATTR, True)
+    return fn
+
+
 def dispatch_stage(fn: _F) -> _F:
     """Mark `fn` as the decode pipeline's DISPATCH stage (ops/pipeline.py
     architecture): a hot-loop function whose job is to start device work,

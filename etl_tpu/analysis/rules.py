@@ -620,6 +620,44 @@ class AdmissionBlockingFetch(Rule):
             f"dispatch/fetch stages")
 
 
+# -- rule 15 ------------------------------------------------------------------
+
+#: unfiltered full-table-list store reads: against a SHARED store these
+#: return EVERY shard's tables, and shard-scoped code acting on the full
+#: list re-copies / re-owns / purges tables a sibling pod owns
+CROSS_SHARD_FULL_READS = frozenset({"get_table_states"})
+
+
+class CrossShardTableAccess(Rule):
+    """`X.get_table_states()` with no arguments inside a `@shard_scoped`
+    function (etl_tpu/sharding): shard-scoped code must read through the
+    shard view (`ShardScopedStore.owned_table_states()`), which filters
+    the shared store down to the tables this shard's ShardMap slice owns.
+    Lexical, same sanctioning machinery as @dispatch_stage: the frame
+    flag inherits into nested defs and lambdas, not across call edges —
+    keep helpers called from shard-scoped code on the filtered view or
+    annotate them too. A deliberate cross-shard sweep (the coordinator's
+    global view) carries an inline ignore with a justification."""
+
+    name = "cross-shard-table-access"
+
+    def on_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not ctx.in_shard_scoped:
+            return
+        term = terminal_name(node.func)
+        if term not in CROSS_SHARD_FULL_READS \
+                or not isinstance(node.func, ast.Attribute):
+            return
+        if node.args or node.keywords:
+            return  # a filter argument makes the read shard-aware
+        ctx.report(
+            self.name, node, f".{term}()",
+            f"unfiltered `.{term}()` inside a @shard_scoped function "
+            f"returns EVERY shard's tables on a shared store; read "
+            f"through the shard view (owned_table_states()) or justify "
+            f"the cross-shard sweep with an inline ignore")
+
+
 # -- entry points -------------------------------------------------------------
 
 def default_rules() -> list[Rule]:
@@ -634,6 +672,7 @@ def default_rules() -> list[Rule]:
         UnboundedAwait(),
         HotLoopRowMaterialization(),
         AdmissionBlockingFetch(),
+        CrossShardTableAccess(),
     ]
 
 

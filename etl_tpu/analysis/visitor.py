@@ -141,6 +141,14 @@ DISPATCH_STAGE_DECORATORS = frozenset({"dispatch_stage"})
 #: nested defs/lambdas (lag/weight providers defined inline).
 ADMISSION_PATH_DECORATORS = frozenset({"admission_path"})
 
+#: decorator marking shard-scoped replication code
+#: (annotations.shard_scoped): the cross-shard-table-access rule forbids
+#: unfiltered full-table-list store reads there — against a shared store
+#: they return every shard's tables. Same sanctioning machinery as
+#: @dispatch_stage: a lexical frame flag inherited by nested
+#: defs/lambdas.
+SHARD_SCOPED_DECORATORS = frozenset({"shard_scoped"})
+
 
 def dotted_name(node: ast.AST) -> str | None:
     """`a.b.c` for a Name/Attribute chain, else None."""
@@ -225,15 +233,17 @@ class Rule:
 
 class _Frame:
     __slots__ = ("name", "is_async", "is_hot", "is_dispatch",
-                 "is_admission")
+                 "is_admission", "is_shard_scoped")
 
     def __init__(self, name: str, is_async: bool, is_hot: bool,
-                 is_dispatch: bool = False, is_admission: bool = False):
+                 is_dispatch: bool = False, is_admission: bool = False,
+                 is_shard_scoped: bool = False):
         self.name = name
         self.is_async = is_async
         self.is_hot = is_hot
         self.is_dispatch = is_dispatch
         self.is_admission = is_admission
+        self.is_shard_scoped = is_shard_scoped
 
 
 class LintContext(ast.NodeVisitor):
@@ -269,6 +279,10 @@ class LintContext(ast.NodeVisitor):
     @property
     def in_admission_path(self) -> bool:
         return bool(self._frames) and self._frames[-1].is_admission
+
+    @property
+    def in_shard_scoped(self) -> bool:
+        return bool(self._frames) and self._frames[-1].is_shard_scoped
 
     @property
     def current_class(self) -> "str | None":
@@ -319,6 +333,8 @@ class LintContext(ast.NodeVisitor):
             or self.in_dispatch_stage
         is_admission = bool(decorators & ADMISSION_PATH_DECORATORS) \
             or self.in_admission_path
+        is_shard_scoped = bool(decorators & SHARD_SCOPED_DECORATORS) \
+            or self.in_shard_scoped
         for rule in self.rules:
             rule.on_function(self, node)
         # decorators, default args, and annotations execute ONCE at def
@@ -333,7 +349,8 @@ class LintContext(ast.NodeVisitor):
             if node.returns is not None:
                 self.visit(node.returns)
             self._frames.append(_Frame(node.name, is_async, is_hot,
-                                       is_dispatch, is_admission))
+                                       is_dispatch, is_admission,
+                                       is_shard_scoped))
             try:
                 for stmt in node.body:
                     self.visit(stmt)
@@ -357,7 +374,8 @@ class LintContext(ast.NodeVisitor):
             self.visit(node.args)
             self._frames.append(_Frame("<lambda>", False, self.in_hot_loop,
                                        self.in_dispatch_stage,
-                                       self.in_admission_path))
+                                       self.in_admission_path,
+                                       self.in_shard_scoped))
             try:
                 self.visit(node.body)
             finally:

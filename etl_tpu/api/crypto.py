@@ -3,18 +3,99 @@
 Reference parity: etl-api encrypted source/destination configs
 (crates/etl-api/src/configs/encryption.rs) — AES-256-GCM with random
 nonces, key from configuration, plus key-id tagging so keys can rotate
-(the reference ships an encryption-key rotation xtask)."""
+(the reference ships an encryption-key rotation xtask).
+
+When the `cryptography` package is not installed (minimal CI images),
+the cipher degrades to a pure-stdlib authenticated scheme with the SAME
+interface and envelope shape: SHA-256 counter-mode keystream +
+truncated HMAC-SHA-256 tag (encrypt-then-MAC, constant-time compare).
+Envelopes are self-consistent within one backend — a deployment must
+not mix backends over the same database, so which backend is live is
+exported as `CIPHER_BACKEND` and logged by the API at startup."""
 
 from __future__ import annotations
 
 import base64
+import hashlib
+import hmac
 import json
 import os
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
 from ..models.errors import ErrorKind, EtlError
+
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    CIPHER_BACKEND = "aes-256-gcm"
+except ImportError:  # minimal image: stdlib fallback, same interface
+    class AESGCM:  # type: ignore[no-redef]
+        """Drop-in stand-in for cryptography's AESGCM: SHA-256-CTR
+        keystream XOR + 16-byte HMAC-SHA-256 tag appended to the
+        ciphertext (the same ct||tag layout AES-GCM emits), so the
+        envelope format and every call site stay identical."""
+
+        _TAG_LEN = 16
+
+        def __init__(self, key: bytes):
+            if len(key) != 32:
+                raise ValueError("key must be 32 bytes")
+            self._key = key
+
+        @staticmethod
+        def generate_key(bit_length: int) -> bytes:
+            if bit_length != 256:
+                raise ValueError("only 256-bit keys are supported")
+            return os.urandom(32)
+
+        def _keystream(self, nonce: bytes, n: int) -> bytes:
+            out = bytearray()
+            counter = 0
+            while len(out) < n:
+                out += hashlib.sha256(
+                    b"etl-ks|" + self._key + b"|" + nonce + b"|"
+                    + counter.to_bytes(8, "big")).digest()
+                counter += 1
+            return bytes(out[:n])
+
+        def _tag(self, nonce: bytes, ct: bytes,
+                 aad: "bytes | None") -> bytes:
+            return hmac.new(
+                self._key,
+                b"etl-tag|" + nonce + b"|" + (aad or b"") + b"|" + ct,
+                hashlib.sha256).digest()[:self._TAG_LEN]
+
+        def encrypt(self, nonce: bytes, data: bytes,
+                    aad: "bytes | None") -> bytes:
+            ct = bytes(a ^ b for a, b in
+                       zip(data, self._keystream(nonce, len(data))))
+            return ct + self._tag(nonce, ct, aad)
+
+        def decrypt(self, nonce: bytes, data: bytes,
+                    aad: "bytes | None") -> bytes:
+            if len(data) < self._TAG_LEN:
+                raise ValueError("ciphertext too short")
+            ct, tag = data[:-self._TAG_LEN], data[-self._TAG_LEN:]
+            if not hmac.compare_digest(tag, self._tag(nonce, ct, aad)):
+                raise ValueError("authentication tag mismatch")
+            return bytes(a ^ b for a, b in
+                         zip(ct, self._keystream(nonce, len(ct))))
+
+    CIPHER_BACKEND = "stdlib-hmac-ctr"
+
+    import logging
+
+    # loud by design: a production image missing the `cryptography`
+    # wheel silently changing the at-rest cipher would be a security
+    # posture change nobody asked for — and envelopes written by the
+    # two backends are mutually undecryptable, so adding the wheel
+    # later strands every stored config. CI/test images are the
+    # intended audience of this fallback.
+    logging.getLogger("etl_tpu.api.crypto").warning(
+        "cryptography not installed: config encryption degraded to the "
+        "stdlib HMAC-CTR fallback (CIPHER_BACKEND=%s); envelopes are "
+        "NOT interchangeable with the AES-256-GCM backend — install "
+        "`cryptography` for production deployments", CIPHER_BACKEND)
 
 
 @dataclass(frozen=True)

@@ -102,6 +102,12 @@ class ApiDb(abc.ABC):
                             raise
 
 
+#: sqlite grew RETURNING in 3.35; older runtimes (debian bullseye ships
+#: 3.34) get the clause stripped and the id synthesized from lastrowid
+_SQLITE_HAS_RETURNING = sqlite3.sqlite_version_info >= (3, 35, 0)
+_RETURNING_ID = " returning id"
+
+
 class SqliteApiDb(ApiDb):
     bigserial = "INTEGER"
 
@@ -120,9 +126,18 @@ class SqliteApiDb(ApiDb):
 
     async def run(self, sql: str, params: tuple = ()) -> list[tuple]:
         assert self._db is not None, "api db not connected"
+        emulate_returning = (not _SQLITE_HAS_RETURNING
+                             and sql.rstrip().lower()
+                                 .endswith(_RETURNING_ID))
+        if emulate_returning:
+            sql = sql.rstrip()[:-len(_RETURNING_ID)]
         try:
             cur = self._db.execute(sql, params)
-            rows = cur.fetchall() if cur.description is not None else []
+            if emulate_returning:
+                rows = [(cur.lastrowid,)]
+            else:
+                rows = cur.fetchall() if cur.description is not None \
+                    else []
             self._db.commit()
         except sqlite3.IntegrityError as e:
             self._db.rollback()

@@ -34,6 +34,22 @@ class ReplicatorSpec:
     tenant_id: str
     config: dict  # full replicator config document (plaintext)
     image: "str | None" = None  # container image override (images CRUD)
+    # horizontal scale-out (docs/sharding.md): shard_count > 1 splits the
+    # publication across K replica sets — the orchestrator creates ONE
+    # StatefulSet per shard, each pod told its slice via `shard` /
+    # `shard_count` config keys. `shard` set on a spec pins it to one
+    # shard (the per-shard spec the fan-out derives); shard_count 0 =
+    # derive from the config document's own shard_count key.
+    shard: "int | None" = None
+    shard_count: int = 0
+
+    def effective_shard_count(self) -> int:
+        if self.shard_count:
+            return self.shard_count
+        try:
+            return max(1, int(self.config.get("shard_count", 1) or 1))
+        except (TypeError, ValueError):
+            return 1
 
 
 @dataclass
@@ -157,8 +173,15 @@ class K8sOrchestrator(Orchestrator):
         self.control_api_key_secret = control_api_key_secret
         self._session: aiohttp.ClientSession | None = None
 
-    def _name(self, pipeline_id: int) -> str:
-        return f"etl-replicator-{pipeline_id}"
+    #: probing bound for shard discovery (stop/delete/status find a
+    #: sharded deployment's replica sets by walking `-s0, -s1, …` until
+    #: the first 404; a fleet larger than this is not a thing this
+    #: orchestrator ever creates)
+    MAX_SHARDS = 64
+
+    def _name(self, pipeline_id: int, shard: "int | None" = None) -> str:
+        base = f"etl-replicator-{pipeline_id}"
+        return base if shard is None else f"{base}-s{shard}"
 
     async def _api(self, method: str, path: str,
                    body: dict | None = None) -> tuple[int, dict]:
@@ -186,8 +209,51 @@ class K8sOrchestrator(Orchestrator):
             return resp.status, doc
 
     async def start_pipeline(self, spec: ReplicatorSpec) -> None:
+        """Create (or roll) the pipeline's workload. shard_count > 1
+        fans out to ONE replica set per shard — each pod's config names
+        its `shard`/`shard_count` slice, so the replicator binary scopes
+        itself (runtime/pipeline.py); a later start with a different K
+        re-applies the new topology (the coordinator's epoch fence
+        refuses any stale pod that outlives the roll)."""
+        k = spec.effective_shard_count()
+        if spec.shard is not None:
+            await self._start_one(spec, spec.shard)
+            return
+        if k <= 1:
+            await self._start_one(spec, None)
+            # a deployment rolled back from sharded to unsharded must
+            # not leave the old per-shard fleet running beside it
+            # (discovery AFTER creation: scripted/409 re-apply flows see
+            # the same request order as before sharding existed)
+            for name in await self._shard_names(spec.pipeline_id):
+                await self._stop_one(name)
+            return
+        import dataclasses
+
+        for shard in range(k):
+            shard_spec = dataclasses.replace(
+                spec, shard=shard, shard_count=k,
+                config=dict(spec.config, shard=shard, shard_count=k))
+            await self._start_one(shard_spec, shard)
+        # a resharded deployment must not leave the old unsharded
+        # replica set — or, on a SHRINK, the higher-index shards — the
+        # new fleet won't reuse running beside it (their slots would
+        # pin WAL and their writes are only refused, never reaped)
+        status, _ = await self._api(
+            "DELETE", f"/apis/apps/v1/namespaces/{self.namespace}"
+                      f"/statefulsets/{self._name(spec.pipeline_id)}")
+        if status >= 400 and status != 404:
+            raise EtlError(ErrorKind.DESTINATION_FAILED,
+                           f"k8s DELETE stale unsharded set → {status}")
+        wanted = {self._name(spec.pipeline_id, s) for s in range(k)}
+        for name in await self._shard_names(spec.pipeline_id):
+            if name not in wanted:
+                await self._stop_one(name)
+
+    async def _start_one(self, spec: ReplicatorSpec,
+                         shard: "int | None") -> None:
         ns = self.namespace
-        name = self._name(spec.pipeline_id)
+        name = self._name(spec.pipeline_id, shard)
         sanitized, secret_env = split_secrets(spec.config)
         import time
 
@@ -202,7 +268,9 @@ class K8sOrchestrator(Orchestrator):
             "metadata": {"name": name,
                          "labels": {"app": "etl-replicator",
                                     "pipeline_id": str(spec.pipeline_id),
-                                    "tenant_id": spec.tenant_id}},
+                                    "tenant_id": spec.tenant_id,
+                                    **({"shard": str(shard)}
+                                       if shard is not None else {})}},
             "spec": {
                 "serviceName": name, "replicas": 1,
                 "selector": {"matchLabels": {"app": name}},
@@ -393,17 +461,51 @@ class K8sOrchestrator(Orchestrator):
         reference k8s/http.rs:1676,1708)."""
         await self.start_pipeline(spec)
 
-    async def stop_pipeline(self, pipeline_id: int) -> None:
-        """Pause: remove the workload resources but KEEP the warehouse
-        PVC and the maintenance CronJob. Stop is paired with start: the
-        lake data must survive the pause (run_maintenance itself stops
-        the pipeline before compacting the very warehouse that volume
-        holds), and deleting the CronJob here would cascade-GC its OWN
-        running Job mid-compaction — the pause gate calls /stop, and in
-        real Kubernetes the Job's ownerReference makes the delete
-        garbage-collect the pod that issued it."""
+    async def _shard_names(self, pipeline_id: int) -> "list[str]":
+        """Discover a deployment's per-shard replica-set names by walking
+        `-s0, -s1, …` until the first absent StatefulSet — stop/delete/
+        status need the live topology without being told K (the caller
+        may not know it, e.g. after a rebalance changed it)."""
         ns = self.namespace
-        name = self._name(pipeline_id)
+        # preferred: ONE labelSelector list — gap-proof (a half-finished
+        # teardown that already removed -s0 must not hide -s1/-s2)
+        status, doc = await self._api(
+            "GET", f"/apis/apps/v1/namespaces/{ns}/statefulsets"
+                   f"?labelSelector=pipeline_id%3D{pipeline_id}")
+        if status < 400 and isinstance(doc, dict) \
+                and isinstance(doc.get("items"), list):
+            base = self._name(pipeline_id)
+            names = []
+            for item in doc["items"]:
+                name = item.get("metadata", {}).get("name", "")
+                if name.startswith(f"{base}-s") \
+                        and name[len(base) + 2:].isdigit():
+                    names.append(name)
+            return sorted(names,
+                          key=lambda n: int(n.rsplit("-s", 1)[1]))
+        # fallback (API servers/stubs without list support): walk the
+        # deterministic names until the first absent set
+        names = []
+        for shard in range(self.MAX_SHARDS):
+            name = self._name(pipeline_id, shard)
+            status, doc = await self._api(
+                "GET", f"/apis/apps/v1/namespaces/{ns}/statefulsets/{name}")
+            if status == 404:
+                break
+            if status >= 400:
+                raise EtlError(ErrorKind.DESTINATION_FAILED,
+                               f"k8s GET statefulset {name} → {status}")
+            if not isinstance(doc, dict) \
+                    or not ({"metadata", "spec"} & set(doc)):
+                # a real StatefulSet document always carries metadata —
+                # an empty 200 is a permissive stub/proxy, not a replica
+                # set; treat it as absent rather than fabricating shards
+                break
+            names.append(name)
+        return names
+
+    async def _stop_one(self, name: str) -> None:
+        ns = self.namespace
         for path in (f"/apis/apps/v1/namespaces/{ns}/statefulsets/{name}",
                      f"/api/v1/namespaces/{ns}/secrets/{name}-secrets",
                      f"/api/v1/namespaces/{ns}/configmaps/{name}-config"):
@@ -423,14 +525,23 @@ class K8sOrchestrator(Orchestrator):
             raise EtlError(ErrorKind.DESTINATION_FAILED,
                            f"k8s suspend cronjob {name} → {status}")
 
-    async def delete_pipeline(self, pipeline_id: int) -> None:
-        """Permanent teardown: stop, then drop the maintenance CronJob
-        and the warehouse PVC — an orphaned claim would be silently
-        re-adopted by a future pipeline with the same id, running it
-        against stale warehouse data (old catalog, old replay epochs)."""
-        await self.stop_pipeline(pipeline_id)
+    async def stop_pipeline(self, pipeline_id: int) -> None:
+        """Pause: remove the workload resources but KEEP the warehouse
+        PVC and the maintenance CronJob. Stop is paired with start: the
+        lake data must survive the pause (run_maintenance itself stops
+        the pipeline before compacting the very warehouse that volume
+        holds), and deleting the CronJob here would cascade-GC its OWN
+        running Job mid-compaction — the pause gate calls /stop, and in
+        real Kubernetes the Job's ownerReference makes the delete
+        garbage-collect the pod that issued it. Sharded deployments stop
+        EVERY shard's replica set (discovered, not assumed)."""
+        shard_names = await self._shard_names(pipeline_id)
+        await self._stop_one(self._name(pipeline_id))
+        for name in shard_names:
+            await self._stop_one(name)
+
+    async def _delete_owned(self, name: str) -> None:
         ns = self.namespace
-        name = self._name(pipeline_id)
         for path in (f"/apis/batch/v1/namespaces/{ns}/cronjobs/"
                      f"{name}-maintenance",
                      f"/api/v1/namespaces/{ns}/persistentvolumeclaims/"
@@ -440,12 +551,26 @@ class K8sOrchestrator(Orchestrator):
                 raise EtlError(ErrorKind.DESTINATION_FAILED,
                                f"k8s DELETE {path} → {status}")
 
-    async def pod_status(self, pipeline_id: int) -> str:
+    async def delete_pipeline(self, pipeline_id: int) -> None:
+        """Permanent teardown: stop, then drop the maintenance CronJob
+        and the warehouse PVC — an orphaned claim would be silently
+        re-adopted by a future pipeline with the same id, running it
+        against stale warehouse data (old catalog, old replay epochs).
+        Sharded deployments tear down every shard's owned resources."""
+        shard_names = await self._shard_names(pipeline_id)
+        await self.stop_pipeline(pipeline_id)
+        await self._delete_owned(self._name(pipeline_id))
+        for name in shard_names:
+            await self._delete_owned(name)
+
+    async def pod_status(self, pipeline_id: int,
+                         app_name: "str | None" = None) -> str:
         """Pod-level state (reference get_replicator_pod_status): derives
         stopped/starting/started/stopping/failed/unknown from the pod
-        document rather than StatefulSet replica counts."""
+        document rather than StatefulSet replica counts. `app_name`
+        selects one shard's replica set in a sharded deployment."""
         ns = self.namespace
-        name = self._name(pipeline_id)
+        name = app_name or self._name(pipeline_id)
         status, doc = await self._api(
             "GET", f"/api/v1/namespaces/{ns}/pods"
                    f"?labelSelector=app%3D{name}")
@@ -456,9 +581,9 @@ class K8sOrchestrator(Orchestrator):
         items = doc.get("items", [])
         return derive_pod_status(items[0] if items else None)
 
-    async def status(self, pipeline_id: int) -> ReplicatorStatus:
+    async def _status_one(self, pipeline_id: int,
+                          name: str) -> ReplicatorStatus:
         ns = self.namespace
-        name = self._name(pipeline_id)
         status, doc = await self._api(
             "GET", f"/apis/apps/v1/namespaces/{ns}/statefulsets/{name}")
         if status == 404:
@@ -466,13 +591,36 @@ class K8sOrchestrator(Orchestrator):
         if status >= 400:
             return ReplicatorStatus(pipeline_id, "failed",
                                     f"k8s status {status}")
-        pod = await self.pod_status(pipeline_id)
+        pod = await self.pod_status(pipeline_id, app_name=name)
         if pod == "failed":
             return ReplicatorStatus(pipeline_id, "failed",
                                     "pod failed (see pod status)")
         ready = doc.get("status", {}).get("readyReplicas", 0)
         return ReplicatorStatus(pipeline_id,
                                 "running" if ready else "starting")
+
+    async def status(self, pipeline_id: int) -> ReplicatorStatus:
+        """Aggregate over the deployment's replica sets: a sharded
+        pipeline is `running` only when EVERY shard is; any failed shard
+        fails the whole, any starting shard keeps it starting — one
+        hidden dead shard must never read as healthy."""
+        shard_names = await self._shard_names(pipeline_id)
+        if not shard_names:
+            return await self._status_one(pipeline_id,
+                                          self._name(pipeline_id))
+        states = []
+        details = []
+        for i, name in enumerate(shard_names):
+            st = await self._status_one(pipeline_id, name)
+            states.append(st.state)
+            details.append(f"s{i}={st.state}"
+                           + (f" ({st.detail})" if st.detail else ""))
+        detail = ", ".join(details)
+        if any(s == "failed" for s in states):
+            return ReplicatorStatus(pipeline_id, "failed", detail)
+        if any(s in ("starting", "stopped") for s in states):
+            return ReplicatorStatus(pipeline_id, "starting", detail)
+        return ReplicatorStatus(pipeline_id, "running", detail)
 
     async def shutdown(self) -> None:
         if self._session is not None:
@@ -481,22 +629,51 @@ class K8sOrchestrator(Orchestrator):
 
 
 class LocalOrchestrator(Orchestrator):
-    """Runs `python -m etl_tpu.replicator` subprocesses on this host."""
+    """Runs `python -m etl_tpu.replicator` subprocesses on this host.
+
+    Sharded deployments (`shard_count` > 1 in the spec/config) run ONE
+    subprocess per shard — keyed `(pipeline_id, shard)`; unsharded
+    pipelines keep their plain `pipeline_id` key (and the existing
+    restart-on-spec-change semantics)."""
 
     def __init__(self, work_dir: str):
         self.work_dir = Path(work_dir)
-        self._procs: dict[int, asyncio.subprocess.Process] = {}
-        self._specs: dict[int, ReplicatorSpec] = {}
+        # key: pipeline_id (unsharded) | (pipeline_id, shard) (sharded)
+        self._procs: dict = {}
+        self._specs: dict = {}
+
+    def _keys_for(self, pipeline_id: int) -> list:
+        return [k for k in self._procs
+                if k == pipeline_id
+                or (isinstance(k, tuple) and k[0] == pipeline_id)]
 
     async def start_pipeline(self, spec: ReplicatorSpec) -> None:
-        existing = self._procs.get(spec.pipeline_id)
+        k = spec.effective_shard_count()
+        if spec.shard is None and k > 1:
+            import dataclasses
+
+            # a topology change (unsharded→K or K→K') stops whatever is
+            # running under keys the new fleet won't reuse
+            wanted = {(spec.pipeline_id, s) for s in range(k)}
+            for key in self._keys_for(spec.pipeline_id):
+                if key not in wanted:
+                    await self._stop_key(key)
+            for shard in range(k):
+                await self.start_pipeline(dataclasses.replace(
+                    spec, shard=shard, shard_count=k,
+                    config=dict(spec.config, shard=shard, shard_count=k)))
+            return
+        key = spec.pipeline_id if spec.shard is None \
+            else (spec.pipeline_id, spec.shard)
+        existing = self._procs.get(key)
         if existing is not None and existing.returncode is None:
-            if self._specs.get(spec.pipeline_id) == spec:
+            if self._specs.get(key) == spec:
                 return  # unchanged: keep the running process
             # config or image changed → restart with the new spec (the
             # single-host analogue of the StatefulSet template roll)
-            await self.stop_pipeline(spec.pipeline_id)
-        conf_dir = self.work_dir / f"pipeline-{spec.pipeline_id}"
+            await self._stop_key(key)
+        suffix = "" if spec.shard is None else f"-s{spec.shard}"
+        conf_dir = self.work_dir / f"pipeline-{spec.pipeline_id}{suffix}"
         conf_dir.mkdir(parents=True, exist_ok=True)
         (conf_dir / "base.yaml").write_text(yaml.safe_dump(spec.config))
         # logs go to a file: an unread PIPE would block the replicator once
@@ -510,12 +687,12 @@ class LocalOrchestrator(Orchestrator):
                 stdout=log, stderr=asyncio.subprocess.STDOUT)
         finally:
             log.close()
-        self._procs[spec.pipeline_id] = proc
-        self._specs[spec.pipeline_id] = spec
+        self._procs[key] = proc
+        self._specs[key] = spec
 
-    async def stop_pipeline(self, pipeline_id: int) -> None:
-        self._specs.pop(pipeline_id, None)
-        proc = self._procs.pop(pipeline_id, None)
+    async def _stop_key(self, key) -> None:
+        self._specs.pop(key, None)
+        proc = self._procs.pop(key, None)
         if proc is None or proc.returncode is not None:
             return
         proc.send_signal(signal.SIGTERM)
@@ -525,16 +702,38 @@ class LocalOrchestrator(Orchestrator):
             proc.kill()
             await proc.wait()
 
+    async def stop_pipeline(self, pipeline_id: int) -> None:
+        for key in self._keys_for(pipeline_id):
+            await self._stop_key(key)
+
     async def status(self, pipeline_id: int) -> ReplicatorStatus:
-        proc = self._procs.get(pipeline_id)
-        if proc is None:
+        keys = self._keys_for(pipeline_id)
+        if not keys:
             return ReplicatorStatus(pipeline_id, "stopped")
-        if proc.returncode is None:
+        states = []
+        details = []
+        for key in sorted(keys, key=str):
+            proc = self._procs[key]
+            if proc.returncode is None:
+                states.append("running")
+            else:
+                states.append("failed" if proc.returncode else "stopped")
+                details.append(f"{key}: exit code {proc.returncode}")
+        if any(s == "failed" for s in states):
+            return ReplicatorStatus(pipeline_id, "failed",
+                                    "; ".join(details))
+        if all(s == "running" for s in states):
             return ReplicatorStatus(pipeline_id, "running")
-        return ReplicatorStatus(
-            pipeline_id, "failed" if proc.returncode else "stopped",
-            f"exit code {proc.returncode}")
+        if all(s == "stopped" for s in states):
+            return ReplicatorStatus(pipeline_id, "stopped",
+                                    "; ".join(details))
+        # mixed running/exited shard fleet: part of the publication is
+        # still replicating — never report 'stopped' over a live process
+        # (the K8s aggregate's stance: one incomplete shard degrades the
+        # whole to 'starting')
+        return ReplicatorStatus(pipeline_id, "starting",
+                                "; ".join(details))
 
     async def shutdown(self) -> None:
-        for pid in list(self._procs):
-            await self.stop_pipeline(pid)
+        for key in list(self._procs):
+            await self._stop_key(key)

@@ -901,6 +901,92 @@ async def run_multi_pipeline(profiles=None, seed: int = 7,
     }
 
 
+async def run_sharded_processes(shards: int = 2,
+                                profile: str = "insert_heavy",
+                                seed: int = 7, tables: int = 8,
+                                target_ops: int = 2_000,
+                                engine: str = "tpu",
+                                timeout_s: float = 600.0) -> dict:
+    """K shard replicators as K OS PROCESSES (benchmarks/shard_worker.py)
+    — separate interpreters, GILs, and XLA runtimes, the pod resource
+    model — each replaying the identical publication WAL (the workload
+    generator's byte-identical `(profile, seed)` contract) and applying
+    only its ShardMap slice. The parent asserts the slices cover every
+    table exactly once, every worker's slice verifies, and reports the
+    aggregate events/s (sum of per-worker rates over their concurrent
+    measured windows — the same aggregation run_multi_pipeline uses).
+
+    `shards=1` spawns ONE unsharded worker over the same workload: the
+    single-apply-loop baseline the acceptance bar compares against."""
+    import json as _json
+    import os
+    import sys as _sys
+
+    from ..sharding import ShardMap
+    from ..workloads import get_profile
+
+    get_profile(profile)  # fail fast on a typo'd profile name
+    specs = []
+    if shards <= 1:
+        specs.append({"shard": None, "shard_count": 1})
+    else:
+        part = ShardMap(shards).partition(range(16384, 16384 + tables))
+        if any(not owned for owned in part.values()):
+            raise ValueError(
+                f"degenerate shard map over {tables} tables: "
+                f"{ {s: len(v) for s, v in part.items()} }")
+        specs = [{"shard": s, "shard_count": shards}
+                 for s in range(shards)]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    async def spawn(spec: dict):
+        spec = dict(spec, profile=profile, seed=seed, tables=tables,
+                    target_ops=target_ops, engine=engine)
+        proc = await asyncio.create_subprocess_exec(
+            _sys.executable, "-m", "etl_tpu.benchmarks.shard_worker",
+            _json.dumps(spec),
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE, env=env)
+        try:
+            out, err = await asyncio.wait_for(proc.communicate(),
+                                              timeout_s)
+        except asyncio.TimeoutError:
+            proc.kill()
+            await proc.wait()
+            raise TimeoutError(
+                f"shard worker {spec.get('shard')} did not finish in "
+                f"{timeout_s:.0f}s")
+        lines = out.decode().strip().splitlines()
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"shard worker {spec.get('shard')} failed "
+                f"(rc={proc.returncode}): {err.decode()[-400:]}")
+        return _json.loads(lines[-1])
+
+    results = await asyncio.gather(*(spawn(s) for s in specs))
+
+    owned_union: list = []
+    for r in results:
+        owned_union.extend(r["owned_table_ids"])
+    expected_ids = list(range(16384, 16384 + tables))
+    union_ok = sorted(owned_union) == expected_ids if shards > 1 \
+        else results[0]["owned_table_ids"] == expected_ids
+    return {
+        "mode": "sharded", "engine": engine, "seed": seed,
+        "profile": profile, "shards": max(1, shards), "tables": tables,
+        "per_shard": {str(r["shard"]): r for r in results},
+        "tables_per_shard": {str(r["shard"]): r["tables"]
+                             for r in results},
+        "aggregate_row_events": sum(r["delivered_row_events"]
+                                    for r in results),
+        "aggregate_events_per_second": sum(r["events_per_second"]
+                                           for r in results),
+        "all_verified": all(r["verified"] for r in results),
+        "union_covers_all_tables": bool(union_ok),
+    }
+
+
 # ---------------------------------------------------------------------------
 # egress (per-destination encoder isolation: ColumnarBatch → wire bytes)
 # ---------------------------------------------------------------------------

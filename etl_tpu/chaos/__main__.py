@@ -43,6 +43,17 @@ def main(argv: list[str] | None = None) -> int:
                              "survivor must keep decoding, invariants "
                              "must hold for both, and the scheduler must "
                              "drain without leaking tickets or tenants")
+    parser.add_argument("--sharded", dest="sharded", type=int, nargs="?",
+                        const=2, default=None, metavar="K",
+                        help="run the sharded pod-kill scenario instead "
+                             "of the corpus: K shard replicators (default "
+                             "2) split one publication over one shared "
+                             "store, one shard is hard-killed mid-stream "
+                             "and restarted; survivors must deliver their "
+                             "whole remaining slice during the outage, "
+                             "per-shard AND cross-shard-union invariants "
+                             "must hold, and no shard may see another's "
+                             "tables")
     parser.add_argument("--list", action="store_true",
                         help="list scenario names and exit")
     parser.add_argument("--timeout", type=float, default=60.0,
@@ -65,13 +76,28 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.multi_pipeline:
-        if args.matrix or args.workload or args.scenario:
+        if args.matrix or args.workload or args.scenario or args.sharded:
             parser.error("--multi-pipeline runs its own two-stream "
                          "scenario and cannot be combined with "
-                         "--matrix/--workload/--scenario")
+                         "--matrix/--workload/--scenario/--sharded")
         from .multi import run_multi_pipeline_scenario
 
         run = asyncio.run(run_multi_pipeline_scenario(seed=args.seed))
+        print(json.dumps(run.describe(), sort_keys=True))
+        return 0 if run.ok else 1
+
+    if args.sharded is not None:
+        if args.matrix or args.workload or args.scenario:
+            parser.error("--sharded runs its own K-shard pod-kill "
+                         "scenario and cannot be combined with "
+                         "--matrix/--workload/--scenario")
+        if args.sharded < 2:
+            parser.error("--sharded needs K >= 2 (killing the only "
+                         "shard proves nothing about isolation)")
+        from .sharded import run_sharded_scenario
+
+        run = asyncio.run(run_sharded_scenario(seed=args.seed,
+                                               shards=args.sharded))
         print(json.dumps(run.describe(), sort_keys=True))
         return 0 if run.ok else 1
 

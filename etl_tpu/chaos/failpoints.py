@@ -77,6 +77,10 @@ DESTINATION_FLUSH = "destination.flush"
 STORE_STATE_COMMIT = "store.state_commit"
 STORE_SCHEMA_COMMIT = "store.schema_commit"
 STORE_PROGRESS_COMMIT = "store.progress_commit"
+# shard-assignment commits (store/memory.py, store/sql.py): the
+# coordinator's two-phase rebalance persists through here — a fault is
+# the crash-mid-rebalance window (docs/sharding.md)
+STORE_SHARD_COMMIT = "store.shard_commit"
 
 CHAOS_SITES = (
     PIPELINE_PACK, PIPELINE_DISPATCH, PIPELINE_FETCH, ENGINE_DEVICE_OOM,
@@ -84,6 +88,7 @@ CHAOS_SITES = (
     APPLY_FRAME_READ,
     DESTINATION_WRITE, DESTINATION_FLUSH,
     STORE_STATE_COMMIT, STORE_SCHEMA_COMMIT, STORE_PROGRESS_COMMIT,
+    STORE_SHARD_COMMIT,
 )
 
 #: sites that can stall asynchronously (an armed stall is consumed by the
@@ -94,6 +99,7 @@ ASYNC_STALL_SITES = (
     APPLY_FRAME_READ, DESTINATION_WRITE, DESTINATION_FLUSH,
     COPY_PARTITION_START, COPY_PARTITION_END,
     STORE_STATE_COMMIT, STORE_SCHEMA_COMMIT, STORE_PROGRESS_COMMIT,
+    STORE_SHARD_COMMIT,
 )
 
 ALL_SITES = REFERENCE_SITES + CHAOS_SITES

@@ -191,10 +191,28 @@ class PipelineConfig:
     # out-of-band lag sampler cadence (reference apply.rs:579-624 polling
     # pg_current_wal_lsn on a lazy side connection); 0 disables
     lag_sample_interval_s: float = 10.0
+    # horizontal scale-out (etl_tpu/sharding, docs/sharding.md): this
+    # pod's shard index within a K-way split of the publication. None =
+    # unsharded (the pod owns every published table, slot names carry no
+    # suffix). A sharded pod filters publication tables by ShardMap
+    # membership, replicates through `_s{shard}`-suffixed slots, and
+    # fences its store writes against the authoritative epoch.
+    shard: int | None = None
+    shard_count: int = 1
 
     def validate(self) -> None:
         _require(self.pipeline_id >= 0, "pipeline_id must be >= 0")
         _require(bool(self.publication_name), "publication_name required")
+        _require(self.shard_count >= 1, "shard_count must be >= 1")
+        if self.shard is not None:
+            _require(0 <= self.shard < self.shard_count,
+                     f"shard must be in [0, {self.shard_count}), "
+                     f"got {self.shard}")
+        else:
+            _require(self.shard_count == 1,
+                     "shard_count > 1 requires a shard index (every pod "
+                     "of a sharded deployment must know which slice it "
+                     "owns)")
         _require(self.max_table_sync_workers >= 1,
                  "need >= 1 table sync worker")
         _require(self.destination_op_timeout_s >= 0,

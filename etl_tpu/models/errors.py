@@ -58,6 +58,15 @@ class ErrorKind(enum.Enum):
     INVALID_STATE_TRANSITION = enum.auto()
     STORE_SERIALIZATION_FAILED = enum.auto()
     PROGRESS_REGRESSION = enum.auto()
+    # --- sharding class (etl_tpu/sharding, no reference counterpart) ---
+    # a shard-scoped runtime touched a table the shard map assigns to a
+    # different shard — a routing bug or a racing rebalance
+    SHARD_NOT_OWNED = enum.auto()
+    # the pod's adopted epoch no longer matches the store's authoritative
+    # assignment: the coordinator flipped underneath a stale pod — the
+    # pod must be rolled with the new topology, retrying in place is
+    # useless (both kinds are MANUAL, not TIMED)
+    SHARD_EPOCH_STALE = enum.auto()
 
     # --- destination class ---
     DESTINATION_FAILED = enum.auto()
@@ -178,6 +187,8 @@ _MANUAL_KINDS = frozenset({
     ErrorKind.SOURCE_REPLICA_IDENTITY,
     ErrorKind.SCHEMA_MISMATCH,
     ErrorKind.SCHEMA_CHANGE_UNSUPPORTED,
+    ErrorKind.SHARD_NOT_OWNED,
+    ErrorKind.SHARD_EPOCH_STALE,
     ErrorKind.UNSUPPORTED_TYPE,
     ErrorKind.ROW_CONVERSION_FAILED,
     ErrorKind.INVALID_DATA,

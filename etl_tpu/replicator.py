@@ -52,19 +52,32 @@ def build_observability_app(pipeline=None) -> web.Application:
     def _supervisor():
         return pipeline.supervisor if pipeline is not None else None
 
+    def _shard_fields() -> dict:
+        # sharded pods identify their slice on every health surface so a
+        # fleet dashboard can tell WHICH shard is unhealthy
+        if pipeline is None or pipeline.config.shard is None:
+            return {}
+        ident = pipeline.shard_identity
+        return {"shard": ident.describe() if ident is not None else {
+            "shard": pipeline.config.shard,
+            "shard_count": pipeline.config.shard_count,
+            "epoch": None}}
+
     async def health(_request: web.Request) -> web.Response:
         sup = _supervisor()
         if sup is None:
             # supervision disabled: liveness of the process is all we
             # can honestly attest
             return web.json_response({"status": "ok",
-                                      "supervision": "disabled"})
+                                      "supervision": "disabled",
+                                      **_shard_fields()})
         if not sup.started:
-            return web.json_response({"status": "starting"}, status=503)
+            return web.json_response(
+                {"status": "starting", **_shard_fields()}, status=503)
         from .supervision import HealthState
 
         state = sup.health.state
-        body = {"status": state.value}
+        body = {"status": state.value, **_shard_fields()}
         if state is HealthState.FAULTED:
             body["fatal"] = sup.health.fatal
             return web.json_response(body, status=503)
@@ -125,8 +138,17 @@ def store_connection_from_doc(base, overrides_doc):
 
 
 async def run_replicator(config_dir: str,
-                         environment: Environment | None = None) -> None:
+                         environment: Environment | None = None,
+                         shard: int | None = None,
+                         shard_count: int | None = None) -> None:
     doc = load_config_dict(config_dir, environment)
+    # CLI shard identity wins over the config document: the orchestrator
+    # writes per-shard config docs, but an operator can also pin a pod's
+    # slice at the command line (docs/sharding.md runbook)
+    if shard is not None:
+        doc["shard"] = shard
+    if shard_count is not None:
+        doc["shard_count"] = shard_count
     dest_doc = doc.pop("destination", {"type": "memory"})
     store_doc = doc.pop("store", {"type": "memory"})
     maint_doc = doc.pop("maintenance", {})
@@ -170,9 +192,12 @@ async def run_replicator(config_dir: str,
         notifier = WebhookErrorNotifier(error_webhook,
                                         pipeline_id=config.pipeline_id)
         notifier.install()
-    logger.info("starting replicator pipeline=%s publication=%s engine=%s",
+    logger.info("starting replicator pipeline=%s publication=%s engine=%s"
+                "%s",
                 config.pipeline_id, config.publication_name,
-                config.batch.batch_engine.value)
+                config.batch.batch_engine.value,
+                f" shard={config.shard}/{config.shard_count}"
+                if config.shard is not None else "")
 
     store_type = store_doc.get("type", "memory")
     if store_type == "sqlite":
@@ -268,10 +293,25 @@ def main(argv: list[str] | None = None) -> int:
                         help="directory with base.yaml / {env}.yaml")
     parser.add_argument("--environment", choices=[e.value for e in Environment],
                         default=None)
+    parser.add_argument("--shard", type=int, default=None,
+                        help="this pod's shard index in a K-way split of "
+                             "the publication (etl_tpu/sharding); "
+                             "overrides the config document's `shard` "
+                             "key. The pod then replicates only its "
+                             "ShardMap slice through `_s{shard}` slots "
+                             "and fences its store writes by epoch.")
+    parser.add_argument("--shard-count", dest="shard_count", type=int,
+                        default=None,
+                        help="total shard count K of the deployment; "
+                             "overrides the config document's "
+                             "`shard_count` key and must match the "
+                             "store's authoritative assignment")
     args = parser.parse_args(argv)
     env = Environment(args.environment) if args.environment else None
     try:
-        asyncio.run(run_replicator(args.config_dir, env))
+        asyncio.run(run_replicator(args.config_dir, env,
+                                   shard=args.shard,
+                                   shard_count=args.shard_count))
         return 0
     except KeyboardInterrupt:
         return 0

@@ -46,7 +46,9 @@ class ApplyWorker:
         self.supervisor = supervisor  # supervision.Supervisor | None
         self._restart_requested: asyncio.Event | None = None
         self._hb = None  # registered in _guarded_run (loop must be live)
-        self.slot_name = apply_slot_name(config.pipeline_id)
+        # sharded pods stream through their own `_s{shard}` slot: the
+        # durable-progress key AND the replication stream are per-shard
+        self.slot_name = apply_slot_name(config.pipeline_id, config.shard)
         self._task: asyncio.Task | None = None
 
     def spawn(self) -> asyncio.Task:

@@ -36,6 +36,10 @@ class Pipeline:
         config.validate()
         self.config = config
         self.store = store
+        # set at start() for sharded pods: the adopted ShardIdentity
+        # (shard, shard_count, epoch) — the raw store stays reachable
+        # through `self.store._inner` only via the scoped view
+        self.shard_identity = None
         self.destination = destination
         self.source_factory = source_factory  # () -> ReplicationSource
         self.shutdown_signal = ShutdownSignal()
@@ -70,6 +74,17 @@ class Pipeline:
                 heartbeat=self.supervisor.register("destination"))
 
     async def start(self) -> None:
+        if self.config.shard is not None and self.shard_identity is None:
+            # adopt the authoritative shard assignment and swap the store
+            # for this pod's filtered, write-fenced view BEFORE anything
+            # reads table states — init, the pool, and the apply worker
+            # must all see only this shard's slice (docs/sharding.md)
+            from ..sharding.runtime import resolve_shard_scope
+
+            scoped = await resolve_shard_scope(self.store, self.config)
+            self.store = scoped
+            self.shard_identity = scoped.identity
+            logger.info("shard scope: %s", scoped.identity.describe())
         source = self.source_factory()
         await source.connect()
         try:
@@ -133,6 +148,13 @@ class Pipeline:
         if not await source.publication_exists(pub):
             raise EtlError(ErrorKind.PUBLICATION_NOT_FOUND, pub)
         published = set(await source.get_publication_table_ids(pub))
+        if self.shard_identity is not None:
+            # this pod initialises (and may purge) only ITS slice of the
+            # publication; sibling shards own the rest. The store view is
+            # already filtered, so `known` below is owned tables only.
+            smap = self.shard_identity.shard_map()
+            published = {tid for tid in published
+                         if smap.owns(tid, self.shard_identity.shard)}
         known = await self.store.get_table_states()
         for tid in published:
             if tid not in known:
@@ -141,7 +163,8 @@ class Pipeline:
             logger.info("purging table %s (no longer in publication)", tid)
             await self.store.purge_table(tid)
             await source.delete_slot(
-                table_sync_slot_name(self.config.pipeline_id, tid))
+                table_sync_slot_name(self.config.pipeline_id, tid,
+                                     self.config.shard))
 
     async def wait(self) -> None:
         """Wait until the apply worker stops (shutdown or fatal error)."""
@@ -171,11 +194,21 @@ class Pipeline:
 
     def health_snapshot(self) -> dict:
         """The live supervision surface the replicator's /health/detail
-        serves; minimal shape when supervision is disabled."""
+        serves; minimal shape when supervision is disabled. Sharded pods
+        always report their identity (shard/shard_count/epoch) so an
+        operator can tell WHICH slice a degraded pod owns."""
         if self.supervisor is None:
-            return {"state": "unsupervised", "started":
+            snap = {"state": "unsupervised", "started":
                     self._apply_task is not None}
-        return self.supervisor.snapshot()
+        else:
+            snap = self.supervisor.snapshot()
+        if self.config.shard is not None:
+            snap["shard"] = self.shard_identity.describe() \
+                if self.shard_identity is not None else {
+                    "shard": self.config.shard,
+                    "shard_count": self.config.shard_count,
+                    "epoch": None}  # not adopted yet (before start())
+        return snap
 
     async def shutdown(self) -> None:
         self.shutdown_signal.trigger()

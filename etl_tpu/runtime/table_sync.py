@@ -313,7 +313,8 @@ class TableSyncWorker:
         pool = self.pool
         store = self.store
         shutdown = pool.shutdown
-        slot_name = table_sync_slot_name(self.config.pipeline_id, self.tid)
+        slot_name = table_sync_slot_name(self.config.pipeline_id, self.tid,
+                                         self.config.shard)
         source: ReplicationSource = pool.source_factory()
         await source.connect()
         try:

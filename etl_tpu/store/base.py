@@ -22,9 +22,11 @@ from __future__ import annotations
 import abc
 from dataclasses import dataclass
 
+from ..models.errors import ErrorKind, EtlError
 from ..models.lsn import Lsn
 from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
 from ..models.table_state import TableState
+from ..sharding.shardmap import ShardAssignment
 
 # a worker's durable-progress key: the apply worker uses the pipeline slot
 # name, table-sync workers their per-table slot name (reference progress
@@ -67,6 +69,26 @@ class StateStore(abc.ABC):
 
     @abc.abstractmethod
     async def delete_durable_progress(self, key: ProgressKey) -> None: ...
+
+    # -- shard-assignment surface (docs/sharding.md) --------------------------
+    # Concrete defaults rather than abstract methods: third-party and
+    # test stores that never shard keep working unchanged; the memory and
+    # sql backends override both with real persistence.
+
+    async def get_shard_assignment(self) -> "ShardAssignment | None":
+        """The authoritative (epoch, shard_count) record, or None when
+        the pipeline has never been sharded."""
+        return None
+
+    async def update_shard_assignment(self,
+                                      assignment: ShardAssignment) -> None:
+        """Persist the assignment. Epochs are MONOTONIC: storing an
+        assignment whose epoch is lower than the current record's is a
+        typed error (a stale coordinator must never roll the fleet
+        back)."""
+        raise EtlError(
+            ErrorKind.STATE_STORE_FAILED,
+            f"{type(self).__name__} does not persist shard assignments")
 
     @abc.abstractmethod
     async def get_destination_metadata(

@@ -16,6 +16,7 @@ from ..models.errors import ErrorKind, EtlError
 from ..models.lsn import Lsn
 from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
 from ..models.table_state import TableState, TableStateType
+from ..sharding.shardmap import ShardAssignment
 from .base import (DestinationTableMetadata, PipelineStore, ProgressKey)
 
 
@@ -26,6 +27,7 @@ class MemoryStore(PipelineStore):
         self._schemas: dict[TableId, list[tuple[SnapshotId, ReplicatedTableSchema]]] = \
             defaultdict(list)  # sorted by snapshot id
         self._dest_meta: dict[TableId, DestinationTableMetadata] = {}
+        self._shard_assignment: ShardAssignment | None = None
 
     # -- StateStore ----------------------------------------------------------
 
@@ -73,6 +75,23 @@ class MemoryStore(PipelineStore):
 
     async def delete_destination_metadata(self, table_id: TableId) -> None:
         self._dest_meta.pop(table_id, None)
+
+    # -- shard assignment ----------------------------------------------------
+
+    async def get_shard_assignment(self) -> ShardAssignment | None:
+        return self._shard_assignment
+
+    async def update_shard_assignment(self,
+                                      assignment: ShardAssignment) -> None:
+        cur = self._shard_assignment
+        if cur is not None and assignment.epoch < cur.epoch:
+            raise EtlError(
+                ErrorKind.PROGRESS_REGRESSION,
+                f"shard assignment epoch regression: {cur.epoch} -> "
+                f"{assignment.epoch}")
+        failpoints.fail_point(failpoints.STORE_SHARD_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_SHARD_COMMIT)
+        self._shard_assignment = assignment
 
     # -- SchemaStore ---------------------------------------------------------
 

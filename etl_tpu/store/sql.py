@@ -38,6 +38,7 @@ from ..models.errors import ErrorKind, EtlError
 from ..models.lsn import Lsn
 from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
 from ..models.table_state import TableState
+from ..sharding.shardmap import ShardAssignment
 from .base import DestinationTableMetadata, PipelineStore, ProgressKey
 
 MIGRATIONS: list[tuple[str, str]] = [
@@ -75,6 +76,13 @@ CREATE TABLE IF NOT EXISTS etl_replication_progress (
     PRIMARY KEY (pipeline_id, progress_key)
 );
 """),
+    ("20260803000000_shard_assignment", """
+CREATE TABLE IF NOT EXISTS etl_shard_assignment (
+    pipeline_id BIGINT NOT NULL,
+    assignment_json TEXT NOT NULL,
+    PRIMARY KEY (pipeline_id)
+);
+"""),
 ]
 
 
@@ -92,6 +100,7 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
         self._schemas: dict[TableId, list[tuple[SnapshotId, ReplicatedTableSchema]]] = {}
         self._progress: dict[ProgressKey, Lsn] = {}
         self._meta: dict[TableId, DestinationTableMetadata] = {}
+        self._shard_assignment: ShardAssignment | None = None
 
     # -- execution seam ------------------------------------------------------
 
@@ -134,6 +143,12 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
             for tid, name, gen in await self._run(
                 "SELECT table_id, destination_table_name, generation "
                 "FROM etl_table_mappings WHERE pipeline_id = ?", (pid,))}
+        rows = await self._run(
+            "SELECT assignment_json FROM etl_shard_assignment "
+            "WHERE pipeline_id = ?", (pid,))
+        self._shard_assignment = \
+            ShardAssignment.from_json(json.loads(rows[0][0])) if rows \
+            else None
 
     # -- StateStore ----------------------------------------------------------
 
@@ -221,6 +236,40 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
             "DELETE FROM etl_table_mappings WHERE pipeline_id = ? "
             "AND table_id = ?", (self.pipeline_id, table_id))
         self._meta.pop(table_id, None)
+
+    # -- shard assignment ----------------------------------------------------
+
+    async def get_shard_assignment(self) -> ShardAssignment | None:
+        """Always read THROUGH to the database, unlike the cache-first
+        table-state reads: the assignment is the one row another PROCESS
+        (the coordinator) rewrites underneath a running pod, and the
+        ShardScopedStore epoch fence exists precisely to observe that
+        flip — a connect-time cache would never refuse a stale pod."""
+        rows = await self._run(
+            "SELECT assignment_json FROM etl_shard_assignment "
+            "WHERE pipeline_id = ?", (self.pipeline_id,))
+        self._shard_assignment = \
+            ShardAssignment.from_json(json.loads(rows[0][0])) if rows \
+            else None
+        return self._shard_assignment
+
+    async def update_shard_assignment(self,
+                                      assignment: ShardAssignment) -> None:
+        cur = await self.get_shard_assignment()  # read-through (above)
+        if cur is not None and assignment.epoch < cur.epoch:
+            raise EtlError(
+                ErrorKind.PROGRESS_REGRESSION,
+                f"shard assignment epoch regression: {cur.epoch} -> "
+                f"{assignment.epoch}")
+        failpoints.fail_point(failpoints.STORE_SHARD_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_SHARD_COMMIT)
+        await self._run(
+            "INSERT INTO etl_shard_assignment "
+            "(pipeline_id, assignment_json) VALUES (?, ?) "
+            "ON CONFLICT (pipeline_id) DO UPDATE SET "
+            "assignment_json = excluded.assignment_json",
+            (self.pipeline_id, json.dumps(assignment.to_json())))
+        self._shard_assignment = assignment
 
     # -- SchemaStore ---------------------------------------------------------
 
@@ -348,7 +397,8 @@ import functools
 # maps EXACTLY these into the `etl` schema; the fake server reverses the
 # same list — one source of truth, no drift.
 STORE_TABLE_NAMES = ("etl_replication_state", "etl_table_schemas",
-                     "etl_table_mappings", "etl_replication_progress")
+                     "etl_table_mappings", "etl_replication_progress",
+                     "etl_shard_assignment")
 
 _QUALIFY_RE = re.compile(r"\b(" + "|".join(STORE_TABLE_NAMES) + r")\b")
 

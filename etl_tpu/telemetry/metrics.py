@@ -109,6 +109,22 @@ ETL_LAKE_INLINED_DATA_BYTES = "etl_lake_inlined_data_bytes"
 # ETL_SNOWFLAKE_CHANNEL_RECOVERIES_TOTAL, snowflake/metrics.rs)
 ETL_SNOWPIPE_CHANNEL_RECOVERIES_TOTAL = \
     "etl_snowpipe_channel_recoveries_total"
+# horizontal scale-out (etl_tpu/sharding): the authoritative topology
+# (shard count + epoch), tables-per-shard (labeled per shard — skew means
+# the HRW map and the table population disagree), rebalance timings +
+# moved-table counts from the two-phase coordinator, and write refusals
+# from the shard fence (labeled by reason: not_owned = a routing bug or a
+# racing rebalance, epoch_stale = a pod outliving its topology — both
+# should be zero in steady state and NONZERO refusals are the fence
+# doing its job during a rollout)
+ETL_SHARD_COUNT = "etl_shard_count"
+ETL_SHARD_EPOCH = "etl_shard_epoch"
+ETL_SHARD_TABLES = "etl_shard_tables"
+ETL_SHARD_REBALANCE_DURATION_SECONDS = \
+    "etl_shard_rebalance_duration_seconds"
+ETL_SHARD_REBALANCE_MOVED_TABLES_TOTAL = \
+    "etl_shard_rebalance_moved_tables_total"
+ETL_SHARD_WRITE_REFUSALS_TOTAL = "etl_shard_write_refusals_total"
 # chaos subsystem (etl_tpu/chaos): fault firings per site, per-scenario
 # pass/fail, and how long crash→restart recovery took until the workload
 # fully re-delivered

@@ -512,6 +512,14 @@ class FakePgServer:
 
         stmt = sql.replace("BIGINT GENERATED BY DEFAULT AS IDENTITY",
                            "INTEGER")
+        # real Postgres supports `INSERT ... RETURNING id` everywhere;
+        # the embedded sqlite only grew it in 3.35 — emulate the one
+        # form the control plane uses so old runtimes stay faithful
+        emulate_returning = (sqlite3.sqlite_version_info < (3, 35, 0)
+                             and stmt.rstrip().lower()
+                                 .endswith(" returning id"))
+        if emulate_returning:
+            stmt = stmt.rstrip()[:-len(" returning id")]
         try:
             cur = store.execute(stmt)
         except sqlite3.Error as e:
@@ -520,7 +528,9 @@ class FakePgServer:
             w.write(READY)
             return True
         maybe_release()
-        if cur.description is not None:
+        if emulate_returning:
+            self._send_rows(w, ["id"], [[str(cur.lastrowid)]])
+        elif cur.description is not None:
             names = [d[0] for d in cur.description]
             rows = [[None if v is None else str(v) for v in r]
                     for r in cur.fetchall()]

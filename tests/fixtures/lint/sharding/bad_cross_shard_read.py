@@ -1,0 +1,33 @@
+# etl-lint fixture: unfiltered full-table-list store reads inside
+# @shard_scoped functions (etl_tpu/sharding) — against a SHARED store
+# `get_table_states()` returns EVERY shard's tables, and acting on the
+# full list re-copies / re-owns / purges tables a sibling pod owns.
+# Nested defs and lambdas inherit the frame flag.
+# expect: cross-shard-table-access=4
+from etl_tpu.analysis.annotations import shard_scoped
+
+
+@shard_scoped
+async def respawn_sync_workers(store, pool):
+    states = await store.get_table_states()  # flagged: every shard's tables
+    for tid in states:
+        await pool.ensure_worker(tid)
+
+
+@shard_scoped
+async def purge_departed(store, published):
+    for tid in set(await store.get_table_states()) - published:  # flagged
+        await store.purge_table(tid)
+
+
+@shard_scoped
+def make_state_reader(store):
+    async def read_all():
+        return await store.get_table_states()  # nested def: flagged
+
+    return read_all
+
+
+@shard_scoped
+def gauge_provider(store):
+    return lambda: store.get_table_states()  # lambda inherits: flagged

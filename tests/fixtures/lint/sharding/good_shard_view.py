@@ -1,0 +1,29 @@
+# etl-lint fixture: clean shard-scoped reads — everything goes through
+# the shard view's filtered read (owned_table_states), a single-table
+# lookup, or a read carrying an explicit filter argument; an unfiltered
+# full-list read OUTSIDE any @shard_scoped function is also fine (the
+# unsharded runtime owns the whole publication by definition).
+# (no expectations: zero findings)
+from etl_tpu.analysis.annotations import shard_scoped
+
+
+@shard_scoped
+async def respawn_owned_sync_workers(scoped_store, pool):
+    states = await scoped_store.owned_table_states()
+    for tid in states:
+        await pool.ensure_worker(tid)
+
+
+@shard_scoped
+async def check_one_table(scoped_store, tid):
+    return await scoped_store.get_table_state(tid)
+
+
+@shard_scoped
+async def filtered_read(store, shard_map, shard):
+    # an explicit filter argument makes the read shard-aware
+    return await store.get_table_states(shard=shard)
+
+
+async def unsharded_refresh(store):
+    return await store.get_table_states()

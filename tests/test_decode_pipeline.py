@@ -457,3 +457,16 @@ class TestBenchSmoke:
         assert out["multi_pipeline_admission_grants"] > 0
         assert set(out["workload_events_per_sec"]) >= \
             {"update_heavy_default", "truncate_storm"}
+        # sharded scale-out gates (ISSUE 9): the K=2 pod-kill chaos
+        # scenario must hold every invariant (survivors unaffected,
+        # victim reconverges, per-shard + cross-shard-union checks), and
+        # the K=2 sharded bench slice (one worker process per shard)
+        # must clear the aggregate floor with every slice verified
+        assert out["sharded_chaos_ok"] is True, out["sharded_chaos"]
+        assert out["sharded_chaos"]["union_matches"] is True
+        assert out["sharded_ok"] is True, out
+        assert out["sharded_shards"] == 2
+        assert out["sharded_all_verified"] is True
+        assert out["sharded_union_covers_all_tables"] is True
+        assert out["sharded_events_per_sec"] >= \
+            out["sharded_floor_events_per_sec"]
