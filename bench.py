@@ -198,6 +198,24 @@ def run_mesh_check(n_rows: int = 65_536, iters: int = 5) -> dict:
     st = stage().staged
     identical = _batches_identical(single.decode(st), sharded.decode(st))
 
+    # fused-filter case: per-shard in-program compaction must land the
+    # SAME survivors with the SAME bytes as the single-device scatter
+    # (ROADMAP item 4's mesh gate — bitpack.compact_packed stays
+    # shard-local, so this proves the shard-block reshape and the host's
+    # per-shard slice stitching agree)
+    from etl_tpu.ops.predicate import parse_row_filter
+
+    fschema = schema.with_row_predicate(parse_row_filter("abalance < 0"))
+    fsingle = DeviceDecoder(fschema, device_min_rows=0, mesh=None)
+    fsharded = DeviceDecoder(fschema, device_min_rows=0, mesh=mesh,
+                             mesh_min_rows=0)
+    fb1, fb8 = fsingle.decode(stage().staged), fsharded.decode(stage().staged)
+    filtered_identical = (
+        _batches_identical(fb1, fb8)
+        and fb1.source_rows is not None and fb8.source_rows is not None
+        and np.array_equal(fb1.source_rows, fb8.source_rows)
+        and 0 < fb1.num_rows < n_rows)
+
     def best_decode(dec):
         ts = []
         for _ in range(iters):
@@ -231,13 +249,15 @@ def run_mesh_check(n_rows: int = 65_536, iters: int = 5) -> dict:
     p1, p8 = best_program(single), best_program(sharded)
     out.update({
         "sharded_equals_single": bool(identical),
+        "filtered_sharded_equals_single": bool(filtered_identical),
+        "filtered_survivors": int(fb1.num_rows),
         "single_device_decode_ms": round(t1 * 1e3, 2),
         "sharded_decode_ms": round(t8 * 1e3, 2),
         "decode_wall_clock_speedup": round(t1 / t8, 2),
         "single_device_program_ms": round(p1 * 1e3, 2),
         "sharded_program_ms": round(p8 * 1e3, 2),
         "device_program_speedup": round(p1 / p8, 2),
-        "ok": bool(identical),
+        "ok": bool(identical and filtered_identical),
     })
     return out
 
@@ -393,7 +413,20 @@ def run_smoke() -> dict:
         mesh_out = {"error": (mesh_proc.stderr or "no output")[-400:]}
     mesh_ok = mesh_proc.returncode == 0 \
         and mesh_out.get("sharded_equals_single") is True \
+        and mesh_out.get("filtered_sharded_equals_single") is True \
         and mesh_out.get("mesh_shards") == 8
+
+    # fused-filter gate (ISSUE 11): both device engines across filter
+    # selectivities — Pallas == XLA == host-oracle BYTE identity on the
+    # compacted output (survivor mapping included), and the MEASURED
+    # fetched bytes <= (selectivity + pad slack) x the unfiltered fetch.
+    # Wall-clock speedup is recorded, not gated, on this CPU container
+    # (the fetch link this fusion optimizes is the TPU tunnel)
+    selectivity = harness.run_selectivity(
+        n_rows=floors.get("selectivity_smoke_rows", 8_192),
+        n_iters=floors.get("selectivity_smoke_iters", 3),
+        fetch_slack=floors.get("selectivity_fetch_slack", 0.11))
+    selectivity_ok = selectivity["ok"]
 
     # multi-pipeline tenancy gate (ISSUE 8): ≥2 concurrent streams
     # sharing one device set through the fair batch-admission scheduler,
@@ -466,7 +499,10 @@ def run_smoke() -> dict:
         "ok": bool(identical and stages_observed and stream_ok
                    and heartbeat_ok and lint_ok and no_row_path
                    and egress_ok and workload_ok and mesh_ok and mp_ok
-                   and sharded_chaos_ok and sharded_ok),
+                   and sharded_chaos_ok and sharded_ok
+                   and selectivity_ok),
+        "selectivity_ok": bool(selectivity_ok),
+        "selectivity": selectivity,
         "sharded_chaos_ok": bool(sharded_chaos_ok),
         "sharded_chaos": sharded_chaos.describe(),
         "sharded_events_per_sec":
@@ -597,7 +633,8 @@ def main():
     parser.add_argument("--mode", default="decode",
                         choices=["decode", "table_copy", "table_streaming",
                                  "wide_row", "lag", "egress", "workload",
-                                 "multi_pipeline", "mesh_check"])
+                                 "multi_pipeline", "mesh_check",
+                                 "selectivity"])
     parser.add_argument("--multi-pipeline", dest="multi_pipeline",
                         action="store_true",
                         help="alias for --mode multi_pipeline: N "
@@ -632,6 +669,17 @@ def main():
                              "--xla_force_host_platform_device_count=8")
     parser.add_argument("--mesh-rows", type=int, default=65_536,
                         help="batch size for --mesh-check (default 65536)")
+    parser.add_argument("--selectivity", dest="selectivity",
+                        action="store_true",
+                        help="alias for --mode selectivity: the fused "
+                             "publication-row-filter matrix — both device "
+                             "engines (XLA mask twin + Pallas fused "
+                             "kernel) across filter selectivities, gating "
+                             "Pallas == XLA == host-oracle byte identity "
+                             "on the compacted output and fetched bytes "
+                             "<= (selectivity + pad slack) x unfiltered; "
+                             "wall-clock speedup recorded NOT gated off-"
+                             "TPU")
     parser.add_argument("--egress", dest="egress", action="store_true",
                         help="alias for --mode egress: measure each "
                              "destination encoder in isolation "
@@ -653,6 +701,8 @@ def main():
                              "pipelined decode == serial decode; exit 1 on "
                              "mismatch")
     args = parser.parse_args()
+    if args.selectivity:
+        args.mode = "selectivity"
     if args.egress:
         args.mode = "egress"
     if args.workload is not None:
@@ -784,6 +834,23 @@ def main():
             n for n, v in out["events_per_second"].items()
             if n in wfloors and v < wfloors[n]]
         out["ok"] = bool(out["all_verified"]) and not out["failures"]
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
+    if args.mode == "selectivity":
+        # decode-level matrix: identity + fetch-reduction gates are
+        # backend-independent (they hold on the host CPU platform and on
+        # a real chip alike); the wall-clock columns are only meaningful
+        # on real TPU hardware and are recorded, never gated, elsewhere
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        from etl_tpu.benchmarks import harness
+
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_FLOOR.json")) as f:
+            floors = json.load(f)
+        out = harness.run_selectivity(
+            n_rows=floors.get("selectivity_rows", 16_384),
+            fetch_slack=floors.get("selectivity_fetch_slack", 0.11))
         print(json.dumps(out))
         sys.exit(0 if out["ok"] else 1)
     if args.mode == "egress":

@@ -540,6 +540,14 @@ class UnboundedAwait(Rule):
 ROW_MATERIALIZATION_CTORS = frozenset({"TableRow", "PartialTableRow"})
 ROW_MATERIALIZATION_FREE_CALLS = frozenset({"expand_batch_events"})
 ROW_MATERIALIZATION_METHODS = frozenset({"to_rows", "from_rows"})
+#: predicate-compile sinks: binding a publication row filter re-resolves
+#: columns, re-coerces every literal, and (on first dispatch) re-traces
+#: the fused device program — decoder-CONSTRUCTION work. Inside a
+#: @hot_loop function it runs per batch/flush, the exact per-batch
+#: recompile the fused-filter design forbids (ops/predicate.py).
+PREDICATE_COMPILE_CALLS = frozenset({"compile_row_filter",
+                                     "parse_row_filter",
+                                     "compile_texts", "compile_values"})
 
 
 class HotLoopRowMaterialization(Rule):
@@ -547,7 +555,12 @@ class HotLoopRowMaterialization(Rule):
     `expand_batch_events(...)` inside a `@hot_loop` function: the columnar
     egress hot path is materializing Python row objects. Intentional
     compatibility-shim uses carry an inline ignore with a justification
-    (they are the row fallback, not the hot path)."""
+    (they are the row fallback, not the hot path).
+
+    Also covers the predicate-compile path (`compile_row_filter` /
+    `parse_row_filter` / the per-row evaluator compilers): publication
+    row filters compile ONCE at decoder construction; a compile inside a
+    @hot_loop function re-binds and re-traces per batch."""
 
     name = "hot-loop-row-materialization"
 
@@ -556,13 +569,25 @@ class HotLoopRowMaterialization(Rule):
             return
         term = terminal_name(node.func)
         subject = None
+        pred_compile = False
         if term in ROW_MATERIALIZATION_CTORS \
                 or term in ROW_MATERIALIZATION_FREE_CALLS:
             subject = f"{term}(…)"
+        elif term in PREDICATE_COMPILE_CALLS:
+            subject = f"{term}(…)"
+            pred_compile = True
         elif term in ROW_MATERIALIZATION_METHODS \
                 and isinstance(node.func, ast.Attribute):
             subject = f".{term}(…)"
         if subject is None:
+            return
+        if pred_compile:
+            ctx.report(
+                self.name, node, subject,
+                f"row-filter compilation `{subject}` inside a @hot_loop "
+                f"function: predicates compile at decoder construction, "
+                f"never per batch — hoist it to __init__/startup, or "
+                f"justify with an inline ignore")
             return
         ctx.report(
             self.name, node, subject,

@@ -90,6 +90,25 @@ def _admission_metrics() -> dict:
     }
 
 
+def _filter_metrics() -> dict:
+    """Snapshot of the fused publication-row-filter counters (ops/engine
+    filtered completion): rows compacted out of decode output and the
+    bytes the packed-result fetch actually moved. Benches report the
+    delta over their measured window — the fetched-bytes delta is the
+    MEASURED evidence behind the "fetch scales with selectivity" claim,
+    not an assumption."""
+    from ..telemetry.metrics import (ETL_DECODE_FETCHED_BYTES_TOTAL,
+                                     ETL_DECODE_ROWS_FILTERED_TOTAL,
+                                     registry)
+
+    return {
+        "decode_rows_filtered": registry.get_counter(
+            ETL_DECODE_ROWS_FILTERED_TOTAL),
+        "decode_fetched_bytes": registry.get_counter(
+            ETL_DECODE_FETCHED_BYTES_TOTAL),
+    }
+
+
 # ---------------------------------------------------------------------------
 # table_copy (reference table_copy.rs:74-183)
 # ---------------------------------------------------------------------------
@@ -412,6 +431,7 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
     routed0 = _routed()
     stages0 = _pipeline_metrics()
     adm0 = _admission_metrics()
+    filt0 = _filter_metrics()
     # row-materialization gate input: zero constructions over the measured
     # window = the egress path stayed columnar fetch-to-wire (the smoke
     # gate asserts this on the null destination; 'memory' exercises the
@@ -474,6 +494,8 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
     stages = {k: stages1[k] - stages0[k] for k in stages1}
     adm1 = _admission_metrics()
     adm = {k: adm1[k] - adm0[k] for k in adm1}
+    filt1 = _filter_metrics()
+    filt = {k: filt1[k] - filt0[k] for k in filt1}
     pack_s = stages["pipeline_pack_seconds"]
     lags_ms = [(t - commit_times[lsn]) * 1000 for lsn, t in arrivals
                if lsn in commit_times]
@@ -517,6 +539,12 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
         "admission_wait_seconds": round(adm["admission_wait_seconds"], 4),
         "mesh_batches": int(adm["mesh_batches"]),
         "mesh_padded_rows": int(adm["mesh_padded_rows"]),
+        # fused row-filter activity over the measured window (zero on
+        # unfiltered publications): filtered rows never reach the fetch
+        # path, and fetched_bytes is the link traffic the packed-result
+        # fetches actually moved
+        "decode_rows_filtered": int(filt["decode_rows_filtered"]),
+        "decode_fetched_bytes": int(filt["decode_fetched_bytes"]),
         "replication_lag_p50_ms":
             round(pct(0.50), 2) if lags_ms else None,
         "replication_lag_p95_ms":
@@ -1160,3 +1188,149 @@ def run_wide_row(n_rows: int = 16_384, n_iters: int = 5,
             "engine": ran,
             "rows_per_second": round(rps),
             "cells_per_second": round(rps * 100)}
+
+
+# ---------------------------------------------------------------------------
+# selectivity (fused publication row filtering, ROADMAP item 4)
+# ---------------------------------------------------------------------------
+
+
+def _filtered_batches_identical(a, b) -> bool:
+    """Byte-level equality of two compacted decode outputs, INCLUDING the
+    survivor row mapping — a filter that dropped the right count but the
+    wrong rows must fail here."""
+    import numpy as np
+
+    if a.num_rows != b.num_rows:
+        return False
+    sa = getattr(a, "source_rows", None)
+    sb = getattr(b, "source_rows", None)
+    if (sa is None) != (sb is None):
+        return False
+    if sa is not None and not np.array_equal(sa, sb):
+        return False
+    for ca, cb in zip(a.columns, b.columns):
+        if not np.array_equal(ca.validity, cb.validity):
+            return False
+        if ca.is_dense and cb.is_dense:
+            if not np.array_equal(ca.data[ca.validity],
+                                  cb.data[cb.validity]):
+                return False
+        else:
+            for i in range(a.num_rows):
+                if ca.validity[i] and ca.value(i) != cb.value(i):
+                    return False
+    return True
+
+
+def run_selectivity(n_rows: int = 16_384, n_iters: int = 5,
+                    keep_fractions=(0.1, 0.5, 0.9),
+                    fetch_slack: float = 0.11) -> dict:
+    """Fused-filter decode matrix: both device engines (XLA jnp.where-mask
+    twin and the Pallas fused kernel) across publication-filter
+    selectivities, against the host oracle.
+
+    Per selectivity: rows/s for each engine (filtered, compacted output),
+    byte identity Pallas == XLA == host-oracle on the compacted batch AND
+    the survivor mapping, and the MEASURED fetched-bytes ratio vs the
+    unfiltered program — gated at (selectivity + fetch_slack), where the
+    slack covers the keep-mask (1 bit/row), the survivor-count words and
+    the fetch-slice bucket granularity (max(R/16, 256) rows,
+    staging.slice_rows). Wall-clock speedup vs the unfiltered decode is
+    recorded, NOT gated, on CPU containers (PR 8 precedent: only real
+    TPU hardware turns fetch-link savings into throughput)."""
+    import numpy as np
+
+    from ..models import (ColumnSchema, Oid, ReplicatedTableSchema,
+                          TableName, TableSchema)
+    from ..ops.engine import DeviceDecoder
+    from ..ops.predicate import parse_row_filter
+    from ..ops.wal import concat_payloads, stage_wal_batch
+    from ..postgres.codec.pgoutput import encode_insert
+    from ..telemetry.metrics import (ETL_DECODE_FETCHED_BYTES_TOTAL,
+                                     registry)
+
+    table = TableSchema(
+        16384, TableName("public", "filter_bench"),
+        (ColumnSchema("id", Oid.INT8, nullable=False, primary_key_ordinal=1),
+         ColumnSchema("v", Oid.INT4),
+         ColumnSchema("note", Oid.TEXT)))
+    rng = np.random.RandomState(11)
+    vals = rng.randint(-1_000_000, 1_000_000, size=n_rows)
+    payloads = [encode_insert(16384, [str(i).encode(),
+                                      str(int(v)).encode(),
+                                      b"n-%d" % i])
+                for i, v in enumerate(vals)]
+    buf, offs, lens = concat_payloads(payloads)
+
+    def stage():
+        return stage_wal_batch(buf, offs, lens, 3).staged
+
+    def fetched_delta(dec, staged):
+        b0 = registry.get_counter(ETL_DECODE_FETCHED_BYTES_TOTAL)
+        batch = dec.decode(staged)
+        return batch, registry.get_counter(
+            ETL_DECODE_FETCHED_BYTES_TOTAL) - b0
+
+    def best_rate(dec):
+        times = []
+        for _ in range(n_iters):
+            s = stage()
+            t0 = time.perf_counter()
+            dec.decode(s)
+            times.append(time.perf_counter() - t0)
+        return n_rows / min(times)
+
+    plain = ReplicatedTableSchema.with_all_columns(table)
+    base_dec = DeviceDecoder(plain, device_min_rows=1, mesh=None)
+    _, unfiltered_bytes = fetched_delta(base_dec, stage())
+    unfiltered_rate = best_rate(base_dec)
+
+    out = {"mode": "selectivity", "rows": n_rows,
+           "unfiltered_rows_per_sec": round(unfiltered_rate),
+           "unfiltered_fetched_bytes": int(unfiltered_bytes),
+           "fetch_slack": fetch_slack,
+           "points": []}
+    all_ok = True
+    for keep in keep_fractions:
+        threshold = int(-1_000_000 + 2_000_000 * keep)
+        sql = f"v < {threshold}"
+        rts = ReplicatedTableSchema.with_all_columns(table) \
+            .with_row_predicate(parse_row_filter(sql))
+        xla = DeviceDecoder(rts, device_min_rows=1, mesh=None)
+        pallas = DeviceDecoder(rts, device_min_rows=1, mesh=None,
+                               use_pallas=True)
+        # host oracle reference: every row through the per-row CPU
+        # decode, the filter applied over decoded values (host_keep)
+        oracle = DeviceDecoder(rts, device_min_rows=10**9,
+                               host_min_rows=10**9, mesh=None)
+        bx, filtered_bytes = fetched_delta(xla, stage())
+        bp = pallas.decode(stage())
+        bo = oracle.decode(stage())
+        identical = _filtered_batches_identical(bx, bp) \
+            and _filtered_batches_identical(bx, bo)
+        measured_keep = bx.num_rows / n_rows
+        ratio = filtered_bytes / unfiltered_bytes if unfiltered_bytes else 0
+        fetch_ok = ratio <= measured_keep + fetch_slack
+        xla_rate = best_rate(xla)
+        point = {
+            "row_filter": sql,
+            "target_keep": keep,
+            "measured_keep": round(measured_keep, 4),
+            "survivors": bx.num_rows,
+            "xla_rows_per_sec": round(xla_rate),
+            "pallas_rows_per_sec": round(best_rate(pallas)),
+            # recorded NOT gated on CPU (the fetch link this optimizes
+            # is the TPU tunnel; the host backend has no transfer cost)
+            "xla_speedup_vs_unfiltered":
+                round(xla_rate / unfiltered_rate, 3),
+            "filtered_fetched_bytes": int(filtered_bytes),
+            "fetched_bytes_ratio": round(ratio, 4),
+            "fetch_reduction_ok": bool(fetch_ok),
+            "engines_and_oracle_identical": bool(identical),
+            "pallas_engine_ran": bool(pallas.use_pallas),
+        }
+        all_ok = all_ok and identical and fetch_ok
+        out["points"].append(point)
+    out["ok"] = bool(all_ok)
+    return out

@@ -198,6 +198,18 @@ class DecodedBatchEvent:
         if self._batch is None:
             self._batch = self._pending.result()
             self._pending = None
+            surv = getattr(self._batch, "source_rows", None)
+            if surv is not None:
+                # fused publication row filter: the decode compacted the
+                # rows, so the per-row identity arrays compact in lockstep
+                # the moment the batch resolves. Consumers read these
+                # arrays only alongside the batch (CoalescedBatch /
+                # expand_batch_events both resolve `batch` first);
+                # event_size_hint deliberately reads the pre-filter arrays
+                # — an overestimate, never a forced decode.
+                self.change_types = self.change_types[surv]
+                self.commit_lsns = np.asarray(self.commit_lsns)[surv]
+                self.tx_ordinals = np.asarray(self.tx_ordinals)[surv]
         return self._batch
 
     @property

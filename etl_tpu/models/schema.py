@@ -198,20 +198,36 @@ class ReplicatedTableSchema:
     """
 
     __slots__ = ("table_schema", "replication_mask", "identity_mask",
-                 "_replicated_columns", "_replicated_indices")
+                 "row_predicate", "_replicated_columns", "_replicated_indices")
 
     def __init__(self, table_schema: TableSchema, replication_mask: ColumnMask,
-                 identity_mask: ColumnMask):
+                 identity_mask: ColumnMask, row_predicate=None):
         n = len(table_schema.columns)
         if len(replication_mask) != n or len(identity_mask) != n:
             raise ValueError("mask length != column count")
         self.table_schema = table_schema
         self.replication_mask = replication_mask
         self.identity_mask = identity_mask
+        # publication row filter (ops/predicate.RowFilter | None): the
+        # WHERE clause this table's publication carries. The decode engine
+        # compiles it into the fused device program (coerce → filter →
+        # transpose with in-kernel compaction); kept OUT of __eq__ —
+        # schema-diff semantics compare the positional decode view, and a
+        # filter change is a publication change, not a DDL change.
+        self.row_predicate = row_predicate
         self._replicated_indices = replication_mask.indices()
         self._replicated_columns = tuple(
             table_schema.columns[i] for i in self._replicated_indices
         )
+
+    def with_row_predicate(self, row_predicate) -> "ReplicatedTableSchema":
+        """Copy with the publication row filter attached (None detaches).
+        Identity-preserving when nothing changes — the table cache's
+        `is`-based decoder reuse must survive RELATION re-sends."""
+        if row_predicate is self.row_predicate:
+            return self
+        return ReplicatedTableSchema(self.table_schema, self.replication_mask,
+                                     self.identity_mask, row_predicate)
 
     @classmethod
     def with_all_columns(cls, schema: TableSchema) -> "ReplicatedTableSchema":
@@ -252,11 +268,14 @@ class ReplicatedTableSchema:
         )
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "table": self.table_schema.to_json(),
             "replicated": self.replication_mask.indices(),
             "identity": self.identity_mask.indices(),
         }
+        if self.row_predicate is not None:
+            out["row_filter"] = self.row_predicate.to_json()
+        return out
 
     @classmethod
     def from_json(cls, d: dict) -> "ReplicatedTableSchema":
@@ -264,9 +283,15 @@ class ReplicatedTableSchema:
         n = len(schema.columns)
         repl = set(d["replicated"])
         ident = set(d["identity"])
+        pred = None
+        if d.get("row_filter") is not None:
+            from ..ops.predicate import RowFilter  # late: models←ops cycle
+
+            pred = RowFilter.from_json(d["row_filter"])
         return cls(schema,
                    ColumnMask(i in repl for i in range(n)),
-                   ColumnMask(i in ident for i in range(n)))
+                   ColumnMask(i in ident for i in range(n)),
+                   row_predicate=pred)
 
     def __repr__(self) -> str:
         return (f"ReplicatedTableSchema({self.table_schema.name}, "

@@ -164,6 +164,24 @@ class Column:
 
         return isinstance(self.data, pa.Array)
 
+    def take(self, rows: np.ndarray) -> "Column":
+        """Row-gather of this column by index array (the host half of
+        publication row-filter compaction): dense data gathers as numpy,
+        Arrow text via Arrow take (no python objects), object lists by
+        comprehension."""
+        if self.is_dense:
+            data: Any = self.data[rows]
+        elif self.is_arrow:
+            import pyarrow as pa
+
+            data = self.data.take(pa.array(rows, type=pa.int64()))
+        else:
+            data = [self.data[int(i)] for i in rows]
+        toast = self.toast_unchanged[rows] \
+            if self.toast_unchanged is not None else None
+        return Column(self.schema, data, self.validity[rows], toast,
+                      lazy_text_oid=self.lazy_text_oid)
+
     def value(self, i: int) -> Any:
         """Python value at row i regardless of storage form."""
         if self.is_toast_unchanged(i):
@@ -187,17 +205,31 @@ class Column:
 
 class ColumnarBatch:
     """Typed columnar rows for one table — the unit the TPU decode engine
-    emits and Arrow-native destinations consume."""
+    emits and Arrow-native destinations consume.
 
-    __slots__ = ("schema", "columns", "num_rows")
+    `source_rows` (int64[num_rows] | None) is set by filtered decodes
+    only: the staged-batch row index each surviving row came from, so
+    consumers holding per-source-row side arrays (the assembler's LSN /
+    change-type vectors) can compact them to match."""
+
+    __slots__ = ("schema", "columns", "num_rows", "source_rows")
 
     def __init__(self, schema: ReplicatedTableSchema, columns: list[Column]):
         self.schema = schema
         self.columns = columns
         self.num_rows = len(columns[0]) if columns else 0
+        self.source_rows: np.ndarray | None = None
         for c in columns:
             if len(c) != self.num_rows:
                 raise ValueError("ragged columnar batch")
+
+    def take(self, rows: np.ndarray) -> "ColumnarBatch":
+        """Row-gather into a new batch (column-at-a-time, no row
+        objects); `source_rows` composes through the gather when set."""
+        out = ColumnarBatch(self.schema, [c.take(rows) for c in self.columns])
+        if self.source_rows is not None:
+            out.source_rows = self.source_rows[rows]
+        return out
 
     @classmethod
     def from_rows(cls, schema: ReplicatedTableSchema, rows: Sequence[TableRow]) -> "ColumnarBatch":

@@ -4,5 +4,7 @@ three-stage pipelined decode scheduler."""
 from .engine import DEVICE_KINDS, DeviceDecoder
 from .pipeline import (AdmissionScheduler, DecodePipeline, TenantAdmission,
                        global_admission, reset_global_admission)
+from .predicate import (CompiledRowFilter, RowFilter, RowFilterError,
+                        compile_row_filter, parse_row_filter)
 from .staging import (ARENA_POOL, StagedBatch, StagingArenaPool, bucket_pow2,
                       bucket_rows, stage_copy_chunk, stage_tuples)

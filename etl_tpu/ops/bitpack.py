@@ -208,8 +208,58 @@ def pack_device(layout: BitLayout, columns) -> jnp.ndarray:
     return jnp.stack(words, axis=0)
 
 
+def compact_packed(words, keep, n_shards: int):
+    """In-program row compaction: scatter the kept rows of
+    `words` uint32[n_words, R] to the FRONT of their shard block via an
+    exclusive prefix sum over the keep mask — filtered rows never reach
+    the HBM output buffer positions the host fetches.
+
+    Shard-local by construction: rows reshape to [n_shards, R/n_shards]
+    exactly along the mesh's block sharding, the cumsum runs inside each
+    shard, and every kept row's destination stays inside its own block —
+    zero cross-device collectives on the forward path, matching the
+    unfiltered program's contract. Single-device callers pass n_shards=1
+    (one global block).
+
+    Returns (words_compacted, keep_mask uint32[⌈R/32⌉] — the keep bits
+    packed 32/word, little bit order, counts int32[n_shards]). The host
+    reconstructs survivor row indices from the mask (compaction is
+    stable, so survivors are exactly the set bit positions in ascending
+    order) at 1 BIT per staged row of fetch — against 32 bits a rowid
+    vector would cost. On a single device the words fetch is then sized
+    to the survivor count (engine._complete_filtered): fetched bytes
+    scale with selectivity, not batch size."""
+    R = keep.shape[0]
+    rps = R // n_shards
+    k2 = keep.astype(jnp.int32).reshape(n_shards, rps)
+    pos = jnp.cumsum(k2, axis=1) - k2  # exclusive prefix sum, shard-local
+    counts = k2.sum(axis=1, dtype=jnp.int32)
+    base = (jnp.arange(n_shards, dtype=jnp.int32) * rps)[:, None]
+    # dropped rows scatter to index R, which mode="drop" discards
+    dest = jnp.where(k2 > 0, base + pos, R).reshape(R)
+    words_c = jnp.zeros_like(words).at[:, dest].set(words, mode="drop")
+    pad = (-R) % 32
+    bits = keep
+    if pad:
+        bits = jnp.concatenate(
+            [keep, jnp.zeros((pad,), dtype=keep.dtype)])
+    bits32 = bits.astype(jnp.uint32).reshape(-1, 32)
+    mask = (bits32 << jnp.arange(32, dtype=jnp.uint32)[None, :]) \
+        .sum(axis=1, dtype=jnp.uint32)
+    return words_c, mask, counts
+
+
+def unpack_keep_mask(mask: np.ndarray, n_rows: int) -> np.ndarray:
+    """Host half of compact_packed's mask transport: set-bit positions →
+    survivor row indices, ascending (== compaction order)."""
+    bits = np.unpackbits(np.ascontiguousarray(mask).view(np.uint8),
+                         bitorder="little")[:n_rows]
+    return np.flatnonzero(bits).astype(np.int64)
+
+
 def parse_and_pack(bmat, lengths, specs, nibble: bool,
-                   n_shards: int | None = None):
+                   n_shards: int | None = None,
+                   pred=None, row_flags=None):
     """THE device program body shared by the XLA path and the Pallas
     kernel: per-column parse (parsers.parse_column) + bit-pack
     (pack_device). One definition — a divergence between the two lowering
@@ -225,12 +275,28 @@ def parse_and_pack(bmat, lengths, specs, nibble: bool,
     The host aggregates these for shard-health telemetry only: the exact
     per-row fallback set still comes from the unpacked ok bits masked by
     host-side validity (a zero-length field of a non-null row IS a real
-    fallback there, invisible to this length-gated device mask)."""
+    fallback there, invisible to this length-gated device mask).
+
+    With `pred` (a predicate.CompiledRowFilter — the fused publication
+    row filter), the predicate evaluates over the ALREADY-PARSED int32
+    components (no re-parse, no extra HBM traffic: the values are in
+    registers between parse and pack) and survivors compact to the front
+    of their shard block (`compact_packed`). `row_flags` uint8[R] carries
+    the host's per-row disposition (0 dead padding / 1 live / 2 live +
+    force-keep). Returns (words_compacted, keep_mask,
+    counts[, shard_bad]).
+    The XLA path and the Pallas kernel share the predicate evaluator and
+    the compaction epilogue, so the two engines' compacted outputs are
+    byte-identical by construction — `jnp.where`-mask evaluation here is
+    the differential twin of the in-kernel keep computation."""
     layout = layout_for_specs(specs)
     columns = []
     row_ok = None
+    colmap: dict = {}
+    ref_cols = frozenset(pred.referenced_indices) if pred is not None \
+        else frozenset()
     w_off = 0
-    for j, (_col_idx, kind, width, _bw) in enumerate(specs):
+    for j, (col_idx, kind, width, _bw) in enumerate(specs):
         if nibble:
             packed = bmat[:, w_off // 2 : (w_off + width) // 2]
             b = parsers.unpack_nibbles(packed, width)
@@ -239,16 +305,25 @@ def parse_and_pack(bmat, lengths, specs, nibble: bool,
         w_off += width
         comp, ok = parsers.parse_column(kind, b, lengths[:, j])
         columns.append((ok, comp))
+        if col_idx in ref_cols:
+            colmap[col_idx] = (comp, ok, lengths[:, j] == 0)
         if n_shards is not None:
             col_ok = ok | (lengths[:, j] == 0)
             row_ok = col_ok if row_ok is None else (row_ok & col_ok)
     words = pack_device(layout, columns)
+    if pred is not None:
+        keep = pred.device_keep(colmap, row_flags.astype(jnp.int32))
+        words_c, mask, counts = compact_packed(words, keep, n_shards or 1)
+        if n_shards is None:
+            return words_c, mask, counts
     if n_shards is None:
         return words
     nonempty = (lengths > 0).any(axis=1)
     bad = jnp.zeros_like(nonempty) if row_ok is None \
         else ((~row_ok) & nonempty)
     shard_bad = bad.reshape(n_shards, -1).sum(axis=1, dtype=jnp.int32)
+    if pred is not None:
+        return words_c, mask, counts, shard_bad
     return words, shard_bad
 
 

@@ -125,7 +125,12 @@ class _ColSpec:
 class _PackedInputs:
     """Output of the pack stage, input of the dispatch stage.
     `row_capacity` may exceed the staged capacity (mesh padding rows,
-    zeroed); the fn-cache key and device shapes use it."""
+    zeroed); the fn-cache key and device shapes use it. `row_flags`
+    (uint8[row_capacity], fused-filter dispatches only) carries the
+    host's per-row disposition: 0 dead padding / 1 live / 2 live +
+    force-keep (escapes, nibble-flagged, oversized or TOASTed
+    predicate-referenced field — device values untrustworthy, the host
+    re-evaluates those survivors after oracle fixup)."""
 
     bmat: np.ndarray
     lengths: np.ndarray
@@ -133,11 +138,14 @@ class _PackedInputs:
     bad_rows: np.ndarray | None
     row_capacity: int
     use_mesh: bool
+    row_flags: np.ndarray | None = None
+    filtered: bool = False
 
 
 def build_device_program(specs: tuple[tuple[int, CellKind, int, int], ...],
                          nibble: bool = False,
-                         n_shards: int | None = None):
+                         n_shards: int | None = None,
+                         pred=None):
     """The (unjitted) single-chip forward step for one width-signature.
 
     Inputs:  bmat u8[R, ΣW] packed field bytes (or u8[R, ΣW/2] nibble pairs
@@ -154,10 +162,24 @@ def build_device_program(specs: tuple[tuple[int, CellKind, int, int], ...],
              reduced on device inside each row shard (bitpack.
              parse_and_pack) — 4 bytes per shard of extra fetch, and the
              host learns shard health without unpacking anything.
+             With `pred` (predicate.CompiledRowFilter) the program takes a
+             third input (row_flags uint8[R]) and returns the FUSED
+             coerce→filter→pack result: (words_compacted, keep_mask,
+             counts[, shard_bad]) — survivors compacted to the front of
+             their shard block so the host fetch is sized by the survivor
+             count, not the batch size.
 
     specs: (col_index, kind, gather_width, bit_width) per dense column.
     """
     from .bitpack import parse_and_pack
+
+    if pred is not None:
+        def fn(bmat, lengths, row_flags):
+            return parse_and_pack(bmat, lengths.astype(jnp.int32), specs,
+                                  nibble, n_shards=n_shards, pred=pred,
+                                  row_flags=row_flags)
+
+        return fn
 
     def fn(bmat, lengths):
         return parse_and_pack(bmat, lengths.astype(jnp.int32), specs, nibble,
@@ -209,13 +231,18 @@ _BG_COMPILE_FAILED: set = set()
 _BG_COMPILE_LOCK = threading.Lock()
 
 
-def _host_fn_key(row_capacity: int, specs: tuple) -> tuple:
+def _host_fn_key(row_capacity: int, specs: tuple,
+                 pred_fp: "tuple | None" = None) -> tuple:
     """The module-level program-cache key of the HOST decode path for one
     (row bucket, specs) signature: host packs force nibble compression
-    off, never shard on the mesh, and never select pallas. The dispatch
-    stage builds its keys through this same helper, so the probe in
-    `_host_fn_ready` can never drift from the cache it is probing."""
-    return (row_capacity, specs, False, None, False, True)
+    off, never shard on the mesh, and never select pallas. `pred_fp` is
+    the fused row filter's fingerprint (None = unfiltered program — a
+    different output STRUCTURE, so the keys must never collide). The
+    dispatch stage builds its keys through this same helper, so the probe
+    in `_host_fn_ready` can never drift from the cache it is probing.
+    The engine flag stays the LAST element (routing-proof tests key on
+    key[-1])."""
+    return (row_capacity, specs, False, None, False, pred_fp, True)
 
 
 def _host_fn_ready(decoder: "DeviceDecoder", staged: "StagedBatch",
@@ -225,7 +252,9 @@ def _host_fn_ready(decoder: "DeviceDecoder", staged: "StagedBatch",
     background thread (executing the decoder's own dispatch path against
     the triggering batch, so the key and shapes match exactly) and report
     not ready."""
-    key = _host_fn_key(staged.row_capacity, specs)
+    pred = decoder._device_filter_for(staged)
+    key = _host_fn_key(staged.row_capacity, specs,
+                       pred.fingerprint() if pred is not None else None)
     with _BG_COMPILE_LOCK:
         if key in _BG_COMPILE_KEYS or key in _BG_COMPILE_FAILED:
             return False
@@ -305,7 +334,7 @@ def accelerator_backend() -> bool:
 
 
 def _build_device_fn(specs, nibble: bool = False, use_pallas: bool = False,
-                     mesh=None, donate: bool = False):
+                     mesh=None, donate: bool = False, pred=None):
     # donate_argnums on the packed inputs: XLA reuses the uploaded bmat /
     # lengths device buffers for scratch or output, so a steady pipelined
     # stream stops accumulating one dead input buffer per in-flight batch
@@ -320,12 +349,24 @@ def _build_device_fn(specs, nibble: bool = False, use_pallas: bool = False,
         # and the per-shard fallback-candidate counts stay sharded too
         # (one i32 per device). The packed staging buffers are donated
         # (TPU/GPU) exactly as on the single-device path — donation is
-        # per-shard, so each device reuses its own input block.
+        # per-shard, so each device reuses its own input block. The fused
+        # row filter compacts PER SHARD (bitpack.compact_packed reshapes
+        # exactly along the block sharding), so survivor scatter stays
+        # shard-local too; rowids and per-shard survivor counts come back
+        # row-sharded.
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         rows_sharded = NamedSharding(mesh, P("sp", None))
         out_sharded = NamedSharding(mesh, P(None, "sp"))
         shard_red = NamedSharding(mesh, P("sp"))
+        if pred is not None:
+            rows_1d = NamedSharding(mesh, P("sp"))
+            return jax.jit(
+                build_device_program(specs, nibble, n_shards=mesh.size,
+                                     pred=pred),
+                in_shardings=(rows_sharded, rows_sharded, rows_1d),
+                out_shardings=(out_sharded, rows_1d, shard_red, shard_red),
+                **kw)
         return jax.jit(build_device_program(specs, nibble,
                                             n_shards=mesh.size),
                        in_shardings=(rows_sharded, rows_sharded),
@@ -333,8 +374,8 @@ def _build_device_fn(specs, nibble: bool = False, use_pallas: bool = False,
     if use_pallas:
         from .pallas_kernel import build_pallas_program
 
-        return jax.jit(build_pallas_program(specs, nibble), **kw)
-    return jax.jit(build_device_program(specs, nibble), **kw)
+        return jax.jit(build_pallas_program(specs, nibble, pred=pred), **kw)
+    return jax.jit(build_device_program(specs, nibble, pred=pred), **kw)
 
 
 def _combine(kind: CellKind, rows: np.ndarray) -> np.ndarray:
@@ -377,21 +418,31 @@ class _PendingDecode:
     The device→host copy of the packed result is started at construction
     (`copy_to_host_async`), so the transfer rides the link while the host
     stages and packs the next batches — `result()` mostly finds the bytes
-    already landed. Mesh-sharded dispatches carry a (packed, shard_bad)
-    tuple; both values start their host copies here."""
+    already landed. Mesh-sharded dispatches carry a tuple; every value
+    starts its host copy here — EXCEPT the fused-filter single-device
+    case, where only the 4-byte survivor COUNT pre-fetches: the packed
+    words and rowids are fetched at `result()` as a count-sized slice, so
+    the device→host link carries survivor bytes, not batch bytes (the
+    fetch-reduction half of the fused-filter win)."""
 
-    __slots__ = ("_decoder", "_staged", "_specs", "_packed", "_bad_rows",
+    __slots__ = ("_decoder", "_staged", "_specs", "_packed", "_meta",
                  "_done")
 
     def __init__(self, decoder: "DeviceDecoder", staged: StagedBatch,
-                 specs: tuple, packed, bad_rows=None):
+                 specs: tuple, packed, meta: "_PackedInputs | None" = None):
         self._decoder = decoder
         self._staged = staged
         self._specs = specs
         self._packed = packed
-        self._bad_rows = bad_rows
+        self._meta = meta
         self._done: ColumnarBatch | None = None
-        values = packed if isinstance(packed, tuple) else (packed,)
+        filtered = meta is not None and meta.filtered
+        if filtered and not meta.use_mesh and isinstance(packed, tuple):
+            # keep mask (1 bit/row) + counts only; the words fetch is a
+            # count-sized device slice at result()
+            values = packed[1:3]
+        else:
+            values = packed if isinstance(packed, tuple) else (packed,)
         for v in values:
             if v is not None:
                 try:
@@ -399,10 +450,19 @@ class _PendingDecode:
                 except AttributeError:
                     pass  # non-jax array (tests may inject numpy)
 
+    @property
+    def survivors(self) -> "np.ndarray | None":
+        """Original staged-row indices of the rows the completed batch
+        kept, or None for an unfiltered decode. Valid after result()."""
+        batch = self.result()
+        return getattr(batch, "source_rows", None)
+
     def result(self) -> ColumnarBatch:
         if self._done is None:
             self._done = self._decoder._complete(
-                self._staged, self._specs, self._packed, self._bad_rows)
+                self._staged, self._specs, self._packed,
+                self._meta.bad_rows if self._meta is not None else None,
+                meta=self._meta)
         return self._done
 
 
@@ -530,6 +590,28 @@ class DeviceDecoder:
             for spec in self._dense[250:]:
                 self._object.append(spec)
             self._dense = self._dense[:250]
+        # publication row filter: compiled ONCE here (etl-lint rule 13
+        # flags compile_row_filter on @hot_loop paths — a per-batch
+        # compile would re-bind literals and re-trace per flush). An
+        # unparseable/unbindable filter degrades to None with a warning:
+        # the batch then decodes unfiltered, which is only correct when
+        # the server still filters — the pipeline logs loudly so the
+        # offload deployment can't silently deliver excluded rows.
+        self._row_filter = None
+        rf = getattr(schema, "row_predicate", None)
+        if rf is not None:
+            from .predicate import RowFilterError, compile_row_filter
+
+            try:
+                self._row_filter = compile_row_filter(rf, schema)
+            except RowFilterError:
+                import logging
+
+                logging.getLogger("etl_tpu.ops").warning(
+                    "row filter %r on %s is outside the client-side "
+                    "envelope; decoding UNFILTERED (server-side filtering "
+                    "must cover this table)", getattr(rf, "sql", rf),
+                    schema.name, exc_info=True)
         # record of the programs THIS decoder used (tests pin per-
         # decoder compile-count invariants on it); the fns themselves
         # live in the module-level _SHARED_FN_CACHE
@@ -674,6 +756,61 @@ class DeviceDecoder:
             w_off += w
         return bmat, lengths, False, None
 
+    def _device_filter_for(self, staged: StagedBatch):
+        """The CompiledRowFilter to FUSE into this batch's device/host-XLA
+        program, or None. Requires: a filter, a batch the caller allows
+        filtering on (insert/COPY streams only — runtime/assembler clears
+        the flag for runs carrying updates/deletes), and a predicate whose
+        every referenced column is device-parsed with an exact int32
+        comparison. Anything else falls back to `_host_filter_for`'s
+        post-decode mask — correct, just without the fetch-bytes win."""
+        rf = self._row_filter
+        if rf is None or not staged.allow_row_filter \
+                or not rf.device_supported:
+            return None
+        dense_idx = frozenset(s.index for s in self._dense)
+        if not frozenset(rf.referenced_indices) <= dense_idx:
+            return None
+        return rf
+
+    def _host_filter_for(self, staged: StagedBatch):
+        """The filter to apply host-side AFTER an unfiltered decode (the
+        oracle route, and device routes whose predicate is outside the
+        device envelope)."""
+        rf = self._row_filter
+        if rf is None or not staged.allow_row_filter:
+            return None
+        return rf
+
+    def _row_flags(self, staged: StagedBatch, specs: tuple,
+                   pred, bad_rows, row_capacity: int) -> np.ndarray:
+        """Per-row disposition vector for the fused filter program:
+        0 dead (bucket/mesh padding), 1 live, 2 live + force-keep. Force-
+        keep marks rows whose predicate-referenced device values cannot be
+        trusted (COPY escapes, nibble-alphabet violations, oversized or
+        TOASTed referenced fields): the device keeps them unconditionally
+        and the host re-evaluates after oracle fixup, so the compacted
+        output equals the host oracle bit for bit."""
+        n = staged.n_rows
+        flags = np.zeros(row_capacity, dtype=np.uint8)
+        flags[:n] = 1
+        force = np.zeros(n, dtype=bool)
+        fb = staged.cpu_fallback_rows
+        if len(fb):
+            force[fb[fb < n]] = True
+        if bad_rows is not None:
+            force |= bad_rows[:n].astype(bool)
+        ref = pred.referenced_indices
+        widths = {i: w for i, _, w, _ in specs}
+        for j in ref:
+            if staged.max_field_len(j) > widths[j]:
+                force |= staged.lengths[:n, j] > widths[j]
+            toast_col = staged.toast[:n, j]
+            if toast_col.any():
+                force |= toast_col
+        flags[:n][force] = 2
+        return flags
+
     def _use_mesh(self, row_capacity: int) -> bool:
         # no divisibility requirement: the pack stage pads row capacity up
         # to a mesh.size multiple with all-NULL rows (staging.pad_to_
@@ -698,7 +835,12 @@ class DeviceDecoder:
         bmat, lengths, nibble, bad_rows = self._pack_host(
             staged, widths, allow_nibble=not host, arena=arena,
             row_capacity=cap)
-        return _PackedInputs(bmat, lengths, nibble, bad_rows, cap, use_mesh)
+        pred = self._device_filter_for(staged)
+        row_flags = None
+        if pred is not None:
+            row_flags = self._row_flags(staged, specs, pred, bad_rows, cap)
+        return _PackedInputs(bmat, lengths, nibble, bad_rows, cap, use_mesh,
+                             row_flags=row_flags, filtered=pred is not None)
 
     @dispatch_stage
     @hot_loop
@@ -750,16 +892,18 @@ class DeviceDecoder:
         from ..parallel.mesh import mesh_cache_key
 
         pallas = self.use_pallas and not host
-        key = _host_fn_key(packed.row_capacity, specs) if host else \
+        pred = self._device_filter_for(staged) if packed.filtered else None
+        pred_fp = pred.fingerprint() if pred is not None else None
+        key = _host_fn_key(packed.row_capacity, specs, pred_fp) if host else \
             (packed.row_capacity, specs, packed.nibble,
              mesh_cache_key(self.mesh) if packed.use_mesh else None,
-             pallas, False)
+             pallas, pred_fp, False)
         fn = _shared_fn_get(key)
         if fn is None:
             fn = _build_device_fn(
                 specs, packed.nibble, pallas,
                 mesh=self.mesh if packed.use_mesh else None,
-                donate=not host and _donation_supported())
+                donate=not host and _donation_supported(), pred=pred)
             _shared_fn_put(key, fn)
         self._fn_cache[key] = fn
         if packed.use_mesh and self._telemetry:
@@ -783,6 +927,11 @@ class DeviceDecoder:
             registry.gauge_set(ETL_DECODE_MESH_PAD_WASTE_RATIO,
                                pad_total / rows_total if rows_total else 0.0)
         try:
+            if pred is not None:
+                row_flags = packed.row_flags
+                if host:
+                    row_flags = jax.device_put(row_flags, dev)
+                return fn(bmat, lengths, row_flags)  # async dispatch
             return fn(bmat, lengths)  # async dispatch
         except Exception:
             # host calls never run pallas — an error there is real, not a
@@ -805,8 +954,7 @@ class DeviceDecoder:
     def _device_call(self, staged: StagedBatch, specs: tuple,
                      host: bool = False):
         packed = self._pack_stage(staged, specs, host)
-        return self._dispatch_stage(staged, specs, packed, host), \
-            packed.bad_rows
+        return self._dispatch_stage(staged, specs, packed, host), packed
 
     def _gather_string_arrow(self, staged: StagedBatch, spec: _ColSpec,
                              valid: np.ndarray):
@@ -924,25 +1072,19 @@ class DeviceDecoder:
                     c.data[i] = value
                 c.validity[i] = value is not None
 
-    def _complete(self, staged: StagedBatch, specs: tuple,
-                  packed, bad_rows=None) -> ColumnarBatch:
-        import time as _time
-
+    def _assemble(self, staged: StagedBatch, specs: tuple, packed_np,
+                  bad_rows=None) -> "tuple[ColumnarBatch, np.ndarray]":
+        """Shared completion core: fetched packed words (+ the staged
+        bookkeeping they index) → typed columns + CPU fixup. For a fused-
+        filter decode `staged` is the COMPACTED view (staging.gather_rows)
+        and `packed_np` the count-sized slice, so every index here —
+        including the fallback rows returned for the caller's post-fixup
+        predicate re-check — lives in the compacted space."""
         from .bitpack import layout_for_specs, unpack_host
 
-        _t0 = _time.perf_counter()
         n = staged.n_rows
         cols = self.schema.replicated_columns
         valid_full = ~staged.nulls & ~staged.toast
-        shard_bad = None
-        if isinstance(packed, tuple):
-            # mesh-sharded dispatch: (packed words, per-shard fallback-
-            # candidate counts reduced on device). The counts are HOST-
-            # aggregated into shard-health telemetry below; the exact
-            # fallback set still comes from the unpacked ok bits, so
-            # sharded and single-device decodes stay byte-identical.
-            packed, shard_bad = packed
-        packed_np = np.asarray(packed) if packed is not None else None
 
         columns: list[Column] = [None] * len(cols)  # type: ignore[list-item]
         fallback = set(int(r) for r in staged.cpu_fallback_rows)
@@ -993,27 +1135,9 @@ class DeviceDecoder:
                 lazy_text_oid=lazy_oid)
 
         from ..telemetry.metrics import (
-            ETL_DECODE_MESH_FALLBACK_CANDIDATE_ROWS_TOTAL,
-            ETL_DECODE_MESH_SHARD_FALLBACK_CANDIDATES,
-            ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL,
-            ETL_DEVICE_DECODE_ROWS_TOTAL, ETL_DEVICE_DECODE_SECONDS,
-            registry)
+            ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL, registry)
 
-        if self._telemetry:
-            # n = staged.n_rows: bucket- and mesh-padding tail rows are
-            # excluded from every error/telemetry counter by construction
-            registry.counter_inc(ETL_DEVICE_DECODE_ROWS_TOTAL, n)
-        if shard_bad is not None and self._telemetry:
-            sb = np.asarray(shard_bad)
-            total_bad = float(sb.sum())
-            if total_bad:
-                registry.counter_inc(
-                    ETL_DECODE_MESH_FALLBACK_CANDIDATE_ROWS_TOTAL, total_bad)
-            # last-batch shard-health snapshot: a single sick shard (one
-            # device corrupting its block) shows up here as skew
-            for s in range(sb.shape[0]):
-                registry.gauge_set(ETL_DECODE_MESH_SHARD_FALLBACK_CANDIDATES,
-                                   float(sb[s]), {"shard": str(s)})
+        rows_arr = np.zeros(0, dtype=np.int64)
         if fallback:
             rows_arr = np.asarray(sorted(r for r in fallback if r < n),
                                   dtype=np.int64)
@@ -1021,12 +1145,171 @@ class DeviceDecoder:
             if self._telemetry:
                 registry.counter_inc(ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL,
                                      len(rows_arr))
+        return ColumnarBatch(self.schema, columns), rows_arr
+
+    def _shard_health(self, shard_bad) -> None:
+        from ..telemetry.metrics import (
+            ETL_DECODE_MESH_FALLBACK_CANDIDATE_ROWS_TOTAL,
+            ETL_DECODE_MESH_SHARD_FALLBACK_CANDIDATES, registry)
+
+        sb = np.asarray(shard_bad)
+        total_bad = float(sb.sum())
+        if total_bad:
+            registry.counter_inc(
+                ETL_DECODE_MESH_FALLBACK_CANDIDATE_ROWS_TOTAL, total_bad)
+        # last-batch shard-health snapshot: a single sick shard (one
+        # device corrupting its block) shows up here as skew
+        for s in range(sb.shape[0]):
+            registry.gauge_set(ETL_DECODE_MESH_SHARD_FALLBACK_CANDIDATES,
+                               float(sb[s]), {"shard": str(s)})
+
+    def _filter_telemetry(self, n_in: int, n_out: int,
+                          fetched_bytes: float) -> None:
+        from ..telemetry.metrics import (ETL_DECODE_FETCHED_BYTES_TOTAL,
+                                         ETL_DECODE_FILTER_SELECTIVITY,
+                                         ETL_DECODE_ROWS_FILTERED_TOTAL,
+                                         registry)
+
+        if not self._telemetry:
+            return
+        if fetched_bytes:
+            registry.counter_inc(ETL_DECODE_FETCHED_BYTES_TOTAL,
+                                 float(fetched_bytes))
+        if n_in > n_out:
+            registry.counter_inc(ETL_DECODE_ROWS_FILTERED_TOTAL,
+                                 n_in - n_out)
+        if n_in and self._row_filter is not None:
+            registry.gauge_set(ETL_DECODE_FILTER_SELECTIVITY, n_out / n_in)
+
+    def _complete(self, staged: StagedBatch, specs: tuple,
+                  packed, bad_rows=None,
+                  meta: "_PackedInputs | None" = None) -> ColumnarBatch:
+        import time as _time
+
+        from ..telemetry.metrics import (ETL_DEVICE_DECODE_ROWS_TOTAL,
+                                         ETL_DEVICE_DECODE_SECONDS, registry)
+
+        _t0 = _time.perf_counter()
+        n = staged.n_rows
+        if self._telemetry:
+            # n = staged.n_rows: bucket- and mesh-padding tail rows are
+            # excluded from every error/telemetry counter by construction
+            registry.counter_inc(ETL_DEVICE_DECODE_ROWS_TOTAL, n)
+        if meta is not None and meta.filtered and packed is not None:
+            batch = self._complete_filtered(staged, specs, packed,
+                                            bad_rows, meta)
+        else:
+            shard_bad = None
+            if isinstance(packed, tuple):
+                # mesh-sharded dispatch: (packed words, per-shard fallback-
+                # candidate counts reduced on device). The counts are HOST-
+                # aggregated into shard-health telemetry; the exact
+                # fallback set still comes from the unpacked ok bits, so
+                # sharded and single-device decodes stay byte-identical.
+                packed, shard_bad = packed
+            packed_np = np.asarray(packed) if packed is not None else None
+            if shard_bad is not None and self._telemetry:
+                self._shard_health(shard_bad)
+            batch, _ = self._assemble(staged, specs, packed_np, bad_rows)
+            fetched = packed_np.nbytes if packed_np is not None else 0.0
+            host_rf = self._host_filter_for(staged)
+            if host_rf is not None:
+                # predicate outside the device envelope (or an oracle-
+                # routed batch): the same filter applies host-side over
+                # the decoded batch — correct, without the fetch win
+                keep = host_rf.host_keep(batch)
+                surv = np.flatnonzero(keep).astype(np.int64)
+                batch = batch.take(surv)
+                batch.source_rows = surv
+                self._filter_telemetry(n, len(surv), fetched)
+            elif self._telemetry and fetched:
+                from ..telemetry.metrics import \
+                    ETL_DECODE_FETCHED_BYTES_TOTAL
+
+                registry.counter_inc(ETL_DECODE_FETCHED_BYTES_TOTAL,
+                                     float(fetched))
         # completion time (fetch wait + unpack + combines + object cols);
         # dispatch/transfer overlap is deliberately excluded
         if self._telemetry:
             registry.histogram_observe(ETL_DEVICE_DECODE_SECONDS,
                                        _time.perf_counter() - _t0)
-        return ColumnarBatch(self.schema, columns)
+        return batch
+
+    def _complete_filtered(self, staged: StagedBatch, specs: tuple,
+                           packed, bad_rows,
+                           meta: "_PackedInputs") -> ColumnarBatch:
+        """Completion of a fused coerce→filter→pack dispatch: fetch the
+        survivor count + the 1-bit-per-row keep mask, fetch a count-sized
+        slice of the compacted words (single device — fetched bytes scale
+        with selectivity; the mesh path fetches its row-sharded words
+        whole and slices per shard block on host), then run the normal
+        completion against the COMPACTED staged view. Fallback
+        bookkeeping lives in the compacted index space throughout;
+        force-kept and fixed-up survivors get one exact host
+        re-evaluation so the final batch is byte-identical to the host
+        oracle."""
+        from .bitpack import unpack_keep_mask
+        from .staging import slice_rows
+
+        pred = self._device_filter_for(staged)
+        mesh_shards = self.mesh.size if meta.use_mesh else None
+        if mesh_shards is not None:
+            words_d, mask_d, counts_d, shard_bad_d = packed
+            if self._telemetry:
+                self._shard_health(shard_bad_d)
+        else:
+            words_d, mask_d, counts_d = packed
+        counts = np.asarray(counts_d)
+        mask_np = np.asarray(mask_d)
+        R = meta.row_capacity
+        fetched = float(counts.nbytes + mask_np.nbytes)
+        survivors = unpack_keep_mask(mask_np, R)
+        if mesh_shards is None:
+            S = int(counts[0])
+            Sb = slice_rows(S, R)
+            if Sb:
+                # count-sized device slice: the only words bytes that
+                # ever cross the link are the survivors' (+ the slice
+                # bucket's pad slack)
+                words_np = np.asarray(words_d[:, :Sb])
+                fetched += words_np.nbytes
+                words_np = words_np[:, :S]
+            else:
+                words_np = np.zeros((words_d.shape[0], 0), dtype=np.uint32)
+        else:
+            words_full = np.asarray(words_d)
+            fetched += words_full.nbytes
+            rps = R // mesh_shards
+            parts = [np.arange(s * rps, s * rps + int(counts[s]),
+                               dtype=np.int64)
+                     for s in range(mesh_shards) if counts[s] > 0]
+            sel = np.concatenate(parts) if parts \
+                else np.zeros(0, dtype=np.int64)
+            words_np = words_full[:, sel]
+            S = len(sel)
+        assert len(survivors) == S, (len(survivors), S)
+        cstaged = staged.gather_rows(survivors)
+        cbad = bad_rows[survivors] if bad_rows is not None else None
+        batch, fixup_rows = self._assemble(cstaged, specs, words_np, cbad)
+        # exact arbitration for rows the device could not judge: force-
+        # kept rows (escapes / nibble / oversize / TOAST on a referenced
+        # field) and every fixed-up row re-evaluate on their DECODED
+        # values; rows the re-check rejects compact out host-side
+        suspect = np.zeros(S, dtype=bool)
+        if meta.row_flags is not None and S:
+            suspect |= meta.row_flags[survivors] > 1
+        if len(fixup_rows):
+            suspect[fixup_rows] = True
+        if suspect.any():
+            keep_h = pred.host_keep(batch)
+            final = ~suspect | keep_h
+            if not final.all():
+                sel2 = np.flatnonzero(final).astype(np.int64)
+                batch = batch.take(sel2)
+                survivors = survivors[sel2]
+        batch.source_rows = survivors
+        self._filter_telemetry(staged.n_rows, len(survivors), fetched)
+        return batch
 
     # -- public -------------------------------------------------------------
 
@@ -1092,9 +1375,9 @@ class DeviceDecoder:
         mode, specs = self._route(staged)
         if mode == "oracle":
             return _PendingDecode(self, staged, (), None, None)
-        packed, bad_rows = self._device_call(staged, specs,
-                                             host=mode == "host")
-        return _PendingDecode(self, staged, specs, packed, bad_rows)
+        value, packed = self._device_call(staged, specs,
+                                          host=mode == "host")
+        return _PendingDecode(self, staged, specs, value, packed)
 
     def decode(self, staged: StagedBatch) -> ColumnarBatch:
         return self.decode_async(staged).result()

@@ -62,9 +62,25 @@ def pallas_supported(specs) -> bool:
 def build_pallas_program(specs: tuple[tuple[int, CellKind, int, int], ...],
                          nibble: bool = False,
                          block_rows: int = DEFAULT_BLOCK_ROWS,
-                         interpret: bool | None = None):
-    """Same contract as engine.build_device_program, lowered via Pallas."""
-    from .bitpack import layout_for_specs, pack_device
+                         interpret: bool | None = None,
+                         pred=None):
+    """Same contract as engine.build_device_program, lowered via Pallas.
+
+    With `pred` (predicate.CompiledRowFilter) the kernel is the FUSED
+    coerce→filter→pack step: the publication row filter evaluates inside
+    the kernel body over the parsed [R]-lane component vectors (the same
+    `predicate.device_keep` evaluator the XLA twin uses — comps dicts
+    have the identical shape in both conventions), the keep bits mask the
+    packed words in-register so filtered rows' values never reach the HBM
+    output block, and a second (1, blk) output carries the keep bits out.
+    The row-compaction epilogue (`bitpack.compact_packed` — an in-block
+    exclusive prefix-sum scatter) runs as XLA ops over the kernel's
+    outputs: cross-block survivor destinations depend on every earlier
+    block's count, which a grid-parallel kernel cannot know, so the
+    scatter lives outside the grid while the per-row verdicts stay fused
+    in-kernel. Output structure matches the XLA twin exactly:
+    (words_compacted, keep_mask, counts)."""
+    from .bitpack import compact_packed, layout_for_specs, pack_device
 
     layout = layout_for_specs(specs)
     k_out = layout.n_words
@@ -72,11 +88,14 @@ def build_pallas_program(specs: tuple[tuple[int, CellKind, int, int], ...],
         interpret = jax.default_backend() != "tpu"
     total_w = sum(w for _, _, w, _ in specs)
     w_in = total_w // 2 if nibble else total_w
+    ref_cols = frozenset(pred.referenced_indices) if pred is not None \
+        else frozenset()
 
-    def kernel(bmat_ref, len_ref, out_ref):
+    def parse_block(bmat_ref, len_ref):
         columns = []
+        colmap = {}
         w_off = 0
-        for j, (_col_idx, kind, width, _bw) in enumerate(specs):
+        for j, (col_idx, kind, width, _bw) in enumerate(specs):
             if nibble:
                 packed = [bmat_ref[w_off // 2 + i, :].astype(jnp.int32)
                           for i in range(width // 2)]
@@ -88,9 +107,25 @@ def build_pallas_program(specs: tuple[tuple[int, CellKind, int, int], ...],
             lengths = len_ref[j, :].astype(jnp.int32)
             comp, ok = parse_column_lanes(kind, rows, lengths)
             columns.append((ok, comp))
+            if col_idx in ref_cols:
+                colmap[col_idx] = (comp, ok, lengths == 0)
+        return columns, colmap
+
+    def kernel(bmat_ref, len_ref, out_ref):
+        columns, _ = parse_block(bmat_ref, len_ref)
         out_ref[:, :] = pack_device(layout, columns)
 
-    def fn(bmat, lengths):
+    def kernel_filtered(bmat_ref, len_ref, flags_ref, out_ref, keep_ref):
+        columns, colmap = parse_block(bmat_ref, len_ref)
+        keep = pred.device_keep(colmap, flags_ref[0, :].astype(jnp.int32))
+        keep_i = keep.astype(jnp.int32)
+        # mask in-register: a filtered row's packed words never reach the
+        # HBM output block — the epilogue scatter only moves survivors
+        out_ref[:, :] = pack_device(layout, columns) \
+            * keep_i[None, :].astype(jnp.uint32)
+        keep_ref[:, :] = keep_i[None, :]
+
+    def fn(bmat, lengths, row_flags=None):
         R = bmat.shape[0]
         blk = min(block_rows, R)
         assert R % blk == 0, (R, blk)
@@ -99,16 +134,37 @@ def build_pallas_program(specs: tuple[tuple[int, CellKind, int, int], ...],
         # kernel read of a byte position is a contiguous [blk] vector
         bmat_t = bmat.T
         lengths_t = lengths.T
-        return pl.pallas_call(
-            kernel,
+        if pred is None:
+            return pl.pallas_call(
+                kernel,
+                grid=grid,
+                in_specs=[
+                    pl.BlockSpec((w_in, blk), lambda i: (0, i)),
+                    pl.BlockSpec((lengths.shape[1], blk), lambda i: (0, i)),
+                ],
+                out_specs=pl.BlockSpec((k_out, blk), lambda i: (0, i)),
+                out_shape=jax.ShapeDtypeStruct((k_out, R), jnp.uint32),
+                interpret=interpret,
+            )(bmat_t, lengths_t)
+        words, keep = pl.pallas_call(
+            kernel_filtered,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((w_in, blk), lambda i: (0, i)),
                 pl.BlockSpec((lengths.shape[1], blk), lambda i: (0, i)),
+                pl.BlockSpec((1, blk), lambda i: (0, i)),
             ],
-            out_specs=pl.BlockSpec((k_out, blk), lambda i: (0, i)),
-            out_shape=jax.ShapeDtypeStruct((k_out, R), jnp.uint32),
+            out_specs=[
+                pl.BlockSpec((k_out, blk), lambda i: (0, i)),
+                pl.BlockSpec((1, blk), lambda i: (0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((k_out, R), jnp.uint32),
+                jax.ShapeDtypeStruct((1, R), jnp.int32),
+            ],
             interpret=interpret,
-        )(bmat_t, lengths_t)
+        )(bmat_t, lengths_t, row_flags.reshape(1, R))
+        # compaction epilogue: in-block prefix-sum scatter of survivors
+        return compact_packed(words, keep[0] > 0, 1)
 
     return fn

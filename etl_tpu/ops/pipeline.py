@@ -628,7 +628,7 @@ class DecodePipeline:
             arena.release()
             raise
         pending = _PendingDecode(decoder, staged, specs, packed_dev,
-                                 packed.bad_rows)
+                                 packed)
         iv = _Interval(t2)
         with self._lock:
             self._inflight.append(iv)

@@ -80,6 +80,12 @@ class StagedBatch:
         default_factory=lambda: np.zeros(0, dtype=np.int64))
     # rows needing the exact CPU decoder (escapes, oversized fields)
     copy_escapes: bool = False  # True: field bytes may carry COPY escapes
+    # False: the caller forbids publication row-filter compaction on this
+    # batch (the assembler clears it for runs carrying updates/deletes or
+    # old tuples — client-side filtering covers insert/COPY streams; U/D
+    # row-filter transforms are the PG15 walsender's job, docs/decode-
+    # pipeline.md). Copy chunks and insert runs keep the default.
+    allow_row_filter: bool = True
     _maxlens: np.ndarray | None = field(default=None, repr=False,
                                         compare=False)
 
@@ -107,6 +113,32 @@ class StagedBatch:
             object.__setattr__(self, "_maxlens",
                                self.lengths[: self.n_rows].max(axis=0))
         return int(self._maxlens[col])
+
+    def gather_rows(self, rows: np.ndarray) -> "StagedBatch":
+        """Row-compacted view over the SAME data buffer: the per-row
+        bookkeeping arrays gather by `rows` (survivor indices from the
+        fused filter's in-program compaction), so the host completion —
+        object columns, validity, CPU fixup — runs against the compacted
+        index space with zero byte copies."""
+        fb = self.cpu_fallback_rows
+        if len(fb):
+            fb = np.flatnonzero(np.isin(rows, fb)).astype(np.int64)
+        return StagedBatch(
+            self.data, self.offsets[rows], self.lengths[rows],
+            self.nulls[rows], self.toast[rows], len(rows),
+            cpu_fallback_rows=fb, copy_escapes=self.copy_escapes,
+            allow_row_filter=False)
+
+
+#: fetch-slice granularity: survivor counts bucket to multiples of
+#: max(R/16, 256) so the filtered fetch compiles at most ~16 slice
+#: programs per (capacity, layout) while bounding pad slack at ~1/16 of
+#: the batch (the "pad slack" term in the bench.py --selectivity gate)
+def slice_rows(n: int, capacity: int) -> int:
+    if n <= 0:
+        return 0
+    step = max(256, capacity // 16)
+    return min(capacity, -(-n // step) * step)
 
 
 def stage_tuples(tuples: Sequence[TupleData], n_cols: int) -> StagedBatch:

@@ -12,6 +12,7 @@ pgoutput options (raw.rs:623), server version detection (raw.rs:308).
 
 from __future__ import annotations
 
+import logging
 import ssl as ssl_mod
 import time
 from typing import AsyncIterator
@@ -26,6 +27,8 @@ from .version import POSTGRES_15, meets_version, parse_server_version
 from .source import (CopyStream, CreatedSlot, ReplicationSource,
                      ReplicationStream, SlotInfo)
 from .wire import PgServerError, PgWireConnection
+
+logger = logging.getLogger("etl_tpu.postgres.client")
 
 
 def _quote_literal(s: str) -> str:
@@ -238,9 +241,11 @@ class PgReplicationClient(ReplicationSource):
         # not even a column on 14, the query would error); pre-15 every
         # column replicates
         repl_mask = ColumnMask.all_set(n)
+        rowfilter_sql = None
         if meets_version(self.server_version, POSTGRES_15):
             filt = await self.conn.query(
-                "SELECT pt.attnames FROM pg_publication_tables pt "
+                "SELECT pt.attnames, pt.rowfilter "
+                "FROM pg_publication_tables pt "
                 "JOIN pg_namespace ns ON ns.nspname = pt.schemaname "
                 "JOIN pg_class pc ON pc.relnamespace = ns.oid "
                 "AND pc.relname = pt.tablename "
@@ -250,10 +255,38 @@ class PgReplicationClient(ReplicationSource):
                 names = _parse_name_array(filt.rows[0][0])
                 if names:
                     repl_mask = ColumnMask.from_column_names(schema, names)
+            if filt.rows and len(filt.rows[0]) > 1:
+                rowfilter_sql = filt.rows[0][1]
         identity = ColumnMask(c.is_primary_key for c in columns)
         if identity.count() == 0 and replident == "f":
             identity = ColumnMask.all_set(n)
-        return ReplicatedTableSchema(schema, repl_mask, identity)
+        out = ReplicatedTableSchema(schema, repl_mask, identity)
+        if rowfilter_sql:
+            # fused decode filtering (ops/predicate.py): the publication's
+            # WHERE clause rides the schema so the decoder compiles it
+            # into the device program. Unsupported expressions stay
+            # server-side only — the walsender filters them on PG15+.
+            from ..ops.predicate import RowFilterError, parse_row_filter
+
+            try:
+                out = out.with_row_predicate(parse_row_filter(rowfilter_sql))
+            except RowFilterError:
+                logger.info("row filter %r on table %s is outside the "
+                            "client-side envelope; relying on the "
+                            "walsender", rowfilter_sql, table_id)
+        return out
+
+    async def get_row_filters(self, publication: str) -> "dict[TableId, str]":
+        if not meets_version(self.server_version, POSTGRES_15):
+            return {}  # row filters were added in Postgres 15
+        r = await self.conn.query(
+            "SELECT pc.oid, pt.rowfilter FROM pg_publication_tables pt "
+            "JOIN pg_namespace ns ON ns.nspname = pt.schemaname "
+            "JOIN pg_class pc ON pc.relnamespace = ns.oid "
+            "AND pc.relname = pt.tablename "
+            f"WHERE pt.pubname = {_quote_literal(publication)}")
+        return {int(row[0]): row[1] for row in r.rows
+                if len(row) > 1 and row[1]}
 
     async def get_current_wal_lsn(self) -> Lsn:
         r = await self.conn.query("SELECT pg_current_wal_lsn()")

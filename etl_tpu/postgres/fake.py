@@ -92,6 +92,14 @@ class FakeDatabase:
         # pg_publication_tables.rowfilter for the wire client's COPY
         self.row_filters: dict[tuple[str, TableId], "callable"] = {}
         self.row_filter_sql: dict[tuple[str, TableId], str] = {}
+        # True (faithful PG15): the walsender/COPY evaluate row filters at
+        # send time. False models the FILTER-OFFLOAD deployment (or a PG14
+        # walsender): the server ships every row and the catalog still
+        # surfaces the filter SQL, so the client's fused decode filter
+        # (ops/predicate.py) is the only thing between excluded rows and
+        # the destination — end-state verification then proves the
+        # device-side filter, not the fake's
+        self.server_row_filtering = True
         # (start_lsn, payload, table_id|None, row_texts|None) — the row
         # metadata lets streams evaluate publication row filters the way
         # the walsender evaluates WHERE clauses at send time
@@ -224,6 +232,8 @@ class FakeDatabase:
 
     def row_filter_allows(self, publication: str, table_id: TableId | None,
                           row: "list[str | None] | None") -> bool:
+        if not self.server_row_filtering:
+            return True  # filter-offload mode: the client's decode filters
         if table_id is None or row is None:
             return True
         pred = self.row_filters.get((publication, table_id))
@@ -864,7 +874,24 @@ class FakeSource(ReplicationSource):
         if identity.count() == 0:
             identity = ColumnMask.all_set(n) \
                 if t.replica_identity == ord("f") else ColumnMask([False] * n)
-        return ReplicatedTableSchema(schema, repl_mask, identity)
+        out = ReplicatedTableSchema(schema, repl_mask, identity)
+        # leaf partitions inherit the published ROOT's row filter, same as
+        # the column filters above (pg_publication_tables lists the root)
+        sql = self.db.row_filter_sql.get(
+            (publication, self.db.wal_relid(table_id)))
+        if sql:
+            from ..ops.predicate import RowFilterError, parse_row_filter
+
+            try:
+                out = out.with_row_predicate(parse_row_filter(sql))
+            except RowFilterError:
+                pass  # outside the client envelope; server-side only
+        return out
+
+    async def get_row_filters(self, publication: str) -> "dict[TableId, str]":
+        return {tid: sql
+                for (pub, tid), sql in self.db.row_filter_sql.items()
+                if pub == publication}
 
     async def get_current_wal_lsn(self) -> Lsn:
         return self.db.current_lsn
@@ -928,7 +955,8 @@ class FakeSource(ReplicationSource):
         rows, encoded = snap.get(table_id, ([], None))
         # a leaf partition inherits the published root's row/column filters
         pub_tid = self.db.wal_relid(table_id)
-        pred = self.db.row_filters.get((publication, pub_tid))
+        pred = self.db.row_filters.get((publication, pub_tid)) \
+            if self.db.server_row_filtering else None
         if pred is not None:
             rows = [r for r in rows if pred(r)]
             encoded = None  # filtered subset no longer aligns with the cache

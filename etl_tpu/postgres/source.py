@@ -171,6 +171,15 @@ class ReplicationSource(abc.ABC):
         """Schema + replica identity + publication column filters, read in
         the slot snapshot when given (reference transaction.rs:750-768)."""
 
+    async def get_row_filters(self, publication: str) -> "dict[TableId, str]":
+        """Publication row-filter SQL per published table (PG15+
+        `pg_publication_tables.rowfilter`). The pipeline compiles these
+        into the fused decode programs (ops/predicate.py) so filtering
+        runs client-side on device — required when the walsender does not
+        filter (PG14, or the filter-offload deployment), idempotent when
+        it does. Default: none (pre-15 sources)."""
+        return {}
+
     @abc.abstractmethod
     async def get_current_wal_lsn(self) -> Lsn: ...
 

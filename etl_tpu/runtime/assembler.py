@@ -261,6 +261,19 @@ class EventAssembler:
                                             monitor=self._monitor,
                                             name="cdc", heartbeat=hb,
                                             admission=admission)
+        # publication row-filter eligibility: the fused device filter
+        # compacts INSERT-only runs (and the COPY path); runs carrying
+        # updates/deletes keep the server-side filtering contract — the
+        # U/D row-filter transforms (UPDATE whose old image leaves the
+        # filter becomes INSERT, etc.) are walsender semantics the client
+        # does not re-implement (docs/decode-pipeline.md)
+        from ..models.event import ChangeType
+
+        wal.staged.allow_row_filter = bool(
+            wal.old_staged is None
+            and (wal.change_types == ChangeType.INSERT).all())
+        if wal.old_staged is not None:
+            wal.old_staged.allow_row_filter = False
         pending = self._pipeline.submit(decoder, wal.staged)
         old_pending = self._pipeline.submit(decoder, wal.old_staged) \
             if wal.old_staged is not None else None

@@ -96,6 +96,7 @@ class Pipeline:
 
                 await run_source_migrations(source)
             await self._initialize_table_states(source)
+            await self._install_row_filters(source)
         finally:
             await source.close()
         if self.supervisor is not None:
@@ -141,6 +142,42 @@ class Pipeline:
             monitor=self.memory_monitor, budget=self.batch_budget,
             supervisor=self.supervisor)
         self._apply_task = self.apply_worker.spawn()
+
+    async def _install_row_filters(self, source: ReplicationSource) -> None:
+        """Discover the publication's row filters and install them on the
+        shared table cache: RELATION messages carry no filter, so every
+        decode view the apply loop builds re-attaches its table's
+        predicate and the decoder fuses it into the device program
+        (ops/predicate.py). Parsed ONCE here — never on the apply loop or
+        per batch (etl-lint rule 13). Unsupported expressions degrade to
+        server-side-only filtering with a log line; a failing catalog
+        read is non-fatal for the same reason (pre-15 sources have no
+        rowfilter column at all)."""
+        from ..ops.predicate import RowFilterError, parse_row_filter
+        from ..postgres.wire import PgServerError
+
+        try:
+            filters = await source.get_row_filters(
+                self.config.publication_name)
+        except (EtlError, PgServerError, ConnectionError, OSError):
+            # catalog quirk (e.g. a pre-15 server behind a version probe
+            # that lied): filtering falls back to the server side —
+            # never fatal, but logged so the offload deployment notices
+            logger.info("publication row-filter discovery failed; "
+                        "client-side filtering disabled", exc_info=True)
+            return
+        parsed: dict = {}
+        for tid, sql in filters.items():
+            try:
+                parsed[tid] = parse_row_filter(sql)
+            except RowFilterError:
+                logger.info(
+                    "row filter %r on table %s is outside the client-side "
+                    "envelope; relying on server-side filtering", sql, tid)
+        if parsed:
+            self.table_cache.set_row_predicates(parsed)
+            logger.info("client-side row filters active for tables %s",
+                        sorted(parsed))
 
     async def _initialize_table_states(self,
                                        source: ReplicationSource) -> None:

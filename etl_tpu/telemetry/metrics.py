@@ -45,6 +45,16 @@ ETL_DEVICE_DECODE_ROWS_TOTAL = "etl_device_decode_rows_total"
 ETL_DEVICE_DECODE_FALLBACK_ROWS_TOTAL = \
     "etl_device_decode_fallback_rows_total"
 ETL_DEVICE_DECODE_SECONDS = "etl_device_decode_seconds"
+# fused publication row filtering (ops/predicate.py + the fused decode
+# program): rows the predicate compacted out of decode output, the bytes
+# the packed-result fetch actually moved over the device→host link
+# (filtered dispatches fetch a survivor-count-sized slice, so this
+# counter — not an assumption — is the evidence that fetched bytes scale
+# with selectivity), and the last-batch selectivity (survivors / staged
+# rows) of filter-bearing decoders
+ETL_DECODE_ROWS_FILTERED_TOTAL = "etl_decode_rows_filtered_total"
+ETL_DECODE_FETCHED_BYTES_TOTAL = "etl_decode_fetched_bytes_total"
+ETL_DECODE_FILTER_SELECTIVITY = "etl_decode_filter_selectivity"
 # decode routing by path (device / host-XLA / per-row oracle): the
 # device share is the headline honesty metric for "decode on TPU" —
 # benches report it so a host-only steady state can't hide

@@ -634,6 +634,15 @@ class FakePgServer:
                                 "attnotnull", "ord", "default"], rows)
             return True
 
+        if "SELECT pc.oid, pt.rowfilter" in norm \
+                and "FROM pg_publication_tables" in norm:
+            pub = re.search(r"pt\.pubname = '([^']*)'", norm).group(1)
+            rows = [[str(tid), sql]
+                    for (p, tid), sql in db.row_filter_sql.items()
+                    if p == pub and tid in db.publications.get(pub, [])]
+            self._send_rows(w, ["oid", "rowfilter"], rows)
+            return True
+
         if "SELECT pt.attnames" in norm \
                 and "FROM pg_publication_tables" in norm:
             pub = re.search(r"pt\.pubname = '([^']*)'", norm).group(1)

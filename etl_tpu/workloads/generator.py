@@ -55,6 +55,27 @@ class WorkloadGenerator:
             rng = random.Random(f"workload:{profile.name}:{seed}")
         self.rng = rng
         self.table_ids = [BASE_TABLE_ID + i for i in range(profile.tables)]
+        # publication row filter (filter_selective_* profiles): the
+        # committed-truth filter below and the fake walsender's catalog
+        # SQL both derive from ONE parsed IR, so the generator's
+        # `expected`, the server's (disabled) WHERE evaluation, and the
+        # decoder's fused device filter can never disagree on a verdict
+        self.row_filter = None
+        self._row_pred = None
+        if profile.row_filter:
+            from ..ops.predicate import parse_row_filter
+
+            if profile.update_weight or profile.delete_weight \
+                    or profile.ddl_every or profile.truncate_every:
+                # the client-filter envelope is insert-only (UPDATE/DELETE
+                # row-filter transforms are walsender semantics): a
+                # filtered profile with mutating traffic would deliver
+                # unfiltered U/D batches and silently diverge from
+                # `expected` — refuse at construction, not mid-run
+                raise ValueError(
+                    f"profile {profile.name!r}: row_filter requires an "
+                    f"insert-only op mix (docs/workloads.md)")
+            self.row_filter = parse_row_filter(profile.row_filter)
         # committed source truth, decoded-cell form (invariant checker's
         # `expected` input)
         self.expected: dict[int, dict[int, tuple]] = \
@@ -88,6 +109,10 @@ class WorkloadGenerator:
             schema = TableSchema(
                 tid, TableName("public", f"wl_{p.name}_{i}"), p.columns())
             self._schemas[tid] = schema
+            if self.row_filter is not None and self._row_pred is None:
+                # every table shares the profile's column mix, so one
+                # compiled text evaluator serves them all
+                self._row_pred = self.row_filter.compile_texts(schema)
             seed_rows = []
             for _ in range(p.rows_per_table):
                 pk, texts = self._new_row(tid, schema)
@@ -111,7 +136,20 @@ class WorkloadGenerator:
                 if p.partitioned:
                     for leaf in self._leaves[tid]:
                         db.set_replica_identity(leaf, "f")
-        db.create_publication("pub", list(self.table_ids))
+        if self.row_filter is None:
+            db.create_publication("pub", list(self.table_ids))
+        else:
+            # filter-offload deployment: the catalog surfaces the WHERE
+            # clause (so the pipeline compiles it into the fused decode
+            # program) but the walsender ships EVERY row — delivery can
+            # only match `expected` if the client-side filter works
+            db.create_publication(
+                "pub", list(self.table_ids),
+                row_filters={
+                    tid: (self.row_filter.sql,
+                          self.row_filter.compile_texts(self._schemas[tid]))
+                    for tid in self.table_ids})
+            db.server_row_filtering = False
         return db
 
     # -- value generation ------------------------------------------------------
@@ -159,13 +197,19 @@ class WorkloadGenerator:
     def _record_row(self, tid: int, schema: TableSchema, pk: int,
                     texts: list) -> None:
         self._text[tid][pk] = list(texts)
+        if self._row_pred is not None and not self._row_pred(texts):
+            # the publication's row filter excludes this row: the SOURCE
+            # stores it (self._text keeps tracking it for update/delete
+            # targeting) but it must never be DELIVERED
+            self.expected[tid].pop(pk, None)
+            return
         self.expected[tid][pk] = tuple(
             parse_cell_text(t, c.type_oid)
             for t, c in zip(texts, schema.columns))
 
     def _drop_row(self, tid: int, pk: int) -> None:
         del self._text[tid][pk]
-        del self.expected[tid][pk]
+        self.expected[tid].pop(pk, None)
         self._leaf_of[tid].pop(pk, None)
 
     # -- op targets ------------------------------------------------------------

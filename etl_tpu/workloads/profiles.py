@@ -113,6 +113,15 @@ class WorkloadProfile:
     partitioned: bool = False
     # deletes never shrink a table below this many rows
     min_rows: int = 2
+    # publication row filter SQL (PG15 WHERE clause, ops/predicate.py
+    # subset) — evaluated CLIENT-SIDE: the generator sets the fake's
+    # server_row_filtering=False (the filter-offload deployment), so the
+    # walsender ships every row and only the fused decode filter stands
+    # between excluded rows and the destination. End-state verification
+    # then proves the device-side filter. Filtered profiles must stay
+    # insert-only: UPDATE/DELETE row-filter transforms are walsender
+    # semantics the client does not re-implement.
+    row_filter: str | None = None
 
     def columns(self):
         return COLUMN_MIXES[self.column_mix]()
@@ -198,6 +207,26 @@ PROFILES: dict[str, WorkloadProfile] = {p.name: p for p in (
                     "same-transaction backfill (mid-stream schema change)",
         insert_weight=0.55, update_weight=0.4, delete_weight=0.05,
         rows_per_table=5, rows_per_tx=4, ddl_every=4),
+    # filter-selective family (ROADMAP item 4): the publication predicate
+    # drops 90/50/10% of rows ("v" is uniform in [-10^6, 10^6)); the name
+    # carries the KEEP percentage. Insert-only by the row_filter contract
+    # above; byte-identical (profile, seed) replay holds like every other
+    # profile — the filter changes what is DELIVERED, not what is
+    # generated.
+    WorkloadProfile(
+        name="filter_selective_10",
+        description="publication row filter keeps ~10% of rows (drops "
+                    "90%) — the fused decode filter's best case",
+        insert_weight=1.0, rows_per_tx=8, row_filter="v < -800000"),
+    WorkloadProfile(
+        name="filter_selective_50",
+        description="publication row filter keeps ~50% of rows",
+        insert_weight=1.0, rows_per_tx=8, row_filter="v < 0"),
+    WorkloadProfile(
+        name="filter_selective_90",
+        description="publication row filter keeps ~90% of rows (drops "
+                    "10%) — near-passthrough selectivity",
+        insert_weight=1.0, rows_per_tx=8, row_filter="v < 800000"),
     WorkloadProfile(
         name="partitioned_root",
         description="2-leaf partitioned tables published via the root "

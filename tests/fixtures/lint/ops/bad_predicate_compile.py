@@ -1,0 +1,12 @@
+# etl-lint fixture: publication row-filter compilation inside @hot_loop
+# functions — binding re-resolves columns/literals and re-traces the
+# fused device program PER BATCH instead of once at decoder construction.
+# expect: hot-loop-row-materialization=2
+from etl_tpu.analysis.annotations import hot_loop
+from etl_tpu.ops.predicate import compile_row_filter, parse_row_filter
+
+
+@hot_loop
+def decode_batch(schema, staged, sql):
+    pred = compile_row_filter(parse_row_filter(sql), schema)
+    return pred.host_keep(staged)

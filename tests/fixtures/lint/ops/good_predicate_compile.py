@@ -1,0 +1,16 @@
+# etl-lint fixture: row filter compiled ONCE at decoder construction;
+# the @hot_loop batch path only EVALUATES the compiled form — the rule
+# must stay quiet.
+# (no expectations: zero findings)
+from etl_tpu.analysis.annotations import hot_loop
+from etl_tpu.ops.predicate import compile_row_filter
+
+
+class Decoder:
+    def __init__(self, schema, row_filter):
+        # construction-time compile: the sanctioned place
+        self._pred = compile_row_filter(row_filter, schema)
+
+    @hot_loop
+    def decode_batch(self, staged):
+        return self._pred.host_keep(staged)

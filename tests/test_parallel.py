@@ -114,10 +114,10 @@ class TestSharedFnCacheKeying:
         plain = DeviceDecoder(schema, device_min_rows=0, mesh=None)
         assert_batches_equal(meshed.decode(staged), plain.decode(staged))
         key_m = next(k for k in meshed._fn_cache
-                     if isinstance(k, tuple) and len(k) == 6
+                     if isinstance(k, tuple) and len(k) == 7
                      and k[3] is not None)
         key_p = next(k for k in plain._fn_cache
-                     if isinstance(k, tuple) and len(k) == 6)
+                     if isinstance(k, tuple) and len(k) == 7)
         # identical signature up to the mesh slot — the slot alone keeps
         # the (packed, shard_bad) mesh program from shadowing the
         # single-array single-device program
