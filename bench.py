@@ -428,6 +428,19 @@ def run_smoke() -> dict:
         fetch_slack=floors.get("selectivity_fetch_slack", 0.11))
     selectivity_ok = selectivity["ok"]
 
+    # program-cache coldstart gate (ISSUE 12): two replicator subprocess
+    # lifetimes against one cache dir — the warm restart must compile
+    # ZERO fresh XLA programs and serve its first durable batch from
+    # disk-loaded executables (no oracle rows), and the cold start's
+    # compile count must be bounded by the prewarm buckets, not by the
+    # table count (the canonical-layout sharing proof). Wall clock is
+    # recorded, not gated, on this CPU container.
+    coldstart = harness.run_coldstart(
+        n_tables=floors.get("coldstart_smoke_tables", 3),
+        rows_per_tx=floors.get("coldstart_smoke_rows_per_tx", 400),
+        txs_per_table=floors.get("coldstart_smoke_txs_per_table", 1))
+    coldstart_ok = coldstart["ok"]
+
     # multi-pipeline tenancy gate (ISSUE 8): ≥2 concurrent streams
     # sharing one device set through the fair batch-admission scheduler,
     # every stream's end state verified, aggregate events/s above the
@@ -500,9 +513,19 @@ def run_smoke() -> dict:
                    and heartbeat_ok and lint_ok and no_row_path
                    and egress_ok and workload_ok and mesh_ok and mp_ok
                    and sharded_chaos_ok and sharded_ok
-                   and selectivity_ok),
+                   and selectivity_ok and coldstart_ok),
         "selectivity_ok": bool(selectivity_ok),
         "selectivity": selectivity,
+        "coldstart_ok": bool(coldstart_ok),
+        "coldstart_warm_zero_compiles":
+            bool(coldstart["warm_zero_compiles"]),
+        "coldstart_failures": coldstart["failures"],
+        "coldstart_warm_first_durable_seconds":
+            coldstart["warm_first_durable_seconds"],
+        "coldstart_cold_first_durable_seconds":
+            coldstart["cold_first_durable_seconds"],
+        "coldstart_cold_oracle_rows":
+            coldstart["cold_oracle_rows_during_warmup"],
         "sharded_chaos_ok": bool(sharded_chaos_ok),
         "sharded_chaos": sharded_chaos.describe(),
         "sharded_events_per_sec":
@@ -634,7 +657,7 @@ def main():
                         choices=["decode", "table_copy", "table_streaming",
                                  "wide_row", "lag", "egress", "workload",
                                  "multi_pipeline", "mesh_check",
-                                 "selectivity"])
+                                 "selectivity", "coldstart"])
     parser.add_argument("--multi-pipeline", dest="multi_pipeline",
                         action="store_true",
                         help="alias for --mode multi_pipeline: N "
@@ -685,6 +708,16 @@ def main():
                              "destination encoder in isolation "
                              "(ColumnarBatch → wire bytes) against the "
                              "egress_floors in BENCH_FLOOR.json")
+    parser.add_argument("--coldstart", dest="coldstart",
+                        action="store_true",
+                        help="alias for --mode coldstart: two replicator "
+                             "subprocess lifetimes against one program-"
+                             "cache dir — measure restart-to-first-"
+                             "durable-batch and oracle-decoded rows "
+                             "during warmup, cold vs warm; gate 'warm "
+                             "restart performs 0 fresh XLA builds' via "
+                             "the compile counter (wall clock recorded, "
+                             "not gated, on this CPU container)")
     parser.add_argument("--workload", default=None, metavar="PROFILE",
                         help="workload matrix mode: run the named workload "
                              "profile (etl_tpu/workloads; 'all' = every "
@@ -705,6 +738,22 @@ def main():
         args.mode = "selectivity"
     if args.egress:
         args.mode = "egress"
+    if args.coldstart:
+        args.mode = "coldstart"
+    if args.mode == "coldstart":
+        # subprocess workers pin their own CPU platform; the parent never
+        # inits a backend
+        from etl_tpu.benchmarks import harness
+
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_FLOOR.json")) as f:
+            floors = json.load(f)
+        out = harness.run_coldstart(
+            n_tables=floors.get("coldstart_tables", 3),
+            rows_per_tx=floors.get("coldstart_rows_per_tx", 800),
+            txs_per_table=floors.get("coldstart_txs_per_table", 2))
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
     if args.workload is not None:
         args.mode = "workload"
     if args.multi_pipeline:

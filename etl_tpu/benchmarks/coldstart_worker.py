@@ -1,0 +1,211 @@
+"""Coldstart measurement worker: one replicator lifetime as a subprocess.
+
+`bench.py --coldstart` (harness.run_coldstart) runs this module twice
+against one program-cache directory: the first run is the COLD start
+(every decode program is a fresh XLA build, kicked to background threads
+while rows decode on the host oracle), the second is the WARM restart
+(Pipeline.start's prewarm loads the serialized executables from disk
+before the apply loop sees traffic). Each run prints one JSON line:
+
+  start_seconds            Pipeline.start wall clock (prewarm included)
+  first_durable_seconds    start() begin → first rows durable at the
+                           destination (restart-to-first-durable-batch)
+  total_seconds            start() begin → full workload delivered
+  programs_compiled        etl_programs_compiled_total (the gate: a warm
+                           restart must report 0)
+  cache_hits_disk/memory, cache_misses, background_compiles
+  oracle_rows/host_rows    decode routing during the run — the oracle
+                           share IS the cost of an unwarmed cache
+  canonical_layouts        distinct canonical layouts (N tables → O(1))
+
+The tables deliberately share one canonical layout under permuted column
+orders, so the cold run's compile count proves canonicalization (one
+program per row bucket, not per table) and the warm run proves
+persistence (zero programs, disk hits only). Schemas are pre-stored in
+the state store before start — the store state a real restart inherits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+#: covers every row bucket a paced flush can stage into, so a warm
+#: restart can never fall off the cache onto a fresh build; the emitted
+#: `prewarm_buckets` count is what run_coldstart bounds the cold run's
+#: compile count by (canonical layouts make it buckets, not tables ×
+#: buckets)
+PREWARM_BUCKETS = (256, 1024, 4096)
+
+
+async def run(cache_dir: str, n_tables: int, rows_per_tx: int,
+              txs_per_table: int) -> dict:
+    from ..config import BatchConfig, BatchEngine, PipelineConfig
+    from ..destinations.base import Destination, WriteAck
+    from ..models import (ColumnSchema, Oid, ReplicatedTableSchema,
+                          TableName, TableSchema)
+    from ..models.event import DecodedBatchEvent
+    from ..models.table_state import TableStateType
+    from ..ops.engine import background_compiles_inflight
+    from ..postgres.codec.pgoutput import encode_insert
+    from ..postgres.fake import FakeDatabase, FakeSource
+    from ..runtime import Pipeline
+    from ..store import NotifyingStore
+    from ..telemetry.metrics import (
+        ETL_COMPILE_CACHE_HITS_TOTAL, ETL_COMPILE_CACHE_MISSES_TOTAL,
+        ETL_DECODE_BACKGROUND_COMPILES_TOTAL,
+        ETL_DECODE_CANONICAL_LAYOUTS, ETL_DECODE_ROUTED_HOST_ROWS_TOTAL,
+        ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL, ETL_PROGRAMS_COMPILED_TOTAL,
+        registry)
+
+    # one kind mix, column order rotated per table: every table resolves
+    # to the SAME canonical layout (the sharing the cold compile count
+    # gates on)
+    kinds = [Oid.INT8, Oid.INT4, Oid.FLOAT8, Oid.INT4, Oid.TIMESTAMP,
+             Oid.INT8, Oid.NUMERIC, Oid.INT4]
+    db = FakeDatabase()
+    tids = []
+    for t in range(n_tables):
+        tid = 17000 + t
+        rot = kinds[t % len(kinds):] + kinds[: t % len(kinds)]
+        cols = [ColumnSchema("id", Oid.INT8, nullable=False,
+                             primary_key_ordinal=1)]
+        cols += [ColumnSchema(f"c{i}", o) for i, o in enumerate(rot)]
+        db.create_table(TableSchema(tid, TableName("public", f"cold_{t}"),
+                                    tuple(cols)))
+        tids.append(tid)
+    db.create_publication("pub", tids)
+
+    store = NotifyingStore()
+    # the restart contract: schemas already live in the SchemaStore (a
+    # real store survives the process), so prewarm has layouts to warm
+    for tid in tids:
+        await store.store_table_schema(
+            ReplicatedTableSchema.with_all_columns(db.tables[tid].schema), 0)
+
+    delivered = [0]
+    first_durable = [None]
+    t0 = time.perf_counter()
+
+    class CountingDestination(Destination):
+        async def startup(self):
+            return None
+
+        async def write_table_rows(self, schema, batch):
+            return WriteAck.durable()
+
+        async def write_events(self, events):
+            for e in events:
+                if isinstance(e, DecodedBatchEvent):
+                    delivered[0] += e.batch.num_rows  # forces decode
+            if delivered[0] and first_durable[0] is None:
+                first_durable[0] = time.perf_counter() - t0
+            return WriteAck.durable()
+
+        async def drop_table(self, table_id, schema=None):
+            return None
+
+        async def truncate_table(self, table_id):
+            return None
+
+    def counters():
+        return {
+            "programs_compiled":
+                registry.get_counter(ETL_PROGRAMS_COMPILED_TOTAL),
+            "cache_hits_disk": registry.get_counter(
+                ETL_COMPILE_CACHE_HITS_TOTAL, {"layer": "disk"}),
+            "cache_hits_memory": registry.get_counter(
+                ETL_COMPILE_CACHE_HITS_TOTAL, {"layer": "memory"}),
+            "cache_misses_absent": registry.get_counter(
+                ETL_COMPILE_CACHE_MISSES_TOTAL, {"reason": "absent"}),
+            "cache_misses_invalid": registry.get_counter(
+                ETL_COMPILE_CACHE_MISSES_TOTAL, {"reason": "invalid"}),
+            "background_compiles": registry.get_counter(
+                ETL_DECODE_BACKGROUND_COMPILES_TOTAL),
+            "oracle_rows": registry.get_counter(
+                ETL_DECODE_ROUTED_ORACLE_ROWS_TOTAL),
+            "host_rows": registry.get_counter(
+                ETL_DECODE_ROUTED_HOST_ROWS_TOTAL),
+        }
+
+    dest = CountingDestination()
+    pipeline = Pipeline(
+        config=PipelineConfig(
+            pipeline_id=1, publication_name="pub",
+            batch=BatchConfig(max_fill_ms=30,
+                              batch_engine=BatchEngine.TPU,
+                              program_cache_dir=cache_dir,
+                              prewarm_row_buckets=PREWARM_BUCKETS)),
+        store=store, destination=dest,
+        source_factory=lambda: FakeSource(db))
+    await pipeline.start()
+    start_seconds = time.perf_counter() - t0
+    for tid in tids:
+        await asyncio.wait_for(
+            store.notify_on(tid, TableStateType.READY), 60)
+
+    total = 0
+    for round_i in range(txs_per_table):
+        for tid in tids:
+            tx = db.transaction()
+            for i in range(rows_per_tx):
+                row = [str(total + i).encode(), b"7", b"1.5", b"9",
+                       b"2026-01-01 00:00:00", b"42", b"3.14", b"11"]
+                # rotate values to match each table's rotated kinds
+                t = tid - 17000
+                r = t % 8
+                tx.insert_preencoded(tid, encode_insert(
+                    tid, [str(total + i).encode()] + row[r:] + row[:r]))
+            lsn = await tx.commit()
+            total += rows_per_tx
+            # paced: await delivery each tx so flush sizes stay inside
+            # the prewarmed buckets and the run measures steady decode,
+            # not producer/consumer queue dynamics
+            deadline = time.monotonic() + 60
+            while delivered[0] < total:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"delivery stalled at "
+                                       f"{delivered[0]}/{total}")
+                await asyncio.sleep(0.01)
+    total_seconds = time.perf_counter() - t0
+
+    # the cold run's background builds must land (and persist) before
+    # exit, or the warm run would have nothing to load
+    deadline = time.monotonic() + 240
+    while background_compiles_inflight() > 0:
+        if time.monotonic() > deadline:
+            raise TimeoutError("background compiles never finished")
+        await asyncio.sleep(0.05)
+    await pipeline.shutdown_and_wait()
+
+    out = counters()
+    out.update({
+        "start_seconds": round(start_seconds, 3),
+        "first_durable_seconds": round(first_durable[0], 3)
+        if first_durable[0] is not None else None,
+        "total_seconds": round(total_seconds, 3),
+        "rows_delivered": delivered[0],
+        "canonical_layouts":
+            registry.get_gauge(ETL_DECODE_CANONICAL_LAYOUTS),
+        "tables": n_tables,
+        "prewarm_buckets": len(PREWARM_BUCKETS),
+    })
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--cache-dir", required=True)
+    p.add_argument("--tables", type=int, default=3)
+    p.add_argument("--rows-per-tx", type=int, default=800)
+    p.add_argument("--txs-per-table", type=int, default=2)
+    args = p.parse_args()
+    out = asyncio.run(run(args.cache_dir, args.tables, args.rows_per_tx,
+                          args.txs_per_table))
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

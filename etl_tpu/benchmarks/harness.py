@@ -109,6 +109,28 @@ def _filter_metrics() -> dict:
     }
 
 
+def _compile_metrics() -> dict:
+    """Snapshot of the program-store counters (ops/program_store.py).
+    Benches report the delta over their measured window: nonzero
+    programs_compiled inside a window means the warmup missed a
+    signature and the window paid an XLA build — the cost the canonical
+    layout cache + prewarm exist to make visible and then kill."""
+    from ..telemetry.metrics import (ETL_COMPILE_CACHE_HITS_TOTAL,
+                                     ETL_COMPILE_CACHE_MISSES_TOTAL,
+                                     ETL_PROGRAMS_COMPILED_TOTAL, registry)
+
+    return {
+        "programs_compiled":
+            registry.get_counter(ETL_PROGRAMS_COMPILED_TOTAL),
+        "compile_cache_hits_memory": registry.get_counter(
+            ETL_COMPILE_CACHE_HITS_TOTAL, {"layer": "memory"}),
+        "compile_cache_hits_disk": registry.get_counter(
+            ETL_COMPILE_CACHE_HITS_TOTAL, {"layer": "disk"}),
+        "compile_cache_misses": registry.sum_counter(
+            ETL_COMPILE_CACHE_MISSES_TOTAL),
+    }
+
+
 # ---------------------------------------------------------------------------
 # table_copy (reference table_copy.rs:74-183)
 # ---------------------------------------------------------------------------
@@ -432,6 +454,7 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
     stages0 = _pipeline_metrics()
     adm0 = _admission_metrics()
     filt0 = _filter_metrics()
+    comp0 = _compile_metrics()
     # row-materialization gate input: zero constructions over the measured
     # window = the egress path stayed columnar fetch-to-wire (the smoke
     # gate asserts this on the null destination; 'memory' exercises the
@@ -496,6 +519,8 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
     adm = {k: adm1[k] - adm0[k] for k in adm1}
     filt1 = _filter_metrics()
     filt = {k: filt1[k] - filt0[k] for k in filt1}
+    comp1 = _compile_metrics()
+    comp = {k: comp1[k] - comp0[k] for k in comp1}
     pack_s = stages["pipeline_pack_seconds"]
     lags_ms = [(t - commit_times[lsn]) * 1000 for lsn, t in arrivals
                if lsn in commit_times]
@@ -545,6 +570,14 @@ async def run_table_streaming(n_events: int = 500_000, tx_size: int = 500,
         # fetches actually moved
         "decode_rows_filtered": int(filt["decode_rows_filtered"]),
         "decode_fetched_bytes": int(filt["decode_fetched_bytes"]),
+        # program-store activity over the measured window: nonzero
+        # programs_compiled means the window paid an XLA build the
+        # warmup should have absorbed — warmup cost stops hiding
+        "programs_compiled": int(comp["programs_compiled"]),
+        "compile_cache_hits_memory":
+            int(comp["compile_cache_hits_memory"]),
+        "compile_cache_hits_disk": int(comp["compile_cache_hits_disk"]),
+        "compile_cache_misses": int(comp["compile_cache_misses"]),
         "replication_lag_p50_ms":
             round(pct(0.50), 2) if lags_ms else None,
         "replication_lag_p95_ms":
@@ -1105,13 +1138,113 @@ def run_egress(n_rows: int = 16_384, n_iters: int = 5) -> dict:
         pq.write_table(pa.Table.from_batches([rb]), sink)
         return sink.tell()
 
+    def snowpipe():
+        # NDJSON line encoding only — zstd compression is a C library
+        # pass-through unchanged by the columnar refactor (and absent on
+        # this container); the Python-cost part the floor guards is the
+        # per-row dict + json.dumps the columnar encoder eliminated
+        from ..destinations.snowflake import (encode_batch_ndjson,
+                                              offset_token_batch)
+
+        labels = ["insert"] * n_rows
+        seqs = offset_token_batch(lsns, txos)
+        lines = encode_batch_ndjson(schema, batch, labels, seqs)
+        return sum(len(ln) for ln in lines)
+
     out: dict = {"mode": "egress", "rows": n_rows, "iters": n_iters}
     for name, fn in (("bq_proto", bq), ("clickhouse_tsv", clickhouse),
-                     ("parquet", parquet)):
+                     ("parquet", parquet), ("snowpipe_ndjson", snowpipe)):
         rps, bps = timed(fn)
         out[f"{name}_rows_per_sec"] = rps
         out[f"{name}_bytes_per_sec"] = bps
     return out
+
+
+# ---------------------------------------------------------------------------
+# coldstart (ISSUE 12): restart-to-first-durable-batch, cold vs warm cache
+# ---------------------------------------------------------------------------
+
+
+def run_coldstart(n_tables: int = 3, rows_per_tx: int = 800,
+                  txs_per_table: int = 2,
+                  cache_dir: "str | None" = None) -> dict:
+    """Two replicator lifetimes (subprocesses — jax program caches are
+    process state, so cold vs warm MUST be separate processes) against
+    one program-cache dir: the cold start compiles, the warm restart
+    loads. Gates (asserted by --smoke):
+
+      - warm restart compiles ZERO fresh XLA programs and serves its
+        first durable batch off cached programs (no oracle rows);
+      - the cold start's compile count proves canonicalization — the
+        permuted-column tables share ONE layout, so compiles are bounded
+        by the prewarm bucket count, not tables × buckets.
+
+    Wall-clock numbers (start / first-durable / total) are recorded, not
+    gated, on this CPU container: the XLA builds they eliminate are
+    seconds here and tens of seconds on wide schemas."""
+    import json as _json
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    owned = cache_dir is None
+    if owned:
+        cache_dir = tempfile.mkdtemp(prefix="etl-coldstart-cache-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    def one_run() -> dict:
+        proc = subprocess.run(
+            [sys.executable, "-m", "etl_tpu.benchmarks.coldstart_worker",
+             "--cache-dir", cache_dir, "--tables", str(n_tables),
+             "--rows-per-tx", str(rows_per_tx),
+             "--txs-per-table", str(txs_per_table)],
+            capture_output=True, text=True, timeout=600, env=env, cwd=repo)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"coldstart worker failed: {proc.stderr[-1500:]}")
+        return _json.loads(proc.stdout.strip().splitlines()[-1])
+
+    try:
+        cold = one_run()
+        warm = one_run()
+    finally:
+        if owned:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+    buckets = cold["prewarm_buckets"]  # emitted by the worker, so the
+    #                                    gate can never drift from its
+    #                                    PREWARM_BUCKETS tuple
+    failures = []
+    if warm["programs_compiled"] != 0:
+        failures.append(f"warm restart compiled "
+                        f"{warm['programs_compiled']} programs (want 0)")
+    if warm["cache_hits_disk"] < 1:
+        failures.append("warm restart never loaded a program from disk")
+    if warm["oracle_rows"] != 0:
+        failures.append(f"warm restart decoded {warm['oracle_rows']} rows "
+                        "on the oracle (first batch not served from "
+                        "cached programs)")
+    if warm["host_rows"] <= 0:
+        failures.append("warm restart routed nothing to the host program")
+    if cold["programs_compiled"] > buckets:
+        failures.append(
+            f"cold start compiled {cold['programs_compiled']} programs "
+            f"for {n_tables} tables — canonicalization should bound it "
+            f"by the {buckets} prewarm buckets")
+    if cold["canonical_layouts"] != 1:
+        failures.append(f"{cold['canonical_layouts']} canonical layouts "
+                        f"for {n_tables} same-multiset tables (want 1)")
+    return {
+        "mode": "coldstart", "ok": not failures, "failures": failures,
+        "cold": cold, "warm": warm,
+        "warm_zero_compiles": warm["programs_compiled"] == 0,
+        "warm_first_durable_seconds": warm["first_durable_seconds"],
+        "cold_first_durable_seconds": cold["first_durable_seconds"],
+        "cold_oracle_rows_during_warmup": cold["oracle_rows"],
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -154,6 +154,33 @@ SCENARIOS: tuple[Scenario, ...] = (
                           after_hits=1),),
         txs=6, expect_restarts=1),
     Scenario(
+        name="crash_restart_warm_programs",
+        description="hard crash with a WARM program cache (ISSUE 12): "
+                    "the runner seeds a cache dir, clears the in-process "
+                    "program caches at the restart (process-death "
+                    "semantics for jit state), and the restarted "
+                    "pipeline must serve its first batch from DISK-"
+                    "cached programs — compile-counter delta == 0, disk "
+                    "hits > 0 — while the usual zero-loss/bounded-dup "
+                    "invariants hold",
+        faults=(FaultSpec(fp.ON_PROGRESS_STORE, kind=FaultKind.CRASH,
+                          after_hits=1),),
+        txs=4, rows_per_tx=96, expect_restarts=1,
+        program_cache="warm"),
+    Scenario(
+        name="crash_restart_corrupt_program_cache",
+        description="hard crash with a CORRUPTED program cache (ISSUE "
+                    "12): every cache file is garbage at restart — the "
+                    "load must degrade to a clean rebuild (invalid-miss "
+                    "counted, file deleted, batches decode on the "
+                    "oracle while the rebuild runs) and the invariants "
+                    "must hold; a corrupt cache must never crash or "
+                    "wedge a replicator",
+        faults=(FaultSpec(fp.ON_PROGRESS_STORE, kind=FaultKind.CRASH,
+                          after_hits=1),),
+        txs=4, rows_per_tx=96, expect_restarts=1,
+        program_cache="corrupt"),
+    Scenario(
         name="crash_mid_copy",
         description="hard crash mid-COPY: restart must drop the "
                     "half-written destination table and recopy",

@@ -51,6 +51,57 @@ _DEST_OPS = ("write_events", "write_table_rows", "truncate_table",
              "drop_table")
 
 
+# -- program-cache restart scenarios (ISSUE 12) -------------------------------
+
+#: row buckets seeded AND prewarmed for program-cache scenarios — one
+#: tuple so the seed can never drift from what the restarted pipeline
+#: warms (covers every bucket the scenarios' flushes can stage into:
+#: txs × rows_per_tx stays well under 4096)
+_PC_PREWARM_BUCKETS = (256, 1024, 4096)
+
+
+def _clear_program_memory_caches() -> None:
+    """Process-death semantics for the decode-program state a real crash
+    would free with the process: the in-process program cache and the
+    background-compile bookkeeping. The program-cache scenarios clear
+    these at setup (so seeding provably writes to DISK) and at every
+    hard restart (so the restarted pipeline can only be warm via the
+    disk layer — exactly what a new process would see)."""
+    from ..ops import engine as engine_mod
+
+    with engine_mod._SHARED_FN_LOCK:
+        engine_mod._SHARED_FN_CACHE.clear()
+    with engine_mod._BG_COMPILE_LOCK:
+        engine_mod._BG_COMPILE_KEYS.clear()
+        engine_mod._BG_COMPILE_FAILED.clear()
+
+
+def _corrupt_program_cache(cache_dir: str) -> None:
+    """Overwrite every serialized executable with garbage (the
+    power-loss / torn-disk case the degrade contract covers)."""
+    import os
+
+    for root, _dirs, files in os.walk(cache_dir):
+        for f in files:
+            if f.endswith(".prog"):
+                with open(os.path.join(root, f), "wb") as fh:
+                    fh.write(b"not a serialized executable")
+
+
+def _program_cache_counters() -> dict:
+    from ..telemetry.metrics import (ETL_COMPILE_CACHE_HITS_TOTAL,
+                                     ETL_COMPILE_CACHE_MISSES_TOTAL,
+                                     ETL_PROGRAMS_COMPILED_TOTAL)
+
+    return {
+        "compiled": registry.get_counter(ETL_PROGRAMS_COMPILED_TOTAL),
+        "disk_hits": registry.get_counter(ETL_COMPILE_CACHE_HITS_TOTAL,
+                                          {"layer": "disk"}),
+        "invalid": registry.get_counter(ETL_COMPILE_CACHE_MISSES_TOTAL,
+                                        {"reason": "invalid"}),
+    }
+
+
 class SimulatedCrash(Exception):
     """Raised at a CRASH site; the watcher hard-kills the pipeline before
     any in-process retry can proceed."""
@@ -332,6 +383,29 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
     leak_probe = LeakProbe.capture()
     workload = _make_workload(scenario, rng)
     db = workload.build_db()
+    pc_dir = None
+    pc_base = None
+    pc_restart_base = None
+    if scenario.program_cache:
+        # seed a private cache dir with this workload's host programs
+        # (AOT-compiled + serialized), then make the in-process caches
+        # look like a fresh process — from here on, warmth can only come
+        # from disk (_PC_PREWARM_BUCKETS keeps the warm assertion from
+        # ever flaking on flush sizing).
+        import tempfile
+
+        from ..models import ReplicatedTableSchema
+        from ..ops import program_store
+
+        pc_dir = tempfile.mkdtemp(prefix="etl-chaos-progcache-")
+        program_store.configure(pc_dir)
+        _clear_program_memory_caches()
+        schemas = [ReplicatedTableSchema.with_all_columns(
+            db.tables[tid].schema) for tid in workload.table_ids]
+        await asyncio.to_thread(program_store.warm_host_programs,
+                                schemas, _PC_PREWARM_BUCKETS, True)
+        _clear_program_memory_caches()
+        pc_base = _program_cache_counters()
     store = RecordingStore()
     inner = TracingDestination()
     dest = FaultInjectingDestination(inner)
@@ -470,7 +544,14 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
     config = PipelineConfig(
         pipeline_id=1, publication_name="pub",
         batch=BatchConfig(max_size_bytes=64 * 1024, max_fill_ms=25,
-                          batch_engine=BatchEngine(scenario.engine)),
+                          batch_engine=BatchEngine(scenario.engine),
+                          # program-cache scenarios: the restarted
+                          # pipeline prewarms the stored schemas from
+                          # the seeded dir at start — the tentpole flow
+                          # under test
+                          program_cache_dir=pc_dir,
+                          prewarm_row_buckets=_PC_PREWARM_BUCKETS
+                          if pc_dir else None),
         apply_retry=RetryConfig(max_attempts=10, initial_delay_ms=15,
                                 max_delay_ms=120),
         table_retry=RetryConfig(max_attempts=10, initial_delay_ms=15,
@@ -558,6 +639,15 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
             except SimulatedCrash:
                 crash.event.clear()
                 await _hard_kill(pipeline)
+                if scenario.program_cache:
+                    # a real crash loses all jit state with the process;
+                    # the corrupt variant additionally trashes the disk
+                    # layer so the restart exercises the degrade path
+                    _clear_program_memory_caches()
+                    if scenario.program_cache == "corrupt":
+                        await asyncio.to_thread(_corrupt_program_cache,
+                                                pc_dir)
+                    pc_restart_base = _program_cache_counters()
                 resume = await store.get_durable_progress(
                     apply_slot_name(1))
                 rec = RestartRecord(kind="crash",
@@ -594,6 +684,27 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
                 lambda: workload.delivered(inner), 30.0,
                 "post-restart workload never delivered"))
             run.restarts[-1].recovery_s = time.monotonic() - t_phase
+
+        if scenario.program_cache and pc_base is not None:
+            now = _program_cache_counters()
+            if scenario.program_cache == "warm":
+                fresh = now["compiled"] - pc_base["compiled"]
+                if fresh != 0:
+                    run.report.fail(
+                        f"warm program cache: {fresh:g} fresh XLA builds "
+                        "after seeding — restart did not serve from "
+                        "cached programs")
+                if pc_restart_base is not None \
+                        and now["disk_hits"] <= pc_restart_base["disk_hits"]:
+                    run.report.fail(
+                        "warm program cache: the restarted pipeline never "
+                        "loaded a program from disk")
+            else:  # corrupt
+                if now["invalid"] <= pc_base["invalid"]:
+                    run.report.fail(
+                        "corrupt program cache: no invalid-miss recorded "
+                        "— the corrupted files were never probed (the "
+                        "degrade path did not run)")
 
         if scenario.expect_health_recovery and pipeline.supervisor is not None:
             # the acceptance arc: /health's state machine must have gone
@@ -633,6 +744,22 @@ async def _run_scenario_inner(scenario: Scenario, seed: int,
         engine.clear_forced_oracle()
         await _hard_kill(pipeline)
         await dest.shutdown()
+        if scenario.program_cache:
+            # the corrupt variant may have rebuilds in flight on
+            # background threads — wait them out (bounded) so the store
+            # is quiescent before it is deconfigured and the dir removed
+            import shutil
+
+            from ..ops import program_store
+
+            try:
+                await _wait_until(
+                    lambda: engine.background_compiles_inflight() == 0,
+                    30.0, "program-cache background compiles lingering")
+            except TimeoutError:
+                pass  # non-fatal: save() re-reads active_dir per write
+            program_store.configure(None)
+            shutil.rmtree(pc_dir, ignore_errors=True)
     # unresolved = still pending now (shutdown missed them) PLUS any the
     # wrapper had to force-fail because no release ever came (shutdown
     # clears _held_acks, so counting the list alone would always be 0)

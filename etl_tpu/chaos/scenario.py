@@ -110,6 +110,18 @@ class Scenario:
     # assert the health state machine visited DEGRADED during the run
     # and settled back to HEALTHY before shutdown
     expect_health_recovery: bool = False
+    # program-cache restart modes (ops/program_store.py):
+    #   "warm"    — seed a cache dir with this workload's programs, clear
+    #               the in-process program caches at every hard restart
+    #               (process-death semantics for jit state), and assert
+    #               the restarted pipeline served its first batch from
+    #               DISK-cached programs: compile-counter delta == 0
+    #               across the whole post-seed run, disk hits > 0.
+    #   "corrupt" — same setup, but every cache file is overwritten with
+    #               garbage at the restart: the load must degrade to a
+    #               clean rebuild (invariants hold, at least one
+    #               invalid-miss recorded), never a crash.
+    program_cache: str | None = None
 
     def describe(self) -> dict:
         return {
@@ -123,5 +135,6 @@ class Scenario:
             "expect_restarts": self.expect_restarts,
             "clean_restart": self.clean_restart,
             "engine": self.engine,
+            "program_cache": self.program_cache,
             "faults": [f.describe() for f in self.faults],
         }

@@ -76,6 +76,25 @@ class BatchConfig:
     # 0 = auto (max(4, 2 × device count)); the FIRST pipeline to start
     # fixes the process-wide value. Drops to 1 under memory pressure.
     admission_capacity: int = 0
+    # AOT program cache directory (ops/program_store.py): compiled decode
+    # executables persist here, keyed by canonical layout + backend +
+    # versions, so a restarted replicator LOADS its programs instead of
+    # re-paying the XLA builds. None = in-memory only (also honors
+    # $ETL_TPU_PROGRAM_CACHE_DIR). The store is PROCESS-global, like the
+    # admission scheduler's capacity: the first pipeline to configure a
+    # dir fixes it for every pipeline in the process (a later pipeline
+    # naming a different dir is ignored with a warning; one naming None
+    # shares the configured store). Safe to share across pods on
+    # identical images/machine types — see the OPERATIONS.md runbook.
+    program_cache_dir: str | None = None
+    # warm stored table schemas' canonical host programs at
+    # Pipeline.start, before the apply loop sees traffic. None = auto
+    # (prewarm iff a program cache dir is configured — without one a
+    # fresh process has nothing to load and the nonblocking background
+    # compiles cover first-touch); the row buckets default to
+    # program_store.PREWARM_ROW_BUCKETS.
+    prewarm_programs: bool | None = None
+    prewarm_row_buckets: tuple | None = None
 
     def validate(self) -> None:
         _require(self.max_size_bytes > 0, "max_size_bytes must be > 0")
@@ -83,6 +102,8 @@ class BatchConfig:
         _require(self.decode_window >= 1, "decode_window must be >= 1")
         _require(self.admission_capacity >= 0,
                  "admission_capacity must be >= 0 (0 = auto)")
+        _require(all(b > 0 for b in self.prewarm_row_buckets or ()),
+                 "prewarm_row_buckets must be positive row capacities")
 
 
 @dataclass(frozen=True)

@@ -122,6 +122,14 @@ class RowBatchBuilder:
             # reference encoding.rs rejects non-finite floats
             raise EtlError(ErrorKind.DESTINATION_FAILED,
                            f"snowpipe: row not JSON-encodable: {e}")
+        self.push_encoded_line(line, offset)
+
+    def push_encoded_line(self, line: bytes, offset: str) -> None:
+        """Append one PRE-ENCODED NDJSON line (newline included) — the
+        columnar egress path (snowflake.encode_batch_ndjson) renders
+        whole batches column-at-a-time and streams the finished lines
+        here, so the compressor/split bookkeeping is shared byte-for-byte
+        with the row path."""
         if len(line) > MAX_UNCOMPRESSED_ROW_BYTES:
             raise EtlError(
                 ErrorKind.DESTINATION_FAILED,

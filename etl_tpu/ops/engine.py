@@ -51,14 +51,19 @@ from . import parsers
 from .staging import (ArenaLease, StagedBatch, bucket_pow2, bucket_width,
                       pad_to_multiple)
 
-# NOTE on the persistent compilation cache: enabling
+# NOTE on the persistent compilation cache: enabling the GLOBAL
 # jax_compilation_cache_dir here was tried and REVERTED — the XLA:CPU
 # backend round-trips AOT results whose recorded machine features
 # (+prefer-no-scatter/+prefer-no-gather) don't match the execution host,
 # and reloading them hard-hangs the process inside the jitted call (GIL
-# held, faulthandler can't even fire). Decode programs instead bound
-# their compile count via the coarse row buckets (staging.ROW_BUCKETS)
-# and callers warm the buckets they stream through.
+# held, faulthandler can't even fire). Decode-program persistence now
+# lives in ops/program_store.py instead: per-program AOT serialization
+# under OUR OWN key (canonical layout + backend + mesh fingerprint +
+# engine flag) inside a version-tag subdirectory that hashes the host
+# CPU's feature flags — the cross-machine mismatch that caused the hang
+# can only land in a different subdirectory. Compile count is bounded
+# twice over: coarse row buckets (staging.ROW_BUCKETS) and canonical
+# layouts (N tables share O(1) programs).
 
 # kinds parsed on device; everything else is host-object
 DEVICE_KINDS = frozenset({
@@ -140,6 +145,12 @@ class _PackedInputs:
     use_mesh: bool
     row_flags: np.ndarray | None = None
     filtered: bool = False
+    # the canonical layout this batch packed into (program_store.
+    # canonical_plan): dispatch keys and builds the program from
+    # plan.specs, completion unpacks each real column from its canonical
+    # slot. None on the fused-filter path (predicates bind staged column
+    # indices, so those programs stay exact).
+    plan: "object | None" = None
 
 
 def build_device_program(specs: tuple[tuple[int, CellKind, int, int], ...],
@@ -240,8 +251,14 @@ def _host_fn_key(row_capacity: int, specs: tuple,
     different output STRUCTURE, so the keys must never collide). The
     dispatch stage builds its keys through this same helper, so the probe
     in `_host_fn_ready` can never drift from the cache it is probing.
-    The engine flag stays the LAST element (routing-proof tests key on
-    key[-1])."""
+    Callers pass EXACT specs; the key carries their CANONICAL layout
+    (program_store.canonical_plan) so every schema that shares a layout
+    shares the key. The engine flag stays the LAST element
+    (routing-proof tests key on key[-1])."""
+    if pred_fp is None and specs:
+        from . import program_store
+
+        specs = program_store.canonical_plan(specs).specs
     return (row_capacity, specs, False, None, False, pred_fp, True)
 
 
@@ -260,6 +277,21 @@ def _host_fn_ready(decoder: "DeviceDecoder", staged: "StagedBatch",
             return False
         if _shared_fn_get(key) is not None:
             return True
+    # disk probe BEFORE conceding to the oracle: a restarted process
+    # finds the executable the previous incarnation compiled and loads
+    # it inline (sub-second even for wide schemas) — the warm-restart
+    # path that makes restart cost I/O, not XLA (ops/program_store.py).
+    # record_absent=False: a miss here flows into the background
+    # build's acquire(), which probes and counts the same key again
+    from . import program_store
+
+    fn = program_store.try_load(key, record_absent=False)
+    if fn is not None:
+        _shared_fn_put(key, fn)
+        return True
+    with _BG_COMPILE_LOCK:
+        if key in _BG_COMPILE_KEYS or key in _BG_COMPILE_FAILED:
+            return False
         _BG_COMPILE_KEYS.add(key)
 
     def work() -> None:
@@ -690,7 +722,9 @@ class DeviceDecoder:
     def _pack_host(self, staged: StagedBatch, widths: tuple[int, ...],
                    allow_nibble: bool = True,
                    arena: "ArenaLease | None" = None,
-                   row_capacity: int | None = None):
+                   row_capacity: int | None = None,
+                   cols: "list[int] | None" = None,
+                   phantom: tuple = ()):
         """Gather all dense fields into one byte matrix: nibble-packed C
         fast path (halves the upload) when the column mix allows, raw C
         pass otherwise, numpy as the last resort. Returns
@@ -702,11 +736,22 @@ class DeviceDecoder:
         pack stage); safe because every pack path overwrites all rows up
         to capacity. `row_capacity` > staged.row_capacity allocates mesh
         padding rows, zeroed after the pack (the C packers only write the
-        staged capacity)."""
+        staged capacity).
+
+        `cols` is the staged column index feeding each byte-matrix slot
+        (default: self._dense order — the exact layout). Canonical
+        layouts pass their slot permutation plus `phantom` pad-slot
+        indices: phantom slots pack a same-(kind,width) DONOR column
+        through the C fast path (so the nibble alphabet scan sees only
+        bytes a real slot already scanned) and are zeroed to all-NULL
+        here, making padding invisible to the parsers and the fallback
+        machinery."""
         from ..native import pack_bmat, pack_bmat_nibble
 
         cap = staged.row_capacity
         R = cap if row_capacity is None else row_capacity
+        if cols is None:
+            cols = [s.index for s in self._dense]
 
         def buf(shape, dtype):
             return arena.take(shape, dtype) if arena is not None \
@@ -717,35 +762,57 @@ class DeviceDecoder:
                 for a in arrays:
                     a[cap:] = 0
 
+        def zero_phantoms(bmat, lengths, nibble: bool):
+            if not phantom:
+                return
+            w_off = 0
+            offs = []
+            for w in widths:
+                offs.append(w_off)
+                w_off += w
+            for j in phantom:
+                lengths[:, j] = 0
+                o, w = offs[j], widths[j]
+                if nibble:
+                    bmat[:, o // 2 : (o + w) // 2] = 0
+                else:
+                    bmat[:, o : o + w] = 0
+
         total_w = sum(widths)
         ldtype = np.uint8 if max(widths, default=0) <= 255 else np.int32
         if allow_nibble and ldtype is np.uint8 and self._can_nibble(widths):
             bmat = buf((R, total_w // 2), np.uint8)
-            lengths = buf((R, len(self._dense)), np.uint8)
+            lengths = buf((R, len(cols)), np.uint8)
             bad = buf((R,), np.uint8)
             if pack_bmat_nibble(
                     staged.data, np.ascontiguousarray(staged.offsets),
                     np.ascontiguousarray(staged.lengths),
-                    [s.index for s in self._dense], list(widths), bmat,
+                    cols, list(widths), bmat,
                     lengths, bad):
                 zero_tail(bmat, lengths, bad)
+                zero_phantoms(bmat, lengths, True)
                 return bmat, lengths, True, bad
         bmat = buf((R, total_w), np.uint8)
-        lengths = buf((R, len(self._dense)), ldtype)
+        lengths = buf((R, len(cols)), ldtype)
         if ldtype is np.uint8 and pack_bmat(
                 staged.data, np.ascontiguousarray(staged.offsets),
                 np.ascontiguousarray(staged.lengths),
-                [s.index for s in self._dense], list(widths), bmat, lengths):
+                cols, list(widths), bmat, lengths):
             zero_tail(bmat, lengths)
+            zero_phantoms(bmat, lengths, False)
             return bmat, lengths, False, None
         bmat[:] = 0
         lengths[:] = 0
         data = staged.data
         n = len(data)
+        phantom_set = frozenset(phantom)
         w_off = 0
-        for j, (spec, w) in enumerate(zip(self._dense, widths)):
-            offs = staged.offsets[:, spec.index].astype(np.int64)
-            lens = np.minimum(staged.lengths[:, spec.index], w)
+        for j, (col, w) in enumerate(zip(cols, widths)):
+            if j in phantom_set:
+                w_off += w  # already zero (all-NULL padding slot)
+                continue
+            offs = staged.offsets[:, col].astype(np.int64)
+            lens = np.minimum(staged.lengths[:, col], w)
             lengths[:cap, j] = lens
             idx = offs[:, None] + np.arange(w, dtype=np.int64)[None, :]
             np.clip(idx, 0, max(n - 1, 0), out=idx)
@@ -827,20 +894,38 @@ class DeviceDecoder:
                     host: bool = False,
                     arena: "ArenaLease | None" = None) -> "_PackedInputs":
         """Stage 1: host gather of all dense fields into (possibly pooled)
-        staging buffers. Pure numpy/C — no jax calls, safe on any thread."""
-        widths = tuple(w for _, _, w, _ in specs)
+        staging buffers. Pure numpy/C — no jax calls, safe on any thread.
+        Unfiltered batches pack into their CANONICAL layout (sorted
+        slots + all-NULL phantom padding, program_store.canonical_plan)
+        so the dispatch stage keys a shared program; the fused-filter
+        path packs the exact layout (its predicate binds staged column
+        indices)."""
+        pred = self._device_filter_for(staged)
+        plan = None
+        cols = None
+        phantom: tuple = ()
+        if pred is None and specs:
+            from . import program_store
+
+            plan = program_store.canonical_plan(specs)
+            widths = tuple(w for _, _, w, _ in plan.specs)
+            if not plan.identity:
+                cols = [self._dense[p].index for p in plan.pack_dense]
+                phantom = plan.phantom_slots
+        else:
+            widths = tuple(w for _, _, w, _ in specs)
         use_mesh = not host and self._use_mesh(staged.row_capacity)
         cap = pad_to_multiple(staged.row_capacity, self.mesh.size) \
             if use_mesh else staged.row_capacity
         bmat, lengths, nibble, bad_rows = self._pack_host(
             staged, widths, allow_nibble=not host, arena=arena,
-            row_capacity=cap)
-        pred = self._device_filter_for(staged)
+            row_capacity=cap, cols=cols, phantom=phantom)
         row_flags = None
         if pred is not None:
             row_flags = self._row_flags(staged, specs, pred, bad_rows, cap)
         return _PackedInputs(bmat, lengths, nibble, bad_rows, cap, use_mesh,
-                             row_flags=row_flags, filtered=pred is not None)
+                             row_flags=row_flags, filtered=pred is not None,
+                             plan=plan)
 
     @dispatch_stage
     @hot_loop
@@ -851,7 +936,12 @@ class DeviceDecoder:
         `jax.device_put` is a committed UPLOAD riding the pipeline, not a
         sync point — fetches still belong at `_PendingDecode.result()`."""
         bmat, lengths = packed.bmat, packed.lengths
-        widths = tuple(w for _, _, w, _ in specs)
+        # pspecs: what the PROGRAM is built from — the canonical layout
+        # when the pack stage resolved one, the exact specs otherwise
+        # (fused-filter dispatches). `specs` stays the exact per-real-
+        # column view the completion path reasons about.
+        pspecs = packed.plan.specs if packed.plan is not None else specs
+        widths = tuple(w for _, _, w, _ in pspecs)
         if host:
             # committed CPU placement: jit compiles/executes this call on
             # the host CPU backend — same program, no accelerator round
@@ -863,7 +953,7 @@ class DeviceDecoder:
         if self.use_pallas and not host:
             from .pallas_kernel import MAX_TOTAL_WIDTH, pallas_supported
 
-            if not pallas_supported(specs):
+            if not pallas_supported(pspecs):
                 # wide schemas overflow the Mosaic compiler's appetite
                 # for the unrolled parse chain (MAX_TOTAL_WIDTH) — take
                 # the XLA program without a doomed remote-compile
@@ -895,16 +985,36 @@ class DeviceDecoder:
         pred = self._device_filter_for(staged) if packed.filtered else None
         pred_fp = pred.fingerprint() if pred is not None else None
         key = _host_fn_key(packed.row_capacity, specs, pred_fp) if host else \
-            (packed.row_capacity, specs, packed.nibble,
+            (packed.row_capacity, pspecs, packed.nibble,
              mesh_cache_key(self.mesh) if packed.use_mesh else None,
              pallas, pred_fp, False)
+        row_flags = packed.row_flags
+        if pred is not None and host:
+            row_flags = jax.device_put(row_flags, dev)
         fn = _shared_fn_get(key)
         if fn is None:
-            fn = _build_device_fn(
-                specs, packed.nibble, pallas,
-                mesh=self.mesh if packed.use_mesh else None,
-                donate=not host and _donation_supported(), pred=pred)
+            # miss: ops/program_store resolves it — disk load when a
+            # cache dir is configured (warm restarts compile NOTHING),
+            # else build + AOT compile + persist; the example args pin
+            # the lowering to exactly what this call passes
+            from . import program_store
+
+            def _builder():
+                return _build_device_fn(
+                    pspecs, packed.nibble, pallas,
+                    mesh=self.mesh if packed.use_mesh else None,
+                    donate=not host and _donation_supported(), pred=pred)
+
+            args = (bmat, lengths) if pred is None \
+                else (bmat, lengths, row_flags)
+            fn = program_store.acquire(key, _builder, args)
             _shared_fn_put(key, fn)
+        elif self._telemetry:
+            from ..telemetry.metrics import (ETL_COMPILE_CACHE_HITS_TOTAL,
+                                             registry)
+
+            registry.counter_inc(ETL_COMPILE_CACHE_HITS_TOTAL,
+                                 labels={"layer": "memory"})
         self._fn_cache[key] = fn
         if packed.use_mesh and self._telemetry:
             from ..telemetry.metrics import (
@@ -928,9 +1038,6 @@ class DeviceDecoder:
                                pad_total / rows_total if rows_total else 0.0)
         try:
             if pred is not None:
-                row_flags = packed.row_flags
-                if host:
-                    row_flags = jax.device_put(row_flags, dev)
                 return fn(bmat, lengths, row_flags)  # async dispatch
             return fn(bmat, lengths)  # async dispatch
         except Exception:
@@ -1073,13 +1180,19 @@ class DeviceDecoder:
                 c.validity[i] = value is not None
 
     def _assemble(self, staged: StagedBatch, specs: tuple, packed_np,
-                  bad_rows=None) -> "tuple[ColumnarBatch, np.ndarray]":
+                  bad_rows=None,
+                  plan=None) -> "tuple[ColumnarBatch, np.ndarray]":
         """Shared completion core: fetched packed words (+ the staged
         bookkeeping they index) → typed columns + CPU fixup. For a fused-
         filter decode `staged` is the COMPACTED view (staging.gather_rows)
         and `packed_np` the count-sized slice, so every index here —
         including the fallback rows returned for the caller's post-fixup
-        predicate re-check — lives in the compacted space."""
+        predicate re-check — lives in the compacted space. With `plan`
+        (the canonical layout the batch packed into) the words carry
+        canonical slot order: each real column unpacks from
+        plan.slot_of[j] and the phantom padding slots are never read —
+        column outputs index by schema position, so the decoded batch is
+        byte-identical to the exact layout's."""
         from .bitpack import layout_for_specs, unpack_host
 
         n = staged.n_rows
@@ -1101,7 +1214,8 @@ class DeviceDecoder:
                     too_big = staged.lengths[:n, spec.index] > w
                     fallback.update(np.flatnonzero(too_big).tolist())
 
-        layout = layout_for_specs(specs) if packed_np is not None else None
+        pspecs = plan.specs if plan is not None else specs
+        layout = layout_for_specs(pspecs) if packed_np is not None else None
         for j, spec in enumerate(self._dense):
             valid = valid_full[:n, spec.index].copy()
             toast_col = staged.toast[:n, spec.index]
@@ -1109,7 +1223,8 @@ class DeviceDecoder:
                 # small batch: host decode of every row via the oracle
                 data = np.zeros(n, dtype=dense_dtype(spec.kind))
             else:
-                ok, comps = unpack_host(layout, packed_np, j, n)
+                slot = plan.slot_of[j] if plan is not None else j
+                ok, comps = unpack_host(layout, packed_np, slot, n)
                 bad = ~ok & valid
                 if bad.any():
                     fallback.update(np.flatnonzero(bad).tolist())
@@ -1210,7 +1325,9 @@ class DeviceDecoder:
             packed_np = np.asarray(packed) if packed is not None else None
             if shard_bad is not None and self._telemetry:
                 self._shard_health(shard_bad)
-            batch, _ = self._assemble(staged, specs, packed_np, bad_rows)
+            batch, _ = self._assemble(
+                staged, specs, packed_np, bad_rows,
+                plan=meta.plan if meta is not None else None)
             fetched = packed_np.nbytes if packed_np is not None else 0.0
             host_rf = self._host_filter_for(staged)
             if host_rf is not None:

@@ -222,7 +222,13 @@ class EventAssembler:
             # background thread while its batches decode on the oracle —
             # a synchronous first-touch build of a wide schema (measured
             # 32s at 120 columns) would wedge the apply loop past the
-            # stall deadline and spiral the watchdog into restarts
+            # stall deadline and spiral the watchdog into restarts.
+            # With a program cache dir configured the cold key usually
+            # isn't cold at all: Pipeline.start's prewarm (or the
+            # first-touch disk probe in engine._host_fn_ready) loads the
+            # previous incarnation's AOT executable, so a warm restart
+            # decodes its first flush on the real program, zero builds
+            # (ops/program_store.py)
             decoder = DeviceDecoder(r.schema, nonblocking_compile=True)
             self._decoders[r.table_id] = decoder
         lens = np.fromiter((len(p) for p in r.payloads), dtype=np.int32,

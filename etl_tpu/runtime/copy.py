@@ -267,7 +267,10 @@ async def parallel_table_copy(*, source_factory, primary_source,
     # nonblocking: cold decode programs compile off-thread while their
     # chunks decode on the oracle — an inline first-touch build of a wide
     # schema would freeze this sync worker past its stall deadline (see
-    # runtime/assembler._seal_run)
+    # runtime/assembler._seal_run). A configured program cache turns the
+    # first touch into a disk load instead: table re-syncs after a
+    # restart decode on the cached executable from chunk one
+    # (ops/program_store.py)
     decoder = DeviceDecoder(schema, nonblocking_compile=True) \
         if config.batch.batch_engine is BatchEngine.TPU else None
     progress = CopyProgress()

@@ -109,9 +109,19 @@ class Pipeline:
             # would run synchronously inside the apply loop at first
             # DeviceDecoder construction, stalling keepalives for every
             # table (round-5 advisor finding, ops/engine.py)
-            from ..ops import autotune
+            from ..ops import autotune, program_store
 
             await autotune.prewarm()
+            # program prewarm (ops/program_store.py): enumerate the
+            # SchemaStore's tables, resolve canonical layouts, and warm
+            # the deduped host-program keys before the apply loop sees
+            # traffic — disk hits load here (a warm restart reaches its
+            # first durable batch with ZERO fresh XLA builds), cold keys
+            # compile on the same background threads the streaming
+            # decoders' nonblocking_compile path uses. Runs on the
+            # executor, never on this loop.
+            await program_store.prewarm_pipeline(self.store,
+                                                 self.config.batch)
         # memory defense (reference pipeline.rs:168 MemoryMonitor::new +
         # batch_budget.rs): the monitor pauses WAL/COPY intake under RSS
         # pressure; the budget controller sizes batches by the active

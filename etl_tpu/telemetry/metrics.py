@@ -151,6 +151,20 @@ ETL_DECODE_DEVICE_OOM_FALLBACKS_TOTAL = \
 # that would wedge the apply loop into a stall-restart cycle)
 ETL_DECODE_BACKGROUND_COMPILES_TOTAL = \
     "etl_decode_background_compiles_total"
+# program store (ops/program_store.py): cache hits by layer (memory =
+# the in-process _SHARED_FN_CACHE, disk = a deserialized AOT
+# executable), misses by reason (absent = never compiled on this
+# version tag, invalid = corrupt/stale file deleted and rebuilt), disk
+# load latency, and ACTUAL XLA program builds — the counter the
+# warm-restart gates pin at zero (bench.py --coldstart, the chaos
+# crash_restart_warm_programs scenario). The canonical-layout gauge is
+# the number of distinct padded layouts live in this process: its ratio
+# to tables-seen is the compile sharing canonicalization buys.
+ETL_COMPILE_CACHE_HITS_TOTAL = "etl_compile_cache_hits_total"
+ETL_COMPILE_CACHE_MISSES_TOTAL = "etl_compile_cache_misses_total"
+ETL_COMPILE_CACHE_LOAD_SECONDS = "etl_compile_cache_load_seconds"
+ETL_PROGRAMS_COMPILED_TOTAL = "etl_programs_compiled_total"
+ETL_DECODE_CANONICAL_LAYOUTS = "etl_decode_canonical_layouts"
 # supervision subsystem (etl_tpu/supervision): watchdog detections by
 # kind+component, cancel-and-restart escalations, the pipeline health
 # state (0 healthy / 1 degraded / 2 faulted), the oldest heartbeat age

@@ -786,3 +786,131 @@ class TestCopyColumnarParse:
 
         with pytest.raises(EtlError):
             parse_copy_chunk_columns(b"1\t2\t3\n", [int(Oid.INT4)])
+
+
+# ---------------------------------------------------------------------------
+# Snowpipe NDJSON columnar encoder (ISSUE 12 satellite — the last
+# destination off the row path)
+# ---------------------------------------------------------------------------
+
+
+class TestSnowpipeNdjsonParity:
+    """encode_batch_ndjson must be byte-identical to the row path's
+    `json.dumps(_doc(...), separators=(",", ":"), ensure_ascii=False,
+    allow_nan=False) + "\\n"` on every kind and escape case."""
+
+    @staticmethod
+    def _reference_lines(schema, batch, ops, seqs):
+        from etl_tpu.destinations.bigquery import encode_value
+        from etl_tpu.destinations.snowflake import (CDC_OPERATION_COLUMN,
+                                                    CDC_SEQUENCE_COLUMN)
+
+        lines = []
+        for i in range(batch.num_rows):
+            doc = {c.schema.name: encode_value(c.value(i), c.schema.kind)
+                   for c in batch.columns}
+            doc[CDC_OPERATION_COLUMN] = \
+                ops if isinstance(ops, str) else ops[i]
+            doc[CDC_SEQUENCE_COLUMN] = \
+                seqs if isinstance(seqs, str) else seqs[i]
+            lines.append((json.dumps(doc, separators=(",", ":"),
+                                     ensure_ascii=False, allow_nan=False)
+                          + "\n").encode())
+        return lines
+
+    def test_every_kind_byte_identical(self):
+        from etl_tpu.destinations.snowflake import (encode_batch_ndjson,
+                                                    offset_token_batch)
+
+        schema = _kinds_schema()
+        batch = ColumnarBatch.from_rows(schema, _kinds_rows(12))
+        seqs = offset_token_batch(
+            np.arange(12, dtype=np.uint64) + (1 << 33),
+            np.arange(12, dtype=np.uint64))
+        got = encode_batch_ndjson(schema, batch, "insert", seqs)
+        assert got == self._reference_lines(schema, batch, "insert", seqs)
+
+    def test_engine_batch_byte_identical(self):
+        """The production shape: dense ints + Arrow strings straight off
+        the decode engine, mixed op labels."""
+        from etl_tpu.destinations.snowflake import (encode_batch_ndjson,
+                                                    offset_token_batch)
+
+        schema, ev = _engine_batch_event(n=96, tid=41050)
+        cb = CoalescedBatch([ev])
+        labels = ["insert" if i % 3 else "update" for i in range(96)]
+        seqs = offset_token_batch(cb.commit_lsns, cb.tx_ordinals)
+        got = encode_batch_ndjson(schema, cb.batch, labels, seqs)
+        assert got == self._reference_lines(schema, cb.batch, labels, seqs)
+
+    def test_unicode_and_escape_cases(self):
+        from etl_tpu.destinations.snowflake import encode_batch_ndjson
+
+        schema = _schema((ColumnSchema("s", Oid.TEXT),), tid=41051)
+        texts = ['plain', 'quote " inside', 'back\\slash', 'tab\tnl\n',
+                 'ctrl\x01\x1f', 'emoji 🚀 café', ' ls  ps',
+                 None, '']
+        rows = [TableRow([t]) for t in texts]
+        batch = ColumnarBatch.from_rows(schema, rows)
+        got = encode_batch_ndjson(schema, batch, "insert", "0" * 33)
+        assert got == self._reference_lines(schema, batch, "insert",
+                                            "0" * 33)
+
+    def test_nonfinite_float_raises_like_row_path(self):
+        from etl_tpu.destinations.snowflake import encode_batch_ndjson
+        from etl_tpu.models.errors import EtlError
+
+        schema = _schema((ColumnSchema("f", Oid.FLOAT8),), tid=41052)
+        batch = ColumnarBatch.from_rows(
+            schema, [TableRow([1.5]), TableRow([float("nan")])])
+        with pytest.raises(EtlError):
+            encode_batch_ndjson(schema, batch, "insert", "0" * 33)
+        # the row path refuses the same batch (allow_nan=False)
+        with pytest.raises(ValueError):
+            json.dumps({"f": float("nan")}, allow_nan=False)
+
+    def test_offset_token_batch_matches_scalar(self):
+        from etl_tpu.destinations.snowflake import offset_token_batch
+        from etl_tpu.destinations.snowpipe import offset_token
+
+        lsns = [0, 1, 0xdeadbeef, (1 << 64) - 1]
+        ords = [0, 7, 123456789, (1 << 40) + 3]
+        assert offset_token_batch(lsns, ords) == \
+            [offset_token(l, o) for l, o in zip(lsns, ords)]
+
+    def test_push_encoded_line_equals_push_row(self):
+        pytest.importorskip("zstandard")
+        from etl_tpu.destinations.snowflake import (CDC_OPERATION_COLUMN,
+                                                    CDC_SEQUENCE_COLUMN,
+                                                    encode_batch_ndjson)
+        from etl_tpu.destinations.snowpipe import RowBatchBuilder
+
+        schema = _kinds_schema()
+        batch = ColumnarBatch.from_rows(schema, _kinds_rows(8))
+        seq = "0" * 16 + "/" + "0" * 16
+        row_builder = RowBatchBuilder()
+        for i in range(batch.num_rows):
+            from etl_tpu.destinations.bigquery import encode_value
+
+            doc = {c.schema.name: encode_value(c.value(i), c.schema.kind)
+                   for c in batch.columns}
+            doc[CDC_OPERATION_COLUMN] = "insert"
+            doc[CDC_SEQUENCE_COLUMN] = seq
+            row_builder.push_row(doc, seq)
+        col_builder = RowBatchBuilder()
+        for line in encode_batch_ndjson(schema, batch, "insert", seq):
+            col_builder.push_encoded_line(line, seq)
+        a, b = row_builder.finish(), col_builder.finish()
+        assert [(x.data, x.row_count, x.start_offset, x.end_offset)
+                for x in a] == \
+            [(x.data, x.row_count, x.start_offset, x.end_offset)
+             for x in b]
+
+    def test_hot_loop_marked(self):
+        """etl-lint rule 13 territory: the encoder is @hot_loop so row
+        materialization can never creep into it unnoticed."""
+        from etl_tpu.analysis.annotations import HOT_LOOP_ATTR
+        from etl_tpu.destinations import snowflake
+
+        assert getattr(snowflake.encode_batch_ndjson, HOT_LOOP_ATTR, False)
+        assert getattr(snowflake._column_json_texts, HOT_LOOP_ATTR, False)

@@ -470,3 +470,10 @@ class TestBenchSmoke:
         assert out["sharded_union_covers_all_tables"] is True
         assert out["sharded_events_per_sec"] >= \
             out["sharded_floor_events_per_sec"]
+        # program-cache coldstart gate (ISSUE 12): the warm restart must
+        # compile ZERO fresh XLA programs — its first durable batch is
+        # served from disk-loaded executables, and the cold run's
+        # compile count is bounded by canonical layouts, not tables
+        assert out["coldstart_ok"] is True, out["coldstart_failures"]
+        assert out["coldstart_warm_zero_compiles"] is True
+        assert out["coldstart_failures"] == []
