@@ -262,6 +262,78 @@ def run_mesh_check(n_rows: int = 65_536, iters: int = 5) -> dict:
     return out
 
 
+def run_autoscale_bench(seed: int = 7, reaction_ticks_max: int = 3) -> dict:
+    """Autoscale reaction-time gate (ISSUE 13): the seeded surge→drain
+    timeline through the scaling policy with the applied-K loop closed.
+    GATED: (a) the scale-up decision lands within `reaction_ticks_max`
+    evaluation ticks of the surge onset; (b) the scale-down must NOT
+    fire before the cooldown expires after the scale-up; (c) the
+    topology returns to the starting K once the backlog drains; (d) the
+    decision trace is bit-identical across two runs of the same seed —
+    the determinism the chaos replay contract rests on. Pure policy
+    arithmetic: no pipeline, no accelerator, milliseconds of wall
+    clock."""
+    from etl_tpu.autoscale import (ACTION_DOWN, ACTION_HOLD, ACTION_UP,
+                                   AutoscalePolicy, AutoscalePolicyConfig,
+                                   seeded_surge_timeline)
+    from etl_tpu.autoscale.policy import simulate
+
+    surge_at = 10
+    config = AutoscalePolicyConfig(
+        min_shards=2, max_shards=3, drain_slo_s=2.0,
+        up_backlog_bytes=256 * 1024, down_backlog_bytes=64 * 1024,
+        up_ticks=2, down_ticks=3, cooldown_ticks=5)
+    policy = AutoscalePolicy(config)
+
+    def trace():
+        timeline = seeded_surge_timeline(seed, shards=2, ticks=40,
+                                         surge_at=surge_at)
+        return [d.describe()
+                for d in simulate(timeline.frames, policy, 2)]
+
+    first, second = trace(), trace()
+    actions = [(d["tick"], d["action"], d["target_k"]) for d in first
+               if d["action"] != ACTION_HOLD]
+    up_ticks = [t for t, a, _ in actions if a == ACTION_UP]
+    down_ticks = [t for t, a, _ in actions if a == ACTION_DOWN]
+    failures = []
+    if first != second:
+        failures.append("decision trace not deterministic across two "
+                        "runs of the same seed")
+    if not up_ticks:
+        failures.append("the surge never produced a scale-up decision")
+    elif up_ticks[0] - surge_at > reaction_ticks_max:
+        failures.append(
+            f"scale-up reacted in {up_ticks[0] - surge_at} ticks, gate "
+            f"is {reaction_ticks_max}")
+    if not down_ticks:
+        failures.append("the drain never produced a scale-down decision")
+    elif up_ticks and down_ticks[0] - up_ticks[0] < config.cooldown_ticks:
+        failures.append(
+            f"scale-down fired {down_ticks[0] - up_ticks[0]} ticks after "
+            f"the scale-up, inside the {config.cooldown_ticks}-tick "
+            f"cooldown")
+    final_k = actions[-1][2] if actions else 2
+    if final_k != 2:
+        failures.append(f"topology did not return to K=2 after the "
+                        f"drain (final K={final_k})")
+    return {
+        "mode": "autoscale",
+        "seed": seed,
+        "surge_at_tick": surge_at,
+        "scale_up_tick": up_ticks[0] if up_ticks else None,
+        "scale_down_tick": down_ticks[0] if down_ticks else None,
+        "reaction_ticks": (up_ticks[0] - surge_at) if up_ticks else None,
+        "reaction_ticks_max": reaction_ticks_max,
+        "cooldown_ticks": config.cooldown_ticks,
+        "decisions": [{"tick": t, "action": a, "target_k": k}
+                      for t, a, k in actions],
+        "deterministic": first == second,
+        "failures": failures,
+        "ok": not failures,
+    }
+
+
 def run_smoke() -> dict:
     """CI gate: CPU backend, small batches, pipelined decode must be
     byte-identical to serial decode() and the stage histograms must have
@@ -428,6 +500,22 @@ def run_smoke() -> dict:
         fetch_slack=floors.get("selectivity_fetch_slack", 0.11))
     selectivity_ok = selectivity["ok"]
 
+    # autoscale gates (ISSUE 13): (a) the policy reaction-time gate —
+    # seeded surge must produce a scale-up decision within the tick
+    # budget, the scale-down must wait out the cooldown, and the
+    # decision trace must be deterministic per seed (pure policy
+    # arithmetic — milliseconds); (b) the end-to-end elasticity chaos
+    # scenario — a seeded backlog surge scales a LIVE K=2 fleet to 3
+    # via the controller while traffic flows, the drain scales back to
+    # 2 only after the cooldown, and zero-loss/bounded-dup invariants
+    # hold across both rebalances
+    autoscale = run_autoscale_bench(
+        reaction_ticks_max=floors.get("autoscale_reaction_ticks_max", 3))
+    from etl_tpu.chaos.autoscale import run_autoscale_surge_drain
+
+    autoscale_chaos = asyncio.run(run_autoscale_surge_drain(seed=7))
+    autoscale_ok = autoscale["ok"] and autoscale_chaos.ok
+
     # program-cache coldstart gate (ISSUE 12): two replicator subprocess
     # lifetimes against one cache dir — the warm restart must compile
     # ZERO fresh XLA programs and serve its first durable batch from
@@ -513,7 +601,16 @@ def run_smoke() -> dict:
                    and heartbeat_ok and lint_ok and no_row_path
                    and egress_ok and workload_ok and mesh_ok and mp_ok
                    and sharded_chaos_ok and sharded_ok
-                   and selectivity_ok and coldstart_ok),
+                   and selectivity_ok and coldstart_ok
+                   and autoscale_ok),
+        "autoscale_ok": bool(autoscale_ok),
+        "autoscale_reaction_ticks": autoscale["reaction_ticks"],
+        "autoscale_scale_up_tick": autoscale["scale_up_tick"],
+        "autoscale_scale_down_tick": autoscale["scale_down_tick"],
+        "autoscale_deterministic": bool(autoscale["deterministic"]),
+        "autoscale_failures": autoscale["failures"],
+        "autoscale_chaos_ok": bool(autoscale_chaos.ok),
+        "autoscale_chaos": autoscale_chaos.describe(),
         "selectivity_ok": bool(selectivity_ok),
         "selectivity": selectivity,
         "coldstart_ok": bool(coldstart_ok),
@@ -657,7 +754,7 @@ def main():
                         choices=["decode", "table_copy", "table_streaming",
                                  "wide_row", "lag", "egress", "workload",
                                  "multi_pipeline", "mesh_check",
-                                 "selectivity", "coldstart"])
+                                 "selectivity", "coldstart", "autoscale"])
     parser.add_argument("--multi-pipeline", dest="multi_pipeline",
                         action="store_true",
                         help="alias for --mode multi_pipeline: N "
@@ -718,6 +815,17 @@ def main():
                              "restart performs 0 fresh XLA builds' via "
                              "the compile counter (wall clock recorded, "
                              "not gated, on this CPU container)")
+    parser.add_argument("--autoscale", dest="autoscale",
+                        action="store_true",
+                        help="alias for --mode autoscale: the seeded "
+                             "surge→drain timeline through the scaling "
+                             "policy (etl_tpu/autoscale) with the "
+                             "applied-K loop closed; gates scale-up "
+                             "reaction time <= "
+                             "autoscale_reaction_ticks_max evaluation "
+                             "ticks, no scale-down inside the cooldown, "
+                             "return to the starting K, and a "
+                             "bit-identical decision trace per seed")
     parser.add_argument("--workload", default=None, metavar="PROFILE",
                         help="workload matrix mode: run the named workload "
                              "profile (etl_tpu/workloads; 'all' = every "
@@ -740,6 +848,20 @@ def main():
         args.mode = "egress"
     if args.coldstart:
         args.mode = "coldstart"
+    if args.autoscale:
+        args.mode = "autoscale"
+    if args.mode == "autoscale":
+        # pure policy arithmetic over the seeded synthetic timeline:
+        # never touches a device backend or the accelerator tunnel
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_FLOOR.json")) as f:
+            floors = json.load(f)
+        out = run_autoscale_bench(
+            seed=args.seed,
+            reaction_ticks_max=floors.get("autoscale_reaction_ticks_max",
+                                          3))
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
     if args.mode == "coldstart":
         # subprocess workers pin their own CPU platform; the parent never
         # inits a backend

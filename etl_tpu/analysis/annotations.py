@@ -80,6 +80,29 @@ def shard_scoped(fn: _F) -> _F:
     return fn
 
 
+#: attribute set by @control_loop (runtime-introspectable, same lexical
+#: matching caveat as HOT_LOOP_ATTR)
+CONTROL_LOOP_ATTR = "__etl_control_loop__"
+
+
+def control_loop(fn: _F) -> _F:
+    """Mark `fn` as part of the autoscaling control loop's DECISION path
+    (etl_tpu/autoscale): the pure signal→policy→decision computation a
+    controller tick runs between sampling and actuation. etl-lint's
+    `control-loop-blocking-io` rule forbids blocking I/O (time.sleep,
+    open, subprocess, sockets, requests) AND all device traffic
+    (jax.device_get / device_put / .block_until_ready / np.asarray on
+    device values) here: the policy must stay a pure, property-testable
+    function of (SignalFrame history, config) — a blocking call makes
+    decision latency depend on an external service, and a device fetch
+    couples shard-count control to accelerator health, which is exactly
+    the dependency loop an autoscaler must never have (a sick device
+    delaying the decision that would route around it). Store writes and
+    orchestrator calls belong in the (async, unmarked) actuation path."""
+    setattr(fn, CONTROL_LOOP_ATTR, True)
+    return fn
+
+
 def dispatch_stage(fn: _F) -> _F:
     """Mark `fn` as the decode pipeline's DISPATCH stage (ops/pipeline.py
     architecture): a hot-loop function whose job is to start device work,

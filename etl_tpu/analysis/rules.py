@@ -683,6 +683,61 @@ class CrossShardTableAccess(Rule):
             f"the cross-shard sweep with an inline ignore")
 
 
+# -- rule 16 ------------------------------------------------------------------
+
+#: forbidden inside the autoscaling decision path: every blocking-I/O
+#: sink rule 1 knows about, PLUS all device traffic (the admission set —
+#: fetches AND uploads). The decision must be a pure function of the
+#: already-sampled signal history: a blocking call ties decision latency
+#: to an external service, a device call ties shard-count control to
+#: accelerator health — the dependency loop an autoscaler must not have
+#: (a sick device delaying the decision that would route around it).
+CONTROL_LOOP_BLOCKING_DOTTED = BLOCKING_DOTTED | ADMISSION_DEVICE_DOTTED
+CONTROL_LOOP_BLOCKING_BARE = BLOCKING_BARE
+CONTROL_LOOP_BLOCKING_METHODS = ADMISSION_DEVICE_METHODS
+
+
+class ControlLoopBlockingIo(Rule):
+    """Blocking I/O or device traffic inside the autoscaling control
+    loop's decision path (`@control_loop`, etl_tpu/autoscale): the
+    signal→policy→decision computation must stay a pure, seeded-
+    replayable function of (SignalFrame history, config) — that is what
+    makes the policy property-testable and the decision trace
+    deterministic per seed. Sampling (async store/registry reads) and
+    actuation (coordinator/orchestrator calls) live OUTSIDE the marked
+    path. Lexical, same sanctioning machinery as @dispatch_stage: the
+    frame flag inherits into nested defs and lambdas (inline capacity
+    estimators, sort keys), not across call edges — keep helpers called
+    from the decision path free of blocking I/O or annotate them too."""
+
+    name = "control-loop-blocking-io"
+
+    def on_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not ctx.in_control_loop:
+            return
+        dotted = dotted_name(node.func)
+        subject = None
+        if dotted in CONTROL_LOOP_BLOCKING_DOTTED:
+            subject = dotted
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in CONTROL_LOOP_BLOCKING_BARE:
+            subject = node.func.id
+        else:
+            term = terminal_name(node.func)
+            if term in CONTROL_LOOP_BLOCKING_METHODS \
+                    and isinstance(node.func, ast.Attribute):
+                subject = f".{term}"
+        if subject is None:
+            return
+        ctx.report(
+            self.name, node, subject,
+            f"blocking/device call `{subject}` inside a @control_loop "
+            f"function: the autoscale decision path must be a pure "
+            f"function of the sampled signal history — move I/O to the "
+            f"collector (sampling) or the controller's actuation, or "
+            f"justify with an inline ignore")
+
+
 # -- entry points -------------------------------------------------------------
 
 def default_rules() -> list[Rule]:
@@ -698,6 +753,7 @@ def default_rules() -> list[Rule]:
         HotLoopRowMaterialization(),
         AdmissionBlockingFetch(),
         CrossShardTableAccess(),
+        ControlLoopBlockingIo(),
     ]
 
 

@@ -149,6 +149,14 @@ ADMISSION_PATH_DECORATORS = frozenset({"admission_path"})
 #: defs/lambdas.
 SHARD_SCOPED_DECORATORS = frozenset({"shard_scoped"})
 
+#: decorator marking the autoscaling control loop's decision path
+#: (annotations.control_loop): the control-loop-blocking-io rule forbids
+#: blocking I/O and ALL device traffic there — the policy must stay a
+#: pure function of (signal history, config). Same sanctioning machinery
+#: as @dispatch_stage: a lexical frame flag inherited by nested
+#: defs/lambdas (inline capacity estimators, comparator keys).
+CONTROL_LOOP_DECORATORS = frozenset({"control_loop"})
+
 
 def dotted_name(node: ast.AST) -> str | None:
     """`a.b.c` for a Name/Attribute chain, else None."""
@@ -233,17 +241,18 @@ class Rule:
 
 class _Frame:
     __slots__ = ("name", "is_async", "is_hot", "is_dispatch",
-                 "is_admission", "is_shard_scoped")
+                 "is_admission", "is_shard_scoped", "is_control")
 
     def __init__(self, name: str, is_async: bool, is_hot: bool,
                  is_dispatch: bool = False, is_admission: bool = False,
-                 is_shard_scoped: bool = False):
+                 is_shard_scoped: bool = False, is_control: bool = False):
         self.name = name
         self.is_async = is_async
         self.is_hot = is_hot
         self.is_dispatch = is_dispatch
         self.is_admission = is_admission
         self.is_shard_scoped = is_shard_scoped
+        self.is_control = is_control
 
 
 class LintContext(ast.NodeVisitor):
@@ -283,6 +292,10 @@ class LintContext(ast.NodeVisitor):
     @property
     def in_shard_scoped(self) -> bool:
         return bool(self._frames) and self._frames[-1].is_shard_scoped
+
+    @property
+    def in_control_loop(self) -> bool:
+        return bool(self._frames) and self._frames[-1].is_control
 
     @property
     def current_class(self) -> "str | None":
@@ -335,6 +348,8 @@ class LintContext(ast.NodeVisitor):
             or self.in_admission_path
         is_shard_scoped = bool(decorators & SHARD_SCOPED_DECORATORS) \
             or self.in_shard_scoped
+        is_control = bool(decorators & CONTROL_LOOP_DECORATORS) \
+            or self.in_control_loop
         for rule in self.rules:
             rule.on_function(self, node)
         # decorators, default args, and annotations execute ONCE at def
@@ -350,7 +365,7 @@ class LintContext(ast.NodeVisitor):
                 self.visit(node.returns)
             self._frames.append(_Frame(node.name, is_async, is_hot,
                                        is_dispatch, is_admission,
-                                       is_shard_scoped))
+                                       is_shard_scoped, is_control))
             try:
                 for stmt in node.body:
                     self.visit(stmt)
@@ -375,7 +390,8 @@ class LintContext(ast.NodeVisitor):
             self._frames.append(_Frame("<lambda>", False, self.in_hot_loop,
                                        self.in_dispatch_stage,
                                        self.in_admission_path,
-                                       self.in_shard_scoped))
+                                       self.in_shard_scoped,
+                                       self.in_control_loop))
             try:
                 self.visit(node.body)
             finally:

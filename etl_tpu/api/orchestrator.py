@@ -73,6 +73,29 @@ class Orchestrator(abc.ABC):
         await self.stop_pipeline(spec.pipeline_id)
         await self.start_pipeline(spec)
 
+    async def scale_pipeline(self, spec: ReplicatorSpec,
+                             shard_count: int) -> None:
+        """Roll the deployment onto a new shard count (the autoscale
+        controller's actuation seam, etl_tpu/autoscale). Re-applies the
+        spec with the new K: start_pipeline's own fan-out/reap semantics
+        do the rest — one replica set (or subprocess) per shard, stale
+        higher-index shards and rolled-back-to-unsharded fleets reaped,
+        pods told their slice via shard/shard_count config keys. Must be
+        called AFTER the ShardCoordinator's epoch flip: the store fence
+        refuses any stale pod that outlives the roll, so ordering errors
+        degrade to refused writes, never double ownership."""
+        import dataclasses
+
+        if shard_count < 1:
+            raise EtlError(ErrorKind.CONFIG_INVALID,
+                           f"shard_count must be >= 1, got {shard_count}")
+        # strip a stale per-shard pin: the fan-out re-derives each pod's
+        # `shard` key; carrying an old one would pin every pod to it
+        base_config = {k: v for k, v in spec.config.items() if k != "shard"}
+        base_config["shard_count"] = shard_count
+        await self.start_pipeline(dataclasses.replace(
+            spec, shard=None, shard_count=shard_count, config=base_config))
+
     async def delete_pipeline(self, pipeline_id: int) -> None:
         """Permanent teardown. Unlike stop (a pause, paired with start),
         delete may destroy pipeline-owned storage."""

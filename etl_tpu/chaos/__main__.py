@@ -54,6 +54,18 @@ def main(argv: list[str] | None = None) -> int:
                              "per-shard AND cross-shard-union invariants "
                              "must hold, and no shard may see another's "
                              "tables")
+    parser.add_argument("--autoscale", dest="autoscale",
+                        action="store_true",
+                        help="run the closed-loop elasticity scenarios "
+                             "instead of the corpus: a seeded backlog "
+                             "surge must scale K=2->3 under flowing "
+                             "traffic via the autoscale controller, the "
+                             "drain must scale back 3->2 only after the "
+                             "cooldown, invariants must hold across both "
+                             "rebalances; then the controller is hard-"
+                             "killed mid-rebalance and a successor must "
+                             "resume via the persisted decision journal "
+                             "with no leaked slots")
     parser.add_argument("--list", action="store_true",
                         help="list scenario names and exit")
     parser.add_argument("--timeout", type=float, default=60.0,
@@ -76,15 +88,35 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.multi_pipeline:
-        if args.matrix or args.workload or args.scenario or args.sharded:
+        if args.matrix or args.workload or args.scenario or args.sharded \
+                or args.autoscale:
             parser.error("--multi-pipeline runs its own two-stream "
                          "scenario and cannot be combined with "
-                         "--matrix/--workload/--scenario/--sharded")
+                         "--matrix/--workload/--scenario/--sharded/"
+                         "--autoscale")
         from .multi import run_multi_pipeline_scenario
 
         run = asyncio.run(run_multi_pipeline_scenario(seed=args.seed))
         print(json.dumps(run.describe(), sort_keys=True))
         return 0 if run.ok else 1
+
+    if args.autoscale:
+        if args.matrix or args.workload or args.scenario or args.sharded \
+                or args.multi_pipeline:
+            parser.error("--autoscale runs its own elasticity scenarios "
+                         "and cannot be combined with --matrix/"
+                         "--workload/--scenario/--sharded/"
+                         "--multi-pipeline")
+        from .autoscale import (run_autoscale_controller_crash,
+                                run_autoscale_surge_drain)
+
+        all_ok = True
+        for runner_fn in (run_autoscale_surge_drain,
+                          run_autoscale_controller_crash):
+            run = asyncio.run(runner_fn(seed=args.seed))
+            print(json.dumps(run.describe(), sort_keys=True))
+            all_ok = all_ok and run.ok
+        return 0 if all_ok else 1
 
     if args.sharded is not None:
         if args.matrix or args.workload or args.scenario:

@@ -82,13 +82,19 @@ STORE_PROGRESS_COMMIT = "store.progress_commit"
 # the crash-mid-rebalance window (docs/sharding.md)
 STORE_SHARD_COMMIT = "store.shard_commit"
 
+# autoscale decision-journal commits (store/memory.py, store/sql.py):
+# the controller persists each scale decision here BEFORE actuating —
+# a fault is the crash-before-actuation window the resume protocol
+# covers (etl_tpu/autoscale/controller.py)
+STORE_AUTOSCALE_COMMIT = "store.autoscale_commit"
+
 CHAOS_SITES = (
     PIPELINE_PACK, PIPELINE_DISPATCH, PIPELINE_FETCH, ENGINE_DEVICE_OOM,
     COPY_PARTITION_START, COPY_PARTITION_END, ASSEMBLER_SEAL,
     APPLY_FRAME_READ,
     DESTINATION_WRITE, DESTINATION_FLUSH,
     STORE_STATE_COMMIT, STORE_SCHEMA_COMMIT, STORE_PROGRESS_COMMIT,
-    STORE_SHARD_COMMIT,
+    STORE_SHARD_COMMIT, STORE_AUTOSCALE_COMMIT,
 )
 
 #: sites that can stall asynchronously (an armed stall is consumed by the
@@ -99,7 +105,7 @@ ASYNC_STALL_SITES = (
     APPLY_FRAME_READ, DESTINATION_WRITE, DESTINATION_FLUSH,
     COPY_PARTITION_START, COPY_PARTITION_END,
     STORE_STATE_COMMIT, STORE_SCHEMA_COMMIT, STORE_PROGRESS_COMMIT,
-    STORE_SHARD_COMMIT,
+    STORE_SHARD_COMMIT, STORE_AUTOSCALE_COMMIT,
 )
 
 ALL_SITES = REFERENCE_SITES + CHAOS_SITES

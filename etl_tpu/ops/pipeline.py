@@ -163,6 +163,40 @@ class AdmissionScheduler:
         self._tenants: list[TenantAdmission] = []
         self._held_total = 0
         self._vt = 0.0  # global virtual time (max pass ever granted)
+        # per-tenant SLO weight inputs (etl_tpu/autoscale feeds these):
+        # a static business-priority multiplier composed WITH the dynamic
+        # lag weight — lag says who is behind right now, the SLO says
+        # whose backlog costs more per second. Keys match tenant names
+        # exactly or as a prefix ("cdc" covers "cdc-0", "cdc-1", …).
+        self._slo_weights: dict[str, float] = {}
+
+    def set_slo_weight(self, tenant: str, weight: float) -> None:
+        """Install (or update) one tenant's SLO weight. `tenant` is an
+        exact tenant name or a prefix; `weight` is clamped to
+        [1/max_weight, max_weight] so one tenant can neither zero itself
+        out nor starve the fleet past the aging valve's reach."""
+        lo = 1.0 / self._max_weight
+        with self._cond:
+            self._slo_weights[tenant] = min(max(float(weight), lo),
+                                            self._max_weight)
+            self._cond.notify_all()
+
+    @admission_path
+    def _slo_for(self, name: str) -> float:
+        """Exact-name match wins; otherwise the LONGEST prefix match
+        (tenant names carry per-stream suffixes the operator's config
+        cannot know: "cdc-3", "copy-16384-2"). Caller holds the lock or
+        tolerates a stale read — weights only drift, never tear."""
+        w = self._slo_weights.get(name)
+        if w is not None:
+            return w
+        best_len = -1
+        best = 1.0
+        for prefix, weight in self._slo_weights.items():
+            if name.startswith(prefix) and len(prefix) > best_len:
+                best_len = len(prefix)
+                best = weight
+        return best
 
     def register(self, name: str, lag_bytes=None,
                  monitor: "MemoryMonitor | None" = None) -> TenantAdmission:
@@ -197,13 +231,15 @@ class AdmissionScheduler:
 
     @admission_path
     def _weight(self, tenant: TenantAdmission) -> float:
+        slo = self._slo_for(tenant.name)
         if tenant._lag_bytes is None:
-            return 1.0
+            return max(slo, 1.0 / self._max_weight)
         try:
             lag = max(0.0, float(tenant._lag_bytes()))
         except Exception:  # a dying lag reader must not kill admission
             lag = 0.0
-        return min(1.0 + lag / self._lag_scale, self._max_weight)
+        return min(max(slo * (1.0 + lag / self._lag_scale),
+                       1.0 / self._max_weight), self._max_weight)
 
     @admission_path
     def _pick(self, now: float) -> "tuple[TenantAdmission, bool] | None":

@@ -49,6 +49,8 @@ from ..telemetry.metrics import (ETL_APPLY_LOOP_BATCHES_TOTAL,
                                  ETL_APPLY_LOOP_EVENTS_TOTAL,
                                  ETL_APPLY_LOOP_FLUSH_LAG_BYTES,
                                  ETL_APPLY_LOOP_RECEIVED_LAG_BYTES,
+                                 ETL_SHARD_DELIVERED_EVENTS,
+                                 ETL_SLOT_LAG_BYTES,
                                  ETL_TRANSACTION_SIZE_BYTES,
                                  ETL_TRANSACTIONS_TOTAL, registry)
 from . import failpoints
@@ -173,6 +175,9 @@ class ApplyLoop:
         # (the moment the producer pauses, normal deadlines resume)
         self._backlog_now = False
         self._ready_states: dict[TableId, bool] = {}
+        # durably delivered event count, published per shard on the
+        # status-update cadence (the autoscale collector's rate signal)
+        self._delivered_events = 0
         interval = config.schema_cleanup_interval_s
         self._next_schema_cleanup = (time.monotonic() + interval) \
             if interval > 0 and isinstance(ctx, ApplyContext) else None
@@ -697,6 +702,7 @@ class ApplyLoop:
                 ErrorKind.DESTINATION_FAILED, str(exc))
         if inflight.commit_end_lsn is None:
             return False
+        self._delivered_events += inflight.n_events
         self.state.durable_lsn = max(self.state.durable_lsn,
                                      inflight.commit_end_lsn)
         failpoints.fail_point(failpoints.ON_PROGRESS_STORE)
@@ -765,6 +771,21 @@ class ApplyLoop:
         registry.gauge_set(
             ETL_APPLY_LOOP_RECEIVED_LAG_BYTES,
             max(0, self.state.server_end_lsn - self.state.received_lsn))
+        if isinstance(self.ctx, ApplyContext):
+            # per-slot lag as a FIRST-CLASS series, on this loop's
+            # existing cadence: the same received−durable number the
+            # admission weight reads, labeled by shard so the autoscale
+            # collector and an operator dashboard read the identical
+            # gauge (table-sync loops deliberately excluded — their
+            # transient catchup slots would clobber the shard series)
+            shard_label = {"shard": str(self.config.shard or 0)}
+            registry.gauge_set(
+                ETL_SLOT_LAG_BYTES,
+                max(0, int(self.state.received_lsn)
+                    - int(self.state.durable_lsn)),
+                labels=shard_label)
+            registry.gauge_set(ETL_SHARD_DELIVERED_EVENTS,
+                               self._delivered_events, labels=shard_label)
         flush = self._effective_flush_lsn()
         self.state.last_status_flush_lsn = flush
         await self.stream.send_status_update(

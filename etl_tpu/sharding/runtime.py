@@ -184,6 +184,17 @@ class ShardScopedStore(PipelineStore):
             "shard-scoped runtimes cannot rewrite the shard assignment; "
             "drive rebalances through ShardCoordinator")
 
+    async def get_autoscale_journal(self) -> "dict | None":
+        return await self._inner.get_autoscale_journal()
+
+    async def update_autoscale_journal(self, journal: dict) -> None:
+        # pods never write scale decisions — only the (pod-external)
+        # AutoscaleController does, against the RAW store
+        raise EtlError(
+            ErrorKind.SHARD_NOT_OWNED,
+            "shard-scoped runtimes cannot rewrite the autoscale journal; "
+            "drive scale decisions through AutoscaleController")
+
     # -- SchemaStore (shared, unguarded — see module docstring) ---------------
 
     async def store_table_schema(self, schema: ReplicatedTableSchema,

@@ -90,6 +90,27 @@ class StateStore(abc.ABC):
             ErrorKind.STATE_STORE_FAILED,
             f"{type(self).__name__} does not persist shard assignments")
 
+    # -- autoscale decision-journal surface (docs/autoscale.md) ---------------
+    # Same stance as the shard surface: concrete defaults so third-party
+    # and test stores that never autoscale keep working unchanged; the
+    # memory and sql backends override both with real persistence. The
+    # journal is one small JSON document (etl_tpu/autoscale/controller.py
+    # AutoscaleJournal shape) rewritten atomically per decision.
+
+    async def get_autoscale_journal(self) -> "dict | None":
+        """The persisted autoscale decision journal, or None when no
+        controller has ever run against this pipeline."""
+        return None
+
+    async def update_autoscale_journal(self, journal: dict) -> None:
+        """Persist the journal document. Decision ids inside it are
+        MONOTONIC; storing a journal whose latest decision id is lower
+        than the current record's is a typed error (a stale controller
+        must never rewind the decision history)."""
+        raise EtlError(
+            ErrorKind.STATE_STORE_FAILED,
+            f"{type(self).__name__} does not persist autoscale journals")
+
     @abc.abstractmethod
     async def get_destination_metadata(
         self, table_id: TableId) -> DestinationTableMetadata | None: ...

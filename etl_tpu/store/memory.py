@@ -28,6 +28,7 @@ class MemoryStore(PipelineStore):
             defaultdict(list)  # sorted by snapshot id
         self._dest_meta: dict[TableId, DestinationTableMetadata] = {}
         self._shard_assignment: ShardAssignment | None = None
+        self._autoscale_journal: dict | None = None
 
     # -- StateStore ----------------------------------------------------------
 
@@ -92,6 +93,23 @@ class MemoryStore(PipelineStore):
         failpoints.fail_point(failpoints.STORE_SHARD_COMMIT)
         await failpoints.stall_point(failpoints.STORE_SHARD_COMMIT)
         self._shard_assignment = assignment
+
+    # -- autoscale decision journal ------------------------------------------
+
+    async def get_autoscale_journal(self) -> dict | None:
+        return self._autoscale_journal
+
+    async def update_autoscale_journal(self, journal: dict) -> None:
+        cur = self._autoscale_journal
+        if cur is not None and int(journal.get("next_id", 0)) \
+                < int(cur.get("next_id", 0)):
+            raise EtlError(
+                ErrorKind.PROGRESS_REGRESSION,
+                f"autoscale journal id regression: {cur.get('next_id')} "
+                f"-> {journal.get('next_id')}")
+        failpoints.fail_point(failpoints.STORE_AUTOSCALE_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_AUTOSCALE_COMMIT)
+        self._autoscale_journal = journal
 
     # -- SchemaStore ---------------------------------------------------------
 

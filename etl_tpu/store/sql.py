@@ -83,6 +83,13 @@ CREATE TABLE IF NOT EXISTS etl_shard_assignment (
     PRIMARY KEY (pipeline_id)
 );
 """),
+    ("20260804000000_autoscale_journal", """
+CREATE TABLE IF NOT EXISTS etl_autoscale_journal (
+    pipeline_id BIGINT NOT NULL,
+    journal_json TEXT NOT NULL,
+    PRIMARY KEY (pipeline_id)
+);
+"""),
 ]
 
 
@@ -271,6 +278,35 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
             (self.pipeline_id, json.dumps(assignment.to_json())))
         self._shard_assignment = assignment
 
+    # -- autoscale decision journal ------------------------------------------
+
+    async def get_autoscale_journal(self) -> dict | None:
+        """Read-through like the shard assignment (not cache-first): the
+        journal is rewritten by the CONTROLLER process underneath running
+        pods, and a crashed controller's successor must see the latest
+        persisted decision, not a connect-time snapshot."""
+        rows = await self._run(
+            "SELECT journal_json FROM etl_autoscale_journal "
+            "WHERE pipeline_id = ?", (self.pipeline_id,))
+        return json.loads(rows[0][0]) if rows else None
+
+    async def update_autoscale_journal(self, journal: dict) -> None:
+        cur = await self.get_autoscale_journal()
+        if cur is not None and int(journal.get("next_id", 0)) \
+                < int(cur.get("next_id", 0)):
+            raise EtlError(
+                ErrorKind.PROGRESS_REGRESSION,
+                f"autoscale journal id regression: {cur.get('next_id')} "
+                f"-> {journal.get('next_id')}")
+        failpoints.fail_point(failpoints.STORE_AUTOSCALE_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_AUTOSCALE_COMMIT)
+        await self._run(
+            "INSERT INTO etl_autoscale_journal "
+            "(pipeline_id, journal_json) VALUES (?, ?) "
+            "ON CONFLICT (pipeline_id) DO UPDATE SET "
+            "journal_json = excluded.journal_json",
+            (self.pipeline_id, json.dumps(journal)))
+
     # -- SchemaStore ---------------------------------------------------------
 
     async def store_table_schema(self, schema: ReplicatedTableSchema,
@@ -393,12 +429,12 @@ class SqliteStore(_SqlStoreBase):
 import functools
 
 
-# The four store tables, flat (sqlite) spelling. The Postgres dialect
-# maps EXACTLY these into the `etl` schema; the fake server reverses the
+# The store tables, flat (sqlite) spelling. The Postgres dialect maps
+# EXACTLY these into the `etl` schema; the fake server reverses the
 # same list — one source of truth, no drift.
 STORE_TABLE_NAMES = ("etl_replication_state", "etl_table_schemas",
                      "etl_table_mappings", "etl_replication_progress",
-                     "etl_shard_assignment")
+                     "etl_shard_assignment", "etl_autoscale_journal")
 
 _QUALIFY_RE = re.compile(r"\b(" + "|".join(STORE_TABLE_NAMES) + r")\b")
 

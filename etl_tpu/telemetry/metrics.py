@@ -165,6 +165,25 @@ ETL_COMPILE_CACHE_MISSES_TOTAL = "etl_compile_cache_misses_total"
 ETL_COMPILE_CACHE_LOAD_SECONDS = "etl_compile_cache_load_seconds"
 ETL_PROGRAMS_COMPILED_TOTAL = "etl_programs_compiled_total"
 ETL_DECODE_CANONICAL_LAYOUTS = "etl_decode_canonical_layouts"
+# closed-loop autoscaling (etl_tpu/autoscale): per-shard replication lag
+# as a FIRST-CLASS gauge, sampled on the apply loop's existing
+# status-update cadence — the same received−durable number the admission
+# weight reads, so the autoscale collector and a human operator stare at
+# the identical series (no ad-hoc lag.py query drift). The decision
+# metrics mirror the policy's outputs: the last raw rate-model target,
+# the aggregate backlog and estimated per-shard drain capacity it was
+# computed from, applied decisions by direction (up/down), holds by
+# reason (cooldown/band/in_flight/unhealthy), and whether an actuation
+# (two-phase rebalance + orchestrator roll) is currently in flight.
+ETL_SLOT_LAG_BYTES = "etl_slot_lag_bytes"
+ETL_SHARD_DELIVERED_EVENTS = "etl_shard_delivered_events"
+ETL_AUTOSCALE_TARGET_SHARDS = "etl_autoscale_target_shards"
+ETL_AUTOSCALE_BACKLOG_BYTES = "etl_autoscale_backlog_bytes"
+ETL_AUTOSCALE_CAPACITY_BYTES_PER_S = "etl_autoscale_capacity_bytes_per_s"
+ETL_AUTOSCALE_DECISIONS_TOTAL = "etl_autoscale_decisions_total"
+ETL_AUTOSCALE_HOLDS_TOTAL = "etl_autoscale_holds_total"
+ETL_AUTOSCALE_DECISION_IN_FLIGHT = "etl_autoscale_decision_in_flight"
+ETL_AUTOSCALE_RESUMES_TOTAL = "etl_autoscale_resumes_total"
 # supervision subsystem (etl_tpu/supervision): watchdog detections by
 # kind+component, cancel-and-restart escalations, the pipeline health
 # state (0 healthy / 1 degraded / 2 faulted), the oldest heartbeat age

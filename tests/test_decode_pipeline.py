@@ -477,3 +477,14 @@ class TestBenchSmoke:
         assert out["coldstart_ok"] is True, out["coldstart_failures"]
         assert out["coldstart_warm_zero_compiles"] is True
         assert out["coldstart_failures"] == []
+        # autoscale gates (ISSUE 13): the policy reaction-time gate
+        # (seeded surge -> scale-up within the tick budget, scale-down
+        # only after the cooldown, deterministic trace) AND the
+        # end-to-end elasticity chaos scenario (a live K=2 fleet scales
+        # to 3 under flowing traffic via the controller and back after
+        # the cooldown, invariants across both rebalances)
+        assert out["autoscale_ok"] is True, out["autoscale_failures"]
+        assert out["autoscale_reaction_ticks"] <= 3
+        assert out["autoscale_deterministic"] is True
+        assert out["autoscale_chaos_ok"] is True, out["autoscale_chaos"]
+        assert out["autoscale_chaos"]["union_matches"] is True
