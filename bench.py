@@ -529,6 +529,23 @@ def run_smoke() -> dict:
         txs_per_table=floors.get("coldstart_smoke_txs_per_table", 1))
     coldstart_ok = coldstart["ok"]
 
+    # windowed-ack gate (ISSUE 14): the same deterministic backlog
+    # drained through the default write window and through a forced
+    # window=1 run against a destination with real ack latency
+    # (destinations/delay.py). GATED: aggregate speedup ≥
+    # ack_window_speedup_floor, byte-identical delivery digests,
+    # window=1 never holds >1 ack in flight, the windowed run provably
+    # overlaps (max pending ≥ 2, nonzero overlap seconds)
+    ack = asyncio.run(harness.run_ack_latency(
+        ack_ms=floors.get("ack_latency_smoke_ms", 20)))
+    ack_floor = floors.get("ack_window_speedup_floor", 0)
+    ack_failures = list(ack["failures"])
+    if ack["ack_window_speedup"] < ack_floor:
+        ack_failures.append(
+            f"ack-window speedup {ack['ack_window_speedup']} under floor "
+            f"{ack_floor}")
+    ack_ok = not ack_failures
+
     # multi-pipeline tenancy gate (ISSUE 8): ≥2 concurrent streams
     # sharing one device set through the fair batch-admission scheduler,
     # every stream's end state verified, aggregate events/s above the
@@ -602,7 +619,14 @@ def run_smoke() -> dict:
                    and egress_ok and workload_ok and mesh_ok and mp_ok
                    and sharded_chaos_ok and sharded_ok
                    and selectivity_ok and coldstart_ok
-                   and autoscale_ok),
+                   and autoscale_ok and ack_ok),
+        "ack_window_ok": bool(ack_ok),
+        "ack_window_speedup": ack["ack_window_speedup"],
+        "ack_window_speedup_floor": ack_floor,
+        "ack_window_overlap_ratio":
+            ack["windowed"]["ack_overlap_ratio"],
+        "ack_window_max_pending": ack["windowed"]["max_acks_pending"],
+        "ack_window_failures": ack_failures,
         "autoscale_ok": bool(autoscale_ok),
         "autoscale_reaction_ticks": autoscale["reaction_ticks"],
         "autoscale_scale_up_tick": autoscale["scale_up_tick"],
@@ -826,6 +850,18 @@ def main():
                              "ticks, no scale-down inside the cooldown, "
                              "return to the starting K, and a "
                              "bit-identical decision trace per seed")
+    parser.add_argument("--ack-latency", dest="ack_latency", type=float,
+                        default=None, metavar="MS",
+                        help="windowed-ack A/B mode: run the same "
+                             "deterministic CDC backlog against a "
+                             "destination whose acks turn durable MS "
+                             "milliseconds late, once at the default "
+                             "write window and once forced to window=1; "
+                             "gates the aggregate speedup against "
+                             "ack_window_speedup_floor in "
+                             "BENCH_FLOOR.json plus byte-identical "
+                             "delivery and the one-in-flight contract "
+                             "at window=1")
     parser.add_argument("--workload", default=None, metavar="PROFILE",
                         help="workload matrix mode: run the named workload "
                              "profile (etl_tpu/workloads; 'all' = every "
@@ -874,6 +910,28 @@ def main():
             n_tables=floors.get("coldstart_tables", 3),
             rows_per_tx=floors.get("coldstart_rows_per_tx", 800),
             txs_per_table=floors.get("coldstart_txs_per_table", 2))
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
+    if args.ack_latency is not None:
+        # full pipeline on the host CPU platform (CPU decode engine, fake
+        # walsender, latency-wrapped memory-style destination) — the ack
+        # window is the system under test; never touches the tunnel
+        import asyncio
+
+        jax.config.update("jax_platforms", "cpu")
+        from etl_tpu.benchmarks import harness
+
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_FLOOR.json")) as f:
+            floors = json.load(f)
+        out = asyncio.run(harness.run_ack_latency(ack_ms=args.ack_latency))
+        floor = floors.get("ack_window_speedup_floor", 0)
+        out["speedup_floor"] = floor
+        if out["ack_window_speedup"] < floor:
+            out["failures"].append(
+                f"ack-window speedup {out['ack_window_speedup']} under "
+                f"floor {floor}")
+            out["ok"] = False
         print(json.dumps(out))
         sys.exit(0 if out["ok"] else 1)
     if args.workload is not None:

@@ -103,6 +103,27 @@ def control_loop(fn: _F) -> _F:
     return fn
 
 
+#: attribute set by @flush_path (runtime-introspectable, same lexical
+#: matching caveat as HOT_LOOP_ATTR)
+FLUSH_PATH_ATTR = "__etl_flush_path__"
+
+
+def flush_path(fn: _F) -> _F:
+    """Mark `fn` as a destination flush/dispatch path (the apply loop's
+    flush machinery, the copy partition's chunk/drain path): code that
+    dispatches destination writes through the bounded ack window
+    (runtime/ack_window.py). etl-lint's `inline-durability-wait` rule
+    forbids a bare `await ack.wait_durable()` here — the WINDOW owns
+    durability waits (contiguous-prefix advance, per-entry timeout
+    bounds, overlap telemetry); an inline wait silently re-serializes
+    the pipeline to one ack round-trip per batch, the exact ceiling the
+    write window removes. Route acks through
+    `AckWindow.dispatch`/`CopyAckWindow.add`, or justify a deliberate
+    inline barrier with an inline ignore."""
+    setattr(fn, FLUSH_PATH_ATTR, True)
+    return fn
+
+
 def dispatch_stage(fn: _F) -> _F:
     """Mark `fn` as the decode pipeline's DISPATCH stage (ops/pipeline.py
     architecture): a hot-loop function whose job is to start device work,

@@ -738,6 +738,46 @@ class ControlLoopBlockingIo(Rule):
             f"justify with an inline ignore")
 
 
+# -- rule 17 ------------------------------------------------------------------
+
+#: the durability-wait terminal: awaiting it inline in a dispatch path
+#: re-serializes the pipeline to one ack round-trip per batch
+DURABILITY_WAIT_METHODS = frozenset({"wait_durable"})
+
+
+class InlineDurabilityWait(Rule):
+    """`await ack.wait_durable()` inside a `@flush_path` function (the
+    apply loop's flush machinery, the copy partition's chunk/drain path):
+    the bounded ack window (runtime/ack_window.py) OWNS durability waits
+    — it chains submissions in WAL order, overlaps up to
+    `BatchConfig.write_window` ack round-trips, advances durable
+    progress over the contiguous acked prefix, and carries the per-entry
+    timeout bounds and overlap telemetry. A bare inline wait silently
+    reintroduces the one-in-flight ceiling (`batch_size / ack_rtt`) the
+    window removes — route the ack through `AckWindow.dispatch` /
+    `CopyAckWindow.add`, or justify a deliberate inline barrier with an
+    inline ignore. Lexical, same sanctioning machinery as
+    @dispatch_stage: the frame flag inherits into nested defs and
+    lambdas (the flush submit closures), not across call edges."""
+
+    name = "inline-durability-wait"
+
+    def on_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not ctx.in_flush_path:
+            return
+        term = terminal_name(node.func)
+        if term not in DURABILITY_WAIT_METHODS \
+                or not isinstance(node.func, ast.Attribute):
+            return
+        ctx.report(
+            self.name, node, f".{term}()",
+            f"bare `.{term}()` inside a @flush_path function "
+            f"re-serializes the pipeline to one ack round-trip per "
+            f"batch; the ack window owns durability waits — dispatch "
+            f"through AckWindow/CopyAckWindow, or justify an inline "
+            f"barrier with an inline ignore")
+
+
 # -- entry points -------------------------------------------------------------
 
 def default_rules() -> list[Rule]:
@@ -754,6 +794,7 @@ def default_rules() -> list[Rule]:
         AdmissionBlockingFetch(),
         CrossShardTableAccess(),
         ControlLoopBlockingIo(),
+        InlineDurabilityWait(),
     ]
 
 

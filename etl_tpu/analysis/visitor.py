@@ -149,6 +149,15 @@ ADMISSION_PATH_DECORATORS = frozenset({"admission_path"})
 #: defs/lambdas.
 SHARD_SCOPED_DECORATORS = frozenset({"shard_scoped"})
 
+#: decorator marking destination flush/dispatch paths
+#: (annotations.flush_path): the inline-durability-wait rule forbids a
+#: bare `await ack.wait_durable()` there — the bounded ack window
+#: (runtime/ack_window.py) owns durability waits, and an inline wait
+#: re-serializes the pipeline to one ack round-trip per batch. Same
+#: sanctioning machinery as @dispatch_stage: a lexical frame flag
+#: inherited by nested defs/lambdas (the flush submit closures).
+FLUSH_PATH_DECORATORS = frozenset({"flush_path"})
+
 #: decorator marking the autoscaling control loop's decision path
 #: (annotations.control_loop): the control-loop-blocking-io rule forbids
 #: blocking I/O and ALL device traffic there — the policy must stay a
@@ -241,11 +250,13 @@ class Rule:
 
 class _Frame:
     __slots__ = ("name", "is_async", "is_hot", "is_dispatch",
-                 "is_admission", "is_shard_scoped", "is_control")
+                 "is_admission", "is_shard_scoped", "is_control",
+                 "is_flush")
 
     def __init__(self, name: str, is_async: bool, is_hot: bool,
                  is_dispatch: bool = False, is_admission: bool = False,
-                 is_shard_scoped: bool = False, is_control: bool = False):
+                 is_shard_scoped: bool = False, is_control: bool = False,
+                 is_flush: bool = False):
         self.name = name
         self.is_async = is_async
         self.is_hot = is_hot
@@ -253,6 +264,7 @@ class _Frame:
         self.is_admission = is_admission
         self.is_shard_scoped = is_shard_scoped
         self.is_control = is_control
+        self.is_flush = is_flush
 
 
 class LintContext(ast.NodeVisitor):
@@ -296,6 +308,10 @@ class LintContext(ast.NodeVisitor):
     @property
     def in_control_loop(self) -> bool:
         return bool(self._frames) and self._frames[-1].is_control
+
+    @property
+    def in_flush_path(self) -> bool:
+        return bool(self._frames) and self._frames[-1].is_flush
 
     @property
     def current_class(self) -> "str | None":
@@ -350,6 +366,8 @@ class LintContext(ast.NodeVisitor):
             or self.in_shard_scoped
         is_control = bool(decorators & CONTROL_LOOP_DECORATORS) \
             or self.in_control_loop
+        is_flush = bool(decorators & FLUSH_PATH_DECORATORS) \
+            or self.in_flush_path
         for rule in self.rules:
             rule.on_function(self, node)
         # decorators, default args, and annotations execute ONCE at def
@@ -365,7 +383,8 @@ class LintContext(ast.NodeVisitor):
                 self.visit(node.returns)
             self._frames.append(_Frame(node.name, is_async, is_hot,
                                        is_dispatch, is_admission,
-                                       is_shard_scoped, is_control))
+                                       is_shard_scoped, is_control,
+                                       is_flush))
             try:
                 for stmt in node.body:
                     self.visit(stmt)
@@ -391,7 +410,8 @@ class LintContext(ast.NodeVisitor):
                                        self.in_dispatch_stage,
                                        self.in_admission_path,
                                        self.in_shard_scoped,
-                                       self.in_control_loop))
+                                       self.in_control_loop,
+                                       self.in_flush_path))
             try:
                 self.visit(node.body)
             finally:

@@ -1467,3 +1467,220 @@ def run_selectivity(n_rows: int = 16_384, n_iters: int = 5,
         out["points"].append(point)
     out["ok"] = bool(all_ok)
     return out
+
+
+async def _ack_latency_run(write_window: int, ack_ms: float,
+                           n_events: int, tx_size: int,
+                           max_size_bytes: int, max_fill_ms: int,
+                           engine: str = "cpu") -> dict:
+    """One full-pipeline CDC run against a destination whose every ack
+    turns durable `ack_ms` later (destinations/delay.py). The producer
+    pre-commits the whole workload, so the run measures BACKLOG DRAIN
+    throughput with size-bounded batches: at window=1 each batch's ack
+    round trip serializes the next dispatch (the `batch_size / ack_rtt`
+    ceiling), at window=K the round trips overlap. Engine defaults to
+    the CPU per-tuple path: the bench isolates ACK PIPELINING, and at
+    the deliberately small batch sizes the latency model needs, the
+    device engine's per-sealed-run machinery (staging + admission + a
+    program call per ~threshold bytes) would dominate the measurement
+    on this host. Every delivered row folds into a BATCH-BOUNDARY-
+    INDEPENDENT digest (per-row records concatenate identically however
+    flushes were split) — the byte-identity evidence across window
+    depths."""
+    import hashlib
+
+    import numpy as np
+
+    from ..config import BatchConfig, BatchEngine, PipelineConfig
+    from ..destinations import DelayedAckDestination
+    from ..destinations.base import Destination, WriteAck
+    from ..models import (ColumnSchema, InsertEvent, Oid, TableName,
+                          TableSchema)
+    from ..models.event import DecodedBatchEvent
+    from ..models.table_state import TableStateType
+    from ..postgres.codec.pgoutput import encode_insert
+    from ..postgres.fake import FakeDatabase, FakeSource
+    from ..runtime import Pipeline
+    from ..store import NotifyingStore
+    from ..telemetry.metrics import (
+        ETL_DESTINATION_ACK_BUSY_SECONDS_TOTAL,
+        ETL_DESTINATION_ACK_OVERLAP_SECONDS_TOTAL, registry)
+
+    TID = 16391
+    db = FakeDatabase()
+    # all-dense columns: the delivery digest covers full content via
+    # column byte concatenation, no per-row Python on the measured path
+    db.create_table(TableSchema(
+        TID, TableName("public", "bench_ack"),
+        (ColumnSchema("id", Oid.INT8, nullable=False, primary_key_ordinal=1),
+         ColumnSchema("v", Oid.INT4))))
+    db.create_publication("pub", [TID])
+    store = NotifyingStore()
+
+    def _digest_batch(digest, e) -> int:
+        """Sync helper (host-side numpy — the batch is already resolved
+        to host arrays): PER-ROW interleaving (column_stack) keeps the
+        digest independent of how flushes were split — concatenating row
+        records across batches yields the same byte stream at every
+        window depth."""
+        batch = e.batch
+        fields = [np.asarray(e.change_types),
+                  np.asarray(e.commit_lsns),
+                  np.asarray(e.tx_ordinals)]
+        for c in batch.columns:
+            valid = np.asarray(c.validity)
+            fields.append(valid)
+            fields.append(np.where(valid, np.asarray(c.data), 0))
+        digest.update(np.column_stack(
+            [f.astype(np.uint64) for f in fields]).tobytes())
+        return batch.num_rows
+
+    def _digest_row(digest, e) -> int:
+        """CPU engine: per-row events; same per-row record shape as one
+        column_stack row, so the digest stays comparable across window
+        depths (not engines)."""
+        digest.update(np.asarray(
+            [1, int(e.commit_lsn), e.tx_ordinal,
+             1, int(e.row.values[0]), 1, int(e.row.values[1])],
+            dtype=np.uint64).tobytes())
+        return 1
+
+    class DigestingDestination(Destination):
+        def __init__(self):
+            self.rows_delivered = 0
+            self.digest = hashlib.sha256()
+
+        async def startup(self):
+            return None
+
+        async def write_table_rows(self, schema, batch):
+            return WriteAck.durable()
+
+        async def write_events(self, events):
+            for e in events:
+                if isinstance(e, DecodedBatchEvent):
+                    self.rows_delivered += _digest_batch(self.digest, e)
+                elif isinstance(e, InsertEvent):
+                    self.rows_delivered += _digest_row(self.digest, e)
+            return WriteAck.durable()
+
+        async def drop_table(self, table_id, schema=None):
+            return None
+
+        async def truncate_table(self, table_id):
+            return None
+
+    inner = DigestingDestination()
+    dest = DelayedAckDestination(inner, ack_ms / 1000.0)
+    labels = {"path": "apply"}
+    busy0 = registry.get_counter(ETL_DESTINATION_ACK_BUSY_SECONDS_TOTAL,
+                                 labels)
+    overlap0 = registry.get_counter(
+        ETL_DESTINATION_ACK_OVERLAP_SECONDS_TOTAL, labels)
+    pipeline = Pipeline(
+        config=PipelineConfig(
+            pipeline_id=1, publication_name="pub",
+            batch=BatchConfig(max_size_bytes=max_size_bytes,
+                              max_fill_ms=max_fill_ms,
+                              batch_engine=BatchEngine(engine),
+                              write_window=write_window)),
+        store=store, destination=dest,
+        source_factory=lambda: FakeSource(db))
+    await pipeline.start()
+    await asyncio.wait_for(store.notify_on(TID, TableStateType.READY), 60)
+
+    # warmup OFF the clock: one tx through the full path compiles the
+    # host decode programs for the buckets this run stages into
+    n_warm = 8
+    tx = db.transaction()
+    for i in range(n_warm):
+        tx.insert_preencoded(TID, encode_insert(
+            TID, [str(10**7 + i).encode(), b"0"]))
+    await tx.commit()
+    while inner.rows_delivered < n_warm:
+        await asyncio.sleep(0.01)
+    await _wait_background_compiles()
+    inner.rows_delivered = 0
+    inner.digest = hashlib.sha256()
+
+    payloads = [encode_insert(TID, [str(i).encode(), str(i % 97).encode()])
+                for i in range(n_events)]
+    t0 = time.perf_counter()
+    produced = 0
+    while produced < n_events:
+        tx = db.transaction()
+        for _ in range(min(tx_size, n_events - produced)):
+            tx.insert_preencoded(TID, payloads[produced])
+            produced += 1
+        await tx.commit()
+    while inner.rows_delivered < n_events:
+        if pipeline._apply_task is not None and pipeline._apply_task.done():
+            pipeline._apply_task.result()
+            raise RuntimeError("pipeline stopped before delivering")
+        await asyncio.sleep(0.002)
+    # durability barrier: every delayed ack must resolve (delivery alone
+    # would flatter the windowed run, which by design has acks pending)
+    while dest.pending > 0:
+        await asyncio.sleep(0.002)
+    elapsed = time.perf_counter() - t0
+    await pipeline.shutdown_and_wait()
+
+    busy = registry.get_counter(ETL_DESTINATION_ACK_BUSY_SECONDS_TOTAL,
+                                labels) - busy0
+    overlap = registry.get_counter(
+        ETL_DESTINATION_ACK_OVERLAP_SECONDS_TOTAL, labels) - overlap0
+    return {
+        "write_window": write_window,
+        "events_per_second": round(n_events / elapsed),
+        "elapsed_seconds": round(elapsed, 4),
+        "acks_issued": dest.acks_issued,
+        "max_acks_pending": dest.max_pending,
+        "delivery_digest": inner.digest.hexdigest(),
+        "ack_busy_seconds": round(busy, 4),
+        "ack_overlap_seconds": round(overlap, 4),
+        "ack_overlap_ratio": round(overlap / busy, 3) if busy else 0.0,
+    }
+
+
+async def run_ack_latency(ack_ms: float = 20.0, n_events: int = 2000,
+                          tx_size: int = 20, max_size_bytes: int = 2048,
+                          max_fill_ms: int = 10,
+                          write_window: "int | None" = None) -> dict:
+    """The windowed-ack A/B gate (ISSUE 14): the SAME deterministic
+    backlog drained through the default write window and through a
+    forced window=1 run. GATES (caller applies the speedup floor):
+    byte-identical delivery (order + content digests equal), window=1
+    never holds more than one ack in flight, the windowed run provably
+    overlaps (max pending ≥ 2, overlap ratio > 0)."""
+    from ..config import BatchConfig
+
+    window = write_window or BatchConfig().write_window
+    windowed = await _ack_latency_run(window, ack_ms, n_events, tx_size,
+                                      max_size_bytes, max_fill_ms)
+    serial = await _ack_latency_run(1, ack_ms, n_events, tx_size,
+                                    max_size_bytes, max_fill_ms)
+    speedup = windowed["events_per_second"] \
+        / max(serial["events_per_second"], 1)
+    failures = []
+    if windowed["delivery_digest"] != serial["delivery_digest"]:
+        failures.append("windowed delivery is not byte-identical to the "
+                        "window=1 run")
+    if serial["max_acks_pending"] > 1:
+        failures.append(
+            f"window=1 held {serial['max_acks_pending']} acks in flight "
+            f"(must be ≤ 1 — the one-in-flight contract)")
+    if windowed["max_acks_pending"] < 2:
+        failures.append("the windowed run never overlapped two acks")
+    if windowed["ack_overlap_seconds"] <= 0:
+        failures.append("the windowed run recorded zero overlap seconds")
+    return {
+        "mode": "ack_latency",
+        "ack_latency_ms": ack_ms,
+        "events": n_events,
+        "max_size_bytes": max_size_bytes,
+        "windowed": windowed,
+        "window1": serial,
+        "ack_window_speedup": round(speedup, 3),
+        "failures": failures,
+        "ok": not failures,
+    }

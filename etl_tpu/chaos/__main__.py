@@ -54,6 +54,16 @@ def main(argv: list[str] | None = None) -> int:
                              "per-shard AND cross-shard-union invariants "
                              "must hold, and no shard may see another's "
                              "tables")
+    parser.add_argument("--ack-window", dest="ack_window",
+                        action="store_true",
+                        help="run the ack-window crash scenario instead "
+                             "of the corpus: CDC flows into a destination "
+                             "whose acks turn durable late, the pipeline "
+                             "is hard-killed while >= 2 acks are "
+                             "verifiably in flight, and the restart must "
+                             "re-stream the unacked window — zero-loss, "
+                             "dup budget = the window, monotonic durable "
+                             "LSN")
     parser.add_argument("--autoscale", dest="autoscale",
                         action="store_true",
                         help="run the closed-loop elasticity scenarios "
@@ -97,6 +107,19 @@ def main(argv: list[str] | None = None) -> int:
         from .multi import run_multi_pipeline_scenario
 
         run = asyncio.run(run_multi_pipeline_scenario(seed=args.seed))
+        print(json.dumps(run.describe(), sort_keys=True))
+        return 0 if run.ok else 1
+
+    if args.ack_window:
+        if args.matrix or args.workload or args.scenario or args.sharded \
+                or args.autoscale or args.multi_pipeline:
+            parser.error("--ack-window runs its own K-in-flight crash "
+                         "scenario and cannot be combined with --matrix/"
+                         "--workload/--scenario/--sharded/--autoscale/"
+                         "--multi-pipeline")
+        from .ack_window import run_ack_window_crash
+
+        run = asyncio.run(run_ack_window_crash(seed=args.seed))
         print(json.dumps(run.describe(), sort_keys=True))
         return 0 if run.ok else 1
 

@@ -70,6 +70,20 @@ class BatchConfig:
     # batches packed/dispatched but not yet fetched. 3 ≈ one packing, one
     # on the device, one streaming back; drops to 1 under memory pressure
     decode_window: int = 3
+    # bounded destination-ack write window (runtime/ack_window.py): the
+    # apply loop keeps dispatching flushes in WAL order while up to this
+    # many earlier acks are still pending, advancing durable progress
+    # only over the contiguous acked prefix. 4 hides one ack round-trip
+    # behind three later writes on real destinations; 1 reproduces the
+    # reference's one-in-flight loop exactly. Shrinks to 1 under memory
+    # pressure. The copy path caps its per-partition outstanding acks
+    # with the same knob.
+    write_window: int = 4
+    # byte cap on the window's pending payloads (0 = unbounded): mega
+    # batches under backlog growth stop stacking K × 128 MiB of
+    # in-flight payload; an empty window always admits one dispatch, so
+    # a single over-budget batch can never deadlock
+    write_window_max_bytes: int = 64 * 1024 * 1024
     # shared-capacity cap of the fair batch-admission scheduler
     # (ops/pipeline.AdmissionScheduler): maximum device/host batches in
     # flight across EVERY pipeline sharing this process's device set.
@@ -100,6 +114,9 @@ class BatchConfig:
         _require(self.max_size_bytes > 0, "max_size_bytes must be > 0")
         _require(self.max_fill_ms > 0, "max_fill_ms must be > 0")
         _require(self.decode_window >= 1, "decode_window must be >= 1")
+        _require(self.write_window >= 1, "write_window must be >= 1")
+        _require(self.write_window_max_bytes >= 0,
+                 "write_window_max_bytes must be >= 0 (0 = unbounded)")
         _require(self.admission_capacity >= 0,
                  "admission_capacity must be >= 0 (0 = auto)")
         _require(all(b > 0 for b in self.prewarm_row_buckets or ()),

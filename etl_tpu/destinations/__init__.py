@@ -1,6 +1,7 @@
 """Destination implementations."""
 
 from .base import Destination, WriteAck, expand_batch_events
+from .delay import DelayedAckDestination
 from .memory import (FaultAction, FaultInjectingDestination, FaultKind,
                      MemoryDestination)
 from .registry import build_destination

@@ -219,6 +219,20 @@ class DecodedBatchEvent:
             self._old_pending = None
         return self._old_batch
 
+    def abandon(self) -> None:
+        """Discard an event that will never be consumed (a hard-killed
+        worker's flushed-but-undelivered write window): release the
+        pending decode's pooled resources (staging arena, window slot,
+        admission ticket) without paying the fetch. Resolved events
+        already returned them; handles without an abandon hook (the
+        serial `_PendingDecode`) hold no pooled resources."""
+        for pending in (self._pending, self._old_pending):
+            ab = getattr(pending, "abandon", None)
+            if ab is not None:
+                ab()
+        self._pending = None
+        self._old_pending = None
+
     def __len__(self) -> int:
         return len(self.change_types)
 

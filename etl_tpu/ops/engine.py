@@ -988,6 +988,16 @@ class DeviceDecoder:
             (packed.row_capacity, pspecs, packed.nibble,
              mesh_cache_key(self.mesh) if packed.use_mesh else None,
              pallas, pred_fp, False)
+        if host:
+            # observed-signature recording (ops/program_store.py): the
+            # (canonical layout, row bucket) signatures a workload
+            # ACTUALLY dispatched persist next to the executables, so a
+            # restarted pipeline prewarms them — mega-seal buckets and
+            # filtered programs the SchemaStore enumeration can't name.
+            # Disarmed cost (no cache dir / already seen): one set probe.
+            from . import program_store
+
+            program_store.record_observed(key)
         row_flags = packed.row_flags
         if pred is not None and host:
             row_flags = jax.device_put(row_flags, dev)

@@ -450,6 +450,45 @@ class PipelinedDecode:
         self._demanded = False  # a consumer is blocked on this handle
         self._admitted = False  # holds a shared admission ticket
 
+    def abandon(self) -> None:
+        """Discard a handle that will never be consumed (a hard-killed
+        apply loop's flushed-but-undelivered window entries): return the
+        pooled resources — staging arena, window slot, admission ticket —
+        without paying the fetch. Completed handles already returned
+        them in `_fetch`; a handle still packing releases via a
+        done-callback the moment the worker resolves it; a handle whose
+        worker errored released in the worker's except path. After
+        abandon, `result()` is forbidden (the arena may be re-leased and
+        dirtied by another batch) — consumers of an abandoned handle are
+        gone by construction."""
+        if self._done is not None or self._exc is not None:
+            return  # fetched (or failed): resources already returned
+        self._exc = RuntimeError("decode handle abandoned")
+
+        def _release(fut) -> None:
+            if fut.exception() is not None:
+                return  # worker error path released window/admission
+            value = fut.result()
+            if len(value) == 2:
+                return  # oracle route: no pooled resources held
+            _pending, arena, iv = value
+            pipe = self._pipe
+            with pipe._lock:
+                iv.end = time.perf_counter()
+                if iv in pipe._inflight:
+                    pipe._inflight.remove(iv)
+            arena.release()
+            if self._admitted:
+                self._admitted = False
+                pipe._admission.release()
+            if self._windowed:
+                self._windowed = False
+                pipe.window.release()
+
+        # runs immediately if already resolved, else on the worker
+        # thread when pack/dispatch completes — either way exactly once
+        self._future.add_done_callback(_release)
+
     def result(self):
         """Complete the batch (idempotent). A failed fetch is permanent:
         the first attempt already returned the arena to the pool, so a

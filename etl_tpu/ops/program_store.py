@@ -423,6 +423,112 @@ def acquire(key: tuple, builder, example_args: "tuple | None" = None):
 
 
 # ---------------------------------------------------------------------------
+# observed signatures (PR 11 leftover)
+# ---------------------------------------------------------------------------
+#
+# SchemaStore enumeration prewarms the layouts the STORE knows about, at
+# the configured row buckets. The workload's actual program population
+# is broader: backlog growth seals mega buckets (65536/262144) the
+# default buckets never name, and fused-filter programs are per-table.
+# Every host dispatch records its key here (first sighting per process;
+# one small atomic file per version tag), and `prewarm_pipeline` folds
+# the recorded signatures into its enumeration — a restart prewarms
+# what the workload actually used, not just what the store implies.
+
+_OBSERVED_FILE = "observed_sigs.pkl"
+_OBSERVED_LOCK = threading.Lock()
+_OBSERVED_SEEN: set = set()
+#: newest-last cap: a pathological signature churn (unbounded DDL
+#: variety) ages out the oldest recordings instead of growing the file
+_OBSERVED_MAX = 256
+
+
+def _observed_path(cache_dir: str) -> str:
+    return os.path.join(cache_dir, version_tag(), _OBSERVED_FILE)
+
+
+def load_observed() -> list:
+    """The recorded observed signatures (program-cache keys), oldest
+    first. Corruption degrades to an empty list + file deletion — the
+    same never-fatal stance as the executable cache."""
+    cache_dir = active_dir()
+    if not cache_dir:
+        return []
+    path = _observed_path(cache_dir)
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        if data.get("format") != _CACHE_FORMAT_VERSION:
+            raise ValueError("observed-signature file format mismatch")
+        return [k for k in data.get("keys", []) if isinstance(k, tuple)]
+    except Exception:
+        log.warning("corrupt observed-signature file %s; deleting",
+                    path, exc_info=True)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return []
+
+
+def record_observed(key: tuple) -> None:
+    """Persist one observed host-program signature. Called by the
+    engine's dispatch stage per host dispatch: the disarmed cost is one
+    set lookup; the first sighting per process pays a small read-merge-
+    write of the signature file (atomic tmp+rename — best-effort across
+    processes, last-writer-wins). No cache dir = no-op."""
+    cache_dir = active_dir()
+    if cache_dir is None:
+        return
+    with _OBSERVED_LOCK:
+        if key in _OBSERVED_SEEN:
+            return
+        _OBSERVED_SEEN.add(key)
+    try:
+        path = _observed_path(cache_dir)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with _OBSERVED_LOCK:
+            merged = [k for k in load_observed() if k != key] + [key]
+            merged = merged[-_OBSERVED_MAX:]
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as f:
+                pickle.dump({"format": _CACHE_FORMAT_VERSION,
+                             "keys": merged}, f)
+            os.replace(tmp, path)
+    except Exception:
+        log.warning("failed to record observed program signature "
+                    "(prewarm coverage only; decode continues)",
+                    exc_info=True)
+
+
+def warm_observed_signatures() -> dict:
+    """Disk-load the executable of every recorded observed signature not
+    already warm in memory. Synchronous — run on an executor. A recorded
+    signature whose .prog was wiped/evicted stays cold here and compiles
+    via the nonblocking first touch like any other (no decoder exists to
+    build from a bare key)."""
+    from .engine import _shared_fn_get, _shared_fn_put
+
+    keys = load_observed()
+    ready = 0
+    missing = 0
+    for key in keys:
+        if _shared_fn_get(key) is not None:
+            ready += 1
+            continue
+        fn = try_load(key, record_absent=False)
+        if fn is not None:
+            _shared_fn_put(key, fn)
+            ready += 1
+        else:
+            missing += 1
+    return {"observed": len(keys), "observed_ready": ready,
+            "observed_missing": missing}
+
+
+# ---------------------------------------------------------------------------
 # prewarm
 # ---------------------------------------------------------------------------
 
@@ -528,19 +634,30 @@ async def prewarm_pipeline(store, batch_config) -> dict:
         log.warning("program prewarm: schema enumeration failed; decode "
                     "warms lazily", exc_info=True)
         return {}
-    if not schemas:
-        return {"layouts": 0, "ready": 0, "building": 0}
     loop = asyncio.get_running_loop()
-    stats = await loop.run_in_executor(
-        None, warm_host_programs, schemas,
-        batch_config.prewarm_row_buckets)
+
+    def _warm() -> dict:
+        stats = warm_host_programs(schemas,
+                                   batch_config.prewarm_row_buckets) \
+            if schemas else {"layouts": 0, "ready": 0, "building": 0}
+        # fold in the OBSERVED signatures recorded by previous
+        # incarnations: the row buckets the workload actually sealed
+        # (mega-seal growth, odd flush sizes) and fused-filter programs,
+        # neither of which the SchemaStore enumeration can name
+        stats.update(warm_observed_signatures())
+        return stats
+
+    stats = await loop.run_in_executor(None, _warm)
     log.info("program prewarm: %d schemas -> %s", len(schemas), stats)
     return stats
 
 
 def reset_for_tests() -> None:
-    """Clear the plan cache / layout gauge inputs (tests only; compiled
-    programs live in engine._SHARED_FN_CACHE and are untouched)."""
+    """Clear the plan cache / layout gauge inputs and the observed-
+    signature process guard (tests only; compiled programs live in
+    engine._SHARED_FN_CACHE and are untouched)."""
     with _PLAN_LOCK:
         _PLAN_CACHE.clear()
         _LAYOUTS_SEEN.clear()
+    with _OBSERVED_LOCK:
+        _OBSERVED_SEEN.clear()

@@ -9,11 +9,16 @@ crates/etl/src/replication/apply.rs:215,1048). Responsibilities:
     > proactive keepalive;
   - decode pgoutput messages into typed events (via EventAssembler — CPU
     per-tuple or TPU batched decode);
-  - batch events by size-hint bytes + fill deadline; dispatch at most ONE
-    in-flight `write_events` (apply.rs:1956-2023);
-  - advance durable progress only on durable acks at commit boundaries
-    (apply.rs:2665-2719) and send standby status updates with the effective
-    flush LSN (the ack/flow-control channel, apply.rs:1575);
+  - batch events by size-hint bytes + fill deadline; dispatch flushes in
+    WAL order through a bounded write window (runtime/ack_window.py) —
+    up to `BatchConfig.write_window` destination writes overlap their
+    ack round-trips (the reference dispatches at most ONE in-flight
+    `write_events`, apply.rs:1956-2023; the window generalizes it and
+    window=1 reproduces it exactly);
+  - advance durable progress only over the CONTIGUOUS ACKED PREFIX of
+    the window, at commit boundaries (apply.rs:2665-2719), and send
+    standby status updates with the effective flush LSN (the
+    ack/flow-control channel, apply.rs:1575);
   - drive the table-sync handoff state machine at commit/flush/idle points
     (apply.rs:2874-3441) — the restart-window reasoning from
     apply.rs:2907-2929 applies: Catchup is set only in memory, so a crash
@@ -43,6 +48,7 @@ from ..postgres.codec import event as event_codec
 from ..postgres.codec import pgoutput
 from ..postgres.source import FrameSpan, ReplicationStream
 from ..store.base import PipelineStore
+from ..analysis.annotations import flush_path
 from ..destinations.base import Destination
 from ..telemetry.egress import record_egress
 from ..telemetry.metrics import (ETL_APPLY_LOOP_BATCHES_TOTAL,
@@ -54,6 +60,7 @@ from ..telemetry.metrics import (ETL_APPLY_LOOP_BATCHES_TOTAL,
                                  ETL_TRANSACTION_SIZE_BYTES,
                                  ETL_TRANSACTIONS_TOTAL, registry)
 from . import failpoints
+from .ack_window import AckWindow
 from .assembler import RUN_SEAL_ROWS, EventAssembler
 from .shutdown import ShutdownSignal
 from .state import TableState, TableStateType
@@ -110,13 +117,6 @@ class TableSyncContext:
 
 
 @dataclass
-class _InFlight:
-    task: asyncio.Task
-    commit_end_lsn: Lsn | None  # durable watermark if batch ends past a commit
-    n_events: int
-
-
-@dataclass
 class _LoopState:
     last_commit_end_lsn: Lsn | None = None  # end of last fully-seen commit
     current_commit_lsn: Lsn = Lsn.ZERO  # from BEGIN
@@ -165,10 +165,21 @@ class ApplyLoop:
             supervisor=supervisor,
             lag_bytes=lambda: max(
                 0, int(self.state.received_lsn) - int(self.state.durable_lsn)),
-            admission_capacity=config.batch.admission_capacity)
+            admission_capacity=config.batch.admission_capacity,
+            seal_bytes=config.batch.max_size_bytes)
         self.state = _LoopState(durable_lsn=start_lsn, received_lsn=start_lsn,
                                 last_status_flush_lsn=start_lsn)
-        self._in_flight: _InFlight | None = None
+        # bounded write window (runtime/ack_window.py): flushes keep
+        # dispatching in WAL order while up to write_window earlier acks
+        # settle; durable progress advances only over the contiguous
+        # acked prefix. Shrinks to 1 under memory pressure (the decode
+        # pipeline's stance), and window=1 reproduces the reference's
+        # one-in-flight loop exactly.
+        self._ack_window = AckWindow(
+            config.batch.write_window,
+            max_bytes=config.batch.write_window_max_bytes,
+            pressure=(lambda: monitor.pressure)
+            if monitor is not None else None)
         self._batch_deadline: float | None = None
         # True while the CURRENT drain keeps coming back full: flush
         # pacing defers to mega-batching only during a live backlog
@@ -259,13 +270,25 @@ class ApplyLoop:
                     waits.add(coord_task)
                 if catchup_future is not None:
                     waits.add(catchup_future)
-                if self._in_flight is not None:
-                    waits.add(self._in_flight.task)
+                # every still-running window task: the head completion
+                # advances the durable prefix, and a deeper failure must
+                # fail fast. Done-but-unactionable tasks (successful
+                # out-of-order completions held for contiguity) are
+                # excluded — a done task in the wait set would make every
+                # select return immediately until the head ack resolves
+                waits.update(self._ack_window.pending_tasks())
                 now = time.monotonic()
+                if self._ack_window.any_actionable():
+                    # a completion became actionable while the loop was
+                    # busy elsewhere: handle it this iteration (its task
+                    # is done, so nothing in `waits` would wake us)
+                    timeout = 0.0
                 # the batch deadline only matters when a flush could actually
-                # dispatch — honoring it while a write is in flight would
-                # busy-spin with a zero timeout until the write completes
-                if self._batch_deadline is not None and self._in_flight is None:
+                # dispatch — honoring it while the window is full (or the
+                # breaker holds dispatch) would busy-spin with a zero
+                # timeout until an ack settles
+                elif self._batch_deadline is not None \
+                        and not self._dispatch_blocked():
                     timeout = min(max(0.0, self._batch_deadline - now),
                                   keepalive_s)
                 else:
@@ -280,7 +303,7 @@ class ApplyLoop:
                     self._hb.beat(
                         progress=(int(self.state.durable_lsn),
                                   int(self.state.received_lsn)),
-                        busy=self._in_flight is not None
+                        busy=not self._ack_window.is_empty
                         or self.state.batch_commit_end is not None
                         or len(self.assembler) > 0)
 
@@ -290,9 +313,14 @@ class ApplyLoop:
                     return ExitIntent.PAUSE
                 if resume_task is not None and resume_task in done:
                     resume_task = None
-                # priority 2: flush result
-                if self._in_flight is not None \
-                        and self._in_flight.task in done:
+                # priority 2: flush results — the contiguous acked prefix
+                # advances durable progress; a mid-window failure raises
+                # after the prefix is persisted (minimal re-stream). Keyed
+                # on ACTIONABLE completions (head done, or any failure):
+                # a successful out-of-order completion pops nothing yet,
+                # and handling it here would spin the loop against an
+                # empty pop until the head ack resolves
+                if self._ack_window.any_actionable():
                     intent = await self._handle_flush_result()
                     if intent is not None:
                         return intent
@@ -329,11 +357,10 @@ class ApplyLoop:
                     intent = await self._handle_frame(frame)
                     if intent is not None:
                         return intent
-                    while not (self.shutdown.is_triggered or (
-                            self._in_flight is not None
-                            and self._in_flight.task.done()) or (
-                            self.monitor is not None
-                            and self.monitor.pressure)):
+                    while not (self.shutdown.is_triggered
+                               or self._ack_window.any_actionable() or (
+                               self.monitor is not None
+                               and self.monitor.pressure)):
                         frames = self.stream.drain_spans(4096)
                         if not frames:
                             backlog_streak = 0
@@ -394,18 +421,22 @@ class ApplyLoop:
                         + self.config.schema_cleanup_interval_s
                     await self._run_schema_cleanup()
         finally:
-            # an error/cancellation exit can leave the in-flight write
-            # running (a supervision restart cancels THIS loop while the
+            # an error/cancellation exit can leave in-flight writes
+            # running (a supervision restart cancels THIS loop while a
             # write sits in a stalled destination call for seconds more)
-            # — cancel it with the select tasks; the window re-streams
-            # from durable progress on resume. drain_cancelled keeps a
+            # — cancel the whole window with the select tasks; resume
+            # re-streams from durable progress (which only ever covered
+            # the contiguous acked prefix). drain_cancelled keeps a
             # hard-kill cancel landing mid-drain lethal.
             from .shutdown import drain_cancelled
 
-            inflight_task = self._in_flight.task \
-                if self._in_flight is not None else None
             await drain_cancelled(msg_task, shutdown_task, resume_task,
-                                  coord_task, inflight_task)
+                                  coord_task, *self._ack_window.tasks())
+            # cancelled window entries will never deliver: abandon their
+            # pending decodes so staging arenas / window slots /
+            # admission tickets return instead of leaking with the
+            # discarded events (the leak probe in chaos counts them)
+            self._ack_window.abandon_payloads()
             if self._lease is not None:
                 self._lease.release()
             self.assembler.close()  # stop the decode pipeline's worker
@@ -560,16 +591,24 @@ class ApplyLoop:
             st.in_transaction = False
             st.last_commit_end_lsn = ev.end_lsn
             st.batch_commit_end = ev.end_lsn
+            # commit watermark for size-bounded flush splitting: a prefix
+            # flush covering everything assembled so far may claim
+            # durability at this commit end (runtime/assembler.py)
+            self.assembler.note_commit_end(ev.end_lsn)
             registry.counter_inc(ETL_TRANSACTIONS_TOTAL)
             # owned-row payload bytes only (tx_bytes definition) — control
             # messages don't count toward transaction size
             registry.histogram_observe(ETL_TRANSACTION_SIZE_BYTES,
                                        st.tx_bytes)
-            # idle-commit fast path: with no write in flight, flushing AT
-            # the commit boundary cuts p50 replication lag by the whole
-            # fill window (an idle pipeline has nothing to batch FOR);
-            # under load the one-in-flight rule keeps later commits
-            # coalescing into full batches, so throughput is unaffected.
+            # commit fast path: while the write window has room, flushing
+            # AT the commit boundary cuts p50 replication lag by the whole
+            # fill window (an idle pipeline has nothing to batch FOR) and
+            # — on destinations with real ack latency — keeps up to
+            # write_window commits' writes overlapping their ack round
+            # trips instead of serializing one per round trip. Once the
+            # window fills, later commits coalesce into full batches, so
+            # saturated throughput is unaffected (at window=1 this is
+            # exactly the old idle-commit fast path).
             # Keyed on ROW events, not len(assembler): commits of
             # unowned-table transactions (whose CPU-engine Begin/Commit
             # controls still land in the assembler) stay on the deadline
@@ -577,9 +616,8 @@ class ApplyLoop:
             # durable progress per commit instead of per fill window.
             # (suppressed during a live backlog: the fast flush exists to
             # cut IDLE lag, and here it would seal a growing mega run)
-            if self._in_flight is None and self.assembler.row_events \
-                    and not self._backlog_now:
-                self._maybe_dispatch_flush(force=True)
+            if self.assembler.row_events and not self._backlog_now:
+                self._maybe_dispatch_flush(force=True)  # no-op when blocked
         elif isinstance(msg, pgoutput.RelationMessage):
             schema = event_codec.schema_from_relation_message(msg)
             prev = self.cache.get(msg.relation_id)
@@ -638,9 +676,56 @@ class ApplyLoop:
         return self.config.batch.max_size_bytes \
             * max(1, self.assembler.seal_rows // RUN_SEAL_ROWS)
 
+    def _breaker_open(self) -> bool:
+        """True when the destination's circuit breaker is OPEN (shedding).
+        Reads through the SupervisedDestination wrapper when present;
+        plain destinations have no breaker."""
+        breaker = getattr(self.destination, "breaker", None)
+        if breaker is None:
+            return False
+        state = getattr(breaker, "state", None)
+        return getattr(state, "value", None) == "open"
+
+    def _flush_threshold(self) -> int:
+        """The size bound of the NEXT flush: the scaled cap, shrunk by
+        the per-stream budget share (batch_budget.rs:72-96)."""
+        threshold = self._scaled_max_bytes()
+        if self._lease is not None:
+            threshold = min(threshold, self._lease.ideal_batch_bytes())
+        return threshold
+
+    def _dispatch_blocked(self) -> bool:
+        """A new flush must not dispatch right now: the write window is
+        at capacity, or the breaker is open while earlier acks are still
+        settling — in-flight writes may yet succeed, so the window drains
+        before the breaker sheds a fresh call (which would fail the
+        worker and cancel them). Once the window is empty the dispatch
+        proceeds and the breaker's fast-fail becomes worker backoff, the
+        existing shedding path. The byte-cap check sees the PROSPECTIVE
+        flush size (≤ threshold — flush_bounded cuts there), not the
+        whole assembler backlog: judging a 60 MiB backlog against the
+        window's byte cap would collapse the window to one-in-flight
+        exactly when the backlog is largest."""
+        nbytes = min(self.assembler.size_bytes, self._flush_threshold())
+        if not self._ack_window.can_dispatch(nbytes):
+            return True
+        return not self._ack_window.is_empty and self._breaker_open()
+
+    @flush_path
     def _maybe_dispatch_flush(self, force: bool = False) -> None:
-        if self._in_flight is not None:
-            return
+        """Dispatch as many flushes as the window accepts: one for a
+        `force` trigger (deadline, commit fast path, catchup drain) plus
+        size-triggered ones while the assembler still holds a full
+        batch. With a size-bounded split in effect (write_window > 1) a
+        drained backlog becomes a sequence of ≤ threshold-byte batches
+        the window pipelines."""
+        dispatched = False
+        while not self._dispatch_blocked():
+            if not self._dispatch_one(force and not dispatched):
+                return
+            dispatched = True
+
+    def _dispatch_one(self, force: bool) -> bool:
         if len(self.assembler) == 0:
             # TPU engine: commits are not assembler events, so a commit
             # window whose owned-row set is EMPTY (unowned tables,
@@ -648,35 +733,58 @@ class ApplyLoop:
             # otherwise batch_commit_end never clears, _is_idle() stays
             # false, and the slot's confirmed_flush pins while source WAL
             # retention grows. Dispatch an event-less flush through the
-            # normal in-flight machinery (one per fill window, amortized
-            # like any other deadline flush).
+            # normal write-window machinery (one per fill window,
+            # amortized like any other deadline flush).
             if not (force and self.state.batch_commit_end is not None):
-                return
+                return False
         # budget-aware threshold: under many active streams the per-stream
         # share shrinks below the static cap (batch_budget.rs:72-96) —
         # flushes happen mid-transaction with the commit LSN carried
         # separately (apply.rs:1932-1945), so splitting huge transactions
         # is safe for durability accounting
-        threshold = self._scaled_max_bytes()
-        if self._lease is not None:
-            threshold = min(threshold, self._lease.ideal_batch_bytes())
+        threshold = self._flush_threshold()
         if not force and self.assembler.size_bytes < threshold:
-            return
-        batch_bytes = self.assembler.size_bytes
-        events = self.assembler.flush()
-        commit_end = self.state.batch_commit_end
-        self.state.batch_commit_end = None
-        self._batch_deadline = None
+            return False
+        # size-bounded flush: flush a WAL-ordered prefix of ≤ threshold
+        # bytes — a drained backlog then dispatches as a sequence of
+        # bounded batches the write window pipelines, instead of one
+        # backlog-sized write whose single ack serializes everything
+        # behind it (and whose payload can exceed what a destination
+        # accepts per request). max_size_bytes is now a real per-write
+        # bound, not just a flush trigger; the delivered event stream is
+        # byte-identical at every window depth (asserted by bench.py
+        # --ack-latency). The commit watermark (`covered`) — not the raw
+        # batch_commit_end — is what a PREFIX flush may claim durability
+        # at; `remaining` is the highest boundary still awaiting a later
+        # flush.
+        before_bytes = self.assembler.size_bytes
+        events, covered, remaining = \
+            self.assembler.flush_bounded(max_bytes=threshold)
+        batch_bytes = before_bytes - self.assembler.size_bytes
+        commit_end = covered
+        self.state.batch_commit_end = remaining
+        if len(self.assembler) > 0:
+            # a remainder stays assembled: keep it on the normal fill
+            # cadence (the dispatch loop may also flush it immediately
+            # when the size threshold still holds and the window has
+            # room)
+            self._batch_deadline = time.monotonic() \
+                + self.config.batch.max_fill_ms / 1000
+        else:
+            self._batch_deadline = None
 
-        async def write() -> None:
+        async def submit():
             if not events:
-                return  # commit-boundary-only flush: no destination call
+                return None  # commit-boundary-only flush: no destination
             # columnar write seam: DecodedBatchEvents reach the
             # destination as batches (columnar-native writers encode them
             # column-at-a-time; others fall back to the row path via the
-            # base-class shim)
-            ack = await self.destination.write_event_batches(events)
-            await ack.wait_durable()
+            # base-class shim). The ack window owns the durability wait
+            # (etl-lint rule 17): submissions stay in WAL order, only the
+            # ack round trips overlap.
+            return await self.destination.write_event_batches(events)
+
+        def on_durable() -> None:
             # billing/egress accounting rides durable acks (egress.rs:1-20)
             record_egress(pipeline_id=self.config.pipeline_id,
                           destination=getattr(
@@ -686,30 +794,47 @@ class ApplyLoop:
 
         registry.counter_inc(ETL_APPLY_LOOP_BATCHES_TOTAL)
         registry.counter_inc(ETL_APPLY_LOOP_EVENTS_TOTAL, len(events))
-        self._in_flight = _InFlight(task=asyncio.ensure_future(write()),
-                                    commit_end_lsn=commit_end,
-                                    n_events=len(events))
-
-    async def _apply_flush_result(self) -> bool:
-        """Consume the finished in-flight write; advance durable progress.
-        Returns True if progress advanced (commit boundary was covered)."""
-        inflight = self._in_flight
-        assert inflight is not None
-        self._in_flight = None
-        exc = inflight.task.exception()
-        if exc is not None:
-            raise exc if isinstance(exc, EtlError) else EtlError(
-                ErrorKind.DESTINATION_FAILED, str(exc))
-        if inflight.commit_end_lsn is None:
-            return False
-        self._delivered_events += inflight.n_events
-        self.state.durable_lsn = max(self.state.durable_lsn,
-                                     inflight.commit_end_lsn)
-        failpoints.fail_point(failpoints.ON_PROGRESS_STORE)
-        await self.store.update_durable_progress(
-            self.ctx.progress_key, self.state.durable_lsn)
-        await self._send_status_update()
+        self._ack_window.dispatch(
+            submit, commit_end_lsn=commit_end, n_events=len(events),
+            nbytes=batch_bytes, on_durable=on_durable if events else None,
+            payload=events)
         return True
+
+    @flush_path
+    async def _apply_flush_result(self) -> bool:
+        """Consume the contiguous acked prefix of the write window;
+        advance durable progress over it. Returns True if progress
+        advanced (a commit boundary was covered). A mid-window failure
+        raises AFTER the durable prefix is persisted, so the restart
+        re-streams only the unacked suffix (bounded-dup budget grows by
+        at most the window size)."""
+        done, failure = self._ack_window.pop_ready()
+        advanced = False
+        for entry in done:
+            self._delivered_events += entry.n_events
+            if entry.commit_end_lsn is None:
+                continue
+            self.state.durable_lsn = max(self.state.durable_lsn,
+                                         entry.commit_end_lsn)
+            advanced = True
+        if advanced:
+            failpoints.fail_point(failpoints.ON_PROGRESS_STORE)
+            await self.store.update_durable_progress(
+                self.ctx.progress_key, self.state.durable_lsn)
+            if failure is None:
+                # NO standby status when a failure was popped: the
+                # failed entry is out of the window, so _is_idle() can
+                # read True and the effective flush LSN would advance to
+                # received_lsn — PAST the failed entry's undelivered WAL
+                # — trimming the slot before the restart re-streams it
+                # (found by the pipeline_pack_fault chaos scenario). The
+                # durable-progress store write above is safe either way:
+                # it only ever names acked commit ends.
+                await self._send_status_update()
+        if failure is not None:
+            raise failure if isinstance(failure, EtlError) else EtlError(
+                ErrorKind.DESTINATION_FAILED, str(failure))
+        return advanced
 
     async def _handle_flush_result(self) -> ExitIntent | None:
         advanced = await self._apply_flush_result()
@@ -720,14 +845,18 @@ class ApplyLoop:
                 return await self._check_catchup(self.state.durable_lsn)
         return None
 
+    @flush_path
     async def _drain(self) -> None:
-        """Shutdown path: wait out the in-flight write, then stop without
-        flushing the open batch (it re-streams on resume — at-least-once)."""
-        if self._in_flight is not None:
+        """Shutdown path: wait out every in-flight write, then stop
+        without flushing the open batch (it re-streams on resume —
+        at-least-once). A failed write ends the drain: everything past
+        the durable prefix re-streams on resume."""
+        while not self._ack_window.is_empty:
+            await self._ack_window.wait_all()
             try:
                 await self._handle_flush_result()
             except EtlError:
-                pass  # resume re-delivers from durable progress
+                return  # resume re-delivers from durable progress
 
     async def _run_schema_cleanup(self) -> None:
         """Prune schema versions no longer reachable by any decode: every
@@ -744,12 +873,12 @@ class ApplyLoop:
             await self.store.prune_schema_versions(tid, snapshot)
 
     def _is_idle(self) -> bool:
-        """No open transaction, nothing assembled, nothing in flight, no
-        commit boundary awaiting durability (apply.rs:885-889). Only then
-        may keepalive progress be reported as flushed."""
+        """No open transaction, nothing assembled, an empty write window,
+        no commit boundary awaiting durability (apply.rs:885-889). Only
+        then may keepalive progress be reported as flushed."""
         return (not self.state.in_transaction
                 and len(self.assembler) == 0
-                and self._in_flight is None
+                and self._ack_window.is_empty
                 and self.state.batch_commit_end is None)
 
     def _effective_flush_lsn(self) -> Lsn:
@@ -845,10 +974,10 @@ class ApplyLoop:
         # Reached the fence. Everything ≤ target MUST be durably flushed
         # before SyncDone is recorded — the apply worker takes over from
         # `target` believing this worker delivered durably up to it.
-        while len(self.assembler) > 0 or self._in_flight is not None:
+        while len(self.assembler) > 0 or not self._ack_window.is_empty:
             self._maybe_dispatch_flush(force=True)
-            if self._in_flight is not None:
-                await asyncio.wait({self._in_flight.task})
+            if not self._ack_window.is_empty:
+                await self._ack_window.wait_all()
                 await self._apply_flush_result()
         done_lsn = max(self.state.durable_lsn, target)
         await self.store.update_table_state(ctx.table_id,

@@ -43,6 +43,7 @@ class _Run:
     start_lsns: list[int] = field(default_factory=list)
     commit_lsns: list[int] = field(default_factory=list)
     tx_ordinals: list[int] = field(default_factory=list)
+    nbytes: int = 0  # size-hint bytes (64/row + payload), the seal bound
 
 
 #: seal an open run once it reaches this many rows. Two effects: decode
@@ -70,8 +71,18 @@ _ASSEMBLER_SEQ = [0]
 class EventAssembler:
     def __init__(self, engine: BatchEngine, monitor=None,
                  decode_window: int = 3, supervisor=None,
-                 lag_bytes=None, admission_capacity: int = 0):
+                 lag_bytes=None, admission_capacity: int = 0,
+                 seal_bytes: int = 0):
         self.engine = engine
+        # byte seal (0 = off): seal the open run once its size-hint
+        # bytes reach this bound (scaled with the dynamic row seal the
+        # same ×-factor _scaled_max_bytes uses), so one contiguous run
+        # can never exceed the flush sizing — size-bounded flushes then
+        # cut at event granularity and the write window has batches to
+        # pipeline. The apply loop passes BatchConfig.max_size_bytes; at
+        # typical row widths the 16384-row seal binds first, so decode
+        # batch shapes are unchanged.
+        self.seal_bytes = seal_bytes
         # fair-admission wiring (ops/pipeline.AdmissionScheduler): this
         # loop's decode pipeline takes one tenant seat on the process-
         # wide scheduler, weighted by `lag_bytes` (the apply loop's
@@ -81,6 +92,18 @@ class EventAssembler:
         self._lag_bytes = lag_bytes
         self._admission_capacity = admission_capacity
         self._events: list[Event] = []
+        # per-event (size_bytes, row_events) — lets flush(max_bytes=...)
+        # cut a WAL-ordered prefix at event granularity and keep the
+        # remainder's accounting exact (the write window dispatches
+        # size-bounded batches instead of one backlog-sized mega write)
+        self._meta: list[tuple[int, int]] = []
+        # commit watermarks: (n_events_covered, commit_end_lsn) — all
+        # events with index < n (counting the open run as one future
+        # event) belong to commits ending ≤ commit_end_lsn, so a prefix
+        # flush of ≥ n events may claim durability at that LSN once
+        # acked. The apply loop records one per commit boundary
+        # (note_commit_end); flush() consumes the covered prefix.
+        self._commit_marks: list[tuple[int, int]] = []
         self._run: _Run | None = None
         self._decoders: dict[TableId, DeviceDecoder] = {}
         # one decode pipeline (worker thread + bounded in-flight window)
@@ -113,6 +136,7 @@ class EventAssembler:
         """Begin/Commit/Relation/Truncate/SchemaChange — barrier events."""
         self._seal_run()
         self._events.append(ev)
+        self._meta.append((size_hint, 0))
         self.size_bytes += size_hint
 
     @hot_loop
@@ -132,9 +156,12 @@ class EventAssembler:
         r.start_lsns.append(int(start_lsn))
         r.commit_lsns.append(int(commit_lsn))
         r.tx_ordinals.append(tx_ordinal)
+        r.nbytes += 64 + len(payload)
         self.size_bytes += 64 + len(payload)
         self.row_events += 1
-        if len(r.payloads) >= self.seal_rows:
+        if len(r.payloads) >= self.seal_rows \
+                or (self.seal_bytes
+                    and r.nbytes >= self._scaled_seal_bytes()):
             self._seal_run()
 
     @hot_loop
@@ -162,11 +189,22 @@ class EventAssembler:
         r.commit_lsns.extend([commit_lsn] * k)
         r.tx_ordinals.extend(range(tx_ordinal0, tx_ordinal0 + k))
         nbytes = sum(map(len, payloads))
+        r.nbytes += 64 * k + nbytes
         self.size_bytes += 64 * k + nbytes
         self.row_events += k
-        if len(r.payloads) >= self.seal_rows:
+        if len(r.payloads) >= self.seal_rows \
+                or (self.seal_bytes
+                    and r.nbytes >= self._scaled_seal_bytes()):
+            # byte overshoot of at most one span: the seal check runs per
+            # span push, so a drained-window span lands whole
             self._seal_run()
         return nbytes
+
+    def _scaled_seal_bytes(self) -> int:
+        """Byte seal scaled with the dynamic row seal — the same growth
+        factor the apply loop's _scaled_max_bytes applies, so backlog
+        mega-batching grows flush payloads and run seals in lockstep."""
+        return self.seal_bytes * max(1, self.seal_rows // RUN_SEAL_ROWS)
 
     # -- dynamic seal (backlog mega-batching) ---------------------------------
 
@@ -198,6 +236,7 @@ class EventAssembler:
                 raise EtlError(ErrorKind.REPLICATION_MESSAGE_INVALID,
                                f"not a row message: {type(msg).__name__}")
             self._events.append(ev)
+            self._meta.append((64 + len(payload), 1))
             self.size_bytes += 64 + len(payload)
             self.row_events += 1
             return
@@ -292,15 +331,79 @@ class EventAssembler:
             old_pending=old_pending, old_rows=wal.old_rows,
             old_is_key=wal.old_is_key, delete_is_key=wal.delete_is_key,
         ))
+        self._meta.append((64 * len(r.payloads) + sum(map(len, r.payloads)),
+                           len(r.payloads)))
+
+    def note_commit_end(self, end_lsn: Lsn) -> None:
+        """Record a commit watermark: every event assembled SO FAR
+        (counting the open run as the one event it seals into) belongs
+        to transactions whose commit ends ≤ `end_lsn`. The open run may
+        still grow past the mark — the sealed event then carries extra
+        later rows, which only makes the covered prefix a superset
+        (claiming durability at the mark stays exact). The apply loop
+        calls this once per commit boundary; flush() consumes marks with
+        the prefix they cover."""
+        n = len(self._events) \
+            + (1 if self._run is not None and self._run.payloads else 0)
+        lsn = int(end_lsn)
+        if self._commit_marks and self._commit_marks[-1][0] == n:
+            self._commit_marks[-1] = (n, max(self._commit_marks[-1][1], lsn))
+        else:
+            self._commit_marks.append((n, lsn))
 
     def flush(self) -> list[Event]:
-        """Seal any open run, return and reset the assembled events."""
+        """Seal any open run, return and reset the assembled events
+        (the whole window — legacy signature; the apply loop's
+        size-bounded dispatch goes through `flush_bounded`)."""
+        return self.flush_bounded()[0]
+
+    def flush_bounded(self, max_bytes: "int | None" = None
+                      ) -> "tuple[list[Event], Lsn | None, Lsn | None]":
+        """Seal any open run and return `(events, covered_commit_end,
+        remaining_commit_end)`.
+
+        With `max_bytes=None` (or everything fitting) the whole window
+        flushes — exact legacy behavior. Otherwise a WAL-ORDERED PREFIX
+        of events totalling ≤ max_bytes (always at least one event) is
+        returned and the remainder stays assembled, so the write window
+        dispatches size-bounded batches a backlog can pipeline instead
+        of one backlog-sized mega write.
+
+        `covered_commit_end` is the highest commit watermark whose
+        events are ALL inside the returned prefix (None = the flush
+        covers no commit boundary — mid-transaction split);
+        `remaining_commit_end` is the highest watermark still pending in
+        the assembler (None = nothing awaits a future flush)."""
         self._seal_run()
-        events = self._events
-        self._events = []
-        self.size_bytes = 0
-        self.row_events = 0
-        return events
+        if max_bytes is None or self.size_bytes <= max_bytes \
+                or len(self._events) <= 1:
+            events = self._events
+            covered = Lsn(self._commit_marks[-1][1]) \
+                if self._commit_marks else None
+            self._events = []
+            self._meta = []
+            self._commit_marks = []
+            self.size_bytes = 0
+            self.row_events = 0
+            return events, covered, None
+        cum = 0
+        k = 0
+        n = len(self._events)
+        while k < n and (k == 0 or cum + self._meta[k][0] <= max_bytes):
+            cum += self._meta[k][0]
+            k += 1
+        events = self._events[:k]
+        self._events = self._events[k:]
+        self._meta = self._meta[k:]
+        self.size_bytes -= cum
+        self.row_events = sum(r for _, r in self._meta)
+        covered = None
+        while self._commit_marks and self._commit_marks[0][0] <= k:
+            covered = Lsn(self._commit_marks.pop(0)[1])
+        self._commit_marks = [(m - k, lsn) for m, lsn in self._commit_marks]
+        remaining = Lsn(self._commit_marks[-1][1]) \
+            if self._commit_marks else None
+        return events, covered, remaining
 
     def close(self) -> None:
         """Stop the decode pipeline's worker (apply-loop teardown).

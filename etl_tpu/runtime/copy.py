@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 from dataclasses import dataclass
 
+from ..analysis.annotations import flush_path
 from ..config.pipeline import BatchEngine, PipelineConfig
 from ..models.errors import ErrorKind, EtlError
 from ..models.schema import ReplicatedTableSchema, TableId
@@ -26,7 +27,8 @@ from ..ops.pipeline import DecodePipeline
 from ..ops.staging import stage_copy_chunk
 from ..postgres.codec.copy_text import parse_copy_chunk_columns
 from ..postgres.source import ReplicationSource
-from ..destinations.base import Destination, WriteAck
+from ..destinations.base import Destination
+from .ack_window import CopyAckWindow
 from ..telemetry.egress import record_egress
 from ..telemetry.metrics import (ETL_TABLE_COPY_BYTES_TOTAL,
                                  ETL_TABLE_COPY_DURATION_SECONDS,
@@ -79,6 +81,7 @@ def plan_copy_partitions(estimated_rows: int, heap_pages: int,
     return parts
 
 
+@flush_path
 async def _copy_partition(source: ReplicationSource,
                           schema: ReplicatedTableSchema, snapshot_id: str,
                           publication: str, part: CopyPartition,
@@ -89,7 +92,8 @@ async def _copy_partition(source: ReplicationSource,
                           lease=None, pipeline_id: int = 0,
                           decode_window: int = 3, heartbeat=None,
                           supervisor=None,
-                          admission_capacity: int = 0) -> None:
+                          admission_capacity: int = 0,
+                          write_window: int = 4) -> None:
     failpoints.fail_point(failpoints.COPY_PARTITION_START)
     # chaos stall mode: a copy partition that wedges before reading any
     # data — recovered by the watchdog restarting the table-sync worker
@@ -110,7 +114,17 @@ async def _copy_partition(source: ReplicationSource,
     # toward an 8 MB threshold, measured 0.7s/85MB on the copy bench
     pending: list[bytes] = []
     pending_len = 0
-    acks: list[WriteAck] = []
+    # bounded ack window (runtime/ack_window.py): the old `acks` list
+    # accumulated EVERY batch's unresolved ack until end-of-copy — a
+    # huge table held unbounded pending acks and surfaced a failed ack
+    # only at the partition barrier. The window caps outstanding acks
+    # (shrinking to 1 under memory pressure) and awaits the OLDEST
+    # first, so per-partition ordering is preserved and errors surface
+    # within `write_window` batches.
+    acks = CopyAckWindow(
+        write_window,
+        pressure=(lambda: monitor.pressure) if monitor is not None
+        else None)
     # three-stage decode pipeline (ops/pipeline.py): chunk N+1 packs on
     # the pipeline's worker thread into a pooled arena while chunk N
     # computes on the device and N-1 streams back — this partition keeps
@@ -150,7 +164,7 @@ async def _copy_partition(source: ReplicationSource,
         # columnar write seam: the decoded batch goes to the destination
         # AS a batch (Arrow/proto/TSV encoders consume it column-wise);
         # row-oriented destinations fall back via the base-class shim
-        acks.append(await destination.write_table_batch(schema, batch))
+        await acks.add(await destination.write_table_batch(schema, batch))
         progress.total_rows += batch.num_rows
         if heartbeat is not None:
             heartbeat.beat(progress=("copy_rows", progress.total_rows),
@@ -191,7 +205,7 @@ async def _copy_partition(source: ReplicationSource,
         # round-trip masked the real parse cost in profiles)
         cells, n_rows = parse_copy_chunk_columns(chunk, oids)
         batch = ColumnarBatch.from_cells(schema, cells, n_rows)
-        acks.append(await destination.write_table_batch(schema, batch))
+        await acks.add(await destination.write_table_batch(schema, batch))
         progress.total_rows += batch.num_rows
         registry.counter_inc(ETL_TABLE_COPY_ROWS_TOTAL, batch.num_rows)
 
@@ -225,9 +239,9 @@ async def _copy_partition(source: ReplicationSource,
     finally:
         if pipe is not None:
             pipe.close()
-    # durability barrier for this partition (mod.rs:360-378)
-    for ack in acks:
-        await ack.wait_durable()
+    # durability barrier for this partition (mod.rs:360-378): the window
+    # owns the waits (etl-lint rule 17) — drain what is still pending
+    await acks.drain()
     # chaos site: the window between a partition's durability barrier and
     # its progress accounting — a crash here must recopy consistently
     failpoints.fail_point(failpoints.COPY_PARTITION_END)
@@ -296,7 +310,8 @@ async def parallel_table_copy(*, source_factory, primary_source,
                     lease=lease, pipeline_id=config.pipeline_id,
                     decode_window=config.batch.decode_window,
                     heartbeat=heartbeat, supervisor=supervisor,
-                    admission_capacity=config.batch.admission_capacity))
+                    admission_capacity=config.batch.admission_capacity,
+                    write_window=config.batch.write_window))
         finally:
             if lease is not None:
                 lease.release()

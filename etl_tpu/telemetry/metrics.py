@@ -190,6 +190,21 @@ ETL_AUTOSCALE_RESUMES_TOTAL = "etl_autoscale_resumes_total"
 # observed in the last sweep, per-destination breaker state (0 closed /
 # 1 half-open / 2 open) + open transitions, and destination calls the
 # per-op timeout bound had to cut off
+# windowed destination-ack pipeline (runtime/ack_window.py): destination
+# writes in flight right now (labeled {"path": "apply"|"copy"} — the
+# apply loop's bounded write window vs the per-partition copy window),
+# dispatch→durable latency per ack, and the overlap evidence: busy =
+# seconds with ≥1 write in flight, overlap = seconds with ≥2 (the time
+# the window actually hid ack latency behind later writes). The ratio
+# gauge is overlap/busy cumulatively — 0 at window=1 by construction,
+# approaching (K-1)/K when a K-deep window stays saturated.
+ETL_DESTINATION_ACK_IN_FLIGHT = "etl_destination_ack_in_flight"
+ETL_DESTINATION_ACK_LATENCY_SECONDS = "etl_destination_ack_latency_seconds"
+ETL_DESTINATION_ACK_BUSY_SECONDS_TOTAL = \
+    "etl_destination_ack_busy_seconds_total"
+ETL_DESTINATION_ACK_OVERLAP_SECONDS_TOTAL = \
+    "etl_destination_ack_overlap_seconds_total"
+ETL_DESTINATION_ACK_OVERLAP_RATIO = "etl_destination_ack_overlap_ratio"
 ETL_SUPERVISION_EVENTS_TOTAL = "etl_supervision_events_total"
 ETL_SUPERVISION_RESTARTS_TOTAL = "etl_supervision_restarts_total"
 ETL_PIPELINE_HEALTH_STATE = "etl_pipeline_health_state"

@@ -1,0 +1,30 @@
+# etl-lint fixture: bare `await ack.wait_durable()` inside a
+# @flush_path function (runtime/ack_window.py owns durability waits):
+# an inline wait re-serializes the pipeline to one ack round-trip per
+# batch — the exact ceiling the bounded write window removes. Nested
+# defs and lambdas inherit the frame flag (the flush submit closures).
+# expect: inline-durability-wait=3
+from etl_tpu.analysis.annotations import flush_path
+
+
+@flush_path
+async def flush_one_batch(destination, events):
+    ack = await destination.write_event_batches(events)
+    await ack.wait_durable()  # flagged: the window owns this wait
+    return len(events)
+
+
+@flush_path
+async def copy_chunk_barrier(destination, schema, batch):
+    ack = await destination.write_table_batch(schema, batch)
+
+    async def barrier():
+        # nested def inherits the flush-path flag: flagged
+        await ack.wait_durable()
+
+    await barrier()
+
+
+@flush_path
+def make_waiter(ack):
+    return lambda: ack.wait_durable()  # lambda inherits: flagged

@@ -1,0 +1,25 @@
+# etl-lint fixture: clean @flush_path dispatch — acks route through the
+# bounded ack window (which owns the durability waits); an inline wait
+# OUTSIDE any marked function (a destination's own internals, a test
+# barrier) is fine.
+# (no expectations: zero findings)
+from etl_tpu.analysis.annotations import flush_path
+
+
+@flush_path
+async def dispatch_flush(window, destination, events, commit_end):
+    async def submit():
+        return await destination.write_event_batches(events)
+
+    window.dispatch(submit, commit_end_lsn=commit_end,
+                    n_events=len(events))
+
+
+@flush_path
+async def copy_chunk(window, destination, schema, batch):
+    await window.add(await destination.write_table_batch(schema, batch))
+
+
+async def test_barrier(ack):
+    # unmarked code may wait inline (tests, destination internals)
+    await ack.wait_durable()

@@ -488,3 +488,12 @@ class TestBenchSmoke:
         assert out["autoscale_deterministic"] is True
         assert out["autoscale_chaos_ok"] is True, out["autoscale_chaos"]
         assert out["autoscale_chaos"]["union_matches"] is True
+        # windowed-ack gate (ISSUE 14): the same deterministic backlog
+        # through the default write window vs a forced window=1 run —
+        # speedup above the floor, byte-identical delivery, the
+        # one-in-flight contract at window=1, provable overlap
+        assert out["ack_window_ok"] is True, out["ack_window_failures"]
+        assert out["ack_window_speedup"] >= \
+            out["ack_window_speedup_floor"]
+        assert out["ack_window_max_pending"] >= 2
+        assert out["ack_window_failures"] == []

@@ -497,7 +497,9 @@ class TestPrewarm:
 
         c0 = registry.get_counter(ETL_PROGRAMS_COMPILED_TOTAL)
         stats = asyncio.run(go())
-        assert stats == {"layouts": 1, "ready": 1, "building": 0}
+        assert stats == {"layouts": 1, "ready": 1, "building": 0,
+                         "observed": 1, "observed_ready": 1,
+                         "observed_missing": 0}
         assert registry.get_counter(ETL_PROGRAMS_COMPILED_TOTAL) == c0
         assert engine_mod._shared_fn_get(key) is not None
 
@@ -513,7 +515,9 @@ class TestPrewarm:
                 BatchConfig(program_cache_dir=str(tmp_path)))
 
         assert asyncio.run(go()) == {"layouts": 0, "ready": 0,
-                                     "building": 0}
+                                     "building": 0, "observed": 0,
+                                     "observed_ready": 0,
+                                     "observed_missing": 0}
 
     def test_prewarm_auto_disabled_without_cache_dir(self):
         import asyncio
