@@ -546,6 +546,32 @@ def run_smoke() -> dict:
             f"{ack_floor}")
     ack_ok = not ack_failures
 
+    # poison-resilience gates (ISSUE 15): (a) the bench A/B — the same
+    # seeded insert-CDC workload clean vs 0.1%-poisoned against a
+    # rejecting destination with isolation live; the poisoned rate must
+    # hold ≥ poison_ratio_floor of the clean rate, bisection probe
+    # writes must stay inside the 2·log₂(batch) bound, and both runs
+    # must verify (the poisoned one against the UNION invariant:
+    # delivered ∪ dead-lettered == committed truth); (b) the dead-letter
+    # chaos scenario — poison rows mid-stream isolate to the DLQ,
+    # the poisoned table quarantines at budget while every survivor
+    # delivers its full workload, and replay + unquarantine restores
+    # exact committed truth idempotently
+    poison = asyncio.run(harness.run_poison_streaming(
+        rate=floors.get("poison_rate", 0.001),
+        target_ops=floors.get("poison_smoke_ops", 12_000)))
+    poison_floor = floors.get("poison_ratio_floor", 0.7)
+    poison_failures = list(poison["failures"])
+    if poison["poison_throughput_ratio"] < poison_floor:
+        poison_failures.append(
+            f"poisoned throughput ratio "
+            f"{poison['poison_throughput_ratio']} under floor "
+            f"{poison_floor}")
+    from etl_tpu.chaos.dlq import run_dlq_poison
+
+    dlq_chaos = asyncio.run(run_dlq_poison(seed=7))
+    poison_ok = not poison_failures and dlq_chaos.ok
+
     # multi-pipeline tenancy gate (ISSUE 8): ≥2 concurrent streams
     # sharing one device set through the fair batch-admission scheduler,
     # every stream's end state verified, aggregate events/s above the
@@ -619,7 +645,16 @@ def run_smoke() -> dict:
                    and egress_ok and workload_ok and mesh_ok and mp_ok
                    and sharded_chaos_ok and sharded_ok
                    and selectivity_ok and coldstart_ok
-                   and autoscale_ok and ack_ok),
+                   and autoscale_ok and ack_ok and poison_ok),
+        "poison_ok": bool(poison_ok),
+        "poison_throughput_ratio": poison["poison_throughput_ratio"],
+        "poison_ratio_floor": poison_floor,
+        "poison_probe_writes": poison["poisoned"]["probe_writes"],
+        "poison_probe_bound": poison["poisoned"]["probe_bound"],
+        "poison_dlq_entries": poison["poisoned"]["dlq_entries"],
+        "poison_failures": poison_failures,
+        "dlq_chaos_ok": bool(dlq_chaos.ok),
+        "dlq_chaos": dlq_chaos.describe(),
         "ack_window_ok": bool(ack_ok),
         "ack_window_speedup": ack["ack_window_speedup"],
         "ack_window_speedup_floor": ack_floor,
@@ -862,6 +897,22 @@ def main():
                              "BENCH_FLOOR.json plus byte-identical "
                              "delivery and the one-in-flight contract "
                              "at window=1")
+    parser.add_argument("--poison", dest="poison", action="store_true",
+                        help="poison-resilience mode: the same seeded "
+                             "insert-CDC workload measured clean and "
+                             "with poison_rate of rows poisoned against "
+                             "a rejecting destination (isolation + "
+                             "dead-letter live); gates the poisoned "
+                             "rate >= poison_ratio_floor x the clean "
+                             "rate, bisection probe writes within the "
+                             "2·log2(batch) bound, and the union "
+                             "invariant delivered ∪ dead-lettered == "
+                             "committed truth")
+    parser.add_argument("--poison-ops", dest="poison_ops", type=int,
+                        default=None, metavar="N",
+                        help="row ops per measured poison pass "
+                             "(default: poison_smoke_ops from "
+                             "BENCH_FLOOR.json)")
     parser.add_argument("--workload", default=None, metavar="PROFILE",
                         help="workload matrix mode: run the named workload "
                              "profile (etl_tpu/workloads; 'all' = every "
@@ -931,6 +982,31 @@ def main():
             out["failures"].append(
                 f"ack-window speedup {out['ack_window_speedup']} under "
                 f"floor {floor}")
+            out["ok"] = False
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
+    if args.poison:
+        # full pipeline on the host CPU platform (fake walsender,
+        # poison-rejecting memory destination) — the isolation protocol
+        # is the system under test; never touches the tunnel
+        import asyncio
+
+        jax.config.update("jax_platforms", "cpu")
+        from etl_tpu.benchmarks import harness
+
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_FLOOR.json")) as f:
+            floors = json.load(f)
+        out = asyncio.run(harness.run_poison_streaming(
+            rate=floors.get("poison_rate", 0.001), seed=args.seed,
+            target_ops=args.poison_ops
+            or floors.get("poison_smoke_ops", 12_000)))
+        floor = floors.get("poison_ratio_floor", 0.7)
+        out["ratio_floor"] = floor
+        if out["poison_throughput_ratio"] < floor:
+            out["failures"].append(
+                f"poisoned throughput ratio "
+                f"{out['poison_throughput_ratio']} under floor {floor}")
             out["ok"] = False
         print(json.dumps(out))
         sys.exit(0 if out["ok"] else 1)

@@ -778,6 +778,91 @@ class InlineDurabilityWait(Rule):
             f"barrier with an inline ignore")
 
 
+# -- rule 18 ------------------------------------------------------------------
+
+#: destination write-path entry points: an `except Exception` that
+#: re-raises unwrapped from one of these hands the worker retry layer a
+#: failure with no ErrorKind — which the retry classifier treats as
+#: UNKNOWN/TIMED and, worse, the poison-isolation protocol can never
+#: trigger on (models.errors.POISON_KINDS needs a concrete kind)
+DESTINATION_WRITE_FNS = frozenset({
+    "write_events", "write_table_rows", "write_event_batches",
+    "write_table_batch",
+})
+
+#: names whose appearance in a raised expression mean the failure was
+#: classified: an EtlError construction, the shared classifiers, or
+#: anything carrying an ErrorKind
+_CLASSIFIED_RAISE_NAMES = ("EtlError", "ErrorKind", "etl_error",
+                           "classify_http_error",
+                           "classify_write_exception")
+
+
+class UnclassifiedDestinationError(Rule):
+    """Broad `except Exception` (or bare `except`) on a destination
+    write path or inside a `@flush_path` function whose body RE-RAISES
+    without wrapping in `EtlError`/`ErrorKind`: the unclassified
+    exception reaches the worker retry layer bare, where the retry
+    classifier falls back to UNKNOWN (blind timed retry) and the
+    poison-isolation protocol (runtime/poison.py) can never key on it —
+    a permanent rejection retries forever instead of bisecting to the
+    poison row. Wrap through `destinations.util.classify_write_exception`
+    / `classify_http_error` (or construct a typed EtlError), or justify
+    a deliberate passthrough with an inline ignore. Handlers that never
+    re-raise are rule 5's (cancellation-swallow) business, not this
+    rule's. Lexical: the flush-path frame flag inherits into nested
+    defs/lambdas; the write-path function-name scope covers nested defs
+    too (the retried `attempt()` closures)."""
+
+    name = "unclassified-destination-error"
+
+    @staticmethod
+    def _raise_classified(node: ast.Raise) -> bool:
+        if node.exc is None:
+            return False  # bare re-raise: whatever was caught, unwrapped
+        for n in ast.walk(node.exc):
+            label = None
+            if isinstance(n, ast.Name):
+                label = n.id
+            elif isinstance(n, ast.Attribute):
+                label = n.attr
+            if label in _CLASSIFIED_RAISE_NAMES:
+                return True
+        return False
+
+    @staticmethod
+    def _in_scope(ctx: LintContext) -> bool:
+        if ctx.in_flush_path:
+            return True
+        if ctx.rel_path.split("/", 1)[0] != "destinations":
+            return False
+        return any(part in DESTINATION_WRITE_FNS
+                   for part in ctx.scope.split("."))
+
+    def on_except_handler(self, ctx: LintContext,
+                          node: ast.ExceptHandler) -> None:
+        if not self._in_scope(ctx):
+            return
+        names = set(handler_type_names(node))
+        if not ({"Exception", "<bare>"} & names):
+            return
+        raises = [n for stmt in node.body for n in ast.walk(stmt)
+                  if isinstance(n, ast.Raise)]
+        if not raises:
+            return  # swallowing is cancellation-swallow's concern
+        if all(self._raise_classified(r) for r in raises):
+            return
+        caught = "except" if "<bare>" in names else "except Exception"
+        ctx.report(
+            self.name, node, caught,
+            f"`{caught}` on a destination write path re-raises without "
+            f"wrapping in EtlError/ErrorKind: the unclassified failure "
+            f"reaches the retry layer bare (blind UNKNOWN retry, and "
+            f"the poison-isolation trigger can never fire) — wrap via "
+            f"destinations.util.classify_write_exception / "
+            f"classify_http_error, or justify with an inline ignore")
+
+
 # -- entry points -------------------------------------------------------------
 
 def default_rules() -> list[Rule]:
@@ -795,6 +880,7 @@ def default_rules() -> list[Rule]:
         CrossShardTableAccess(),
         ControlLoopBlockingIo(),
         InlineDurabilityWait(),
+        UnclassifiedDestinationError(),
     ]
 
 

@@ -1684,3 +1684,173 @@ async def run_ack_latency(ack_ms: float = 20.0, n_events: int = 2000,
         "failures": failures,
         "ok": not failures,
     }
+
+
+async def _run_poison_pass(profile, seed: int, target_ops: int,
+                           poisoned: bool,
+                           verify_timeout_s: float = 120.0) -> dict:
+    """One streamed-CDC measurement for the poison gate: the same
+    (profile, seed) workload through the full pipeline, either clean
+    (poison_rate=0, plain destination, view==truth verification) or
+    poisoned (PoisonRejectingDestination + isolation live, union
+    verification: delivered ∪ dead-lettered == committed truth)."""
+    from dataclasses import replace as _replace
+
+    from ..chaos.invariants import reconstruct_final_view, view_matches
+    from ..chaos.runner import RecordingStore, TracingDestination
+    from ..config import (BatchConfig, BatchEngine, PipelineConfig,
+                          PoisonConfig)
+    from ..destinations import PoisonRejectingDestination
+    from ..dlq.codec import decode_cell
+    from ..models.table_state import TableStateType
+    from ..postgres.fake import FakeSource
+    from ..runtime import Pipeline
+    from ..runtime import poison as poison_mod
+    from ..workloads import WorkloadGenerator
+
+    if not poisoned:
+        profile = _replace(profile, poison_rate=0.0)
+    gen = WorkloadGenerator(profile, seed=seed)
+    db = gen.build_db()
+    store = RecordingStore()
+    inner = TracingDestination()
+    dest = PoisonRejectingDestination(inner) if poisoned else inner
+    pipeline = Pipeline(
+        config=PipelineConfig(
+            pipeline_id=1, publication_name="pub",
+            batch=BatchConfig(max_fill_ms=30,
+                              batch_engine=BatchEngine("tpu")),
+            # budget high enough that quarantine never trips: the gate
+            # measures bisection + DLQ cost on a flowing stream, not the
+            # (cheaper) parking path
+            poison=PoisonConfig(budget_rows=1_000_000)),
+        store=store, destination=dest,
+        source_factory=lambda: FakeSource(db))
+
+    async def settled() -> bool:
+        if not poisoned:
+            return view_matches(inner, gen.table_ids, gen.expected)
+        entries = await store.list_dead_letters(status=None)
+        import json as _json
+
+        dlq: dict = {tid: {} for tid in gen.table_ids}
+        for e in sorted(entries, key=lambda e: (e.commit_lsn,
+                                                e.tx_ordinal)):
+            doc = _json.loads(e.payload)
+            values = tuple(decode_cell(v) for v in doc["values"])
+            dlq.setdefault(e.table_id, {})[values[0]] = values
+        view = reconstruct_final_view(inner, gen.table_ids)
+        for tid in gen.table_ids:
+            for pk, values in gen.expected[tid].items():
+                if view[tid].get(pk) != values \
+                        and dlq[tid].get(pk) != values:
+                    return False
+        return True
+
+    async def wait_settled(timeout: float) -> bool:
+        deadline = time.perf_counter() + timeout
+        seen = -1
+        while True:
+            n = len(inner.events)
+            if n == seen and await settled():
+                return True
+            seen = n
+            if pipeline._apply_task is not None \
+                    and pipeline._apply_task.done():
+                pipeline._apply_task.result()
+                raise RuntimeError("pipeline stopped before delivering")
+            if time.perf_counter() >= deadline:
+                return False
+            await asyncio.sleep(0.1)
+
+    poison_mod.reset_isolation_trace()
+    try:
+        await pipeline.start()
+        for tid in gen.table_ids:
+            await asyncio.wait_for(
+                store.notify_on(tid, TableStateType.READY), 120)
+        warm_target = max(100, target_ops // 5)
+        while gen.row_ops < warm_target:
+            await gen.run_tx(db)
+        if not await wait_settled(240):
+            raise RuntimeError("warmup never settled")
+        await _wait_background_compiles()
+        ops0 = gen.row_ops
+        t0 = time.perf_counter()
+        while gen.row_ops - ops0 < target_ops:
+            await gen.run_tx(db)
+        verified = await wait_settled(verify_timeout_s)
+        t_done = time.perf_counter()
+    finally:
+        if pipeline._apply_task is not None:
+            await pipeline.shutdown_and_wait()
+    measured = gen.row_ops - ops0
+    traces = list(poison_mod.ISOLATION_TRACE)
+    probe_writes = sum(t["probe_writes"] for t in traces)
+    probe_bound = sum(
+        poison_mod.bisection_bound(t["rows"], t["tables"],
+                                   t["poison_rows"]) for t in traces)
+    dlq_entries = len(await store.list_dead_letters(status=None)) \
+        if poisoned else 0
+    return {
+        "events_per_second": round(measured / max(t_done - t0, 1e-9)),
+        "row_ops": measured,
+        "verified": bool(verified),
+        "poison_rows_committed": sum(len(v)
+                                     for v in gen.poison_pks.values()),
+        "dlq_entries": dlq_entries,
+        "isolations": len(traces),
+        "probe_writes": probe_writes,
+        "probe_bound": probe_bound,
+        "bound_ok": probe_writes <= probe_bound,
+    }
+
+
+async def run_poison_streaming(rate: float = 0.001, seed: int = 7,
+                               target_ops: int = 3_000) -> dict:
+    """The poison-resilience gate (bench.py --poison): the SAME seeded
+    insert-CDC workload measured twice — clean, and with `rate` of rows
+    poisoned against a rejecting destination with isolation live. GATES
+    (caller applies floors): the poisoned rate must hold ≥
+    poison_ratio_floor of the clean rate, the isolation probe writes
+    must stay within the bisection bound, and BOTH runs must verify
+    (clean: view == truth; poisoned: delivered ∪ dead-lettered ==
+    truth, every poison row accounted)."""
+    from dataclasses import replace as _replace
+
+    from ..workloads import get_profile
+
+    profile = _replace(get_profile("poison_rows"), poison_rate=rate)
+    clean = await _run_poison_pass(profile, seed, target_ops,
+                                   poisoned=False)
+    poisoned = await _run_poison_pass(profile, seed, target_ops,
+                                      poisoned=True)
+    ratio = poisoned["events_per_second"] \
+        / max(1, clean["events_per_second"])
+    failures = []
+    if not clean["verified"]:
+        failures.append("clean pass failed end-state verification")
+    if not poisoned["verified"]:
+        failures.append("poisoned pass failed the union invariant "
+                        "(delivered ∪ dead-lettered != committed truth)")
+    if not poisoned["bound_ok"]:
+        failures.append(
+            f"bisection writes {poisoned['probe_writes']} exceeded the "
+            f"bound {poisoned['probe_bound']}")
+    if poisoned["poison_rows_committed"] == 0:
+        failures.append("seed committed no poison rows — the gate "
+                        "measured nothing; raise target_ops or rate")
+    elif poisoned["dlq_entries"] == 0:
+        failures.append("poison rows committed but none dead-lettered")
+    return {
+        "mode": "poison",
+        "seed": seed,
+        "poison_rate": rate,
+        "clean": clean,
+        "poisoned": poisoned,
+        "clean_events_per_second": clean["events_per_second"],
+        "poisoned_events_per_second": poisoned["events_per_second"],
+        "poison_throughput_ratio": round(ratio, 3),
+        "failures": failures,
+        "ok": not failures,
+    }

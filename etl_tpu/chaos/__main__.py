@@ -76,6 +76,19 @@ def main(argv: list[str] | None = None) -> int:
                              "killed mid-rebalance and a successor must "
                              "resume via the persisted decision journal "
                              "with no leaked slots")
+    parser.add_argument("--dlq", dest="dlq", action="store_true",
+                        help="run the poison-pill / dead-letter "
+                             "scenarios instead of the corpus: (1) "
+                             "seeded poison rows mid-stream must bisect "
+                             "to the DLQ within the probe-write bound, "
+                             "quarantine the poisoned table once the "
+                             "budget trips while every OTHER table "
+                             "delivers its full workload, hold "
+                             "delivered ∪ dead-lettered == committed "
+                             "truth, and replay+unquarantine must "
+                             "restore exact truth idempotently; (2) a "
+                             "hard kill mid-bisection must reconverge "
+                             "within the dup budget after restart")
     parser.add_argument("--list", action="store_true",
                         help="list scenario names and exit")
     parser.add_argument("--timeout", type=float, default=60.0,
@@ -122,6 +135,22 @@ def main(argv: list[str] | None = None) -> int:
         run = asyncio.run(run_ack_window_crash(seed=args.seed))
         print(json.dumps(run.describe(), sort_keys=True))
         return 0 if run.ok else 1
+
+    if args.dlq:
+        if args.matrix or args.workload or args.scenario or args.sharded \
+                or args.autoscale or args.multi_pipeline or args.ack_window:
+            parser.error("--dlq runs its own poison-isolation scenarios "
+                         "and cannot be combined with --matrix/"
+                         "--workload/--scenario/--sharded/--autoscale/"
+                         "--multi-pipeline/--ack-window")
+        from .dlq import run_dlq_scenarios
+
+        runs = asyncio.run(run_dlq_scenarios(seed=args.seed))
+        all_ok = True
+        for run in runs:
+            print(json.dumps(run.describe(), sort_keys=True))
+            all_ok = all_ok and run.ok
+        return 0 if all_ok else 1
 
     if args.autoscale:
         if args.matrix or args.workload or args.scenario or args.sharded \

@@ -88,13 +88,25 @@ STORE_SHARD_COMMIT = "store.shard_commit"
 # covers (etl_tpu/autoscale/controller.py)
 STORE_AUTOSCALE_COMMIT = "store.autoscale_commit"
 
+# dead-letter appends (store/memory.py, store/sql.py): the isolation
+# protocol persists poison rows here BEFORE acking their flush durable —
+# a fault is the crash-between-bisect-and-dead-letter window the
+# idempotent (keyed upsert) append covers (docs/dead-letter.md)
+STORE_DLQ_COMMIT = "store.dlq_commit"
+
+# poison-pill bisection (runtime/poison.py): fires once per bisection
+# probe write — a crash here is the hard-kill-mid-bisection window the
+# --dlq chaos scenario proves recoverable within the dup budget
+POISON_BISECT = "poison.bisect"
+
 CHAOS_SITES = (
     PIPELINE_PACK, PIPELINE_DISPATCH, PIPELINE_FETCH, ENGINE_DEVICE_OOM,
     COPY_PARTITION_START, COPY_PARTITION_END, ASSEMBLER_SEAL,
     APPLY_FRAME_READ,
     DESTINATION_WRITE, DESTINATION_FLUSH,
     STORE_STATE_COMMIT, STORE_SCHEMA_COMMIT, STORE_PROGRESS_COMMIT,
-    STORE_SHARD_COMMIT, STORE_AUTOSCALE_COMMIT,
+    STORE_SHARD_COMMIT, STORE_AUTOSCALE_COMMIT, STORE_DLQ_COMMIT,
+    POISON_BISECT,
 )
 
 #: sites that can stall asynchronously (an armed stall is consumed by the
@@ -105,7 +117,8 @@ ASYNC_STALL_SITES = (
     APPLY_FRAME_READ, DESTINATION_WRITE, DESTINATION_FLUSH,
     COPY_PARTITION_START, COPY_PARTITION_END,
     STORE_STATE_COMMIT, STORE_SCHEMA_COMMIT, STORE_PROGRESS_COMMIT,
-    STORE_SHARD_COMMIT, STORE_AUTOSCALE_COMMIT,
+    STORE_SHARD_COMMIT, STORE_AUTOSCALE_COMMIT, STORE_DLQ_COMMIT,
+    POISON_BISECT,
 )
 
 ALL_SITES = REFERENCE_SITES + CHAOS_SITES

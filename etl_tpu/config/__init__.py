@@ -2,5 +2,5 @@
 
 from .pipeline import (BatchConfig, BatchEngine, InvalidatedSlotBehavior,
                        MemoryBackpressureConfig, PgConnectionConfig,
-                       PipelineConfig, RetryConfig, SupervisionConfig,
-                       TableSyncCopyConfig, TlsConfig)
+                       PipelineConfig, PoisonConfig, RetryConfig,
+                       SupervisionConfig, TableSyncCopyConfig, TlsConfig)

@@ -190,6 +190,35 @@ class SupervisionConfig:
 
 
 @dataclass(frozen=True)
+class PoisonConfig:
+    """Poison-pill isolation (runtime/poison.py, docs/dead-letter.md).
+
+    When a CDC flush fails with a PERMANENT destination error
+    (models.errors.POISON_KINDS — the payload is refused, the
+    destination is healthy), the apply loop bisects the failing batch
+    down to the poison row(s), delivers the healthy complement in WAL
+    order, and parks the poison rows on the durable dead-letter surface
+    instead of dying. Tables that exceed `budget_rows` dead-lettered
+    rows inside a sliding `window_s` window transition to QUARANTINE:
+    their events bypass the destination (parked straight to the DLQ,
+    counted) while every other table keeps replicating."""
+
+    enabled: bool = True
+    # dead-lettered rows per table per window before the table
+    # quarantines (also the bisection work bound: once tripped, the
+    # remaining rows of that table park without further probe writes)
+    budget_rows: int = 8
+    window_s: float = 300.0
+    # truncate the stored error detail per entry (payloads are bounded
+    # by the flush sizing already)
+    max_detail_chars: int = 500
+
+    def validate(self) -> None:
+        _require(self.budget_rows >= 1, "poison budget_rows must be >= 1")
+        _require(self.window_s > 0, "poison window_s must be > 0")
+
+
+@dataclass(frozen=True)
 class RetryConfig:
     max_attempts: int = 5
     initial_delay_ms: int = 1_000
@@ -214,6 +243,7 @@ class PipelineConfig:
     apply_retry: RetryConfig = field(default_factory=RetryConfig)
     table_retry: RetryConfig = field(default_factory=RetryConfig)
     supervision: SupervisionConfig = field(default_factory=SupervisionConfig)
+    poison: PoisonConfig = field(default_factory=PoisonConfig)
     # every Destination.startup/write/flush await is bounded by this (a
     # destination that never returns surfaces as EtlError(TIMEOUT), not
     # an eternal await); 0 disables the bound
@@ -260,6 +290,7 @@ class PipelineConfig:
         self.backpressure.validate()
         self.table_sync_copy.validate()
         self.supervision.validate()
+        self.poison.validate()
 
     @property
     def keepalive_deadline_ms(self) -> int:

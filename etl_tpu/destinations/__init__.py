@@ -3,5 +3,5 @@
 from .base import Destination, WriteAck, expand_batch_events
 from .delay import DelayedAckDestination
 from .memory import (FaultAction, FaultInjectingDestination, FaultKind,
-                     MemoryDestination)
+                     MemoryDestination, PoisonRejectingDestination)
 from .registry import build_destination

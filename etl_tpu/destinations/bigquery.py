@@ -53,7 +53,7 @@ from . import bq_proto
 from .base import Destination, WriteAck, expand_batch_events
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
                    DestinationRetryPolicy, TaskSet, change_type_label,
-                   escaped_table_name, http_status_retryable,
+                   classify_http_error, escaped_table_name,
                    require_full_batch, require_full_row,
                    sequential_event_program, versioned_table_name,
                    with_retries)
@@ -159,11 +159,10 @@ class BigQueryDestination(Destination):
                 if resp.status == 409:  # duplicate → idempotent success
                     return {"alreadyExists": True}
                 if resp.status >= 400:
-                    raise EtlError(
-                        ErrorKind.DESTINATION_THROTTLED
-                        if http_status_retryable(resp.status)
-                        else ErrorKind.DESTINATION_FAILED,
-                        f"bigquery {resp.status} {path}: {text[:300]}")
+                    # shared status→kind map (util.classify_http_error):
+                    # permanent 4xx become the poison-trigger kinds
+                    raise classify_http_error(
+                        "bigquery", resp.status, f"{path}: {text[:300]}")
                 return json.loads(text) if text else {}
 
         def retryable(e: BaseException) -> bool:
@@ -398,12 +397,9 @@ class BigQueryDestination(Destination):
                     headers=headers) as resp:
                 payload = await resp.read()
                 if resp.status >= 400:
-                    raise EtlError(
-                        ErrorKind.DESTINATION_THROTTLED
-                        if http_status_retryable(resp.status)
-                        else ErrorKind.DESTINATION_FAILED,
-                        f"bigquery {resp.status} {path}: "
-                        f"{payload[:200]!r}")
+                    raise classify_http_error(
+                        "bigquery", resp.status,
+                        f"{path}: {payload[:200]!r}")
                 return payload
 
         def retryable(e: BaseException) -> bool:
@@ -433,11 +429,8 @@ class BigQueryDestination(Destination):
                     return True
                 if resp.status == 404:
                     return False
-                raise EtlError(
-                    ErrorKind.DESTINATION_THROTTLED
-                    if http_status_retryable(resp.status)
-                    else ErrorKind.DESTINATION_FAILED,
-                    f"bigquery table probe {resp.status} for {table}")
+                raise classify_http_error(
+                    "bigquery", resp.status, f"table probe for {table}")
 
         def retryable(e: BaseException) -> bool:
             if isinstance(e, EtlError):
@@ -502,10 +495,14 @@ class BigQueryDestination(Destination):
             resp = bq_proto.decode_append_rows_response(payload)
             if resp.row_errors:
                 # permanent: bad data / schema mismatch per row
-                # (client.rs:222-244); row values are NOT echoed
+                # (client.rs:222-244); row values are NOT echoed.
+                # DESTINATION_REJECTED — the per-row refusal is THE
+                # poison-pill trigger (docs/dead-letter.md): the
+                # isolation protocol bisects the batch to the rejected
+                # row(s) instead of blind-retrying the same bytes
                 first = resp.row_errors[0]
                 raise EtlError(
-                    ErrorKind.DESTINATION_FAILED,
+                    ErrorKind.DESTINATION_REJECTED,
                     f"bigquery rejected {len(resp.row_errors)} row(s); "
                     f"first: row {first.index} code {first.code}")
             status = resp.error
@@ -541,8 +538,19 @@ class BigQueryDestination(Destination):
                      bq_proto.GRPC_ABORTED, bq_proto.GRPC_CANCELLED,
                      bq_proto.GRPC_DEADLINE_EXCEEDED,
                      bq_proto.GRPC_RESOURCE_EXHAUSTED}
-        kind = ErrorKind.DESTINATION_THROTTLED \
-            if status.code in transient else ErrorKind.DESTINATION_FAILED
+        if status.code in transient:
+            kind = ErrorKind.DESTINATION_THROTTLED
+        elif status.code in (bq_proto.GRPC_PERMISSION_DENIED,):
+            kind = ErrorKind.DESTINATION_AUTH_FAILED
+        elif status.code == bq_proto.GRPC_NOT_FOUND:
+            kind = ErrorKind.DESTINATION_SCHEMA_FAILED
+        elif status.code in (bq_proto.GRPC_INVALID_ARGUMENT,
+                             bq_proto.GRPC_FAILED_PRECONDITION):
+            # the payload was refused — permanent for these bytes, the
+            # poison-isolation trigger kind (docs/dead-letter.md)
+            kind = ErrorKind.DESTINATION_REJECTED
+        else:
+            kind = ErrorKind.DESTINATION_FAILED
         return EtlError(kind, f"bigquery storage write error "
                               f"(grpc code {status.code}): {status.message}")
 

@@ -41,7 +41,7 @@ from .base import expand_batch_events
 from .util import (CDC_DELETE, CDC_UPSERT, CHANGE_SEQUENCE_COLUMN,
                    CHANGE_TYPE_COLUMN, DestinationRetryPolicy,
                    change_type_label, escaped_table_name,
-                   http_status_retryable, require_full_batch,
+                   classify_http_error, require_full_batch,
                    require_full_row, sequential_event_program,
                    with_retries)
 
@@ -296,16 +296,12 @@ class ClickHouseDestination(Destination):
                                            self.config.password)) as resp:
                 text = await resp.text()
                 if resp.status != 200:
-                    # HTTP status → ErrorKind; the unified RetryPolicy
-                    # classifies the kind (throttle/connection/timeout =
-                    # transient, rejected payloads = permanent → the
-                    # worker loop re-streams instead)
-                    err = EtlError(
-                        ErrorKind.DESTINATION_THROTTLED
-                        if http_status_retryable(resp.status)
-                        else ErrorKind.DESTINATION_FAILED,
-                        f"clickhouse {resp.status}: {text[:300]}")
-                    raise err
+                    # shared HTTP status → ErrorKind map
+                    # (util.classify_http_error): throttle/5xx =
+                    # transient, permanent 4xx = the poison-trigger
+                    # kinds the isolation protocol bisects on
+                    raise classify_http_error("clickhouse", resp.status,
+                                              text[:300])
                 return text
 
         return await with_retries(attempt, self.retry)

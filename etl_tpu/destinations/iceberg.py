@@ -47,7 +47,7 @@ from .iceberg_meta import (DataFileInfo, build_snapshot, data_file_stats,
                            write_manifest_list)
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
                    DestinationRetryPolicy, change_type_label,
-                   escaped_table_name, http_status_retryable,
+                   classify_http_error, escaped_table_name,
                    require_full_row, sequential_event_program,
                    with_retries)
 
@@ -129,11 +129,8 @@ class IcebergDestination(Destination):
                     # must re-adopt catalog state and rebuild the commit
                     raise _CasConflict(text[:300])
                 if resp.status >= 400:
-                    raise EtlError(
-                        ErrorKind.DESTINATION_THROTTLED
-                        if http_status_retryable(resp.status)
-                        else ErrorKind.DESTINATION_FAILED,
-                        f"iceberg {resp.status} {path}: {text[:300]}")
+                    raise classify_http_error(
+                        "iceberg", resp.status, f"{path}: {text[:300]}")
                 return json.loads(text) if text else {}
 
         def retryable(e: BaseException) -> bool:

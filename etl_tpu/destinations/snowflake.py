@@ -43,7 +43,7 @@ from .snowpipe import (ZERO_OFFSET, AcceptedBatch, ChannelHandle,
                        RestStreamClient, RowBatch, RowBatchBuilder,
                        offset_token)
 from .util import (DestinationRetryPolicy, escaped_table_name,
-                   http_status_retryable, require_full_batch,
+                   classify_http_error, require_full_batch,
                    require_full_row, sequential_event_program, with_retries)
 
 # CDC metadata column names (reference schema.rs:6-7)
@@ -303,12 +303,16 @@ class SnowflakeDestination(Destination):
                     # the cached JWT and retry (reference auth.rs)
                     self.auth.invalidate_token()
                 if resp.status >= 400:
-                    raise EtlError(
-                        ErrorKind.DESTINATION_THROTTLED
-                        if resp.status == 401
-                        or http_status_retryable(resp.status)
-                        else ErrorKind.DESTINATION_FAILED,
-                        f"snowflake {resp.status} statements: {text[:300]}")
+                    if resp.status == 401:
+                        # transient once re-signed (kept out of the
+                        # shared map: the JWT invalidation above makes
+                        # the retry meaningful)
+                        raise EtlError(
+                            ErrorKind.DESTINATION_THROTTLED,
+                            f"snowflake 401 statements: {text[:300]}")
+                    raise classify_http_error(
+                        "snowflake", resp.status,
+                        f"statements: {text[:300]}")
                 return json.loads(text) if text else {}
 
         def retryable(e: BaseException) -> bool:

@@ -187,6 +187,71 @@ def http_status_retryable(status: int) -> bool:
     return status in _RETRYABLE_HTTP
 
 
+def classify_http_error(destination: str, status: int,
+                        text: str = "") -> "EtlError":
+    """HTTP status → concrete ErrorKind — ONE classification shared by
+    every HTTP destination, so permanent-vs-transient can never drift
+    per sink (docs/dead-letter.md: this is the trigger signal the
+    poison-isolation protocol keys on).
+
+      retryable statuses (408/409/429/5xx)  → DESTINATION_THROTTLED
+                                              (transient: writer retries
+                                              in place, then the worker
+                                              re-streams)
+      401 / 403                             → DESTINATION_AUTH_FAILED
+      404 / 410                             → DESTINATION_SCHEMA_FAILED
+                                              (the table/dataset/channel
+                                              the write names is gone —
+                                              schema drift)
+      413                                   → DESTINATION_PAYLOAD_TOO_LARGE
+      every other 4xx                       → DESTINATION_REJECTED
+                                              (the payload was refused:
+                                              permanent for these bytes,
+                                              the poison-pill kind)
+    """
+    if http_status_retryable(status) or status >= 500:
+        kind = ErrorKind.DESTINATION_THROTTLED
+    elif status in (401, 403):
+        kind = ErrorKind.DESTINATION_AUTH_FAILED
+    elif status in (404, 410):
+        kind = ErrorKind.DESTINATION_SCHEMA_FAILED
+    elif status == 413:
+        kind = ErrorKind.DESTINATION_PAYLOAD_TOO_LARGE
+    elif 400 <= status < 500:
+        kind = ErrorKind.DESTINATION_REJECTED
+    else:
+        kind = ErrorKind.DESTINATION_FAILED
+    return EtlError(kind, f"{destination} {status}: {text[:300]}")
+
+
+def classify_write_exception(destination: str,
+                             exc: BaseException) -> "EtlError":
+    """Any non-EtlError escaping a destination write path → a concrete
+    ErrorKind, so nothing unclassified ever reaches the retry layer
+    (etl-lint rule 18 `unclassified-destination-error` enforces the
+    call-site discipline). Transport failures are transient connection
+    kinds; everything else is the ambiguous DESTINATION_FAILED."""
+    if isinstance(exc, EtlError):
+        return exc
+    if isinstance(exc, (asyncio.TimeoutError, TimeoutError)):
+        return EtlError(ErrorKind.TIMEOUT,
+                        f"{destination}: {exc!r}")
+    if isinstance(exc, (ConnectionError, OSError, EOFError,
+                        asyncio.IncompleteReadError)):
+        return EtlError(ErrorKind.DESTINATION_CONNECTION_FAILED,
+                        f"{destination}: {exc!r}")
+    try:
+        import aiohttp
+
+        if isinstance(exc, aiohttp.ClientError):
+            return EtlError(ErrorKind.DESTINATION_CONNECTION_FAILED,
+                            f"{destination}: {exc!r}")
+    except ImportError:  # aiohttp-less deployments (lake/iceberg only)
+        pass
+    return EtlError(ErrorKind.DESTINATION_FAILED,
+                    f"{destination}: {exc!r}")
+
+
 class DestinationRetryPolicy(RetryPolicy):
     """Writer-scoped alias of the unified RetryPolicy (etl_tpu/retry.py):
     in-place retries for transient transport/capacity errors only
@@ -197,11 +262,30 @@ class DestinationRetryPolicy(RetryPolicy):
 async def with_retries(op: Callable[[], Awaitable[T]],
                        policy: RetryPolicy,
                        retryable: "Callable[[BaseException], bool] | None"
-                       = None) -> T:
+                       = None, destination: str = "destination") -> T:
     """Classify-and-backoff retry wrapper (reference retry.rs:classify).
     Delegates to RetryPolicy.execute; `retryable=None` uses the policy's
-    own per-ErrorKind classification."""
-    return await policy.execute(op, retryable)
+    own per-ErrorKind classification. Whatever finally escapes is
+    GUARANTEED to be an EtlError with a concrete kind: a raw transport
+    exception surviving the in-place retries wraps through
+    `classify_write_exception` instead of reaching the worker retry
+    layer bare (the poison-isolation trigger contract)."""
+    try:
+        return await policy.execute(op, retryable)
+    except (asyncio.CancelledError, EtlError):
+        raise
+    except Exception as e:
+        # Exception, NOT BaseException: KeyboardInterrupt/SystemExit
+        # must terminate the process, not become retryable
+        # destination failures
+        if type(e).__module__.partition(".")[0] == "etl_tpu":
+            # internal control-flow exceptions (iceberg._CasConflict,
+            # snowpipe.SnowpipeWireError, chaos.SimulatedCrash) are
+            # caught-and-handled by their own call sites — wrapping them
+            # would break those protocols, and they never reach the
+            # worker retry layer
+            raise
+        raise classify_write_exception(destination, e) from e
 
 
 class TaskSet:

@@ -1,0 +1,173 @@
+"""Operator surface of the durable dead-letter store.
+
+`python -m etl_tpu.dlq` (see `__main__.py`) and the programmatic
+`DeadLetterQueue` wrap the `StateStore` dead-letter/quarantine surface
+(store/base.py) with the operator verbs:
+
+  list          — entries (optionally per table / per status)
+  inspect       — one entry with its decoded payload
+  replay        — re-deliver entries through the DESTINATION SEAM
+                  (`Destination.write_event_batches`, the same entry
+                  point the apply loop uses) in WAL order, durably, then
+                  mark them `replayed`. IDEMPOTENT: already-replayed
+                  entries are skipped, and a crash mid-replay re-runs
+                  safely because CDC delivery is keyed by
+                  (commit_lsn, tx_ordinal) — destinations collapse the
+                  duplicate exactly like any at-least-once redelivery.
+  discard       — mark entries `discarded` (kept for audit)
+  unquarantine  — lift a table's quarantine record so the (restarted)
+                  replicator streams it again
+
+The zero-loss invariant this surface completes:
+`delivered ∪ dead-lettered == committed truth` (docs/dead-letter.md) —
+replay moves rows from the right side of the union to the left.
+"""
+
+from __future__ import annotations
+
+from ..models.errors import ErrorKind, EtlError
+from ..store.base import (DLQ_STATUS_DEAD, DLQ_STATUS_DISCARDED,
+                          DLQ_STATUS_REPLAYED, DeadLetterEntry,
+                          QuarantineRecord)
+from .codec import decode_cell, decode_row_event, encode_row_event
+
+__all__ = [
+    "DeadLetterQueue",
+    "DeadLetterEntry",
+    "QuarantineRecord",
+    "decode_cell",
+    "decode_row_event",
+    "encode_row_event",
+]
+
+
+class DeadLetterQueue:
+    """Operator verbs over one pipeline's dead-letter surface. `store`
+    is any PipelineStore (memory / sqlite / Postgres)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    async def list(self, table_id=None, status=DLQ_STATUS_DEAD
+                   ) -> "list[DeadLetterEntry]":
+        return await self.store.list_dead_letters(table_id, status)
+
+    async def inspect(self, entry_id: int) -> dict:
+        import json
+
+        entry = await self.store.get_dead_letter(entry_id)
+        if entry is None:
+            raise EtlError(ErrorKind.STATE_STORE_FAILED,
+                           f"no dead-letter entry {entry_id}")
+        doc = entry.describe()
+        payload = json.loads(entry.payload)
+        doc["payload"] = payload
+        schema = await self.store.get_table_schema(entry.table_id)
+        if schema is not None:
+            try:
+                ev = decode_row_event(entry, schema)
+                row = getattr(ev, "row", None) or getattr(ev, "old_row")
+                doc["decoded_values"] = [repr(v) for v in row.values]
+            except EtlError as e:
+                doc["decode_error"] = str(e)
+        return doc
+
+    async def replay(self, destination, entry_ids=None, table_id=None,
+                     include_replayed: bool = False) -> dict:
+        """Re-deliver dead entries through `write_event_batches` in WAL
+        order and mark them replayed once DURABLE. Returns a summary.
+
+        Idempotent by construction: `replayed` entries are skipped
+        (unless `include_replayed` forces a re-push — itself safe, CDC
+        delivery is keyed by WAL coordinates), and a crash after the
+        write but before the status flip re-replays rows a destination
+        collapses as at-least-once duplicates."""
+        from ..telemetry.metrics import ETL_DLQ_REPLAYED_TOTAL, registry
+
+        if entry_ids is not None:
+            entries = []
+            for eid in entry_ids:
+                e = await self.store.get_dead_letter(eid)
+                if e is None:
+                    raise EtlError(ErrorKind.STATE_STORE_FAILED,
+                                   f"no dead-letter entry {eid}")
+                entries.append(e)
+        else:
+            entries = await self.list(table_id=table_id, status=None)
+        wanted = {DLQ_STATUS_DEAD}
+        if include_replayed:
+            wanted.add(DLQ_STATUS_REPLAYED)
+        skipped_status: list[dict] = []
+        if entry_ids is not None:
+            # an explicitly-requested entry excluded by the status
+            # filter must be REPORTED, not silently dropped — an
+            # operator replaying `--ids 5` where 5 is discarded would
+            # otherwise read empty success
+            skipped_status = [
+                {"entry_id": e.entry_id,
+                 "reason": f"status is {e.status!r}, not replayable "
+                           f"(pass --include-replayed to re-push "
+                           f"replayed entries; discarded entries stay "
+                           f"discarded)"}
+                for e in entries if e.status not in wanted]
+        entries = [e for e in entries if e.status in wanted]
+        # WAL order across the whole replay set — destinations see the
+        # rows in their original commit order
+        entries.sort(key=lambda e: (e.commit_lsn, e.tx_ordinal,
+                                    e.entry_id))
+        skipped: list[dict] = list(skipped_status)
+        events = []
+        replayable: list[DeadLetterEntry] = []
+        for e in entries:
+            schema = await self.store.get_table_schema(e.table_id)
+            if schema is None:
+                skipped.append({"entry_id": e.entry_id,
+                                "reason": f"no stored schema for table "
+                                          f"{e.table_id}"})
+                continue
+            try:
+                events.append(decode_row_event(e, schema))
+            except EtlError as err:
+                skipped.append({"entry_id": e.entry_id,
+                                "reason": str(err)})
+                continue
+            replayable.append(e)
+        if events:
+            ack = await destination.write_event_batches(events)
+            if ack is not None:
+                await ack.wait_durable()
+        for e in replayable:
+            await self.store.set_dead_letter_status(e.entry_id,
+                                                    DLQ_STATUS_REPLAYED)
+            registry.counter_inc(ETL_DLQ_REPLAYED_TOTAL)
+        return {"replayed": [e.entry_id for e in replayable],
+                "skipped": skipped}
+
+    async def discard(self, entry_ids) -> list[int]:
+        from ..telemetry.metrics import ETL_DLQ_DISCARDED_TOTAL, registry
+
+        done = []
+        for eid in entry_ids:
+            e = await self.store.get_dead_letter(eid)
+            if e is None:
+                raise EtlError(ErrorKind.STATE_STORE_FAILED,
+                               f"no dead-letter entry {eid}")
+            await self.store.set_dead_letter_status(eid,
+                                                    DLQ_STATUS_DISCARDED)
+            registry.counter_inc(ETL_DLQ_DISCARDED_TOTAL)
+            done.append(eid)
+        return done
+
+    async def quarantined(self) -> dict:
+        return await self.store.get_quarantined_tables()
+
+    async def unquarantine(self, table_id: int) -> bool:
+        """Lift a table's quarantine. Returns False when the table was
+        not quarantined. The running replicator adopts the lift at its
+        next restart (docs/dead-letter.md runbook: replay first, then
+        unquarantine, then roll the pod)."""
+        records = await self.store.get_quarantined_tables()
+        if table_id not in records:
+            return False
+        await self.store.set_table_quarantine(table_id, None)
+        return True

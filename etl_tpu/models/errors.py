@@ -75,6 +75,13 @@ class ErrorKind(enum.Enum):
     DESTINATION_SCHEMA_FAILED = enum.auto()
     DESTINATION_THROTTLED = enum.auto()
     DESTINATION_PAYLOAD_TOO_LARGE = enum.auto()
+    # the destination REFUSED the payload (HTTP 4xx / gRPC
+    # INVALID_ARGUMENT class): retrying the identical bytes can never
+    # succeed — this is the poison-pill trigger signal the isolation
+    # protocol (runtime/poison.py) keys on, distinct from
+    # DESTINATION_FAILED (ambiguous, worker-retryable) and
+    # DESTINATION_THROTTLED (capacity, writer-retryable)
+    DESTINATION_REJECTED = enum.auto()
     # circuit breaker open: load shed before the call reaches the sink
     # (supervision/breaker.py) — retryable by the WORKER (whose backoff IS
     # the backpressure), never in place by a writer
@@ -196,10 +203,42 @@ _MANUAL_KINDS = frozenset({
     ErrorKind.DESTINATION_AUTH_FAILED,
     ErrorKind.DESTINATION_SCHEMA_FAILED,
     ErrorKind.DESTINATION_PAYLOAD_TOO_LARGE,
+    ErrorKind.DESTINATION_REJECTED,
     ErrorKind.CONFIG_INVALID,
     ErrorKind.CONFIG_MISSING,
     ErrorKind.DEVICE_DECODE_FAILED,
 })
+
+
+# kinds a destination WRITE can raise that are PERMANENT for the exact
+# payload written: retrying the identical bytes can never succeed, so
+# the failure is attributable to the batch content (a poison pill), not
+# to the destination's health. The isolation protocol
+# (runtime/poison.py) triggers ONLY on these — transient kinds
+# (throttle, connection, breaker-open DESTINATION_UNAVAILABLE) mean the
+# destination is sick and bisecting would hammer a down service.
+POISON_KINDS = frozenset({
+    ErrorKind.DESTINATION_REJECTED,
+    ErrorKind.DESTINATION_SCHEMA_FAILED,
+    ErrorKind.DESTINATION_PAYLOAD_TOO_LARGE,
+    ErrorKind.SCHEMA_MISMATCH,
+    ErrorKind.ROW_CONVERSION_FAILED,
+    ErrorKind.INVALID_DATA,
+    ErrorKind.UNSUPPORTED_TYPE,
+    ErrorKind.NULL_CONSTRAINT_VIOLATION,
+})
+
+
+def is_poison_error(error: BaseException) -> bool:
+    """True when a destination-write failure is attributable to the
+    PAYLOAD (permanent for those bytes — the poison-pill trigger), not
+    to the destination's health. Aggregated errors are poison only if
+    EVERY kind is: one transient cause means the whole write may
+    succeed on retry, so isolation must not bisect."""
+    if not isinstance(error, EtlError):
+        return False
+    kinds = set(error.kinds())
+    return bool(kinds) and kinds <= POISON_KINDS
 
 
 def retry_directive(error: EtlError) -> RetryDirective:

@@ -229,56 +229,109 @@ class AckWindow:
 
     # -- completion -----------------------------------------------------------
 
+    @staticmethod
+    def _entry_tables(entry: AckEntry) -> "list[int]":
+        tids = set()
+        for ev in entry.payload or ():
+            sch = getattr(ev, "schema", None)
+            if sch is not None:
+                tids.add(sch.id)
+            for s in getattr(ev, "schemas", ()) or ():
+                tids.add(s.id)
+        return sorted(tids)
+
+    @staticmethod
+    def _entry_failure(entry: AckEntry) -> "BaseException | None":
+        if entry.task.cancelled():
+            return EtlError(ErrorKind.DESTINATION_FAILED,
+                            "in-flight destination write cancelled")
+        return entry.task.exception()
+
+    @classmethod
+    def _aggregate_failures(
+            cls, failed: "list[tuple[AckEntry, BaseException]]"
+    ) -> "BaseException | None":
+        """EVERY completed failure in the window surfaces at once, each
+        annotated with its entry's tables. A single failure raises
+        unchanged (exact legacy behavior); multiple failures aggregate
+        into one EtlError whose `kinds()` union all causes — so
+        multi-table poison in one window reaches the isolation layer as
+        ONE signal (bisected once), not across N worker restarts, and
+        the retry classifier still sees every kind."""
+        if not failed:
+            return None
+        if len(failed) == 1:
+            return failed[0][1]
+        causes = []
+        table_note = []
+        for entry, exc in failed:
+            tables = cls._entry_tables(entry)
+            table_note.append(f"tables {tables}")
+            if isinstance(exc, EtlError):
+                wrapped = EtlError(
+                    exc.kind, f"{exc.detail} [tables {tables}]",
+                    causes=exc.causes)
+            else:
+                wrapped = EtlError(
+                    ErrorKind.DESTINATION_FAILED,
+                    f"{exc!r} [tables {tables}]")
+            # keep the original exception (and its traceback) on the
+            # chain — a repr alone makes a multi-failure window
+            # materially harder to debug than the single-failure path
+            wrapped.__cause__ = exc
+            causes.append(wrapped)
+        # kind of the FIRST failure, every other as a cause: kinds()
+        # reports the full union (no synthetic UNKNOWN diluting the
+        # poison/transient classification the way EtlError.many would)
+        return EtlError(
+            causes[0].kind,
+            f"{len(causes)} window writes failed "
+            f"({'; '.join(table_note)})", causes=causes[1:])
+
     def pop_ready(self) -> "tuple[list[AckEntry], BaseException | None]":
         """Consume the contiguous completed prefix. Returns the entries
-        that completed durably (in WAL order) plus the first failure
-        observed — head-most first; a completed failure DEEPER in the
-        window also surfaces (fail fast) without popping the still-
-        running entries before it. The caller advances durable progress
-        over the returned entries BEFORE raising the failure, so a
-        mid-window error re-streams as little as possible."""
+        that completed durably (in WAL order) plus the failure signal:
+        ALL completed failures — the popped head-most one and every
+        completed failure DEEPER in the window — aggregated into one
+        error naming each failed entry's tables (a permanent multi-table
+        poison in one window surfaces whole, not one table per worker
+        restart). Still-running entries before a deep failure are NOT
+        popped. The caller advances durable progress over the returned
+        entries BEFORE raising, so a mid-window error re-streams as
+        little as possible."""
         self._tick()
         done: "list[AckEntry]" = []
-        failure: "BaseException | None" = None
+        failed: "list[tuple[AckEntry, BaseException]]" = []
         while self._entries and self._entries[0].task.done():
             entry = self._entries.popleft()
             self._bytes -= entry.nbytes
-            if entry.task.cancelled():
-                failure = EtlError(ErrorKind.DESTINATION_FAILED,
-                                   "in-flight destination write cancelled")
-                self._abandon_entry(entry)
-                break
-            exc = entry.task.exception()
+            exc = self._entry_failure(entry)
             if exc is not None:
-                failure = exc
+                failed.append((entry, exc))
                 # the failed entry leaves the window here, so teardown's
                 # abandon_payloads would miss it: release its pending
                 # decodes now (the restart re-streams the events — they
-                # will never be consumed from this incarnation)
+                # will never be consumed from this incarnation).
+                # Successors stay in the window: durable progress must
+                # never advance past the failed entry's undelivered WAL,
+                # so a done SUCCESSOR cannot pop either.
                 self._abandon_entry(entry)
                 break
             done.append(entry)
-        if failure is None:
-            # fail fast on an out-of-order failure: a later entry that
-            # already failed can never become durable, and every entry
-            # after the failed one re-streams anyway. Cancellation
-            # counts (same as the head path) — any_actionable treats it
-            # as a failure, so skipping it here would zero-timeout-spin
-            # the select loop against an empty pop
-            for entry in self._entries:
-                if not entry.task.done():
-                    continue
-                if entry.task.cancelled():
-                    failure = EtlError(
-                        ErrorKind.DESTINATION_FAILED,
-                        "in-flight destination write cancelled")
-                    break
-                exc = entry.task.exception()
-                if exc is not None:
-                    failure = exc
-                    break
+        # surface every other completed failure too (fail fast + the
+        # whole poison signal): a later entry that already failed can
+        # never become durable, and every entry after the first failure
+        # re-streams anyway. Cancellation counts (same as the head path)
+        # — any_actionable treats it as a failure, so skipping it here
+        # would zero-timeout-spin the select loop against an empty pop
+        for entry in self._entries:
+            if not entry.task.done():
+                continue
+            exc = self._entry_failure(entry)
+            if exc is not None:
+                failed.append((entry, exc))
         self._publish()
-        return done, failure
+        return done, self._aggregate_failures(failed)
 
     async def wait_all(self) -> None:
         """Await every in-flight task (results stay queued for

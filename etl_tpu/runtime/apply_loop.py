@@ -180,6 +180,20 @@ class ApplyLoop:
             max_bytes=config.batch.write_window_max_bytes,
             pressure=(lambda: monitor.pressure)
             if monitor is not None else None)
+        # poison-pill isolation boundary (runtime/poison.py): flush
+        # submits route through it so a permanent destination error
+        # bisects down to the poison row(s) and dead-letters them
+        # instead of killing the worker. Apply context only — initial
+        # sync keeps the reference's per-table error states
+        # (table_retry), and a sync worker's batches cover one table
+        # anyway.
+        self._poison = None
+        if config.poison.enabled and isinstance(ctx, ApplyContext):
+            from .poison import PoisonIsolator
+
+            self._poison = PoisonIsolator(store=store,
+                                          destination=destination,
+                                          config=config)
         self._batch_deadline: float | None = None
         # True while the CURRENT drain keeps coming back full: flush
         # pacing defers to mega-batching only during a live backlog
@@ -680,11 +694,9 @@ class ApplyLoop:
         """True when the destination's circuit breaker is OPEN (shedding).
         Reads through the SupervisedDestination wrapper when present;
         plain destinations have no breaker."""
-        breaker = getattr(self.destination, "breaker", None)
-        if breaker is None:
-            return False
-        state = getattr(breaker, "state", None)
-        return getattr(state, "value", None) == "open"
+        from ..supervision.breaker import breaker_is_open
+
+        return breaker_is_open(self.destination)
 
     def _flush_threshold(self) -> int:
         """The size bound of the NEXT flush: the scaled cap, shrunk by
@@ -781,7 +793,13 @@ class ApplyLoop:
             # column-at-a-time; others fall back to the row path via the
             # base-class shim). The ack window owns the durability wait
             # (etl-lint rule 17): submissions stay in WAL order, only the
-            # ack round trips overlap.
+            # ack round trips overlap. The poison isolator sits between
+            # the flush and the destination: a PERMANENT (poison-kind)
+            # write failure bisects to the poison rows and dead-letters
+            # them, quarantined tables' events park — transient failures
+            # pass through to the worker-retry path unchanged.
+            if self._poison is not None:
+                return await self._poison.submit(events)
             return await self.destination.write_event_batches(events)
 
         def on_durable() -> None:

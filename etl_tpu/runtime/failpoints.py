@@ -14,7 +14,8 @@ from ..chaos.failpoints import (  # noqa: F401
     BEFORE_STREAMING, CHAOS_SITES, COPY_PARTITION_END, COPY_PARTITION_START,
     DESTINATION_FLUSH, DESTINATION_WRITE, DURING_COPY, ENGINE_DEVICE_OOM,
     ON_PROGRESS_STORE, ON_SCHEMA_CLEANUP, ON_STATUS_UPDATE, PIPELINE_DISPATCH,
-    PIPELINE_FETCH, PIPELINE_PACK, REFERENCE_SITES, STORE_PROGRESS_COMMIT,
+    PIPELINE_FETCH, PIPELINE_PACK, POISON_BISECT, REFERENCE_SITES,
+    STORE_DLQ_COMMIT, STORE_PROGRESS_COMMIT,
     STORE_SCHEMA_COMMIT, STORE_STATE_COMMIT, arm, arm_error, arm_stall,
     armed_sites, disarm, disarm_all, fail_point, release_stalls, scope,
     stall_point, stalls_armed)

@@ -184,6 +184,36 @@ class ShardScopedStore(PipelineStore):
             "shard-scoped runtimes cannot rewrite the shard assignment; "
             "drive rebalances through ShardCoordinator")
 
+    # -- dead-letter / quarantine (docs/dead-letter.md) -----------------------
+    # Reads pass through (the CLI and invariant checkers read the whole
+    # pipeline's DLQ); WRITES are shard-fenced exactly like table-state
+    # writes — a pod may only dead-letter or quarantine tables its
+    # ShardMap slice owns, and never after the coordinator bumped the
+    # epoch (a stale pod parking a freshly-rehomed table would fight the
+    # new owner's delivery).
+
+    async def append_dead_letters(self, entries) -> "list[int]":
+        for e in entries:
+            await self._check_write(e.table_id)
+        return await self._inner.append_dead_letters(entries)
+
+    async def list_dead_letters(self, table_id=None, status="dead"):
+        return await self._inner.list_dead_letters(table_id, status)
+
+    async def get_dead_letter(self, entry_id: int):
+        return await self._inner.get_dead_letter(entry_id)
+
+    async def set_dead_letter_status(self, entry_id: int,
+                                     status: str) -> None:
+        await self._inner.set_dead_letter_status(entry_id, status)
+
+    async def get_quarantined_tables(self):
+        return await self._inner.get_quarantined_tables()
+
+    async def set_table_quarantine(self, table_id, record) -> None:
+        await self._check_write(table_id)
+        await self._inner.set_table_quarantine(table_id, record)
+
     async def get_autoscale_journal(self) -> "dict | None":
         return await self._inner.get_autoscale_journal()
 

@@ -1,6 +1,6 @@
 """State/schema stores."""
 
-from .base import (DestinationTableMetadata, PipelineStore, SchemaStore,
-                   StateStore)
+from .base import (DeadLetterEntry, DestinationTableMetadata, PipelineStore,
+                   QuarantineRecord, SchemaStore, StateStore)
 from .memory import MemoryStore, NotifyingStore
 from .sql import PostgresStore, SqliteStore

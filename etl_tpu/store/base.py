@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import abc
 from dataclasses import dataclass
+from typing import Sequence
 
 from ..models.errors import ErrorKind, EtlError
 from ..models.lsn import Lsn
@@ -32,6 +33,78 @@ from ..sharding.shardmap import ShardAssignment
 # name, table-sync workers their per-table slot name (reference progress
 # rows keyed by slot)
 ProgressKey = str
+
+
+#: dead-letter entry lifecycle states (docs/dead-letter.md): `dead` =
+#: parked awaiting operator action; `replayed` = re-delivered through
+#: the destination seam (kept for audit); `discarded` = operator chose
+#: to drop the row permanently (kept for audit).
+DLQ_STATUS_DEAD = "dead"
+DLQ_STATUS_REPLAYED = "replayed"
+DLQ_STATUS_DISCARDED = "discarded"
+
+
+@dataclass(frozen=True)
+class DeadLetterEntry:
+    """One poison row parked on the durable dead-letter surface.
+
+    Identity is `(table_id, commit_lsn, tx_ordinal, change_type)` — the
+    row's WAL coordinates — so a re-streamed batch that re-isolates the
+    same poison row after a crash UPSERTS (attempts += 1) instead of
+    duplicating, which is what makes both the isolation protocol and
+    `replay` idempotent. `payload` is the dlq-codec JSON of the decoded
+    row (etl_tpu/dlq/codec.py): enough to rebuild the event and push it
+    back through `Destination.write_event_batches`."""
+
+    entry_id: int  # store-assigned, monotonic per pipeline
+    table_id: TableId
+    commit_lsn: int
+    tx_ordinal: int
+    change_type: int  # models.event.ChangeType value
+    payload: str  # dlq-codec JSON of the decoded row (+ old image)
+    error_kind: str  # ErrorKind.name at isolation time
+    detail: str  # the triggering error's detail, truncated
+    attempts: int = 1  # write attempts that found this row poison
+    status: str = DLQ_STATUS_DEAD
+
+    def key(self) -> tuple:
+        return (self.table_id, self.commit_lsn, self.tx_ordinal,
+                self.change_type)
+
+    def describe(self) -> dict:
+        return {
+            "entry_id": self.entry_id, "table_id": self.table_id,
+            "commit_lsn": self.commit_lsn, "tx_ordinal": self.tx_ordinal,
+            "change_type": self.change_type, "error_kind": self.error_kind,
+            "detail": self.detail, "attempts": self.attempts,
+            "status": self.status,
+        }
+
+
+@dataclass(frozen=True)
+class QuarantineRecord:
+    """A table parked out of the streaming path: its events bypass the
+    destination and append straight to the dead-letter surface until an
+    operator replays + unquarantines (docs/dead-letter.md)."""
+
+    table_id: TableId
+    since_lsn: int  # commit LSN of the flush that tripped the budget
+    poison_rows: int  # dead-lettered rows that funded the budget
+    parked_events: int = 0  # events parked since quarantine began
+    reason: str = ""
+
+    def to_json(self) -> dict:
+        return {"table_id": self.table_id, "since_lsn": self.since_lsn,
+                "poison_rows": self.poison_rows,
+                "parked_events": self.parked_events, "reason": self.reason}
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "QuarantineRecord":
+        return cls(table_id=int(doc["table_id"]),
+                   since_lsn=int(doc["since_lsn"]),
+                   poison_rows=int(doc.get("poison_rows", 0)),
+                   parked_events=int(doc.get("parked_events", 0)),
+                   reason=str(doc.get("reason", "")))
 
 
 @dataclass(frozen=True)
@@ -110,6 +183,55 @@ class StateStore(abc.ABC):
         raise EtlError(
             ErrorKind.STATE_STORE_FAILED,
             f"{type(self).__name__} does not persist autoscale journals")
+
+    # -- dead-letter / quarantine surface (docs/dead-letter.md) ---------------
+    # Concrete defaults like the shard and autoscale surfaces: stores
+    # that never see poison keep working unchanged — READS return empty
+    # (so the apply loop and CLI degrade to "no DLQ"), WRITES raise a
+    # typed error (the isolation protocol then re-raises the original
+    # poison error instead of silently dropping rows). The memory and
+    # sql backends override all of them with real persistence.
+
+    async def append_dead_letters(
+            self, entries: "Sequence[DeadLetterEntry]") -> "list[int]":
+        """Persist poison rows; returns assigned entry ids. MUST be an
+        idempotent keyed upsert on `DeadLetterEntry.key()` (attempts
+        accumulate) — a crash between bisection and ack re-streams the
+        batch and re-appends the same rows."""
+        raise EtlError(
+            ErrorKind.STATE_STORE_FAILED,
+            f"{type(self).__name__} does not persist dead letters")
+
+    async def list_dead_letters(
+            self, table_id: "TableId | None" = None,
+            status: "str | None" = DLQ_STATUS_DEAD
+    ) -> "list[DeadLetterEntry]":
+        """Entries in id order, optionally filtered by table and status
+        (None = every status)."""
+        return []
+
+    async def get_dead_letter(self,
+                              entry_id: int) -> "DeadLetterEntry | None":
+        return None
+
+    async def set_dead_letter_status(self, entry_id: int,
+                                     status: str) -> None:
+        """dead → replayed/discarded transitions (operator CLI)."""
+        raise EtlError(
+            ErrorKind.STATE_STORE_FAILED,
+            f"{type(self).__name__} does not persist dead letters")
+
+    async def get_quarantined_tables(self
+                                     ) -> "dict[TableId, QuarantineRecord]":
+        return {}
+
+    async def set_table_quarantine(
+            self, table_id: TableId,
+            record: "QuarantineRecord | None") -> None:
+        """Persist (record) or lift (None) a table's quarantine."""
+        raise EtlError(
+            ErrorKind.STATE_STORE_FAILED,
+            f"{type(self).__name__} does not persist quarantine records")
 
     @abc.abstractmethod
     async def get_destination_metadata(

@@ -17,7 +17,8 @@ from ..models.lsn import Lsn
 from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
 from ..models.table_state import TableState, TableStateType
 from ..sharding.shardmap import ShardAssignment
-from .base import (DestinationTableMetadata, PipelineStore, ProgressKey)
+from .base import (DeadLetterEntry, DestinationTableMetadata, PipelineStore,
+                   ProgressKey, QuarantineRecord)
 
 
 class MemoryStore(PipelineStore):
@@ -29,6 +30,11 @@ class MemoryStore(PipelineStore):
         self._dest_meta: dict[TableId, DestinationTableMetadata] = {}
         self._shard_assignment: ShardAssignment | None = None
         self._autoscale_journal: dict | None = None
+        # dead-letter surface: WAL-coordinate key -> entry (the keyed
+        # upsert that makes crash-era re-appends idempotent)
+        self._dead_letters: dict[tuple, DeadLetterEntry] = {}
+        self._next_dlq_id = 1
+        self._quarantine: dict[TableId, QuarantineRecord] = {}
 
     # -- StateStore ----------------------------------------------------------
 
@@ -110,6 +116,69 @@ class MemoryStore(PipelineStore):
         failpoints.fail_point(failpoints.STORE_AUTOSCALE_COMMIT)
         await failpoints.stall_point(failpoints.STORE_AUTOSCALE_COMMIT)
         self._autoscale_journal = journal
+
+    # -- dead-letter / quarantine surface ------------------------------------
+
+    async def append_dead_letters(self, entries) -> list[int]:
+        from dataclasses import replace
+
+        failpoints.fail_point(failpoints.STORE_DLQ_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_DLQ_COMMIT)
+        ids = []
+        for e in entries:
+            cur = self._dead_letters.get(e.key())
+            if cur is not None:
+                # idempotent keyed upsert: a re-streamed batch that
+                # re-isolates the same poison row accumulates attempts
+                # instead of duplicating the entry
+                merged = replace(cur, attempts=cur.attempts + e.attempts,
+                                 error_kind=e.error_kind,
+                                 detail=e.detail or cur.detail)
+                self._dead_letters[e.key()] = merged
+                ids.append(merged.entry_id)
+                continue
+            stored = replace(e, entry_id=self._next_dlq_id)
+            self._next_dlq_id += 1
+            self._dead_letters[stored.key()] = stored
+            ids.append(stored.entry_id)
+        return ids
+
+    async def list_dead_letters(self, table_id=None,
+                                status="dead") -> list[DeadLetterEntry]:
+        out = [e for e in self._dead_letters.values()
+               if (table_id is None or e.table_id == table_id)
+               and (status is None or e.status == status)]
+        out.sort(key=lambda e: e.entry_id)
+        return out
+
+    async def get_dead_letter(self, entry_id: int) -> DeadLetterEntry | None:
+        for e in self._dead_letters.values():
+            if e.entry_id == entry_id:
+                return e
+        return None
+
+    async def set_dead_letter_status(self, entry_id: int,
+                                     status: str) -> None:
+        from dataclasses import replace
+
+        for k, e in self._dead_letters.items():
+            if e.entry_id == entry_id:
+                self._dead_letters[k] = replace(e, status=status)
+                return
+        raise EtlError(ErrorKind.STATE_STORE_FAILED,
+                       f"no dead-letter entry {entry_id}")
+
+    async def get_quarantined_tables(self) -> dict[TableId, QuarantineRecord]:
+        return dict(self._quarantine)
+
+    async def set_table_quarantine(self, table_id: TableId,
+                                   record: QuarantineRecord | None) -> None:
+        failpoints.fail_point(failpoints.STORE_DLQ_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_DLQ_COMMIT)
+        if record is None:
+            self._quarantine.pop(table_id, None)
+        else:
+            self._quarantine[table_id] = record
 
     # -- SchemaStore ---------------------------------------------------------
 

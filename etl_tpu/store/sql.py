@@ -39,7 +39,8 @@ from ..models.lsn import Lsn
 from ..models.schema import ReplicatedTableSchema, SnapshotId, TableId
 from ..models.table_state import TableState
 from ..sharding.shardmap import ShardAssignment
-from .base import DestinationTableMetadata, PipelineStore, ProgressKey
+from .base import (DeadLetterEntry, DestinationTableMetadata, PipelineStore,
+                   ProgressKey, QuarantineRecord)
 
 MIGRATIONS: list[tuple[str, str]] = [
     ("20250827000000_base", """
@@ -88,6 +89,30 @@ CREATE TABLE IF NOT EXISTS etl_autoscale_journal (
     pipeline_id BIGINT NOT NULL,
     journal_json TEXT NOT NULL,
     PRIMARY KEY (pipeline_id)
+);
+"""),
+    ("20260805000000_dead_letter", """
+CREATE TABLE IF NOT EXISTS etl_dead_letter (
+    id {bigserial} PRIMARY KEY,
+    pipeline_id BIGINT NOT NULL,
+    table_id BIGINT NOT NULL,
+    commit_lsn BIGINT NOT NULL,
+    tx_ordinal BIGINT NOT NULL,
+    change_type BIGINT NOT NULL,
+    payload TEXT NOT NULL,
+    error_kind TEXT NOT NULL,
+    detail TEXT NOT NULL,
+    attempts BIGINT NOT NULL DEFAULT 1,
+    status TEXT NOT NULL DEFAULT 'dead'
+);
+CREATE UNIQUE INDEX IF NOT EXISTS etl_dead_letter_key
+    ON etl_dead_letter (pipeline_id, table_id, commit_lsn, tx_ordinal,
+                        change_type);
+CREATE TABLE IF NOT EXISTS etl_quarantine (
+    pipeline_id BIGINT NOT NULL,
+    table_id BIGINT NOT NULL,
+    record_json TEXT NOT NULL,
+    PRIMARY KEY (pipeline_id, table_id)
 );
 """),
 ]
@@ -307,6 +332,143 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
             "journal_json = excluded.journal_json",
             (self.pipeline_id, json.dumps(journal)))
 
+    # -- dead-letter / quarantine surface ------------------------------------
+    # Read-THROUGH like the shard assignment, not cache-first: the
+    # operator CLI (python -m etl_tpu.dlq) mutates these rows from
+    # another process while a replicator runs, and replay/discard/
+    # unquarantine must be visible to whichever process reads next.
+
+    _DLQ_COLS = ("id, table_id, commit_lsn, tx_ordinal, change_type, "
+                 "payload, error_kind, detail, attempts, status")
+
+    @staticmethod
+    def _dlq_row(r) -> DeadLetterEntry:
+        return DeadLetterEntry(
+            entry_id=int(r[0]), table_id=int(r[1]), commit_lsn=int(r[2]),
+            tx_ordinal=int(r[3]), change_type=int(r[4]), payload=r[5],
+            error_kind=r[6], detail=r[7], attempts=int(r[8]), status=r[9])
+
+    #: rows per multi-row upsert statement: fixed-size chunks keep the
+    #: `?`→`$n` placeholder rewrite cache small (≤ _DLQ_CHUNK distinct
+    #: statement widths) while a quarantine parking a whole flush costs
+    #: O(rows/chunk) round trips instead of 2·rows
+    _DLQ_CHUNK = 64
+
+    async def append_dead_letters(self, entries) -> list[int]:
+        failpoints.fail_point(failpoints.STORE_DLQ_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_DLQ_COMMIT)
+        pid = self.pipeline_id
+        # in-batch dedup (defensive: Postgres refuses ON CONFLICT
+        # affecting one row twice in a single statement) — merge
+        # duplicate WAL keys, accumulating attempts like the upsert does
+        merged: dict[tuple, object] = {}
+        order: list[tuple] = []
+        for e in entries:
+            cur = merged.get(e.key())
+            if cur is None:
+                merged[e.key()] = e
+                order.append(e.key())
+            else:
+                from dataclasses import replace as _replace
+
+                merged[e.key()] = _replace(
+                    cur, attempts=cur.attempts + e.attempts,
+                    error_kind=e.error_kind, detail=e.detail or cur.detail)
+        todo = [merged[k] for k in order]
+        row_sql = "(?, ?, ?, ?, ?, ?, ?, ?, ?, ?)"
+        for i in range(0, len(todo), self._DLQ_CHUNK):
+            chunk = todo[i:i + self._DLQ_CHUNK]
+            params: list = []
+            for e in chunk:
+                params += [pid, e.table_id, e.commit_lsn, e.tx_ordinal,
+                           e.change_type, e.payload, e.error_kind,
+                           e.detail, e.attempts, e.status]
+            # idempotent keyed upsert on the WAL coordinates: a crash
+            # between bisection and ack re-streams the batch and
+            # re-appends the same rows — attempts accumulate, no dup row
+            await self._run(
+                "INSERT INTO etl_dead_letter "
+                "(pipeline_id, table_id, commit_lsn, tx_ordinal, "
+                "change_type, payload, error_kind, detail, attempts, "
+                "status) VALUES " + ", ".join([row_sql] * len(chunk))
+                + " ON CONFLICT (pipeline_id, table_id, commit_lsn, "
+                "tx_ordinal, change_type) DO UPDATE SET "
+                "attempts = etl_dead_letter.attempts + excluded.attempts, "
+                "error_kind = excluded.error_kind, "
+                "detail = excluded.detail",
+                tuple(params))
+        if not todo:
+            return []
+        # ONE read-back for the assigned ids, keyed client-side (the
+        # batch's commit range bounds the scan)
+        lo = min(e.commit_lsn for e in todo)
+        hi = max(e.commit_lsn for e in todo)
+        rows = await self._run(
+            "SELECT id, table_id, commit_lsn, tx_ordinal, change_type "
+            "FROM etl_dead_letter WHERE pipeline_id = ? "
+            "AND commit_lsn >= ? AND commit_lsn <= ?", (pid, lo, hi))
+        by_key = {(int(t), int(c), int(o), int(ch)): int(i)
+                  for i, t, c, o, ch in rows}
+        return [by_key[e.key()] for e in entries]
+
+    async def list_dead_letters(self, table_id=None,
+                                status="dead") -> list[DeadLetterEntry]:
+        sql = (f"SELECT {self._DLQ_COLS} FROM etl_dead_letter "
+               f"WHERE pipeline_id = ?")
+        params: list = [self.pipeline_id]
+        if table_id is not None:
+            sql += " AND table_id = ?"
+            params.append(table_id)
+        if status is not None:
+            sql += " AND status = ?"
+            params.append(status)
+        sql += " ORDER BY id"
+        return [self._dlq_row(r) for r in await self._run(sql,
+                                                          tuple(params))]
+
+    async def get_dead_letter(self, entry_id: int) -> DeadLetterEntry | None:
+        rows = await self._run(
+            f"SELECT {self._DLQ_COLS} FROM etl_dead_letter "
+            f"WHERE pipeline_id = ? AND id = ?",
+            (self.pipeline_id, entry_id))
+        return self._dlq_row(rows[0]) if rows else None
+
+    async def set_dead_letter_status(self, entry_id: int,
+                                     status: str) -> None:
+        rows = await self._run(
+            "SELECT id FROM etl_dead_letter WHERE pipeline_id = ? "
+            "AND id = ?", (self.pipeline_id, entry_id))
+        if not rows:
+            raise EtlError(ErrorKind.STATE_STORE_FAILED,
+                           f"no dead-letter entry {entry_id}")
+        await self._run(
+            "UPDATE etl_dead_letter SET status = ? WHERE "
+            "pipeline_id = ? AND id = ?",
+            (status, self.pipeline_id, entry_id))
+
+    async def get_quarantined_tables(self) -> dict[TableId, QuarantineRecord]:
+        rows = await self._run(
+            "SELECT table_id, record_json FROM etl_quarantine "
+            "WHERE pipeline_id = ?", (self.pipeline_id,))
+        return {int(tid): QuarantineRecord.from_json(json.loads(raw))
+                for tid, raw in rows}
+
+    async def set_table_quarantine(self, table_id: TableId,
+                                   record: QuarantineRecord | None) -> None:
+        failpoints.fail_point(failpoints.STORE_DLQ_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_DLQ_COMMIT)
+        if record is None:
+            await self._run(
+                "DELETE FROM etl_quarantine WHERE pipeline_id = ? "
+                "AND table_id = ?", (self.pipeline_id, table_id))
+            return
+        await self._run(
+            "INSERT INTO etl_quarantine (pipeline_id, table_id, "
+            "record_json) VALUES (?, ?, ?) "
+            "ON CONFLICT (pipeline_id, table_id) DO UPDATE SET "
+            "record_json = excluded.record_json",
+            (self.pipeline_id, table_id, json.dumps(record.to_json())))
+
     # -- SchemaStore ---------------------------------------------------------
 
     async def store_table_schema(self, schema: ReplicatedTableSchema,
@@ -434,7 +596,8 @@ import functools
 # same list — one source of truth, no drift.
 STORE_TABLE_NAMES = ("etl_replication_state", "etl_table_schemas",
                      "etl_table_mappings", "etl_replication_progress",
-                     "etl_shard_assignment", "etl_autoscale_journal")
+                     "etl_shard_assignment", "etl_autoscale_journal",
+                     "etl_dead_letter", "etl_quarantine")
 
 _QUALIFY_RE = re.compile(r"\b(" + "|".join(STORE_TABLE_NAMES) + r")\b")
 

@@ -28,6 +28,18 @@ class BreakerState(enum.Enum):
     HALF_OPEN = "half_open"
 
 
+def breaker_is_open(destination) -> bool:
+    """True when `destination` (usually a SupervisedDestination) carries
+    a circuit breaker in the OPEN (shedding) state. Plain destinations
+    have no breaker. THE shared probe for dispatch gating
+    (runtime/apply_loop.py) and poison-isolation abort
+    (runtime/poison.py) — one definition of "the sink is being shed"."""
+    breaker = getattr(destination, "breaker", None)
+    if breaker is None:
+        return False
+    return getattr(breaker, "state", None) is BreakerState.OPEN
+
+
 #: gauge encoding for ETL_DESTINATION_BREAKER_STATE
 _STATE_VALUE = {BreakerState.CLOSED: 0, BreakerState.HALF_OPEN: 1,
                 BreakerState.OPEN: 2}

@@ -22,7 +22,7 @@ from typing import Sequence
 
 from ..chaos import failpoints
 from ..destinations.base import Destination, WriteAck
-from ..models.errors import ErrorKind, EtlError
+from ..models.errors import ErrorKind, EtlError, is_poison_error as _is_poison
 from .breaker import CircuitBreaker
 from .heartbeat import Heartbeat
 
@@ -70,18 +70,24 @@ class BoundedAck(WriteAck):
             if self._breaker is not None:
                 self._breaker.abort_call()
             raise
-        except Exception:
-            self._record(ok=False)
+        except Exception as e:
+            self._record(ok=False, available=_is_poison(e))
             raise
         else:
             self._record(ok=True)
 
-    def _record(self, ok: bool) -> None:
+    def _record(self, ok: bool, available: bool = False) -> None:
         if self._hb is not None:
             self._hb.beat(progress=("flush", ok), busy=False)
         if self._breaker is None:
             return
-        if ok:
+        if ok or available:
+            # `available`: the sink REFUSED the payload (poison kind) —
+            # a definitive 4xx-class response proves the destination is
+            # up, so the availability breaker must not count it; the
+            # isolation layer (runtime/poison.py) owns that failure
+            # class, and tripping the breaker on it would turn one
+            # poison row into shedding for every table
             self._breaker.record_success()
         else:
             self._breaker.record_failure()
@@ -153,12 +159,20 @@ class SupervisedDestination(Destination):
             if gated and self.breaker is not None:
                 self.breaker.abort_call()
             raise
-        except Exception:
+        except Exception as e:
             # EtlError and any unexpected failure alike count against
             # the sink (an exception with no classification is still a
-            # failed call, and must not strand a half-open trial)
+            # failed call, and must not strand a half-open trial) —
+            # EXCEPT poison-kind rejections: a definitive payload
+            # refusal proves the sink is up and answering, and counting
+            # it would let one poison row (or its bisection probes) trip
+            # availability shedding for every table. The isolation layer
+            # owns that failure class (runtime/poison.py).
             if gated and self.breaker is not None:
-                self.breaker.record_failure()
+                if _is_poison(e):
+                    self.breaker.record_success()
+                else:
+                    self.breaker.record_failure()
             if self.heartbeat is not None:
                 self.heartbeat.beat(progress=("error", self._ops),
                                     busy=False)

@@ -205,6 +205,20 @@ ETL_DESTINATION_ACK_BUSY_SECONDS_TOTAL = \
 ETL_DESTINATION_ACK_OVERLAP_SECONDS_TOTAL = \
     "etl_destination_ack_overlap_seconds_total"
 ETL_DESTINATION_ACK_OVERLAP_RATIO = "etl_destination_ack_overlap_ratio"
+# poison-pill isolation + dead-letter store (runtime/poison.py,
+# docs/dead-letter.md): isolations run (one per poisoned flush),
+# bisection probe writes (the O(log batch) isolation cost — bounded by
+# the chaos invariant), rows appended to the DLQ by reason (poison =
+# bisected to a poison row; quarantine = parked because the table is
+# quarantined), events parked, replay/discard operator actions, and the
+# live quarantined-table count
+ETL_POISON_ISOLATIONS_TOTAL = "etl_poison_isolations_total"
+ETL_POISON_BISECTION_WRITES_TOTAL = "etl_poison_bisection_writes_total"
+ETL_DLQ_ENTRIES_TOTAL = "etl_dlq_entries_total"
+ETL_DLQ_REPLAYED_TOTAL = "etl_dlq_replayed_total"
+ETL_DLQ_DISCARDED_TOTAL = "etl_dlq_discarded_total"
+ETL_QUARANTINED_TABLES = "etl_quarantined_tables"
+ETL_QUARANTINE_PARKED_EVENTS_TOTAL = "etl_quarantine_parked_events_total"
 ETL_SUPERVISION_EVENTS_TOTAL = "etl_supervision_events_total"
 ETL_SUPERVISION_RESTARTS_TOTAL = "etl_supervision_restarts_total"
 ETL_PIPELINE_HEALTH_STATE = "etl_pipeline_health_state"

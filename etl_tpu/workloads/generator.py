@@ -90,6 +90,18 @@ class WorkloadGenerator:
             {tid: {} for tid in self.table_ids}  # root -> pk -> leaf
         self._next_pk: dict[int, int] = {tid: 1 for tid in self.table_ids}
         self._ddl_step: dict[int, int] = {tid: 0 for tid in self.table_ids}
+        # poison-pill seeding (docs/dead-letter.md): CDC inserts into the
+        # first `poison_tables` tables carry a POISON marker at
+        # `poison_rate`; seed/copy rows never do (the isolation boundary
+        # is streaming CDC). The extra RNG draw happens ONLY for
+        # poisoned profiles, so every other profile's byte-identical
+        # replay contract is untouched.
+        self._poison_tids = set(
+            self.table_ids[:profile.poison_tables]) \
+            if profile.poison_rate > 0 else set()
+        self._seeding = False
+        self.poison_pks: dict[int, set[int]] = \
+            {tid: set() for tid in self.table_ids}
         self.tx_index = 0  # generator steps completed
         self.row_ops = 0  # Insert/Update/Delete ops committed (bench rate)
 
@@ -97,6 +109,7 @@ class WorkloadGenerator:
 
     def build_db(self) -> FakeDatabase:
         p = self.profile
+        self._seeding = True  # seed/copy rows are never poisoned
         db = FakeDatabase()
         db.clock_us = FIXED_CLOCK_US
         if p.ddl_every:
@@ -150,6 +163,7 @@ class WorkloadGenerator:
                           self.row_filter.compile_texts(self._schemas[tid]))
                     for tid in self.table_ids})
             db.server_row_filtering = False
+        self._seeding = False
         return db
 
     # -- value generation ------------------------------------------------------
@@ -192,6 +206,15 @@ class WorkloadGenerator:
                 texts.append(None)
             else:
                 texts.append(self._text_for(c.type_oid))
+        if self._poison_tids and not self._seeding \
+                and tid in self._poison_tids \
+                and self.rng.random() < self.profile.poison_rate:
+            for i in range(len(schema.columns) - 1, -1, -1):
+                c = schema.columns[i]
+                if c.type_oid == Oid.TEXT and not c.is_primary_key:
+                    texts[i] = f"POISON-{self.rng.randrange(10**6)}"
+                    self.poison_pks[tid].add(pk)
+                    break
         return pk, texts
 
     def _record_row(self, tid: int, schema: TableSchema, pk: int,

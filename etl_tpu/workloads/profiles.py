@@ -113,6 +113,15 @@ class WorkloadProfile:
     partitioned: bool = False
     # deletes never shrink a table below this many rows
     min_rows: int = 2
+    # seeded poison-pill rate (docs/dead-letter.md): this fraction of
+    # CDC-inserted rows (never seed/copy rows — isolation is a streaming
+    # boundary) carry a `POISON-…` marker value in their last TEXT
+    # column; the PoisonRejectingDestination refuses any write containing
+    # one with DESTINATION_REJECTED, driving the bisection + DLQ path.
+    # Only the first `poison_tables` tables are poisoned so survivor
+    # tables prove delivery isolation during quarantine.
+    poison_rate: float = 0.0
+    poison_tables: int = 1
     # publication row filter SQL (PG15 WHERE clause, ops/predicate.py
     # subset) — evaluated CLIENT-SIDE: the generator sets the fake's
     # server_row_filtering=False (the filter-offload deployment), so the
@@ -227,6 +236,16 @@ PROFILES: dict[str, WorkloadProfile] = {p.name: p for p in (
         description="publication row filter keeps ~90% of rows (drops "
                     "10%) — near-passthrough selectivity",
         insert_weight=1.0, rows_per_tx=8, row_filter="v < 800000"),
+    WorkloadProfile(
+        name="poison_rows",
+        description="insert CDC where a seeded ~0.1% of rows carry a "
+                    "POISON marker value the destination rejects "
+                    "(DESTINATION_REJECTED) — drives batch bisection, "
+                    "the dead-letter store, and per-table quarantine; "
+                    "tables beyond the first stay clean as the "
+                    "delivery-isolation control group",
+        insert_weight=1.0, rows_per_tx=8, tables=3, rows_per_table=4,
+        poison_rate=0.001, poison_tables=1),
     WorkloadProfile(
         name="partitioned_root",
         description="2-leaf partitioned tables published via the root "

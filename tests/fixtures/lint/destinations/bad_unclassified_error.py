@@ -1,0 +1,37 @@
+# etl-lint fixture: broad `except Exception` on destination write paths
+# that re-raises WITHOUT wrapping in EtlError/ErrorKind — the
+# unclassified failure reaches the worker retry layer bare, where the
+# poison-isolation trigger (models.errors.POISON_KINDS) can never fire.
+# Nested `attempt()` closures inside a write_* function are in scope
+# too, as is any @flush_path function.
+# expect: unclassified-destination-error=3
+from etl_tpu.analysis.annotations import flush_path
+
+
+class LeakyDestination:
+    async def write_events(self, events):
+        try:
+            await self._post(events)
+        except Exception:
+            raise  # flagged: bare re-raise, nothing classified
+
+    async def write_table_rows(self, schema, batch):
+        async def attempt():
+            try:
+                return await self._post(batch)
+            except Exception as e:
+                raise RuntimeError(f"write failed: {e}")  # flagged:
+                # re-raised as another unclassified exception
+
+        return await attempt()
+
+    async def _post(self, payload):
+        return payload
+
+
+@flush_path
+async def dispatch_unclassified(destination, events):
+    try:
+        return await destination.write_event_batches(events)
+    except Exception as e:
+        raise ValueError(str(e))  # flagged: @flush_path frame

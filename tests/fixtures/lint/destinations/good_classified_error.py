@@ -1,0 +1,46 @@
+# etl-lint fixture: clean destination write paths — every broad handler
+# that re-raises wraps through the shared classifiers or a typed
+# EtlError; handlers that never re-raise, narrow handlers, and broad
+# handlers OUTSIDE write paths are out of this rule's scope.
+# (no expectations: zero findings)
+from etl_tpu.analysis.annotations import flush_path
+from etl_tpu.destinations.util import classify_write_exception
+from etl_tpu.models.errors import ErrorKind, EtlError
+
+
+class ClassifiedDestination:
+    async def write_events(self, events):
+        try:
+            return await self._post(events)
+        except Exception as e:
+            raise classify_write_exception("fixture", e)  # wrapped: ok
+
+    async def write_table_rows(self, schema, batch):
+        try:
+            return await self._post(batch)
+        except Exception as e:
+            raise EtlError(ErrorKind.DESTINATION_FAILED, repr(e))  # ok
+
+    async def write_event_batches(self, events):
+        try:
+            return await self._post(events)
+        except ValueError:
+            raise EtlError(ErrorKind.DESTINATION_REJECTED, "bad value")
+        # narrow handler: out of scope even if it re-raised bare
+
+    async def startup(self):
+        try:
+            await self._post(None)
+        except Exception:
+            raise  # not a write path, not @flush_path: out of scope
+
+    async def _post(self, payload):
+        return payload
+
+
+@flush_path
+async def dispatch_classified(destination, events):
+    try:
+        return await destination.write_event_batches(events)
+    except Exception as e:
+        raise classify_write_exception("fixture", e)

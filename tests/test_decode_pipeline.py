@@ -497,3 +497,17 @@ class TestBenchSmoke:
             out["ack_window_speedup_floor"]
         assert out["ack_window_max_pending"] >= 2
         assert out["ack_window_failures"] == []
+        # poison-resilience gates (ISSUE 15): the clean-vs-poisoned A/B
+        # (throughput ratio above the floor, bisection probe writes
+        # within the 2·log2(batch) bound, union invariant verified) AND
+        # the dead-letter chaos scenario (poison rows quarantine their
+        # table while survivors deliver everything; replay +
+        # unquarantine restores exact committed truth)
+        assert out["poison_ok"] is True, out["poison_failures"]
+        assert out["poison_throughput_ratio"] >= \
+            out["poison_ratio_floor"]
+        assert out["poison_probe_writes"] <= out["poison_probe_bound"]
+        assert out["poison_dlq_entries"] >= 1
+        assert out["poison_failures"] == []
+        assert out["dlq_chaos_ok"] is True, out["dlq_chaos"]
+        assert out["dlq_chaos"]["quarantined_tables"] == [16384]

@@ -138,7 +138,9 @@ class TestClickHouse:
             d = ClickHouseDestination(self.config(server), RETRY_FAST)
             with pytest.raises(EtlError) as ei:
                 await d.startup()
-            assert ei.value.kind is ErrorKind.DESTINATION_FAILED
+            # a definitive 4xx is the permanent REJECTED kind (the
+            # poison-isolation trigger), not the ambiguous FAILED
+            assert ei.value.kind is ErrorKind.DESTINATION_REJECTED
             await d.shutdown()
         finally:
             await server.stop()
@@ -585,7 +587,9 @@ class TestBigQueryStorageWrite:
             ack = await d.write_events([ins(0, [1, "x", None])])
             with pytest.raises(EtlError) as ei:
                 await ack.wait_durable()
-            assert ei.value.kind is ErrorKind.DESTINATION_FAILED
+            # per-row refusal = the poison-pill trigger kind: the
+            # isolation protocol bisects instead of blind-retrying
+            assert ei.value.kind is ErrorKind.DESTINATION_REJECTED
             assert len(fake.attempts) == 1  # no retry for row errors
             await d.shutdown()
         finally:

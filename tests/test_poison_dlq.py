@@ -1,0 +1,1108 @@
+"""Poison-pill isolation + durable dead-letter store (ISSUE 15).
+
+Covers, bottom-up:
+  - the DLQ payload codec round trip across the cell vocabulary;
+  - the store surface on memory AND sqlite (idempotent keyed upsert,
+    status transitions, quarantine persistence incl. resume-after-kill
+    semantics and the STORE_DLQ_COMMIT failpoint), plus the
+    ShardScopedStore epoch/ownership fence on DLQ + quarantine writes;
+  - the isolator protocol units (bisection, WAL order, budget →
+    quarantine, transient abort, breaker integration, no-DLQ-store
+    degrade);
+  - the AckWindow multi-failure aggregation (satellite: every failed
+    entry's tables surface at once);
+  - destination error classification (shared HTTP map + wrap-through of
+    transport errors);
+  - the operator round trip (replay idempotence, discard, unquarantine)
+    through the DeadLetterQueue API and the CLI;
+  - both chaos scenarios green in tier-1.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as dt
+import json
+import uuid
+
+import pytest
+
+from etl_tpu.config import PipelineConfig, PoisonConfig
+from etl_tpu.destinations import (MemoryDestination,
+                                  PoisonRejectingDestination)
+from etl_tpu.destinations.base import WriteAck
+from etl_tpu.dlq import DeadLetterQueue
+from etl_tpu.dlq.codec import (decode_cell, decode_row_event,
+                               encode_cell, encode_row_event)
+from etl_tpu.models import ColumnSchema, Oid, TableName, TableSchema
+from etl_tpu.models.cell import (JSON_NULL, PgInterval, PgNumeric,
+                                 PgSpecialDate, PgSpecialTimestamp,
+                                 PgTimeTz, TOAST_UNCHANGED)
+from etl_tpu.models.errors import (ErrorKind, EtlError, is_poison_error,
+                                   retry_directive, RetryKind)
+from etl_tpu.models.event import (ChangeType, DeleteEvent, InsertEvent,
+                                  UpdateEvent)
+from etl_tpu.models.lsn import Lsn
+from etl_tpu.models.schema import ReplicatedTableSchema
+from etl_tpu.models.table_row import PartialTableRow, TableRow
+from etl_tpu.runtime import poison as poison_mod
+from etl_tpu.runtime.poison import PoisonIsolator, bisection_bound
+from etl_tpu.store import MemoryStore, SqliteStore
+from etl_tpu.store.base import (DLQ_STATUS_DEAD, DLQ_STATUS_DISCARDED,
+                                DLQ_STATUS_REPLAYED, DeadLetterEntry,
+                                QuarantineRecord)
+from etl_tpu.chaos import failpoints
+
+
+def make_schema(tid: int = 16384) -> ReplicatedTableSchema:
+    return ReplicatedTableSchema.with_all_columns(TableSchema(
+        tid, TableName("public", f"t{tid}"),
+        (ColumnSchema("id", Oid.INT8, nullable=False,
+                      primary_key_ordinal=1),
+         ColumnSchema("note", Oid.TEXT))))
+
+
+def insert_event(schema, pk: int, note: str, commit: int = 100,
+                 ordinal: int | None = None) -> InsertEvent:
+    return InsertEvent(Lsn(commit - 1), Lsn(commit),
+                       ordinal if ordinal is not None else pk, schema,
+                       TableRow([pk, note]))
+
+
+def make_entry(ev, kind: str = "DESTINATION_REJECTED") -> DeadLetterEntry:
+    change, payload = encode_row_event(ev)
+    return DeadLetterEntry(
+        entry_id=0, table_id=ev.schema.id, commit_lsn=int(ev.commit_lsn),
+        tx_ordinal=ev.tx_ordinal, change_type=change, payload=payload,
+        error_kind=kind, detail="test")
+
+
+@pytest.fixture
+def config() -> PipelineConfig:
+    return PipelineConfig(pipeline_id=1, publication_name="pub",
+                          poison=PoisonConfig(budget_rows=3,
+                                              window_s=300.0))
+
+
+# -- codec --------------------------------------------------------------------
+
+
+class TestDlqCodec:
+    CELLS = [
+        None, True, False, 0, -5, 2**62, 1.5, -0.25, "text", "",
+        "POISON-1", b"\x00\xff", PgNumeric("123.450"),
+        PgNumeric("NaN"), dt.date(2024, 5, 1),
+        dt.datetime(2024, 5, 1, 12, 30, 15, 123456),
+        dt.datetime(2024, 5, 1, 12, 30, 15, tzinfo=dt.timezone.utc),
+        dt.time(23, 59, 59, 5),
+        PgTimeTz(dt.time(1, 2, 3), 3600),
+        PgInterval(1, 2, 3_000_000),
+        PgSpecialDate(-1_000_000, "1000-01-01 BC"),
+        PgSpecialTimestamp(-(2**45), "2000-01-01 00:00:00 BC", True),
+        uuid.UUID(int=7), JSON_NULL, TOAST_UNCHANGED,
+        {"k": [1, "two"]}, [1, "two", None, [3]],
+        float("inf"), float("-inf"),
+    ]
+
+    def test_cell_round_trip(self):
+        for v in self.CELLS:
+            enc = encode_cell(v)
+            json_safe = json.loads(json.dumps(enc))
+            got = decode_cell(json_safe)
+            assert got == v or (v != v and got != got), (v, got)
+            # identity-style singletons survive as the same sentinel
+            if v is TOAST_UNCHANGED or v is JSON_NULL:
+                assert got is v
+
+    def test_nan_round_trips(self):
+        got = decode_cell(json.loads(json.dumps(encode_cell(
+            float("nan")))))
+        assert got != got
+
+    def test_opaque_fallback(self):
+        class Exotic:
+            def __repr__(self):
+                return "<exotic>"
+
+        assert decode_cell(encode_cell(Exotic())) == "<exotic>"
+
+    def test_insert_round_trip(self):
+        schema = make_schema()
+        ev = insert_event(schema, 7, "POISON-1", commit=500, ordinal=3)
+        entry = make_entry(ev)
+        got = decode_row_event(entry, schema)
+        assert isinstance(got, InsertEvent)
+        assert got.row.values == [7, "POISON-1"]
+        assert int(got.commit_lsn) == 500 and got.tx_ordinal == 3
+
+    def test_update_with_key_old_row(self):
+        schema = make_schema()
+        ev = UpdateEvent(Lsn(9), Lsn(10), 1, schema, TableRow([2, "new"]),
+                         PartialTableRow([2, None], [True, False]))
+        got = decode_row_event(make_entry(ev), schema)
+        assert isinstance(got, UpdateEvent)
+        assert isinstance(got.old_row, PartialTableRow)
+        assert got.old_row.present == [True, False]
+        assert got.row.values == [2, "new"]
+
+    def test_delete_round_trip(self):
+        schema = make_schema()
+        ev = DeleteEvent(Lsn(9), Lsn(10), 1, schema,
+                         PartialTableRow([2, None], [True, False]))
+        got = decode_row_event(make_entry(ev), schema)
+        assert isinstance(got, DeleteEvent)
+        assert isinstance(got.old_row, PartialTableRow)
+
+    def test_schema_width_mismatch_is_typed(self):
+        schema = make_schema()
+        entry = make_entry(insert_event(schema, 1, "x"))
+        wider = ReplicatedTableSchema.with_all_columns(TableSchema(
+            16384, TableName("public", "t"),
+            (ColumnSchema("id", Oid.INT8, nullable=False,
+                          primary_key_ordinal=1),
+             ColumnSchema("note", Oid.TEXT),
+             ColumnSchema("extra", Oid.INT4))))
+        with pytest.raises(EtlError) as ei:
+            decode_row_event(entry, wider)
+        assert ei.value.kind is ErrorKind.SCHEMA_MISMATCH
+
+
+# -- error taxonomy -----------------------------------------------------------
+
+
+class TestPoisonKinds:
+    def test_rejected_is_manual_and_poison(self):
+        e = EtlError(ErrorKind.DESTINATION_REJECTED, "4xx")
+        assert retry_directive(e).kind is RetryKind.MANUAL
+        assert is_poison_error(e)
+
+    def test_transient_kinds_are_not_poison(self):
+        for kind in (ErrorKind.DESTINATION_THROTTLED,
+                     ErrorKind.DESTINATION_CONNECTION_FAILED,
+                     ErrorKind.DESTINATION_UNAVAILABLE,
+                     ErrorKind.DESTINATION_FAILED,
+                     ErrorKind.TIMEOUT):
+            assert not is_poison_error(EtlError(kind, "x"))
+
+    def test_aggregate_poison_only_if_every_cause_is(self):
+        pois = EtlError(ErrorKind.DESTINATION_REJECTED, "a")
+        trans = EtlError(ErrorKind.DESTINATION_THROTTLED, "b")
+        both_poison = EtlError(ErrorKind.DESTINATION_SCHEMA_FAILED, "c",
+                               causes=[pois])
+        assert is_poison_error(both_poison)
+        mixed = EtlError(ErrorKind.DESTINATION_REJECTED, "d",
+                         causes=[trans])
+        assert not is_poison_error(mixed)
+
+    def test_non_etl_error_is_not_poison(self):
+        assert not is_poison_error(RuntimeError("boom"))
+
+
+# -- store surface ------------------------------------------------------------
+
+
+def sqlite_store(tmp_path):
+    return SqliteStore(tmp_path / "state.db", 1)
+
+
+class _StoreEnv:
+    """One dialect's store over shared backing storage (the test_sql_store
+    pattern — no pytest-asyncio, so construction happens inside the
+    async test body)."""
+
+    def __init__(self, dialect: str, tmp_path):
+        self.dialect = dialect
+        self.tmp_path = tmp_path
+        self._server = None
+        self._stores: list = []
+
+    async def make(self, pipeline_id: int = 1):
+        if self.dialect == "memory":
+            s = MemoryStore()
+            self._stores.append(s)
+            return s
+        if self.dialect == "sqlite":
+            s = SqliteStore(self.tmp_path / "store.db", pipeline_id)
+        else:
+            from etl_tpu.config import PgConnectionConfig
+            from etl_tpu.postgres.fake import FakeDatabase
+            from etl_tpu.store import PostgresStore
+            from etl_tpu.testing.fake_pg_server import FakePgServer
+
+            if self._server is None:
+                self._server = FakePgServer(FakeDatabase())
+                await self._server.start()
+            s = PostgresStore(
+                PgConnectionConfig(host="127.0.0.1",
+                                   port=self._server.port,
+                                   name="postgres", username="etl"),
+                pipeline_id)
+        await s.connect()
+        self._stores.append(s)
+        return s
+
+    async def cleanup(self):
+        for s in self._stores:
+            close = getattr(s, "close", None)
+            if close is not None:
+                try:
+                    await close()
+                except Exception:
+                    pass
+        if self._server is not None:
+            await self._server.stop()
+
+
+@pytest.fixture(params=["memory", "sqlite", "postgres"])
+def dialect(request):
+    return request.param
+
+
+class TestDlqStoreSurface:
+    """The dead-letter + quarantine surface on all three backends
+    (memory / sqlite / Postgres-over-the-fake-wire)."""
+
+    async def test_append_list_get(self, dialect, tmp_path):
+        env = _StoreEnv(dialect, tmp_path)
+        try:
+            store = await env.make()
+            schema = make_schema()
+            ids = await store.append_dead_letters(
+                [make_entry(insert_event(schema, i, f"v{i}",
+                                         commit=100 + i))
+                 for i in range(3)])
+            assert len(ids) == 3 and len(set(ids)) == 3
+            entries = await store.list_dead_letters()
+            assert [e.entry_id for e in entries] == sorted(ids)
+            assert all(e.status == DLQ_STATUS_DEAD for e in entries)
+            got = await store.get_dead_letter(ids[1])
+            assert got is not None and got.commit_lsn == 101
+            assert await store.get_dead_letter(10**9) is None
+        finally:
+            await env.cleanup()
+
+    async def test_append_is_idempotent_keyed_upsert(self, dialect,
+                                                     tmp_path):
+        env = _StoreEnv(dialect, tmp_path)
+        try:
+            store = await env.make()
+            e = make_entry(insert_event(make_schema(), 1, "x"))
+            (id1,) = await store.append_dead_letters([e])
+            (id2,) = await store.append_dead_letters([e])
+            assert id1 == id2
+            entries = await store.list_dead_letters()
+            assert len(entries) == 1
+            assert entries[0].attempts == 2
+        finally:
+            await env.cleanup()
+
+    async def test_filters_and_status_transitions(self, dialect,
+                                                  tmp_path):
+        env = _StoreEnv(dialect, tmp_path)
+        try:
+            store = await env.make()
+            s1, s2 = make_schema(16384), make_schema(16385)
+            await store.append_dead_letters(
+                [make_entry(insert_event(s1, 1, "a")),
+                 make_entry(insert_event(s2, 2, "b", commit=200))])
+            only = await store.list_dead_letters(table_id=16385)
+            assert [e.table_id for e in only] == [16385]
+            (eid,) = [e.entry_id for e in only]
+            await store.set_dead_letter_status(eid, DLQ_STATUS_REPLAYED)
+            assert await store.list_dead_letters(table_id=16385) == []
+            replayed = await store.list_dead_letters(
+                table_id=16385, status=DLQ_STATUS_REPLAYED)
+            assert [e.entry_id for e in replayed] == [eid]
+            assert len(await store.list_dead_letters(status=None)) == 2
+            with pytest.raises(EtlError):
+                await store.set_dead_letter_status(12345,
+                                                   DLQ_STATUS_DISCARDED)
+        finally:
+            await env.cleanup()
+
+    async def test_quarantine_round_trip(self, dialect, tmp_path):
+        env = _StoreEnv(dialect, tmp_path)
+        try:
+            store = await env.make()
+            rec = QuarantineRecord(16384, since_lsn=500, poison_rows=4,
+                                   parked_events=2, reason="drift")
+            await store.set_table_quarantine(16384, rec)
+            assert await store.get_quarantined_tables() == {16384: rec}
+            await store.set_table_quarantine(16384, None)
+            assert await store.get_quarantined_tables() == {}
+        finally:
+            await env.cleanup()
+
+    async def test_persists_across_store_restart(self, dialect,
+                                                 tmp_path):
+        """Hard-kill semantics on the durable dialects: a NEW store over
+        the same backing storage sees the DLQ and the quarantine record
+        — what a restarted replicator loads at its first flush."""
+        if dialect == "memory":
+            pytest.skip("memory store dies with the process by design")
+        env = _StoreEnv(dialect, tmp_path)
+        try:
+            store = await env.make()
+            await store.append_dead_letters(
+                [make_entry(insert_event(make_schema(), 1, "POISON-1"))])
+            await store.set_table_quarantine(
+                16384, QuarantineRecord(16384, 100, 1, reason="r"))
+            second = await env.make()  # fresh process over same storage
+            assert set(await second.get_quarantined_tables()) == {16384}
+            entries = await second.list_dead_letters()
+            assert len(entries) == 1 and entries[0].table_id == 16384
+        finally:
+            await env.cleanup()
+
+    async def test_dlq_failpoint_fires(self, dialect, tmp_path):
+        env = _StoreEnv(dialect, tmp_path)
+        try:
+            store = await env.make()
+            failpoints.arm_error(failpoints.STORE_DLQ_COMMIT,
+                                 ErrorKind.STATE_STORE_FAILED, times=1)
+            try:
+                with pytest.raises(EtlError):
+                    await store.append_dead_letters(
+                        [make_entry(insert_event(make_schema(), 1, "x"))])
+            finally:
+                failpoints.disarm_all()
+            # next append succeeds and nothing was half-written
+            await store.append_dead_letters(
+                [make_entry(insert_event(make_schema(), 1, "x"))])
+            assert len(await store.list_dead_letters()) == 1
+        finally:
+            await env.cleanup()
+
+
+class TestSqliteQuarantinePersistence:
+    async def test_survives_process_death(self, tmp_path):
+        """Hard-kill semantics: a NEW store over the same file sees the
+        quarantine record and the DLQ — what a restarted replicator
+        loads at its first flush."""
+        s = sqlite_store(tmp_path)
+        await s.connect()
+        schema = make_schema()
+        await s.append_dead_letters(
+            [make_entry(insert_event(schema, 1, "POISON-1"))])
+        await s.set_table_quarantine(
+            16384, QuarantineRecord(16384, 100, 1, reason="r"))
+        await s.close()  # no graceful anything else — process death
+
+        s2 = sqlite_store(tmp_path)
+        await s2.connect()
+        assert set(await s2.get_quarantined_tables()) == {16384}
+        entries = await s2.list_dead_letters()
+        assert len(entries) == 1 and entries[0].table_id == 16384
+        await s2.close()
+
+    async def test_replay_then_unquarantine_round_trip(self, tmp_path):
+        s = sqlite_store(tmp_path)
+        await s.connect()
+        schema = make_schema()
+        await s.store_table_schema(schema, 1)
+        ev = insert_event(schema, 9, "fixed-now", commit=300)
+        await s.append_dead_letters([make_entry(ev)])
+        await s.set_table_quarantine(
+            16384, QuarantineRecord(16384, 300, 1))
+        dest = MemoryDestination()
+        dlq = DeadLetterQueue(s)
+        out = await dlq.replay(dest)
+        assert len(out["replayed"]) == 1 and not out["skipped"]
+        assert [e.row.values for e in dest.events] == [[9, "fixed-now"]]
+        assert await dlq.unquarantine(16384) is True
+        assert await s.get_quarantined_tables() == {}
+        # idempotent: nothing left to replay, nothing re-delivered
+        again = await dlq.replay(dest)
+        assert again["replayed"] == [] and len(dest.events) == 1
+        assert await dlq.unquarantine(16384) is False
+        # an explicitly-requested non-replayable id is REPORTED skipped,
+        # never silent empty success
+        entries = await s.list_dead_letters(status=None)
+        out = await dlq.replay(dest, entry_ids=[entries[0].entry_id])
+        assert out["replayed"] == []
+        assert out["skipped"][0]["entry_id"] == entries[0].entry_id
+        assert "replayed" in out["skipped"][0]["reason"]
+        await s.close()
+
+
+class TestShardScopedDlqFence:
+    async def _scoped(self, shard: int, epoch: int = 0, count: int = 2):
+        from etl_tpu.sharding.runtime import (ShardIdentity,
+                                              ShardScopedStore)
+        from etl_tpu.sharding.shardmap import ShardAssignment
+
+        inner = MemoryStore()
+        await inner.update_shard_assignment(
+            ShardAssignment(epoch=epoch, shard_count=count))
+        return inner, ShardScopedStore(
+            inner, ShardIdentity(pipeline_id=1, shard=shard,
+                                 shard_count=count, epoch=epoch))
+
+    async def test_owned_writes_pass_others_fenced(self):
+        from etl_tpu.sharding.shardmap import ShardMap
+
+        inner, scoped = await self._scoped(shard=0)
+        smap = ShardMap(2, 0)
+        owned = next(t for t in range(16384, 16500) if smap.owns(t, 0))
+        foreign = next(t for t in range(16384, 16500)
+                       if not smap.owns(t, 0))
+        ev = insert_event(make_schema(owned), 1, "x")
+        await scoped.append_dead_letters([make_entry(ev)])
+        await scoped.set_table_quarantine(
+            owned, QuarantineRecord(owned, 1, 1))
+        with pytest.raises(EtlError) as ei:
+            await scoped.append_dead_letters(
+                [make_entry(insert_event(make_schema(foreign), 1, "x"))])
+        assert ei.value.kind is ErrorKind.SHARD_NOT_OWNED
+        with pytest.raises(EtlError):
+            await scoped.set_table_quarantine(
+                foreign, QuarantineRecord(foreign, 1, 1))
+        # reads pass through whole (CLI/invariant vantage)
+        assert len(await scoped.list_dead_letters()) == 1
+        assert set(await scoped.get_quarantined_tables()) == {owned}
+
+    async def test_epoch_stale_refuses(self):
+        from etl_tpu.sharding.shardmap import ShardAssignment, ShardMap
+
+        inner, scoped = await self._scoped(shard=0)
+        smap = ShardMap(2, 0)
+        owned = next(t for t in range(16384, 16500) if smap.owns(t, 0))
+        await inner.update_shard_assignment(
+            ShardAssignment(epoch=1, shard_count=2))
+        with pytest.raises(EtlError) as ei:
+            await scoped.set_table_quarantine(
+                owned, QuarantineRecord(owned, 1, 1))
+        assert ei.value.kind is ErrorKind.SHARD_EPOCH_STALE
+
+
+# -- isolator protocol units --------------------------------------------------
+
+
+class RecordingPoisonDest(PoisonRejectingDestination):
+    """Poison rejection + write-order recording (WAL-order proof)."""
+
+    def __init__(self, inner):
+        super().__init__(inner)
+        self.write_sizes: list[int] = []
+
+    async def write_event_batches(self, events):
+        self.write_sizes.append(len(list(events)))
+        return await super().write_event_batches(events)
+
+
+class TestPoisonIsolator:
+    def make(self, config, budget: "int | None" = None):
+        if budget is not None:
+            from dataclasses import replace
+
+            config = replace(config,
+                             poison=PoisonConfig(budget_rows=budget))
+        store = MemoryStore()
+        inner = MemoryDestination()
+        dest = RecordingPoisonDest(inner)
+        iso = PoisonIsolator(store=store, destination=dest, config=config)
+        return store, inner, dest, iso
+
+    async def test_single_poison_bisects_within_bound(self, config):
+        poison_mod.reset_isolation_trace()
+        store, inner, dest, iso = self.make(config, budget=100)
+        schema = make_schema()
+        events = [insert_event(schema, i,
+                               "POISON-x" if i == 11 else f"v{i}")
+                  for i in range(16)]
+        ack = await iso.submit(events)
+        assert ack.is_durable
+        delivered = sorted(e.row.values[0] for e in inner.events)
+        assert delivered == [i for i in range(16) if i != 11]
+        entries = await store.list_dead_letters()
+        assert [(e.table_id, e.tx_ordinal) for e in entries] \
+            == [(16384, 11)]
+        (trace,) = poison_mod.ISOLATION_TRACE
+        assert trace["poison_rows"] == 1
+        assert trace["probe_writes"] <= bisection_bound(16, 1, 1)
+
+    async def test_wal_order_within_table_preserved(self, config):
+        store, inner, dest, iso = self.make(config, budget=100)
+        schema = make_schema()
+        events = [insert_event(schema, i,
+                               "POISON-x" if i == 3 else f"v{i}")
+                  for i in range(8)]
+        await iso.submit(events)
+        pks = [e.row.values[0] for e in inner.events]
+        assert pks == sorted(pks)  # delivered in WAL order
+
+    async def test_multi_table_multi_poison(self, config):
+        store, inner, dest, iso = self.make(config, budget=100)
+        s1, s2, s3 = (make_schema(t) for t in (16384, 16385, 16386))
+        events = []
+        for i in range(6):
+            events.append(insert_event(
+                s1, i, "POISON-a" if i == 2 else f"a{i}"))
+            events.append(insert_event(
+                s2, i, "POISON-b" if i in (1, 4) else f"b{i}",
+                commit=200))
+            events.append(insert_event(s3, i, f"c{i}", commit=300))
+        await iso.submit(events)
+        entries = await store.list_dead_letters()
+        assert sorted((e.table_id, e.tx_ordinal) for e in entries) \
+            == [(16384, 2), (16385, 1), (16385, 4)]
+        by_table: dict = {}
+        for e in inner.events:
+            by_table.setdefault(e.schema.id, []).append(e.row.values[0])
+        assert by_table[16384] == [0, 1, 3, 4, 5]
+        assert by_table[16385] == [0, 2, 3, 5]
+        assert by_table[16386] == list(range(6))  # untouched survivor
+
+    async def test_budget_trips_quarantine_and_parks(self, config):
+        store, inner, dest, iso = self.make(config, budget=2)
+        schema = make_schema()
+        events = [insert_event(schema, i,
+                               f"POISON-{i}" if i < 4 else f"v{i}")
+                  for i in range(12)]
+        await iso.submit(events)
+        q = await store.get_quarantined_tables()
+        assert set(q) == {16384}
+        assert q[16384].poison_rows >= 2
+        # every committed row is delivered or dead-lettered
+        entries = await store.list_dead_letters()
+        accounted = {e.tx_ordinal for e in entries} \
+            | {e.row.values[0] for e in inner.events}
+        assert accounted == set(range(12))
+        # a LATER flush parks without touching the destination
+        n_before = len(inner.events)
+        ack = await iso.submit(
+            [insert_event(schema, 100, "healthy-but-parked")])
+        assert ack.is_durable
+        assert len(inner.events) == n_before
+        parked = [e for e in await store.list_dead_letters()
+                  if e.error_kind == "quarantine"]
+        assert any(e.tx_ordinal == 100 for e in parked)
+
+    async def test_quarantine_loaded_from_store_on_first_use(self, config):
+        """A restarted worker parks from its FIRST flush: the quarantine
+        set loads from the store, not from this process's history."""
+        store, inner, dest, iso = self.make(config)
+        await store.set_table_quarantine(
+            16384, QuarantineRecord(16384, 1, 5, reason="previous life"))
+        schema = make_schema()
+        await iso.submit([insert_event(schema, 1, "v1")])
+        assert inner.events == []
+        assert len(await store.list_dead_letters()) == 1
+
+    async def test_transient_error_never_bisects(self, config):
+        store, inner, dest, iso = self.make(config)
+
+        class FlakyDest(MemoryDestination):
+            async def write_event_batches(self, events):
+                raise EtlError(ErrorKind.DESTINATION_CONNECTION_FAILED,
+                               "down")
+
+        iso.destination = FlakyDest()
+        with pytest.raises(EtlError) as ei:
+            await iso.submit([insert_event(make_schema(), 1, "v")])
+        assert ei.value.kind is ErrorKind.DESTINATION_CONNECTION_FAILED
+        assert await store.list_dead_letters() == []
+
+    async def test_transient_mid_bisection_aborts(self, config):
+        """A destination that goes DOWN mid-bisection aborts isolation
+        with the transient error (worker re-streams), leaving no
+        spurious dead letters behind."""
+        store, inner, dest, iso = self.make(config, budget=100)
+        schema = make_schema()
+        calls = [0]
+        orig = dest.write_event_batches
+
+        async def flaky(events):
+            calls[0] += 1
+            if calls[0] >= 3:
+                raise EtlError(ErrorKind.DESTINATION_CONNECTION_FAILED,
+                               "went down mid-bisection")
+            return await orig(events)
+
+        dest.write_event_batches = flaky
+        events = [insert_event(schema, i,
+                               "POISON-x" if i == 0 else f"v{i}")
+                  for i in range(8)]
+        with pytest.raises(EtlError) as ei:
+            await iso.submit(events)
+        assert ErrorKind.DESTINATION_CONNECTION_FAILED in ei.value.kinds()
+
+    async def test_open_breaker_never_bisects(self, config):
+        """Breaker open when the poison error surfaces: NO bisection,
+        and the MANUAL poison kind must not leak either — the worker
+        gets the breaker's own TIMED kind and re-streams; the row
+        isolates once the breaker closes."""
+        from etl_tpu.supervision.breaker import BreakerState
+
+        store, inner, dest, iso = self.make(config)
+
+        class FakeBreaker:
+            state = BreakerState.OPEN
+
+        class RejectingWithBreaker(MemoryDestination):
+            breaker = FakeBreaker()
+
+            async def write_event_batches(self, events):
+                raise EtlError(ErrorKind.DESTINATION_REJECTED, "4xx")
+
+        iso.destination = RejectingWithBreaker()
+        with pytest.raises(EtlError) as ei:
+            await iso.submit([insert_event(make_schema(), 1, "v")])
+        assert ei.value.kind is ErrorKind.DESTINATION_UNAVAILABLE
+        assert retry_directive(ei.value).kind is RetryKind.TIMED
+        assert await store.list_dead_letters() == []
+
+    async def test_store_without_dlq_degrades_to_original_error(
+            self, config):
+        """No DLQ surface → the ORIGINAL poison error surfaces (pre-PR
+        worker behavior), never silent row loss."""
+
+        class BareStore(MemoryStore):
+            async def append_dead_letters(self, entries):
+                raise EtlError(ErrorKind.STATE_STORE_FAILED,
+                               "BareStore does not persist dead letters")
+
+        inner = MemoryDestination()
+        dest = PoisonRejectingDestination(inner)
+        iso = PoisonIsolator(store=BareStore(), destination=dest,
+                             config=config)
+        with pytest.raises(EtlError) as ei:
+            await iso.submit([insert_event(make_schema(), 1, "POISON-1")])
+        assert ei.value.kind is ErrorKind.DESTINATION_REJECTED
+
+    async def test_deferred_ack_poison_isolates(self, config):
+        """BigQuery shape: write_event_batches returns an ACCEPTED ack
+        and the rejection only surfaces at wait_durable — the guarded
+        ack must run the same isolation instead of leaking the MANUAL
+        poison error to the worker unisolated."""
+
+        class DeferredFirstRejection(RecordingPoisonDest):
+            """First poisoned write fails via the ack FUTURE (deferred);
+            later writes (the bisection probes) reject synchronously."""
+
+            def __init__(self, inner):
+                super().__init__(inner)
+                self.deferred_fired = False
+
+            async def write_event_batches(self, events):
+                events = list(events)
+                if not self.deferred_fired:
+                    try:
+                        self._scan(events)
+                    except EtlError as e:
+                        self.deferred_fired = True
+                        ack, fut = WriteAck.accepted()
+                        fut.set_exception(e)
+                        fut.exception()  # mark retrieved
+                        return ack
+                return await super().write_event_batches(events)
+
+        store = MemoryStore()
+        inner = MemoryDestination()
+        dest = DeferredFirstRejection(inner)
+        from dataclasses import replace
+
+        iso = PoisonIsolator(
+            store=store, destination=dest,
+            config=replace(config, poison=PoisonConfig(budget_rows=100)))
+        schema = make_schema()
+        events = [insert_event(schema, i,
+                               "POISON-x" if i == 5 else f"v{i}")
+                  for i in range(10)]
+        ack = await iso.submit(events)
+        assert not ack.is_durable  # the guarded deferred ack
+        assert dest.deferred_fired
+        await ack.wait_durable()  # isolation runs HERE and resolves
+        delivered = sorted(e.row.values[0] for e in inner.events)
+        assert delivered == [i for i in range(10) if i != 5]
+        entries = await store.list_dead_letters()
+        assert [(e.table_id, e.tx_ordinal) for e in entries] \
+            == [(16384, 5)]
+
+    async def test_deferred_ack_transient_passes_through(self, config):
+        """A transient failure surfacing at wait_durable keeps the
+        worker-retry semantics — the guard never isolates it."""
+        store = MemoryStore()
+
+        class DeferredTransient(MemoryDestination):
+            async def write_event_batches(self, events):
+                ack, fut = WriteAck.accepted()
+                fut.set_exception(EtlError(
+                    ErrorKind.DESTINATION_CONNECTION_FAILED, "lost"))
+                fut.exception()
+                return ack
+
+        iso = PoisonIsolator(store=store,
+                             destination=DeferredTransient(),
+                             config=config)
+        ack = await iso.submit([insert_event(make_schema(), 1, "v")])
+        with pytest.raises(EtlError) as ei:
+            await ack.wait_durable()
+        assert ei.value.kind is ErrorKind.DESTINATION_CONNECTION_FAILED
+        assert await store.list_dead_letters() == []
+
+    async def test_crash_era_reappend_accumulates_attempts(self, config):
+        """Re-running the same isolation (the re-streamed flush after a
+        mid-bisection kill) upserts the same poison rows."""
+        store, inner, dest, iso = self.make(config, budget=100)
+        schema = make_schema()
+        events = [insert_event(schema, i,
+                               "POISON-x" if i == 2 else f"v{i}")
+                  for i in range(4)]
+        await iso.submit(events)
+        await iso.submit(events)  # the re-streamed window
+        entries = await store.list_dead_letters()
+        assert len(entries) == 1
+        assert entries[0].attempts == 2
+
+
+# -- ack-window multi-failure surfacing (satellite) ---------------------------
+
+
+class TestAckWindowMultiFailure:
+    async def test_all_failed_entries_tables_surface(self):
+        from etl_tpu.runtime.ack_window import AckWindow
+
+        window = AckWindow(4)
+        s1, s2 = make_schema(16384), make_schema(16385)
+
+        async def ok():
+            return None
+
+        def failing(kind, msg):
+            # fail at the DURABILITY stage (submission succeeded): this
+            # is how a poisoned write actually fails — successors have
+            # already submitted theirs, so multiple entries can fail in
+            # one window (a submit-stage failure fences successors
+            # before they submit instead)
+            async def run():
+                ack, fut = WriteAck.accepted()
+                fut.set_exception(EtlError(kind, msg))
+                return ack
+
+            return run
+
+        e1 = window.dispatch(
+            failing(ErrorKind.DESTINATION_REJECTED, "t1 poison"),
+            payload=[insert_event(s1, 1, "x")])
+        e2 = window.dispatch(ok, payload=[insert_event(s2, 2, "y")])
+        e3 = window.dispatch(
+            failing(ErrorKind.DESTINATION_SCHEMA_FAILED, "t2 drift"),
+            payload=[insert_event(s2, 3, "z")])
+        await asyncio.wait([e1.task, e2.task, e3.task])
+        done, failure = window.pop_ready()
+        # head failed → nothing pops as done, both failures aggregate
+        assert done == []
+        assert isinstance(failure, EtlError)
+        kinds = set(failure.kinds())
+        assert {ErrorKind.DESTINATION_REJECTED,
+                ErrorKind.DESTINATION_SCHEMA_FAILED} <= kinds
+        assert "16384" in failure.detail and "16385" in failure.detail
+        # every kind permanent → the aggregate still reads as poison
+        assert is_poison_error(failure)
+        window.abandon_payloads()
+
+    async def test_single_failure_raises_unchanged(self):
+        from etl_tpu.runtime.ack_window import AckWindow
+
+        window = AckWindow(4)
+        boom = EtlError(ErrorKind.DESTINATION_FAILED, "one")
+
+        async def failing():
+            raise boom
+
+        window.dispatch(failing, payload=[])
+        await asyncio.wait(window.tasks())
+        done, failure = window.pop_ready()
+        assert failure is boom
+
+    async def test_success_never_pops_past_failure(self):
+        """Durable progress must not advance over a done SUCCESSOR of a
+        failed entry — its WAL would be skipped forever."""
+        from etl_tpu.runtime.ack_window import AckWindow
+
+        window = AckWindow(4)
+
+        async def ok():
+            return None
+
+        async def failing():
+            raise EtlError(ErrorKind.DESTINATION_FAILED, "x")
+
+        window.dispatch(ok, commit_end_lsn=Lsn(10), payload=[])
+        window.dispatch(failing, commit_end_lsn=Lsn(20), payload=[])
+        window.dispatch(ok, commit_end_lsn=Lsn(30), payload=[])
+        await asyncio.wait(window.tasks())
+        done, failure = window.pop_ready()
+        assert [int(e.commit_end_lsn) for e in done] == [10]
+        assert failure is not None
+        assert len(window) == 1  # the done successor stays
+
+
+# -- destination classification (satellite) -----------------------------------
+
+
+class TestErrorClassification:
+    def test_http_status_map(self):
+        from etl_tpu.destinations.util import classify_http_error
+
+        cases = {
+            429: ErrorKind.DESTINATION_THROTTLED,
+            503: ErrorKind.DESTINATION_THROTTLED,
+            500: ErrorKind.DESTINATION_THROTTLED,
+            401: ErrorKind.DESTINATION_AUTH_FAILED,
+            403: ErrorKind.DESTINATION_AUTH_FAILED,
+            404: ErrorKind.DESTINATION_SCHEMA_FAILED,
+            410: ErrorKind.DESTINATION_SCHEMA_FAILED,
+            413: ErrorKind.DESTINATION_PAYLOAD_TOO_LARGE,
+            400: ErrorKind.DESTINATION_REJECTED,
+            422: ErrorKind.DESTINATION_REJECTED,
+        }
+        for status, kind in cases.items():
+            err = classify_http_error("dest", status, "detail")
+            assert err.kind is kind, (status, err.kind)
+            assert "dest" in str(err)
+
+    def test_permanent_4xx_is_poison_transient_is_not(self):
+        from etl_tpu.destinations.util import classify_http_error
+
+        assert is_poison_error(classify_http_error("d", 400))
+        assert is_poison_error(classify_http_error("d", 404))
+        assert not is_poison_error(classify_http_error("d", 429))
+        assert not is_poison_error(classify_http_error("d", 503))
+
+    def test_transport_exceptions_classify(self):
+        from etl_tpu.destinations.util import classify_write_exception
+
+        assert classify_write_exception("d", ConnectionError("x")).kind \
+            is ErrorKind.DESTINATION_CONNECTION_FAILED
+        assert classify_write_exception("d", OSError("x")).kind \
+            is ErrorKind.DESTINATION_CONNECTION_FAILED
+        assert classify_write_exception(
+            "d", asyncio.TimeoutError()).kind is ErrorKind.TIMEOUT
+        assert classify_write_exception("d", RuntimeError("x")).kind \
+            is ErrorKind.DESTINATION_FAILED
+        passthrough = EtlError(ErrorKind.DESTINATION_REJECTED, "as-is")
+        assert classify_write_exception("d", passthrough) is passthrough
+
+    async def test_with_retries_never_leaks_bare_exceptions(self):
+        from etl_tpu.destinations.util import (DestinationRetryPolicy,
+                                               with_retries)
+
+        policy = DestinationRetryPolicy(max_attempts=2,
+                                        initial_delay_s=0.001,
+                                        max_delay_s=0.002)
+
+        async def bad():
+            raise ConnectionResetError("socket died")
+
+        with pytest.raises(EtlError) as ei:
+            await with_retries(bad, policy,
+                               lambda e: isinstance(e, ConnectionError),
+                               destination="testdest")
+        assert ei.value.kind is ErrorKind.DESTINATION_CONNECTION_FAILED
+        assert "testdest" in ei.value.detail
+
+    async def test_with_retries_passes_internal_control_flow(self):
+        from etl_tpu.destinations.iceberg import _CasConflict
+        from etl_tpu.destinations.util import (DestinationRetryPolicy,
+                                               with_retries)
+
+        async def cas():
+            raise _CasConflict("stale head")
+
+        with pytest.raises(_CasConflict):
+            await with_retries(cas, DestinationRetryPolicy(
+                max_attempts=1, initial_delay_s=0.001,
+                max_delay_s=0.002))
+
+    async def test_per_destination_4xx_classification(self):
+        """Every HTTP destination maps a definitive 4xx write failure to
+        a permanent poison kind and a retryable 5xx to THROTTLED —
+        through the real wire path (RecordingHttpServer)."""
+        from tests.test_destinations import RecordingHttpServer
+
+        from etl_tpu.destinations.clickhouse import (ClickHouseConfig,
+                                                     ClickHouseDestination)
+        from etl_tpu.destinations.util import DestinationRetryPolicy
+
+        fast = DestinationRetryPolicy(max_attempts=2,
+                                      initial_delay_s=0.001,
+                                      max_delay_s=0.002)
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            ch = ClickHouseDestination(ClickHouseConfig(
+                url=f"http://127.0.0.1:{server.port}", database="db",
+                username="u", password="p"), fast)
+            server.fail_next = [400]
+            with pytest.raises(EtlError) as ei:
+                await ch.startup()
+            assert ei.value.kind is ErrorKind.DESTINATION_REJECTED
+            assert is_poison_error(ei.value)
+            server.fail_next = [503, 503]
+            with pytest.raises(EtlError) as ei:
+                await ch.startup()
+            assert ei.value.kind is ErrorKind.DESTINATION_THROTTLED
+            await ch.shutdown()
+        finally:
+            await server.stop()
+
+    def test_bigquery_grpc_status_classification(self):
+        from etl_tpu.destinations import bq_proto
+        from etl_tpu.destinations.bigquery import BigQueryDestination
+
+        class S:
+            def __init__(self, code):
+                self.code = code
+                self.message = "m"
+
+        fn = BigQueryDestination._status_to_error
+        assert fn(S(bq_proto.GRPC_INVALID_ARGUMENT)).kind \
+            is ErrorKind.DESTINATION_REJECTED
+        assert fn(S(bq_proto.GRPC_FAILED_PRECONDITION)).kind \
+            is ErrorKind.DESTINATION_REJECTED
+        assert fn(S(bq_proto.GRPC_NOT_FOUND)).kind \
+            is ErrorKind.DESTINATION_SCHEMA_FAILED
+        assert fn(S(bq_proto.GRPC_PERMISSION_DENIED)).kind \
+            is ErrorKind.DESTINATION_AUTH_FAILED
+        assert fn(S(bq_proto.GRPC_UNAVAILABLE)).kind \
+            is ErrorKind.DESTINATION_THROTTLED
+
+    async def test_breaker_ignores_poison_rejections(self):
+        """A definitive payload rejection proves the sink is UP: the
+        availability breaker must not count it (bisection probes would
+        otherwise trip shedding for every table), while transient
+        failures still trip it."""
+        from etl_tpu.supervision.breaker import BreakerState, CircuitBreaker
+        from etl_tpu.supervision.destination import SupervisedDestination
+
+        class Rejecting(MemoryDestination):
+            kind = ErrorKind.DESTINATION_REJECTED
+
+            async def write_events(self, events):
+                raise EtlError(self.kind, "scripted")
+
+        breaker = CircuitBreaker(failure_threshold=2)
+        dest = Rejecting()
+        sup = SupervisedDestination(dest, timeout_s=5, breaker=breaker)
+        for _ in range(5):
+            with pytest.raises(EtlError):
+                await sup.write_events([])
+        assert breaker.state is BreakerState.CLOSED
+        dest.kind = ErrorKind.DESTINATION_CONNECTION_FAILED
+        for _ in range(2):
+            with pytest.raises(EtlError):
+                await sup.write_events([])
+        assert breaker.state is BreakerState.OPEN
+
+
+# -- chaos scenarios in tier-1 ------------------------------------------------
+
+
+class TestDlqChaosScenarios:
+    async def test_poison_quarantine_scenario(self):
+        from etl_tpu.chaos.dlq import run_dlq_poison
+
+        run = await run_dlq_poison(seed=7)
+        assert run.ok, run.report.violations
+        assert run.quarantined_tables == [16384]
+        assert run.poison_entries >= 3
+        assert run.parked_entries > 0
+        assert run.probe_writes <= run.probe_bound
+        assert run.replayed == run.dlq_entries
+
+    async def test_bisection_crash_scenario(self):
+        from etl_tpu.chaos.dlq import run_dlq_bisection_crash
+
+        run = await run_dlq_bisection_crash(seed=7)
+        assert run.ok, run.report.violations
+        assert len(run.restarts) == 1
+        assert run.poison_entries >= 1
+
+    def test_cli_determinism(self):
+        """`python -m etl_tpu.chaos --dlq` replays bit-identically per
+        seed (timings stripped)."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        outs = []
+        for _ in range(2):
+            proc = subprocess.run(
+                [sys.executable, "-m", "etl_tpu.chaos", "--dlq",
+                 "--seed", "11"],
+                capture_output=True, text=True, timeout=240, cwd=repo,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            assert proc.returncode == 0, proc.stdout + proc.stderr
+            docs = [json.loads(line)
+                    for line in proc.stdout.strip().splitlines()]
+            for d in docs:
+                d.pop("duration_s", None)
+                for r in d.get("restarts", []):
+                    r.pop("recovery_s", None)
+            outs.append(docs)
+        assert outs[0] == outs[1]
+
+
+# -- operator CLI -------------------------------------------------------------
+
+
+class TestDlqCli:
+    def run_cli(self, *argv) -> dict:
+        from etl_tpu.dlq.__main__ import main
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main(list(argv))
+        assert rc == 0, buf.getvalue()
+        return json.loads(buf.getvalue())
+
+    @pytest.fixture
+    def seeded_db(self, tmp_path):
+        async def seed():
+            s = sqlite_store(tmp_path)
+            await s.connect()
+            schema = make_schema()
+            await s.store_table_schema(schema, 1)
+            await s.append_dead_letters(
+                [make_entry(insert_event(schema, i, f"v{i}",
+                                         commit=100 + i))
+                 for i in range(3)])
+            await s.set_table_quarantine(
+                16384, QuarantineRecord(16384, 100, 3))
+            await s.close()
+
+        asyncio.new_event_loop().run_until_complete(seed())
+        return str(tmp_path / "state.db")
+
+    def test_list_inspect_discard_quarantine(self, seeded_db, tmp_path):
+        base = ["--sqlite", seeded_db, "--pipeline-id", "1"]
+        out = self.run_cli(*base, "list")
+        assert out["count"] == 3
+        eid = out["entries"][0]["entry_id"]
+        detail = self.run_cli(*base, "inspect", str(eid))
+        assert detail["payload"]["columns"] == ["id", "note"]
+        assert detail["decoded_values"][0] == "0"
+        out = self.run_cli(*base, "discard", str(eid))
+        assert out["discarded"] == [eid]
+        assert self.run_cli(*base, "list")["count"] == 2
+        q = self.run_cli(*base, "quarantined")
+        assert [r["table_id"] for r in q["quarantined"]] == [16384]
+
+    def test_replay_via_registry_destination(self, seeded_db, tmp_path):
+        dest_json = tmp_path / "dest.json"
+        dest_json.write_text('{"type": "memory"}')
+        base = ["--sqlite", seeded_db, "--pipeline-id", "1"]
+        out = self.run_cli(*base, "replay",
+                           "--destination-json", str(dest_json))
+        assert len(out["replayed"]) == 3 and not out["skipped"]
+        # idempotent second run
+        out = self.run_cli(*base, "replay",
+                           "--destination-json", str(dest_json))
+        assert out["replayed"] == []
+        out = self.run_cli(*base, "unquarantine", "16384")
+        assert out["lifted"] is True
