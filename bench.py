@@ -638,10 +638,32 @@ def run_smoke() -> dict:
     lint_seconds = time.perf_counter() - t0
     lint_ok = lint_seconds < lint_budget_s
 
+    # IR-tier gate (ISSUE 16 CI satellite): the compiled-program
+    # contract pass — every enumerable canonical layout lowered through
+    # the production jit constructor and checked (callbacks, donation,
+    # collectives, widening, output budget, canonical dedup) — must run
+    # CLEAN (exit 0: violations fail the gate, not just the budget) and
+    # inside its wall-clock budget. Runs as a subprocess because the
+    # --mesh slice re-inits jax with 8 forced host devices, which this
+    # process's already-initialized single-device backend cannot do.
+    ir_budget_s = float(floors.get("ir_analysis_budget_s", 120.0))
+    ir_env = dict(os.environ)
+    ir_env["JAX_PLATFORMS"] = "cpu"
+    t0 = time.perf_counter()
+    ir_proc = subprocess.run(
+        [_sys.executable, "-m", "etl_tpu.analysis", "--programs",
+         "--mesh", "-q"],
+        capture_output=True, text=True, timeout=600, env=ir_env,
+        cwd=_repo)
+    ir_seconds = time.perf_counter() - t0
+    ir_clean = ir_proc.returncode == 0
+    ir_ok = ir_clean and ir_seconds < ir_budget_s
+
     return {
         "mode": "smoke",
         "ok": bool(identical and stages_observed and stream_ok
-                   and heartbeat_ok and lint_ok and no_row_path
+                   and heartbeat_ok and lint_ok and ir_ok
+                   and no_row_path
                    and egress_ok and workload_ok and mesh_ok and mp_ok
                    and sharded_chaos_ok and sharded_ok
                    and selectivity_ok and coldstart_ok
@@ -720,6 +742,12 @@ def run_smoke() -> dict:
         "static_analysis_budget_s": lint_budget_s,
         "static_analysis_under_budget": bool(lint_ok),
         "static_analysis_findings": len(lint_findings),
+        "ir_analysis_seconds": round(ir_seconds, 3),
+        "ir_analysis_budget_s": ir_budget_s,
+        "ir_analysis_under_budget": bool(ir_seconds < ir_budget_s),
+        "ir_analysis_clean": bool(ir_clean),
+        "ir_analysis_error": "" if ir_clean
+        else (ir_proc.stderr or ir_proc.stdout or "")[-400:],
         "pipelined_equals_serial": bool(identical),
         "stage_histograms_observed": bool(stages_observed),
         "streaming_events_per_sec": stream_eps,

@@ -11,6 +11,7 @@ import json
 import sys
 
 from . import baseline as baseline_mod
+from .ir import IR_CONTRACT_NAMES, IR_NAMESPACE
 from .rules import RULE_NAMES, analyze_paths, repo_package_dir
 
 
@@ -36,6 +37,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "suppress nothing")
     p.add_argument("--no-interproc", action="store_true",
                    help="skip the whole-program pass (lexical rules only)")
+    p.add_argument("--programs", action="store_true",
+                   help="also run the IR tier: lower every enumerable "
+                        "decode program and check the compiled-program "
+                        "contracts (ir-*)")
+    p.add_argument("--mesh", action="store_true",
+                   help="with --programs: additionally verify the "
+                        "mesh-sharded program variants in a forced "
+                        "8-device subprocess")
+    # internal: the forced-mesh child process entry (see ir.runner)
+    p.add_argument("--programs-mesh-inner", action="store_true",
+                   help=argparse.SUPPRESS)
     p.add_argument("--callgraph", action="store_true",
                    help="dump the resolved call graph edges and exit")
     p.add_argument("--explain", action="store_true",
@@ -102,7 +114,20 @@ def main(argv: "list[str] | None" = None) -> int:
     if args.as_json:
         args.fmt = "json"
     if args.list_rules:
-        print("\n".join(RULE_NAMES))
+        names = RULE_NAMES + (IR_CONTRACT_NAMES if args.programs else ())
+        print("\n".join(names))
+        return 0
+    if args.mesh and not (args.programs or args.programs_mesh_inner):
+        print("etl-lint: --mesh requires --programs", file=sys.stderr)
+        return 2
+    if args.programs_mesh_inner:
+        from .ir import runner as ir_runner
+
+        try:
+            print(json.dumps(ir_runner.run_mesh_inner()))
+        except Exception as e:  # analyzer failure, not a lint result
+            print(f"etl-lint: ir analyzer error: {e}", file=sys.stderr)
+            return 2
         return 0
     paths = args.paths or [str(repo_package_dir())]
     if args.callgraph:
@@ -124,6 +149,19 @@ def main(argv: "list[str] | None" = None) -> int:
         print(f"etl-lint: analyzer error: {e}", file=sys.stderr)
         return 2
 
+    if args.programs:
+        from .ir import runner as ir_runner
+
+        try:
+            ir_findings, ir_paths = ir_runner.analyze_programs(
+                mesh=args.mesh)
+        except ir_runner.IrAnalysisError as e:
+            print(f"etl-lint: {e}", file=sys.stderr)
+            return 2
+        findings = sorted(findings + ir_findings,
+                          key=lambda f: (f.path, f.line, f.col, f.rule))
+        scanned.extend(ir_paths)
+
     if args.update_baseline:
         # scanned_paths bounds the rewrite: a scoped run only rewrites
         # entries for the files it actually looked at
@@ -144,10 +182,17 @@ def main(argv: "list[str] | None" = None) -> int:
             return 2
     violations, stale = baseline_mod.apply(findings, allowed)
     # stale warnings only make sense for files this run actually looked
-    # at — a scoped run can't know whether out-of-scope debt was fixed
+    # at — a scoped run can't know whether out-of-scope debt was fixed.
+    # When the IR tier ran, the ENTIRE `programs/` namespace counts as
+    # scanned (not just the enumerated paths): that pass enumerates
+    # every program any tier can produce, so a baseline entry it did not
+    # re-produce — including one for a layout that no longer exists, or
+    # a finding that migrated between tiers — is genuinely stale.
     scanned_set = set(scanned)
     stale = {fp: n for fp, n in stale.items()
-             if baseline_mod.fingerprint_path(fp) in scanned_set}
+             if baseline_mod.fingerprint_path(fp) in scanned_set
+             or (args.programs and baseline_mod.fingerprint_path(fp)
+                 .startswith(IR_NAMESPACE))}
 
     if args.check_baseline:
         unused_ignores = [(u.path, line, rule) for u in units

@@ -1,0 +1,49 @@
+"""etl-lint IR tier: contract verification of compiled decode programs.
+
+The AST tier (..rules) guards source; this tier guards the *lowered
+programs themselves*. It enumerates every decode program the system can
+compile — canonical layouts from the program store + schema catalog,
+both engines (XLA and pallas), filtered and unfiltered, single-device
+and forced 8-shard mesh — lowers each through the exact
+`ops.engine._build_device_fn` constructor production dispatch uses, and
+checks per-program contracts on the jaxpr / StableHLO / compiled HLO.
+
+Findings flow through the same `findings.Finding` model, fingerprints,
+baseline, and `--format=github` machinery as AST findings. IR findings
+live under the reserved `programs/<layout-tag>` path namespace (which
+`findings.canonical_path` passes through untouched) with the program
+variant as the scope, so fingerprints are stable across runs and
+machines.
+
+This module stays import-light (no jax): the CLI imports it
+unconditionally for the contract names and namespace; the heavy runner
+loads only behind `--programs`.
+"""
+
+from __future__ import annotations
+
+#: reserved path namespace for IR-tier findings ("programs/<tag>");
+#: never collides with a real file path, so baseline entries for the two
+#: tiers cannot alias
+IR_NAMESPACE = "programs/"
+
+#: the contract catalog, in check order. These are finding `rule` names,
+#: deliberately NOT part of rules.RULE_NAMES: the AST fixture-coverage
+#: tests pin that tuple to source-level rules, and IR contracts are
+#: exercised against lowered programs, not fixture files.
+IR_CONTRACT_NAMES = (
+    "ir-host-callback",
+    "ir-donation",
+    "ir-collective",
+    "ir-widening",
+    "ir-output-budget",
+    "ir-canonical-dedup",
+)
+
+
+def analyze_programs(*, mesh: bool = False, row_buckets=None):
+    """Run the IR tier; returns (findings, program_paths). Lazy import —
+    pulls in jax and the decode engine."""
+    from . import runner
+
+    return runner.analyze_programs(mesh=mesh, row_buckets=row_buckets)

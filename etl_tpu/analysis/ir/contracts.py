@@ -1,0 +1,232 @@
+"""The per-program contracts the IR tier checks.
+
+Each checker is a pure function over introspection artifacts the runner
+already holds (jaxpr, lowered StableHLO text, compiled HLO text, output
+avals) and returns a list of (detail, message) violation pairs — empty
+means the contract holds. Keeping the checkers artifact-in/tuples-out
+makes them trivially falsifiable from tests without a catalog or a CLI:
+build a deliberately-bad program, hand its artifacts to the checker,
+assert it fires.
+
+Contract catalog (see docs/static-analysis.md "IR tier"):
+
+  ir-host-callback    no pure/io/debug callback primitive anywhere in a
+                      @hot_loop program's jaxpr (a host round-trip per
+                      dispatch is a silent perf cliff on real TPUs)
+  ir-donation         declared `donate_argnums` must be realized as
+                      input/output aliasing in the lowered module on
+                      accelerator backends — and must NOT be declared at
+                      all on CPU, where the engine deliberately skips
+                      donation (_donation_supported)
+  ir-collective       mesh-sharded decode programs compile to zero
+                      forward-path collectives (the PR 8 shard-local
+                      invariant, machine-checked on compiled HLO)
+  ir-widening         no 64-bit element types (f64/i64/u64) introduced by
+                      convert_element_type or flowing out of any
+                      equation, outside an explicit allowlist
+  ir-output-budget    fetched-output bytes computed from the output
+                      avals stay within the per-layout budget (packed
+                      words + filter metadata + slack) — the
+                      selectivity-scaling property as a static bound
+  ir-canonical-dedup  permuted-column specs sharing a canonical layout
+                      must lower to byte-identical serialized IR
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+#: jaxpr primitives that round-trip through the host. Matched exactly
+#: first, then by substring as a forward guard for new callback flavors.
+CALLBACK_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+})
+
+#: 64-bit element types the decode path must never widen to: the packed
+#: output format is u32 words and every parser is specified in 32-bit
+#: arithmetic; an f64/i64 creeping in doubles register pressure and
+#: transfer bytes on TPU for zero precision the format can represent.
+WIDE_DTYPES = frozenset({"float64", "int64", "uint64", "complex128"})
+
+#: primitives allowed to touch 64-bit types. Deliberately tiny:
+#: nothing on the current forward path needs one.
+WIDENING_ALLOWLIST: frozenset = frozenset()
+
+#: compiled-HLO opcodes that are cross-shard collectives. `\b...\b(?!-)`?
+#: — HLO spells variants like `all-gather-start`, so match the stem.
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-gather|all-reduce|collective-permute|all-to-all|"
+    r"reduce-scatter|collective-broadcast)\b")
+
+#: marker StableHLO attaches to donated inputs in the lowered module
+_ALIASING_MARKER = "tf.aliasing_output"
+
+#: accelerator backends where the engine declares donation
+#: (mirrors ops.engine._donation_supported)
+ACCEL_BACKENDS = ("tpu", "gpu")
+
+
+def iter_eqns(jaxpr):
+    """Every equation in `jaxpr` and, recursively, in any sub-jaxpr an
+    equation carries in its params (pjit bodies, scan/cond branches,
+    custom_jvp call jaxprs, ...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(sub, "jaxpr", sub)
+                if hasattr(inner, "eqns"):
+                    yield from iter_eqns(inner)
+
+
+def check_host_callback(jaxpr) -> list:
+    """ir-host-callback: callback primitives anywhere in the jaxpr."""
+    out = []
+    seen = set()
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in seen:
+            continue
+        if name in CALLBACK_PRIMITIVES or "callback" in name:
+            seen.add(name)
+            out.append((name,
+                        f"hot-loop program contains host callback "
+                        f"primitive `{name}`: every dispatch round-trips "
+                        f"to the host"))
+    return out
+
+
+def check_donation(stablehlo_text: str, declared: bool,
+                   backend: str) -> list:
+    """ir-donation: declared donation must match realized aliasing for
+    the backend. Three failure modes, each its own detail so baselines
+    stay precise."""
+    realized = _ALIASING_MARKER in stablehlo_text
+    accel = backend in ACCEL_BACKENDS
+    if declared and not accel:
+        return [("declared-on-" + backend,
+                 f"donation declared on {backend} where the engine "
+                 f"deliberately skips it (_donation_supported): the "
+                 f"lowering cannot realize the aliasing and XLA warns "
+                 f"per compile")]
+    if declared and accel and not realized:
+        return [("declared-not-realized",
+                 f"donate_argnums declared but no {_ALIASING_MARKER} in "
+                 f"the lowered module on {backend}: the packed input "
+                 f"buffers are NOT being reused for the output")]
+    if not declared and realized:
+        return [("realized-not-declared",
+                 f"{_ALIASING_MARKER} present without declared donation "
+                 f"on {backend}: aliasing the engine did not ask for")]
+    return []
+
+
+def check_collectives(compiled_hlo_text: str) -> list:
+    """ir-collective: cross-shard ops in the compiled forward module."""
+    out = []
+    for op in sorted(set(_COLLECTIVE_RE.findall(compiled_hlo_text))):
+        out.append((op,
+                    f"mesh-sharded decode program compiles to `{op}`: "
+                    f"the forward path must stay shard-local (rows are "
+                    f"independent; any collective is a sharding-spec "
+                    f"regression)"))
+    return out
+
+
+def _dtype_name(dt) -> str:
+    try:
+        return str(np.dtype(dt))
+    except TypeError:
+        return str(dt)
+
+
+def check_widening(jaxpr, allowlist: frozenset = WIDENING_ALLOWLIST) -> list:
+    """ir-widening: 64-bit element types in the jaxpr. Checked on the
+    jaxpr (not the StableHLO text) because MLIR spells shape/dimension
+    ATTRIBUTES as i64 — a raw text scan false-positives on every
+    broadcast_in_dim."""
+    out = []
+    seen = set()
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in allowlist:
+            continue
+        if name == "convert_element_type":
+            nd = _dtype_name(eqn.params.get("new_dtype"))
+            if nd in WIDE_DTYPES and ("convert:" + nd) not in seen:
+                seen.add("convert:" + nd)
+                out.append((f"convert_element_type[{nd}]",
+                            f"convert_element_type widens to {nd}: "
+                            f"x64 creep on the decode path"))
+                continue
+        for v in eqn.outvars:
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is None:
+                continue
+            nd = _dtype_name(dt)
+            key = f"{name}:{nd}"
+            if nd in WIDE_DTYPES and key not in seen:
+                seen.add(key)
+                out.append((f"{name}[{nd}]",
+                            f"`{name}` produces a {nd} value: 64-bit "
+                            f"types are outside the packed-u32 decode "
+                            f"contract"))
+    return out
+
+
+def output_bytes(out_avals) -> int:
+    """Total fetched-output bytes across the program's output avals."""
+    total = 0
+    for aval in out_avals:
+        n = 1
+        for d in aval.shape:
+            n *= int(d)
+        total += n * np.dtype(aval.dtype).itemsize
+    return total
+
+
+def output_budget_bytes(n_words: int, row_capacity: int, *,
+                        filtered: bool, n_shards: int) -> int:
+    """The per-program budget: the packed words themselves, plus the
+    filter metadata the fused path legitimately returns (keep mask,
+    per-shard survivor counts), plus the mesh's per-shard fallback
+    counts, plus 64 bytes of fixed slack. Anything more — an extra
+    R-sized output, a widened word array — trips the contract."""
+    budget = n_words * 4 * row_capacity
+    shards = max(n_shards, 1)
+    if filtered:
+        budget += 4 * ((row_capacity + 31) // 32)  # keep mask, 1 bit/row
+        budget += 4 * shards                       # survivor counts
+    if n_shards:
+        budget += 4 * n_shards                     # shard_bad counts
+    return budget + 64
+
+
+def check_output_budget(out_avals, n_words: int, row_capacity: int, *,
+                        filtered: bool, n_shards: int) -> list:
+    """ir-output-budget: actual output bytes vs the layout budget."""
+    actual = output_bytes(out_avals)
+    budget = output_budget_bytes(n_words, row_capacity,
+                                 filtered=filtered, n_shards=n_shards)
+    if actual <= budget:
+        return []
+    per_row = actual / max(row_capacity, 1)
+    return [(f"bytes={actual}>budget={budget}",
+             f"program fetches {actual} output bytes "
+             f"({per_row:.1f} B/row) against a {budget}-byte budget for "
+             f"this layout ({n_words} packed words/row): an output "
+             f"grew beyond packed words + filter metadata")]
+
+
+def check_canonical_dedup(text_a: str, text_b: str) -> list:
+    """ir-canonical-dedup: two spec permutations of one canonical layout
+    must serialize to byte-identical IR."""
+    if text_a == text_b:
+        return []
+    return [("permutation-lowering-differs",
+             "column-permuted specs that share a canonical layout "
+             "lowered to DIFFERENT serialized IR: canonicalization is "
+             "not collapsing them to one cached program (cache-key "
+             "aliasing / compile-count regression)")]

@@ -863,6 +863,107 @@ class UnclassifiedDestinationError(Rule):
             f"classify_http_error, or justify with an inline ignore")
 
 
+# -- rule 19 ------------------------------------------------------------------
+
+#: logger-method terminals whose arguments are emitted to logs. `.log`
+#: rides along: any `.log(...)`-shaped call carrying a secret argument
+#: deserves a look regardless of the receiver.
+LOG_SINK_METHODS = frozenset({
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+})
+
+#: metric-emission calls whose `labels=` values are exported to the
+#: metrics endpoint (runtime/telemetry.py registry surface)
+METRIC_LABEL_CALLS = frozenset({
+    "counter_inc", "gauge_set", "histogram_observe", "labels",
+})
+
+#: attribute/variable names bound to secret-typed config fields. The
+#: config loader (config/load.py) wraps these in `Secret`, whose repr()
+#: redacts — but str()/f-string INTERPOLATION yields the raw value
+#: (Secret subclasses str), so reaching a log sink is a leak either way.
+#: Mirrors the api/orchestrator.py redaction list.
+SECRET_NAMES = frozenset({
+    "password", "api_key", "secret_key", "private_key_pem",
+    "catalog_token", "auth_token", "access_token",
+})
+#: deliberately NOT including bare "_token": replication progress tokens
+#: (offset_token, continuation/page tokens) are identifiers, not secrets
+SECRET_NAME_SUFFIXES = ("_password", "_secret", "_api_key",
+                        "_auth_token", "_access_token")
+#: name prefixes that mark a DERIVED non-secret (presence flags,
+#: switches): `has_password` is shape, not value
+_NONSECRET_PREFIXES = ("has_", "is_", "use_", "with_", "without_",
+                       "no_", "needs_", "require_", "allow_")
+
+
+def _is_secret_name(name: str) -> bool:
+    if name.startswith(_NONSECRET_PREFIXES):
+        return False
+    return name in SECRET_NAMES or name.endswith(SECRET_NAME_SUFFIXES)
+
+
+def _secret_subjects(tree: ast.AST) -> "list[str]":
+    """Secret-valued subexpressions anywhere under `tree`, normalized:
+    `.expose()` unwrap calls, secret-named attributes (`cfg.password`),
+    and bare secret-named locals. Order is source order (ast.walk)."""
+    out = []
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "expose":
+            out.append(".expose()")
+        elif isinstance(n, ast.Attribute):
+            if _is_secret_name(n.attr):
+                out.append(f".{n.attr}")
+        elif isinstance(n, ast.Name):
+            if _is_secret_name(n.id):
+                out.append(n.id)
+    return out
+
+
+class SecretInLog(Rule):
+    """A secret-typed value (config `Secret` fields, `.expose()` unwraps,
+    secret-named variables) interpolated into a logging call, an
+    exception message, or a metric label value.
+
+    `Secret.__repr__` redacts, but `Secret` subclasses `str`: %-format,
+    `.format`, and f-string interpolation all emit the RAW value, and an
+    `.expose()` result is a plain str with no protection at all. Log
+    pipelines, exception trackers, and metric endpoints are all
+    exported surfaces — log presence/shape (`"password=[set]"`), never
+    the value."""
+
+    name = "secret-in-log"
+
+    def on_call(self, ctx: LintContext, node: ast.Call) -> None:
+        term = terminal_name(node.func)
+        if isinstance(node.func, ast.Attribute) \
+                and term in LOG_SINK_METHODS:
+            targets = list(node.args) + [kw.value for kw in node.keywords]
+            sink = f"logging call `.{term}(…)`"
+        elif term in METRIC_LABEL_CALLS:
+            targets = [kw.value for kw in node.keywords
+                       if kw.arg == "labels"]
+            sink = f"metric labels of `{term}(…)`"
+        elif any(isinstance(a, ast.Raise) for a in ctx.ancestors()):
+            targets = list(node.args) + [kw.value for kw in node.keywords]
+            sink = "exception message"
+        else:
+            return
+        seen: set = set()
+        for t in targets:
+            for subject in _secret_subjects(t):
+                if subject in seen:
+                    continue
+                seen.add(subject)
+                ctx.report(
+                    self.name, node, subject,
+                    f"secret value `{subject}` reaches {sink}: Secret's "
+                    f"repr redacts but str/f-string interpolation emits "
+                    f"the raw value — log presence or shape "
+                    f"(\"password=[set]\"), never the secret itself")
+
+
 # -- entry points -------------------------------------------------------------
 
 def default_rules() -> list[Rule]:
@@ -881,6 +982,7 @@ def default_rules() -> list[Rule]:
         ControlLoopBlockingIo(),
         InlineDurabilityWait(),
         UnclassifiedDestinationError(),
+        SecretInLog(),
     ]
 
 

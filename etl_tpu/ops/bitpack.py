@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -234,10 +235,20 @@ def compact_packed(words, keep, n_shards: int):
     k2 = keep.astype(jnp.int32).reshape(n_shards, rps)
     pos = jnp.cumsum(k2, axis=1) - k2  # exclusive prefix sum, shard-local
     counts = k2.sum(axis=1, dtype=jnp.int32)
-    base = (jnp.arange(n_shards, dtype=jnp.int32) * rps)[:, None]
-    # dropped rows scatter to index R, which mode="drop" discards
-    dest = jnp.where(k2 > 0, base + pos, R).reshape(R)
-    words_c = jnp.zeros_like(words).at[:, dest].set(words, mode="drop")
+    # dropped rows scatter to index rps, which mode="drop" discards. The
+    # scatter is BATCHED per shard block (vmap over the leading shard
+    # axis) with block-LOCAL destination indices: GSPMD partitions the
+    # batched scatter along 'sp' with no communication. The previous
+    # formulation scattered through a single GLOBAL dest vector, which
+    # the partitioner could not prove block-diagonal — it all-gathered
+    # the full words array around the scatter on every mesh dispatch
+    # (caught by the etl-lint ir-collective contract).
+    dest_local = jnp.where(k2 > 0, pos, rps)
+    w3 = words.reshape(words.shape[0], n_shards, rps).transpose(1, 0, 2)
+    blocks = jax.vmap(
+        lambda w, d: jnp.zeros_like(w).at[:, d].set(w, mode="drop"))(
+            w3, dest_local)
+    words_c = blocks.transpose(1, 0, 2).reshape(words.shape)
     pad = (-R) % 32
     bits = keep
     if pad:

@@ -410,6 +410,41 @@ def _build_device_fn(specs, nibble: bool = False, use_pallas: bool = False,
     return jax.jit(build_device_program(specs, nibble, pred=pred), **kw)
 
 
+def program_example_avals(specs, row_capacity: int, nibble: bool = False,
+                          pred=None) -> tuple:
+    """ShapeDtypeStructs matching exactly what the dispatch stage passes
+    for one (specs, row bucket) signature: bmat u8[R, ΣW] (halved under
+    nibble packing), lengths u8/i32[R, n] per the pack stage's dtype rule,
+    plus the row_flags u8[R] disposition vector on the fused-filter path.
+    The IR lint tier lowers programs from these instead of staging real
+    batches — shapes/dtypes ARE the jit signature, so the lowering can
+    never drift from what production dispatches compile."""
+    widths = tuple(w for _, _, w, _ in specs)
+    total_w = sum(widths)
+    bmat = jax.ShapeDtypeStruct(
+        (row_capacity, total_w // 2 if nibble else total_w), np.uint8)
+    ldtype = np.uint8 if max(widths, default=0) <= 255 else np.int32
+    lengths = jax.ShapeDtypeStruct((row_capacity, len(specs)), ldtype)
+    if pred is not None:
+        return (bmat, lengths,
+                jax.ShapeDtypeStruct((row_capacity,), np.uint8))
+    return (bmat, lengths)
+
+
+def lower_program(specs, row_capacity: int, *, nibble: bool = False,
+                  use_pallas: bool = False, mesh=None, donate: bool = False,
+                  pred=None):
+    """Lower one decode program WITHOUT compiling it to an executable:
+    returns (jitted, example_avals, jax.stages.Lowered). This is the IR
+    tier's single entry into the engine — the same `_build_device_fn`
+    constructor every dispatch path uses, so the jaxpr/StableHLO the
+    contracts inspect is the jaxpr/StableHLO production compiles."""
+    fn = _build_device_fn(specs, nibble, use_pallas, mesh=mesh,
+                          donate=donate, pred=pred)
+    avals = program_example_avals(specs, row_capacity, nibble, pred)
+    return fn, avals, fn.lower(*avals)
+
+
 def _combine(kind: CellKind, rows: np.ndarray) -> np.ndarray:
     """Exact host-side combine of packed device rows (ordered per
     parsers.COLUMN_COMPONENTS) into the column dtype."""

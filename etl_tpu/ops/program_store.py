@@ -413,13 +413,58 @@ def acquire(key: tuple, builder, example_args: "tuple | None" = None):
             or _serialize_mod() is None:
         return jitted
     try:
-        compiled = jitted.lower(*example_args).compile()
+        lowered = jitted.lower(*example_args)
+        compiled = lowered.compile()
     except Exception:
         # compile errors must surface at the call (engine routes Mosaic
         # rejections to the XLA fallback there; real errors propagate)
         return jitted
+    problems = persist_contract_violations(key, jitted, lowered,
+                                           example_args)
+    if problems:
+        # the executable still serves THIS process (decode must not
+        # regress on a lint result), but it is never persisted: a
+        # prewarm on a later process would otherwise load the poisoned
+        # program straight from disk with no compile step left to catch
+        # it. Fixing the program re-enables persistence on next build.
+        log.warning(
+            "compiled decode program %s violates IR persist contracts "
+            "(%s); serving it memory-only, NOT caching to disk",
+            fingerprint(key), "; ".join(problems))
+        return compiled
     save(key, compiled)
     return compiled
+
+
+def persist_contract_violations(key: tuple, jitted, lowered,
+                                example_args) -> list:
+    """The AOT-persist gate (etl-lint IR tier, satellite of the
+    `--programs` pass): the no-host-callback and donation-verified
+    contracts, evaluated on the program about to be cached to disk.
+    Expected donation is inferred from the cache key — host programs
+    (key[-1] is True) never declare donation; device programs declare it
+    exactly when the backend supports it (engine._donation_supported).
+    Returns human-readable violation strings; analyzer errors return []
+    (the gate must never block decode or persistence on its own bug)."""
+    try:
+        import jax
+
+        from ..analysis.ir import contracts
+        from .engine import _donation_supported
+
+        problems = []
+        jaxpr = jitted.trace(*example_args).jaxpr
+        for detail, _msg in contracts.check_host_callback(jaxpr):
+            problems.append(f"ir-host-callback: {detail}")
+        declared = (not key[-1]) and _donation_supported()
+        for detail, _msg in contracts.check_donation(
+                lowered.as_text(), declared, jax.default_backend()):
+            problems.append(f"ir-donation: {detail}")
+        return problems
+    except Exception:
+        log.warning("IR persist-contract check failed; persisting "
+                    "unchecked", exc_info=True)
+        return []
 
 
 # ---------------------------------------------------------------------------

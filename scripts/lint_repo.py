@@ -7,12 +7,18 @@
     python scripts/lint_repo.py --no-baseline    # include grandfathered debt
     python scripts/lint_repo.py --check-baseline # fail on stale suppressions
     python scripts/lint_repo.py --explain        # chain traces per violation
+    python scripts/lint_repo.py --ir             # + IR tier (compiled-program
+                                                 #   contracts incl. the forced
+                                                 #   8-shard mesh subprocess)
 
-Exit codes (the CI contract): 0 clean after baseline, 1 findings (or
-stale suppressions under --check-baseline), 2 analyzer error (parse
-failure, bad path, bad baseline file) — a gate can distinguish "the
-tree is dirty" from "the analyzer itself broke" and a workflow step can
-annotate PRs inline from the github format.
+Exit codes (the CI contract, identical for the AST and IR tiers):
+0 clean after baseline, 1 findings (or stale suppressions under
+--check-baseline), 2 analyzer error (parse failure, bad path, bad
+baseline file, a program that fails to lower or a mesh subprocess that
+dies) — a gate can distinguish "the tree is dirty" from "the analyzer
+itself broke" and a workflow step can annotate PRs inline from the
+github format. `--ir` expands to `--programs --mesh`: the full
+contract surface (single-device + mesh variants) in one run.
 
 Equivalent to `python -m etl_tpu.analysis etl_tpu/` but runnable from the
 repo root without installing the package (it prepends the repo to
@@ -32,6 +38,9 @@ from etl_tpu.analysis.cli import main  # noqa: E402
 
 if __name__ == "__main__":
     argv = sys.argv[1:]
+    # --ir: the IR tier with full coverage (mesh variants included)
+    if "--ir" in argv:
+        argv = [a for a in argv if a != "--ir"] + ["--programs", "--mesh"]
     # default scan target: the package dir, pinned to THIS repo checkout
     if not any(not a.startswith("-") for a in argv):
         argv = [str(REPO_ROOT / "etl_tpu")] + argv

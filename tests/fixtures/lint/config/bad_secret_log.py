@@ -1,0 +1,23 @@
+# expect: secret-in-log=4
+"""Secret-typed values reaching exported surfaces: a Secret config
+field %-formatted into a log line, a bare secret-named local in an
+f-string handed to a logger, an `.expose()` unwrap concatenated into an
+exception message, and a secret attribute as a metric label value."""
+
+import logging
+
+log = logging.getLogger("etl_tpu.config")
+
+
+def log_connection(config, password):
+    log.info("connecting with password=%s", config.password)
+    log.debug(f"dsn built for {password}")
+
+
+def fail_auth(secret):
+    raise ValueError("bad credentials: " + secret.expose())
+
+
+def emit_metric(registry, config):
+    registry.counter_inc("etl_auth_failures_total",
+                         labels={"key": config.api_key})

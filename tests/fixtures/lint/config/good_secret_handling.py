@@ -1,0 +1,22 @@
+"""Secrets handled correctly around exported surfaces: presence/shape
+logged instead of values, secret read OUTSIDE the sink expression,
+exception messages and metric labels built from non-secret fields."""
+
+import logging
+
+log = logging.getLogger("etl_tpu.config")
+
+
+def log_connection(config):
+    has_password = config.password is not None
+    log.info("connecting as %s (password=%s)", config.username,
+             "[set]" if has_password else "[unset]")
+
+
+def fail_auth(config):
+    raise ValueError(f"auth failed for user {config.username}")
+
+
+def emit_metric(registry, config):
+    registry.counter_inc("etl_auth_failures_total",
+                         labels={"destination": config.name})

@@ -422,6 +422,13 @@ class TestBenchSmoke:
         assert out["static_analysis_under_budget"] is True, out
         assert out["static_analysis_seconds"] < \
             out["static_analysis_budget_s"]
+        # IR-tier satellite (ISSUE 16): the compiled-program contract
+        # pass (`--programs --mesh`) must run CLEAN — exit 0 over every
+        # enumerable canonical layout, single-device AND forced-8-shard
+        # mesh — and inside its own wall-clock budget
+        assert out["ir_analysis_clean"] is True, out
+        assert out["ir_analysis_under_budget"] is True, out
+        assert out["ir_analysis_seconds"] < out["ir_analysis_budget_s"]
         # columnar-egress satellites (ISSUE 6): ZERO TableRow
         # constructions on the streamed CDC hot path (the decode engine's
         # batches must reach the destination columnar fetch-to-wire), and
