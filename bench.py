@@ -431,10 +431,20 @@ def run_smoke() -> dict:
     no_row_path = rows_constructed == 0
     egress = harness.run_egress(
         n_rows=floors.get("egress_smoke_rows", 4096),
-        n_iters=floors.get("egress_smoke_iters", 3))
+        n_iters=floors.get("egress_smoke_iters", 3),
+        device=True)
     egress_floors = floors.get("egress_floors", {})
     egress_failures = [k for k, v in egress_floors.items()
                       if egress.get(k, 0) < v]
+    # device-egress byte-identity gate (ISSUE 17): the wire bytes spliced
+    # from device-rendered buffers must equal the columnar oracles, and
+    # the fast paths must actually have consumed the device buffers —
+    # a silently-degraded fast path (attach failure, buffer mismatch)
+    # fails here instead of hiding behind a still-passing rate floor
+    for flag in ("device_tsv_identical", "device_json_identical",
+                 "device_tsv_used_device", "device_json_used_device"):
+        if not egress.get(flag, False):
+            egress_failures.append(flag)
     egress_ok = not egress_failures
 
     # workload-diversity gate (ISSUE 7): a fast mixed-profile slice
@@ -892,6 +902,14 @@ def main():
                              "destination encoder in isolation "
                              "(ColumnarBatch → wire bytes) against the "
                              "egress_floors in BENCH_FLOOR.json")
+    parser.add_argument("--device", dest="device", action="store_true",
+                        help="with --egress: also measure the device-"
+                             "resident egress seam (decode with the "
+                             "fused wire-encoding stage, destination "
+                             "fast paths splicing the device buffers) "
+                             "against the device_* egress_floors, and "
+                             "gate byte identity vs the columnar "
+                             "oracles")
     parser.add_argument("--coldstart", dest="coldstart",
                         action="store_true",
                         help="alias for --mode coldstart: two replicator "
@@ -1192,13 +1210,23 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         from etl_tpu.benchmarks import harness
 
-        out = harness.run_egress()
+        out = harness.run_egress(device=args.device)
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_FLOOR.json")) as f:
             efloors = json.load(f).get("egress_floors", {})
         out["floors"] = efloors
+        # device_* floors gate only when --device ran the device seam;
+        # the host-encoder floors always gate
         out["failures"] = [k for k, v in efloors.items()
-                           if out.get(k, 0) < v]
+                           if (k in out or not k.startswith("device_"))
+                           and out.get(k, 0) < v]
+        if args.device:
+            out["failures"] += [
+                flag for flag in ("device_tsv_identical",
+                                  "device_json_identical",
+                                  "device_tsv_used_device",
+                                  "device_json_used_device")
+                if not out.get(flag, False)]
         out["ok"] = not out["failures"]
         print(json.dumps(out))
         sys.exit(0 if out["ok"] else 1)

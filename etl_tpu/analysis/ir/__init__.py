@@ -37,6 +37,7 @@ IR_CONTRACT_NAMES = (
     "ir-collective",
     "ir-widening",
     "ir-output-budget",
+    "ir-egress-output-budget",
     "ir-canonical-dedup",
 )
 

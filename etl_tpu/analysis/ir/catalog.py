@@ -41,6 +41,10 @@ class ProgramDescriptor:
     pred: object = None           # predicate.CompiledRowFilter | None
     hot_loop: bool = True
     source: str = "schema"        # schema | observed
+    #: wire-encoder name (ops/egress.py) — set on egress-program
+    #: descriptors, which lower the SECOND fused stage (words → wire
+    #: text) instead of a decode program
+    egress: str = None
     #: permuted-twin canonical specs for ir-canonical-dedup (None = skip)
     dedup_twin: tuple = None
 
@@ -155,6 +159,7 @@ def build_catalog(*, mesh=None, row_buckets=None,
     mesh-sharded variants — the forced-8-shard subprocess runs with just
     those, and the parent runs the single-device set, so no program is
     checked twice."""
+    from ...ops.egress import ENCODER_JSON, ENCODER_TSV, plan_for_specs
     from ...ops.engine import _donation_supported
     from ...ops.pallas_kernel import pallas_supported
     from ...ops.program_store import canonical_plan, load_observed
@@ -167,7 +172,8 @@ def build_catalog(*, mesh=None, row_buckets=None,
     def add(desc: ProgramDescriptor):
         key = (desc.specs, desc.row_capacity, desc.variant, desc.nibble,
                desc.use_pallas, desc.n_shards,
-               desc.pred.fingerprint() if desc.pred is not None else None)
+               desc.pred.fingerprint() if desc.pred is not None else None,
+               desc.egress)
         if key in seen:
             return
         seen.add(key)
@@ -188,6 +194,13 @@ def build_catalog(*, mesh=None, row_buckets=None,
         # same layout; the runner lowers both and byte-compares
         twin = canonical_plan(tuple(reversed(host_specs))).specs \
             if len(host_specs) > 1 else None
+        # egress programs: the wire-encoding second stage, enumerated
+        # per (layout, encoder) exactly as the program store keys them —
+        # only for layouts with at least one renderable field
+        egress_encoders = [e for e in (ENCODER_TSV, ENCODER_JSON)
+                           if pred is None
+                           and plan_for_specs(dev_plan.specs, e)
+                           is not None]
         for bucket in buckets:
             if mesh is not None:
                 if bucket % mesh.size:
@@ -197,6 +210,12 @@ def build_catalog(*, mesh=None, row_buckets=None,
                     row_capacity=bucket,
                     variant="mesh-filtered" if pred is not None else "mesh",
                     mesh=mesh, donate=donate_dev, pred=pred))
+                for enc in egress_encoders:
+                    add(ProgramDescriptor(
+                        tag=layout_tag(dev_plan.specs),
+                        specs=dev_plan.specs, row_capacity=bucket,
+                        variant=f"mesh-egress-{enc}", mesh=mesh,
+                        egress=enc))
                 continue
             add(ProgramDescriptor(
                 tag=layout_tag(host_plan.specs), specs=host_plan.specs,
@@ -218,6 +237,11 @@ def build_catalog(*, mesh=None, row_buckets=None,
                     tag=layout_tag(dev_plan.specs), specs=dev_plan.specs,
                     row_capacity=bucket, variant="pallas",
                     use_pallas=True, donate=donate_dev))
+            for enc in egress_encoders:
+                add(ProgramDescriptor(
+                    tag=layout_tag(dev_plan.specs), specs=dev_plan.specs,
+                    row_capacity=bucket, variant=f"egress-{enc}",
+                    egress=enc))
 
     if mesh is None and include_observed:
         # observed host-program signatures: key shape is

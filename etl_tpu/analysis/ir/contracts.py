@@ -28,6 +28,12 @@ Contract catalog (see docs/static-analysis.md "IR tier"):
                       avals stay within the per-layout budget (packed
                       words + filter metadata + slack) — the
                       selectivity-scaling property as a static bound
+  ir-egress-output-budget
+                      egress (wire-encoding) programs fetch at most the
+                      declared encoded-bytes budget: R·ΣW text bytes +
+                      one int32 length per rendered field per row +
+                      slack (ops/egress.py) — fetched bytes scale with
+                      ENCODED OUTPUT, the tentpole property
   ir-canonical-dedup  permuted-column specs sharing a canonical layout
                       must lower to byte-identical serialized IR
 """
@@ -218,6 +224,34 @@ def check_output_budget(out_avals, n_words: int, row_capacity: int, *,
              f"({per_row:.1f} B/row) against a {budget}-byte budget for "
              f"this layout ({n_words} packed words/row): an output "
              f"grew beyond packed words + filter metadata")]
+
+
+def egress_output_budget_bytes(row_capacity: int, total_width: int,
+                               n_fields: int) -> int:
+    """The egress-program budget (ops/egress.py): the left-aligned text
+    buffer — row_capacity × ΣW uint8 bytes where ΣW is the plan's total
+    rendered field width — plus one int32 length per rendered field per
+    row, plus 64 bytes of fixed slack. Anything more (a widened buffer,
+    an extra R-sized output) trips the contract: encoded bytes must
+    scale with the DECLARED wire widths, nothing else."""
+    return row_capacity * total_width + 4 * row_capacity * n_fields + 64
+
+
+def check_egress_output_budget(out_avals, row_capacity: int,
+                               total_width: int, n_fields: int) -> list:
+    """ir-egress-output-budget: actual output bytes vs the egress plan's
+    encoded-bytes budget."""
+    actual = output_bytes(out_avals)
+    budget = egress_output_budget_bytes(row_capacity, total_width,
+                                        n_fields)
+    if actual <= budget:
+        return []
+    per_row = actual / max(row_capacity, 1)
+    return [(f"bytes={actual}>budget={budget}",
+             f"egress program fetches {actual} output bytes "
+             f"({per_row:.1f} B/row) against a {budget}-byte budget "
+             f"(ΣW={total_width}, {n_fields} rendered fields): an "
+             f"output grew beyond the declared wire widths")]
 
 
 def check_canonical_dedup(text_a: str, text_b: str) -> list:

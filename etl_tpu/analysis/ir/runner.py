@@ -45,17 +45,24 @@ def _lower(desc: ProgramDescriptor, cache: dict):
     host and device variants of one layout collapse to one lowering on
     CPU (identical constructor args), which is exactly the production
     sharing the canonical-program design promises."""
+    from ...ops.egress import lower_egress_program
     from ...ops.engine import lower_program
 
     key = (desc.specs, desc.row_capacity, desc.nibble, desc.use_pallas,
            desc.n_shards, desc.donate,
-           desc.pred.fingerprint() if desc.pred is not None else None)
+           desc.pred.fingerprint() if desc.pred is not None else None,
+           desc.egress)
     hit = cache.get(key)
     if hit is None:
-        fn, avals, lowered = lower_program(
-            desc.specs, desc.row_capacity, nibble=desc.nibble,
-            use_pallas=desc.use_pallas, mesh=desc.mesh,
-            donate=desc.donate, pred=desc.pred)
+        if desc.egress is not None:
+            fn, avals, lowered = lower_egress_program(
+                desc.specs, desc.egress, desc.row_capacity,
+                mesh=desc.mesh)
+        else:
+            fn, avals, lowered = lower_program(
+                desc.specs, desc.row_capacity, nibble=desc.nibble,
+                use_pallas=desc.use_pallas, mesh=desc.mesh,
+                donate=desc.donate, pred=desc.pred)
         hit = (fn, avals, lowered, lowered.as_text())
         cache[key] = hit
     return hit
@@ -94,12 +101,21 @@ def analyze_descriptor(desc: ProgramDescriptor, cache: dict,
     emit("ir-donation",
          contracts.check_donation(text, desc.donate, backend))
     out_avals = jax.tree_util.tree_leaves(lowered.out_info)
-    n_words = layout_for_specs(desc.specs).n_words
-    emit("ir-output-budget",
-         contracts.check_output_budget(out_avals, n_words,
-                                       desc.row_capacity,
-                                       filtered=desc.pred is not None,
-                                       n_shards=desc.n_shards))
+    if desc.egress is not None:
+        from ...ops.egress import plan_for_specs
+
+        plan = plan_for_specs(desc.specs, desc.egress)
+        emit("ir-egress-output-budget",
+             contracts.check_egress_output_budget(
+                 out_avals, desc.row_capacity, plan.total_width,
+                 len(plan.slots)))
+    else:
+        n_words = layout_for_specs(desc.specs).n_words
+        emit("ir-output-budget",
+             contracts.check_output_budget(out_avals, n_words,
+                                           desc.row_capacity,
+                                           filtered=desc.pred is not None,
+                                           n_shards=desc.n_shards))
     if desc.n_shards:
         # collectives only materialize in the COMPILED module — the
         # lowered StableHLO still carries sharding annotations, not ops

@@ -1053,10 +1053,12 @@ async def run_sharded_processes(shards: int = 2,
 # ---------------------------------------------------------------------------
 
 
-def _egress_batch(n_rows: int):
+def _egress_batch(n_rows: int, egress: "str | None" = None):
     """A decode-engine-shaped ColumnarBatch (dense ints + Arrow strings)
     on the pgbench-CDC column mix, produced through the REAL staging +
-    decode path so the encoders see production column storage."""
+    decode path so the encoders see production column storage. With
+    `egress` set the decode fuses the wire-encoding stage and the batch
+    carries `device_egress` buffers (ops/egress.py)."""
     from ..models import (ColumnSchema, Oid, ReplicatedTableSchema,
                           TableName, TableSchema)
     from ..ops.engine import DeviceDecoder
@@ -1075,17 +1077,25 @@ def _egress_batch(n_rows: int):
                 for i in range(n_rows)]
     buf, offs, lens = concat_payloads(payloads)
     wal = stage_wal_batch(buf, offs, lens, 4)
-    batch = DeviceDecoder(schema).decode(wal.staged)
+    batch = DeviceDecoder(schema, egress=egress).decode(wal.staged)
     return schema, batch
 
 
-def run_egress(n_rows: int = 16_384, n_iters: int = 5) -> dict:
+def run_egress(n_rows: int = 16_384, n_iters: int = 5,
+               device: bool = False) -> dict:
     """Measure each destination encoder in ISOLATION (ColumnarBatch →
     wire bytes): rows/s and bytes/s for the BigQuery proto encoder, the
     ClickHouse TSV renderer, and the Parquet row-group writer — so an
     egress regression names the guilty encoder instead of hiding inside
     the end-to-end streaming number. Floors: BENCH_FLOOR.json
-    `egress_floors` (rows/s, min over encoders asserted by --smoke)."""
+    `egress_floors` (rows/s, min over encoders asserted by --smoke).
+
+    `device=True` additionally measures the device-resident egress seam
+    (ISSUE 17): batches decoded WITH the fused wire-encoding stage run
+    through the piece-assembly fast paths splicing the device-rendered
+    buffers — and the produced bytes are compared against the columnar
+    oracles (`*_identical`, gated by --smoke: byte identity is the
+    contract that lets the fast path exist at all)."""
     import io
 
     import numpy as np
@@ -1157,6 +1167,72 @@ def run_egress(n_rows: int = 16_384, n_iters: int = 5) -> dict:
         rps, bps = timed(fn)
         out[f"{name}_rows_per_sec"] = rps
         out[f"{name}_bytes_per_sec"] = bps
+    if device:
+        out.update(_run_egress_device(n_rows, n_iters, timed,
+                                      lsns, txos, ords))
+    return out
+
+
+def _run_egress_device(n_rows: int, n_iters: int, timed, lsns, txos,
+                       ords) -> dict:
+    """The device-egress half of run_egress: decode once WITH the fused
+    wire-encoding stage (blocking compile — bench, not streaming), then
+    time the destination fast paths splicing the attached buffers and
+    gate their bytes against the columnar oracles."""
+    from ..destinations.clickhouse import (render_batch_tsv_columnar,
+                                           render_batch_tsv_fast)
+    from ..destinations.snowflake import (encode_batch_ndjson,
+                                          encode_batch_ndjson_fast,
+                                          offset_token_batch)
+    from ..destinations.util import (sequence_number_batch,
+                                     sequence_number_buffer)
+    from ..ops.egress import ENCODER_JSON, ENCODER_TSV
+
+    out: dict = {}
+    seq_buf = sequence_number_buffer(lsns, txos, ords)
+    seq_strs = [s.decode() for s in sequence_number_batch(lsns, txos,
+                                                          ords)]
+    schema, tsv_batch = _egress_batch(n_rows, egress=ENCODER_TSV)
+    dev_tsv = tsv_batch.device_egress
+    out["device_tsv_attached"] = dev_tsv is not None
+
+    used = {"tsv": False, "json": False}
+
+    def tsv():
+        body, used_device = render_batch_tsv_fast(
+            schema, tsv_batch, "UPSERT", seq_buf, egress=dev_tsv)
+        used["tsv"] = used_device
+        return len(body)
+
+    rps, bps = timed(tsv)
+    out["device_tsv_rows_per_sec"] = rps
+    out["device_tsv_bytes_per_sec"] = bps
+    out["device_tsv_used_device"] = used["tsv"]
+    body, _ = render_batch_tsv_fast(schema, tsv_batch, "UPSERT", seq_buf,
+                                    egress=dev_tsv)
+    out["device_tsv_identical"] = body == render_batch_tsv_columnar(
+        schema, tsv_batch, "UPSERT", seq_strs)
+
+    _, json_batch = _egress_batch(n_rows, egress=ENCODER_JSON)
+    dev_json = json_batch.device_egress
+    out["device_json_attached"] = dev_json is not None
+    ops = ["insert"] * n_rows
+    seqs = offset_token_batch(lsns, txos)
+
+    def ndjson():
+        lines, used_device = encode_batch_ndjson_fast(
+            schema, json_batch, ops, seqs, egress=dev_json)
+        used["json"] = used_device
+        return sum(len(ln) for ln in lines)
+
+    rps, bps = timed(ndjson)
+    out["device_json_rows_per_sec"] = rps
+    out["device_json_bytes_per_sec"] = bps
+    out["device_json_used_device"] = used["json"]
+    lines, _ = encode_batch_ndjson_fast(schema, json_batch, ops, seqs,
+                                        egress=dev_json)
+    out["device_json_identical"] = lines == encode_batch_ndjson(
+        schema, json_batch, ops, seqs)
     return out
 
 

@@ -109,6 +109,14 @@ class BatchConfig:
     # program_store.PREWARM_ROW_BUCKETS.
     prewarm_programs: bool | None = None
     prewarm_row_buckets: tuple | None = None
+    # device-resident wire encoding (ops/egress.py): when True and the
+    # destination declares an egress encoder, decode programs gain a
+    # second fused stage that renders int/bool/temporal field TEXT on
+    # device, and decoded batches arrive with wire-ready byte buffers
+    # the destination splices instead of re-rendering host-side. Purely
+    # a fast path: batches without buffers (cold program, unsupported
+    # layout, filtered batches) encode host-side byte-identically.
+    device_egress: bool = True
 
     def validate(self) -> None:
         _require(self.max_size_bytes > 0, "max_size_bytes must be > 0")
@@ -212,10 +220,23 @@ class PoisonConfig:
     # truncate the stored error detail per entry (payloads are bounded
     # by the flush sizing already)
     max_detail_chars: int = 500
+    # how often the flush path re-reads the store's quarantine records
+    # so an operator `unquarantine` (another process) takes effect
+    # WITHOUT a worker restart; 0 disables the poll (restart-only
+    # adoption, the pre-live behavior)
+    quarantine_poll_s: float = 30.0
+    # age past which replayed/discarded dead-letter rows are eligible
+    # for `python -m etl_tpu.dlq compact` (rows still `dead` are never
+    # expired — they are the zero-loss ledger)
+    dlq_retention_s: float = 7 * 24 * 3600.0
 
     def validate(self) -> None:
         _require(self.budget_rows >= 1, "poison budget_rows must be >= 1")
         _require(self.window_s > 0, "poison window_s must be > 0")
+        _require(self.quarantine_poll_s >= 0,
+                 "poison quarantine_poll_s must be >= 0")
+        _require(self.dlq_retention_s > 0,
+                 "poison dlq_retention_s must be > 0")
 
 
 @dataclass(frozen=True)

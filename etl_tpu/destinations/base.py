@@ -76,6 +76,13 @@ class Destination(abc.ABC):
     """Where decoded rows and CDC events land. Implementations must be
     idempotent under at-least-once delivery (SURVEY §5 checkpoint/resume)."""
 
+    #: wire-encoder name (ops/egress.py ENCODER_*) when this destination
+    #: consumes device-rendered text buffers — the runtime binds it into
+    #: each DeviceDecoder so decoded batches arrive with `device_egress`
+    #: wire bytes attached (docs/destinations.md seam contract). None =
+    #: the destination encodes host-side only.
+    egress_encoder: "str | None" = None
+
     @abc.abstractmethod
     async def startup(self) -> None: ...
 
@@ -157,12 +164,23 @@ class CoalescedBatch:
     The unit the columnar destination encoders consume."""
 
     __slots__ = ("schema", "batch", "change_types", "commit_lsns",
-                 "tx_ordinals")
+                 "tx_ordinals", "egress")
 
     def __init__(self, events: "list[DecodedBatchEvent]"):
         self.schema = events[0].schema
         self.batch = ColumnarBatch.concat([e.batch for e in events]) \
             if len(events) > 1 else events[0].batch
+        # device-rendered wire buffers (ops/egress.py DeviceEgress),
+        # merged across the run. All-or-nothing: one event without
+        # buffers drops the merged fast path — correctness never depends
+        # on egress being present.
+        parts = [getattr(e.batch, "device_egress", None) for e in events]
+        if len(events) == 1:
+            self.egress = parts[0]
+        else:
+            from ..ops.egress import DeviceEgress
+
+            self.egress = DeviceEgress.concat(parts)
         if len(events) == 1:
             self.change_types = events[0].change_types
             self.commit_lsns = events[0].commit_lsns

@@ -53,8 +53,8 @@ from . import bq_proto
 from .base import Destination, WriteAck, expand_batch_events
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
                    DestinationRetryPolicy, TaskSet, change_type_label,
-                   classify_http_error, escaped_table_name,
-                   require_full_batch, require_full_row,
+                   classify_http_error, count_egress_write,
+                   escaped_table_name, require_full_batch, require_full_row,
                    sequential_event_program, versioned_table_name,
                    with_retries)
 
@@ -130,6 +130,8 @@ def encode_value(v: Any, kind: CellKind) -> Any:
 
 
 class BigQueryDestination(Destination):
+    egress_encoder = "tsv"  # device text feeds string-typed proto cells
+
     def __init__(self, config: BigQueryConfig,
                  retry: DestinationRetryPolicy | None = None):
         self.config = config
@@ -282,7 +284,10 @@ class BigQueryDestination(Destination):
         zeros = np.zeros(n, dtype=np.uint64)
         seqs = sequence_number_batch(zeros, zeros,
                                      np.arange(n, dtype=np.uint64))
-        encoded = bq_proto.encode_batch(schema, batch, [b"UPSERT"] * n, seqs)
+        egress = getattr(batch, "device_egress", None)
+        encoded = bq_proto.encode_batch(schema, batch, [b"UPSERT"] * n, seqs,
+                                        egress=egress)
+        count_egress_write(egress is not None)
         ack, fut = WriteAck.accepted()
         self._tasks.spawn(self._append_encoded_and_resolve(
             table, schema, encoded, fut))
@@ -319,7 +324,9 @@ class BigQueryDestination(Destination):
                         labels = change_type_batch(cb.change_types).tolist()
                         ordinal += n
                         encoded = bq_proto.encode_batch(schema, cb.batch,
-                                                        labels, seqs)
+                                                        labels, seqs,
+                                                        egress=cb.egress)
+                        count_egress_write(cb.egress is not None)
                         await self._append_encoded(table, schema, encoded)
                     elif op[0] == "rows":
                         _, schema, evs = op

@@ -348,7 +348,11 @@ def encode_row(schema: ReplicatedTableSchema, values,
 
 # dense timestamptz sentinels/bounds — the SAME objects _from_dense
 # decodes with, so detection can never drift from Column.value()
-from ..models.table_row import (MAX_TS_US as _MAX_TS_US,
+from ..models.table_row import (DATE_INFINITY_DAYS as _DATE_INF,
+                                DATE_NEG_INFINITY_DAYS as _DATE_NEG_INF,
+                                MAX_DATE_DAYS as _MAX_DATE_DAYS,
+                                MAX_TS_US as _MAX_TS_US,
+                                MIN_DATE_DAYS as _MIN_DATE_DAYS,
                                 MIN_TS_US as _MIN_TS_US,
                                 TS_INFINITY_US as _TS_INF,
                                 TS_NEG_INFINITY_US as _TS_NEG_INF)
@@ -358,9 +362,12 @@ from ..analysis.annotations import hot_loop
 
 
 @hot_loop
-def _column_cells(col, tag: int) -> list:
+def _column_cells(col, tag: int, dev=None, untrusted=None) -> list:
     """Encoded proto field bytes per row for one column (None = absent:
-    NULL / TOAST-unchanged cells are omitted, proto3 absence).
+    NULL / TOAST-unchanged cells are omitted, proto3 absence). `dev` is
+    the column's device-rendered text buffer (ops/egress.py DeviceEgress
+    field) when one rode the decoded batch — consumed for the
+    string-typed DATE field below, ignored for binary wire types.
     @hot_loop: runs per column per CDC flush — row materialization here
     would undo the columnar egress win (etl-lint rule 13)."""
     import numpy as np
@@ -373,6 +380,30 @@ def _column_cells(col, tag: int) -> list:
     cells: list = [None] * n
     present = np.flatnonzero(valid)
     if present.size == 0:
+        return cells
+    if dev is not None and kind is CellKind.DATE and col.is_dense:
+        # device-rendered ISO dates → f_string cells; specials /
+        # out-of-range rows (never device-rendered, see egress module
+        # docstring) drop to the generic per-value path below
+        data = col.data
+        ok = ((data != _DATE_INF) & (data != _DATE_NEG_INF)
+              & (data >= _MIN_DATE_DAYS) & (data <= _MAX_DATE_DAYS))
+        if untrusted is not None and untrusted.size:
+            ok = ok.copy()
+            ok[untrusted] = False  # fixed up after the device render
+        key = _key(tag, _WIRE_LEN)
+        buf, lens = dev
+        blob = bytes(np.ascontiguousarray(buf).reshape(-1))
+        width = buf.shape[1]
+        for i in present.tolist():
+            if ok[i]:
+                ln = int(lens[i])
+                cells[i] = key + _varint(ln) \
+                    + blob[i * width:i * width + ln]
+            else:
+                out = bytearray()
+                _encode_scalar(tag, kind, col.value(i), out)
+                cells[i] = bytes(out)
         return cells
     if col.is_dense and kind is CellKind.BOOL:
         t1 = _key(tag, _WIRE_VARINT) + b"\x01"
@@ -467,7 +498,8 @@ def _arrow_string_cells(arr, tag: int, n: int):
 
 @hot_loop
 def encode_batch(schema: ReplicatedTableSchema, batch,
-                 change_types: list, change_sequences: list) -> list[bytes]:
+                 change_types: list, change_sequences: list,
+                 egress=None) -> list[bytes]:
     """Columnar AppendRows encoding: one serialized proto row per batch
     row, fields in column order then the two CDC pseudo-columns —
     byte-identical to per-row `encode_row` over the same values.
@@ -479,7 +511,10 @@ def encode_batch(schema: ReplicatedTableSchema, batch,
     cols = schema.replicated_columns
     bufs = [bytearray() for _ in range(n)]
     for j, col in enumerate(batch.columns):
-        cells = _column_cells(col, j + 1)
+        dev = egress.field(j) if egress is not None else None
+        cells = _column_cells(col, j + 1, dev,
+                              egress.untrusted if egress is not None
+                              else None)
         for i, cell in enumerate(cells):
             if cell is not None:
                 bufs[i] += cell

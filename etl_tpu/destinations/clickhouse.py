@@ -273,7 +273,154 @@ def render_batch_tsv_columnar(schema: ReplicatedTableSchema, batch,
     return (body + "\n").encode() if lines else b""
 
 
+_TSV_NULL = b"\\N"
+_TSV_ESCAPE_BYTES = (9, 10, 13, 92)  # \t \n \r backslash
+
+
+def _count_egress_write(used_device: bool) -> None:
+    from .util import count_egress_write
+
+    count_egress_write(used_device)
+
+
+def _column_piece_tsv(col, dev, oracle_rows: set):
+    """One column's TSV field bytes as an assembly piece (ops/egress.py
+    piece protocol). Sources, in order: the device-rendered buffer
+    (`dev`), the numpy host twin, a zero-copy Arrow slice, or the
+    per-value renderer. Rows neither source can render verbatim
+    (temporal specials, strings needing escapes go per-value inside the
+    piece; whole-row cases land in `oracle_rows`). Returns
+    (piece, used_device)."""
+    from ..ops import egress as eg
+
+    n = len(col)
+    kind = col.schema.kind
+    valid = col.validity
+    if col.toast_unchanged is not None:
+        valid = valid & ~col.toast_unchanged
+    nulls = np.flatnonzero(~valid)
+    fixed_kinds = (CellKind.BOOL, CellKind.I16, CellKind.I32, CellKind.U32,
+                   CellKind.I64, CellKind.DATE, CellKind.TIMESTAMP,
+                   CellKind.TIMESTAMPTZ)
+    if col.is_dense and kind in fixed_kinds:
+        data = col.data
+        if kind in (CellKind.TIMESTAMP, CellKind.TIMESTAMPTZ):
+            specials = valid & ((data == _TS_INF) | (data == _TS_NEG_INF)
+                                | (data < _MIN_TS_US) | (data > _MAX_TS_US))
+            oracle_rows.update(np.flatnonzero(specials).tolist())
+        elif kind is CellKind.DATE:
+            specials = valid & ((data == _DATE_INF) | (data == _DATE_NEG_INF)
+                                | (data < _MIN_DATE_DAYS)
+                                | (data > _MAX_DATE_DAYS))
+            oracle_rows.update(np.flatnonzero(specials).tolist())
+        if dev is not None:
+            buf, lens = eg.patch_rows_fixed(dev[0], dev[1], nulls, _TSV_NULL)
+            return eg.fixed_piece(buf, lens), True
+        if kind is CellKind.BOOL:
+            buf, lens = eg.bool_text_fixed(data)
+        elif kind is CellKind.DATE:
+            buf, lens = eg.date_text_fixed(data)
+        elif kind in (CellKind.TIMESTAMP, CellKind.TIMESTAMPTZ):
+            buf, lens = eg.timestamp_text_fixed(data)
+        else:
+            buf, lens = eg.int_text_fixed(data)
+        buf, lens = eg.patch_rows_fixed(buf, lens, nulls, _TSV_NULL)
+        return eg.fixed_piece(buf, lens), False
+    if col.is_dense and kind in (CellKind.F32, CellKind.F64):
+        data = col.data.tolist()  # Python floats: str() matches row path
+        items = [_TSV_NULL] * n
+        for i in np.flatnonzero(valid).tolist():
+            items[i] = str(data[i]).encode()
+        return eg.var_from_texts(items), False
+    if col.is_arrow and kind is CellKind.STRING \
+            and col.lazy_text_oid is None and col.data.offset == 0:
+        bufs = col.data.buffers()
+        offs = np.frombuffer(bufs[1], dtype=np.int32, count=n + 1) \
+            if bufs[1] is not None else np.zeros(n + 1, dtype=np.int32)
+        vals = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] is not None \
+            else np.zeros(0, dtype=np.uint8)
+        region = vals[offs[0]:offs[n]]
+        clean = True
+        for b in _TSV_ESCAPE_BYTES:
+            if (region == b).any():
+                clean = False
+                break
+        if clean:
+            piece = ("var", vals, offs.astype(np.int64))
+            if nulls.size:
+                out, starts = eg.assemble_rows(
+                    n, [piece], {int(i): _TSV_NULL for i in nulls})
+                piece = ("var", out, starts)
+            return piece, False
+        texts = col.data.to_pylist()
+        items = [_TSV_NULL] * n
+        for i in np.flatnonzero(valid).tolist():
+            items[i] = _tsv_escape(texts[i]).encode()
+        return eg.var_from_texts(items), False
+    # generic fallback (NUMERIC/TIME/JSON/bytes/arrays/lazy-text): box the
+    # value, reuse the row-path renderer — same stance as _column_texts
+    items = [_TSV_NULL] * n
+    for i in np.flatnonzero(valid).tolist():
+        items[i] = render_value(col.value(i), kind).encode()
+    return eg.var_from_texts(items), False
+
+
+@hot_loop
+def render_batch_tsv_fast(schema: ReplicatedTableSchema, batch,
+                          change_types, seq_buf,
+                          egress=None) -> "tuple[bytes, bool]":
+    """Vectorized whole-batch TSV assembly: per-column byte pieces
+    (device egress buffers when attached, numpy host twins otherwise)
+    scattered into one contiguous body — no per-row join, no per-row
+    Python except the oracle-spliced rows. Byte-identical to
+    `render_batch_tsv_columnar` (the identity is gated, ops/egress.py
+    module docstring). `change_types` is a shared str (copy path) or the
+    `change_type_batch` S6 array; `seq_buf` the (n, 50) uint8
+    `sequence_number_buffer`. Returns (body, used_device_buffers).
+    @hot_loop: the ClickHouse egress hot path (etl-lint rule 13)."""
+    from ..ops import egress as eg
+
+    n = batch.num_rows
+    oracle_rows: set = set()
+    if egress is not None and egress.untrusted.size:
+        oracle_rows.update(egress.untrusted.tolist())
+    tab = eg.const_piece(b"\t")
+    pieces = []
+    used_device = False
+    for j, col in enumerate(batch.columns):
+        dev = egress.field(j) if egress is not None else None
+        piece, used = _column_piece_tsv(col, dev, oracle_rows)
+        used_device |= used
+        pieces.append(piece)
+        pieces.append(tab)
+    if isinstance(change_types, str):
+        pieces.append(eg.const_piece(change_types.encode()))
+    else:
+        ct_buf = np.frombuffer(change_types.tobytes(), dtype=np.uint8) \
+            .reshape(n, change_types.dtype.itemsize)
+        pieces.append(eg.fixed_piece(
+            ct_buf, np.full(n, ct_buf.shape[1], dtype=np.int64)))
+    pieces.append(tab)
+    pieces.append(eg.fixed_piece(seq_buf, np.full(n, seq_buf.shape[1],
+                                                  dtype=np.int64)))
+    pieces.append(eg.const_piece(b"\n"))
+    override = None
+    if oracle_rows:
+        override = {}
+        for i in sorted(oracle_rows):
+            fields = [render_value(c.value(i), c.schema.kind)
+                      for c in batch.columns]
+            ct = change_types if isinstance(change_types, str) \
+                else change_types[i].decode()
+            seq = seq_buf[i].tobytes().decode()
+            override[i] = ("\t".join(fields + [ct, seq]) + "\n").encode()
+    out, _ = eg.assemble_rows(n, pieces, override)
+    return out.tobytes(), used_device
+
+
 class ClickHouseDestination(Destination):
+    egress_encoder = "tsv"  # device-rendered TSV fields (ops/egress.py)
+
     def __init__(self, config: ClickHouseConfig,
                  retry: DestinationRetryPolicy | None = None):
         self.config = config
@@ -363,15 +510,23 @@ class ClickHouseDestination(Destination):
                                 batch) -> WriteAck:
         """Copy path, columnar: TSV rendered column-at-a-time (no
         Column.value boxing), same bytes as `write_table_rows`."""
-        from .util import sequence_number_batch
+        from .util import sequence_number_batch, sequence_number_buffer
 
         name = await self._ensure_table(schema)
         require_full_batch("clickhouse", schema, batch)
         n = batch.num_rows
         zeros = np.zeros(n, dtype=np.uint64)
-        seqs = [s.decode() for s in sequence_number_batch(
-            zeros, zeros, np.arange(n, dtype=np.uint64))]
-        body = render_batch_tsv_columnar(schema, batch, CDC_UPSERT, seqs)
+        ords = np.arange(n, dtype=np.uint64)
+        try:
+            seq_buf = sequence_number_buffer(zeros, zeros, ords)
+            body, used_device = render_batch_tsv_fast(
+                schema, batch, CDC_UPSERT, seq_buf,
+                egress=getattr(batch, "device_egress", None))
+            _count_egress_write(used_device)
+        except Exception:  # never fail a write on the fast path — fall back
+            seqs = [s.decode() for s in sequence_number_batch(
+                zeros, zeros, ords)]
+            body = render_batch_tsv_columnar(schema, batch, CDC_UPSERT, seqs)
         await self._insert_tsv(name, schema, body)
         return WriteAck.durable()
 
@@ -380,7 +535,8 @@ class ClickHouseDestination(Destination):
         a-time; old-tuple/TOAST batches and per-row events drop to the row
         path in place (sequential_batch_program preserves WAL order)."""
         from .base import sequential_batch_program
-        from .util import change_type_batch, sequence_number_batch
+        from .util import (change_type_batch, sequence_number_batch,
+                           sequence_number_buffer)
 
         for op in sequential_batch_program(events):
             if op[0] == "batch":
@@ -389,13 +545,22 @@ class ClickHouseDestination(Destination):
                 require_full_batch("clickhouse", schema, cb.batch,
                                    cb.change_types)
                 # row path renders with_ordinal(0): constant third key
-                labels = [t.decode() for t in
-                          change_type_batch(cb.change_types).tolist()]
-                seqs = [s.decode() for s in sequence_number_batch(
-                    cb.commit_lsns, cb.tx_ordinals,
-                    np.zeros(cb.num_rows, dtype=np.uint64))]
-                body = render_batch_tsv_columnar(schema, cb.batch, labels,
-                                                 seqs)
+                zeros = np.zeros(cb.num_rows, dtype=np.uint64)
+                try:
+                    seq_buf = sequence_number_buffer(
+                        cb.commit_lsns, cb.tx_ordinals, zeros)
+                    body, used_device = render_batch_tsv_fast(
+                        schema, cb.batch,
+                        change_type_batch(cb.change_types), seq_buf,
+                        egress=cb.egress)
+                    _count_egress_write(used_device)
+                except Exception:  # fall back — write must never fail here
+                    labels = [t.decode() for t in
+                              change_type_batch(cb.change_types).tolist()]
+                    seqs = [s.decode() for s in sequence_number_batch(
+                        cb.commit_lsns, cb.tx_ordinals, zeros)]
+                    body = render_batch_tsv_columnar(schema, cb.batch,
+                                                     labels, seqs)
                 await self._insert_tsv(name, schema, body)
             elif op[0] == "rows":
                 _, schema, evs = op

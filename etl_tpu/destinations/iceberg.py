@@ -373,15 +373,20 @@ class IcebergDestination(Destination):
 
     async def write_table_rows(self, schema: ReplicatedTableSchema,
                                batch: ColumnarBatch) -> WriteAck:
+        from .util import hex16_arrow
+
         st = await self._ensure_table(schema)
         if batch.num_rows:
+            import numpy as np
+
             rb = batch.to_arrow()
             n = batch.num_rows
             rb = rb.append_column(CHANGE_TYPE_COLUMN,
                                   pa.array(["UPSERT"] * n, pa.string()))
-            rb = rb.append_column(CHANGE_SEQUENCE_COLUMN,
-                                  pa.array([f"{i:016x}" for i in range(n)],
-                                           pa.string()))
+            rb = rb.append_column(
+                CHANGE_SEQUENCE_COLUMN,
+                # vectorized hex render (same bytes as the f-string form)
+                hex16_arrow(np.arange(n, dtype=np.uint64)))
             f = self._write_data_file(st, rb)
             await self._commit_snapshot(st, [f])
         return WriteAck.durable()

@@ -274,7 +274,7 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
                                cb) -> None:
         """@hot_loop: the lake CDC egress hot path — ColumnarBatch → Arrow
         with vectorized metadata, no row objects (etl-lint rule 13)."""
-        from .util import (change_type_arrow, sequence_number_arrow,
+        from .util import (change_type_arrow, fixed_width_string_arrow,
                            sequence_number_buffer)
 
         await self._wait_maintenance_clear(schema.id)
@@ -294,7 +294,10 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
                               change_type_arrow(cb.change_types))
         rb = rb.append_column(
             CHANGE_SEQUENCE_COLUMN,
-            sequence_number_arrow(cb.commit_lsns, cb.tx_ordinals, ordinals))
+            # the watermark render above already produced the (n, 50)
+            # buffer — build the Arrow column from it instead of
+            # re-rendering (the device-egress fixed-buffer idiom)
+            fixed_width_string_arrow(seq_buf))
         await self._store_cdc_rb(schema, name, gen, rb, n, max_seq)
 
     async def _write_cdc_file(self, schema: ReplicatedTableSchema,

@@ -42,9 +42,10 @@ from .bigquery import encode_value  # same JSON value encoding rules
 from .snowpipe import (ZERO_OFFSET, AcceptedBatch, ChannelHandle,
                        RestStreamClient, RowBatch, RowBatchBuilder,
                        offset_token)
-from .util import (DestinationRetryPolicy, escaped_table_name,
-                   classify_http_error, require_full_batch,
-                   require_full_row, sequential_event_program, with_retries)
+from .util import (DestinationRetryPolicy, count_egress_write,
+                   escaped_table_name, classify_http_error,
+                   require_full_batch, require_full_row,
+                   sequential_event_program, with_retries)
 
 # CDC metadata column names (reference schema.rs:6-7)
 CDC_OPERATION_COLUMN = "_cdc_operation"
@@ -159,8 +160,15 @@ def _encode_cdc_batch(schema: ReplicatedTableSchema,
                  "insert")).tolist()
     seqs = offset_token_batch(cb.commit_lsns, cb.tx_ordinals)
     builder = RowBatchBuilder()
-    for line, seq in zip(
-            encode_batch_ndjson(schema, cb.batch, labels, seqs), seqs):
+    try:
+        lines, used_device = encode_batch_ndjson_fast(
+            schema, cb.batch, labels, seqs, egress=cb.egress)
+        count_egress_write(used_device)
+    except EtlError:
+        raise  # typed rejections (non-finite floats) are the contract
+    except Exception:  # assembly bug → fall back, never fail the write
+        lines = encode_batch_ndjson(schema, cb.batch, labels, seqs)
+    for line, seq in zip(lines, seqs):
         builder.push_encoded_line(line, seq)
     return builder
 
@@ -194,6 +202,111 @@ def encode_batch_ndjson(schema: ReplicatedTableSchema, batch: ColumnarBatch,
         fields.append(seq_key + seqs[i])
         lines.append(("{" + ",".join(fields) + "}\n").encode())
     return lines
+
+
+_JSON_FIXED_KINDS = (CellKind.BOOL, CellKind.I16, CellKind.I32,
+                     CellKind.U32, CellKind.I64)
+
+
+@hot_loop
+def encode_batch_ndjson_fast(schema: ReplicatedTableSchema,
+                             batch: ColumnarBatch, ops, seqs,
+                             egress=None) -> "tuple[list[bytes], bool]":
+    """Whole-batch NDJSON via byte-piece assembly: int/bool fields come
+    from device-rendered egress buffers when attached (numpy twins
+    otherwise, NULLs patched to `null`), every other kind reuses
+    `_column_json_texts` verbatim, and untrusted rows are overridden
+    with the per-row oracle line. One scatter builds the body; lines are
+    sliced back out for the Snowpipe compressor. Byte-identical to
+    `encode_batch_ndjson` (gated). Returns (lines, used_device).
+    @hot_loop: the Snowpipe egress hot path (etl-lint rule 13)."""
+    from ..ops import egress as eg
+
+    n = batch.num_rows
+    oracle_rows: set = set()
+    if egress is not None and egress.untrusted.size:
+        oracle_rows.update(egress.untrusted.tolist())
+    comma = eg.const_piece(b",")
+    pieces = [eg.const_piece(b"{")]
+    used_device = False
+    # per-column value-text source, kept for the override rows: either the
+    # oracle texts list or the (col, valid) pair the dense renderer used
+    sources: list = []
+    for j, col in enumerate(batch.columns):
+        pieces.append(eg.const_piece(
+            (encode_basestring(col.schema.name) + ":").encode()))
+        kind = col.schema.kind
+        dev = egress.field(j) if egress is not None else None
+        if col.is_dense and kind in _JSON_FIXED_KINDS:
+            valid = col.validity
+            if col.toast_unchanged is not None:
+                valid = valid & ~col.toast_unchanged
+            nulls = np.flatnonzero(~valid)
+            if dev is not None:
+                buf, lens = eg.patch_rows_fixed(dev[0], dev[1], nulls,
+                                                b"null")
+                used_device = True
+            else:
+                buf, lens = eg.bool_text_fixed(col.data) \
+                    if kind is CellKind.BOOL \
+                    else eg.int_text_fixed(col.data)
+                buf, lens = eg.patch_rows_fixed(buf, lens, nulls, b"null")
+            pieces.append(eg.fixed_piece(buf, lens))
+            sources.append((col, valid))
+        else:
+            # the oracle's own column renderer — identity by construction
+            # (raises the same non-finite-float EtlError the row path does)
+            texts = _column_json_texts(col)
+            pieces.append(eg.var_from_texts(
+                [str(t).encode() for t in texts]))
+            sources.append(texts)
+        pieces.append(comma)
+    pieces.append(eg.const_piece(
+        (encode_basestring(CDC_OPERATION_COLUMN) + ":").encode()))
+    if isinstance(ops, str):
+        pieces.append(eg.const_piece(encode_basestring(ops).encode()))
+    else:
+        pieces.append(eg.var_from_texts(
+            [encode_basestring(o).encode() for o in ops]))
+    pieces.append(comma)
+    pieces.append(eg.const_piece(
+        (encode_basestring(CDC_SEQUENCE_COLUMN) + ":").encode()))
+    if isinstance(seqs, str):
+        pieces.append(eg.const_piece(encode_basestring(seqs).encode()))
+    else:
+        pieces.append(eg.var_from_texts(
+            [encode_basestring(s).encode() for s in seqs]))
+    pieces.append(eg.const_piece(b"}\n"))
+    override = None
+    if oracle_rows:
+
+        def _text(src, i):
+            if isinstance(src, list):
+                return str(src[i])
+            col, valid = src
+            if not valid[i]:
+                return "null"
+            if col.schema.kind is CellKind.BOOL:
+                return "true" if col.data[i] else "false"
+            return str(int(col.data[i]))  # same digits as the U21 twin
+
+        override = {}
+        keys = [encode_basestring(c.schema.name) + ":"
+                for c in batch.columns]
+        for i in sorted(oracle_rows):
+            fields = [k + _text(src, i)
+                      for k, src in zip(keys, sources)]
+            fields.append(encode_basestring(CDC_OPERATION_COLUMN) + ":"
+                          + encode_basestring(
+                              ops if isinstance(ops, str) else ops[i]))
+            fields.append(encode_basestring(CDC_SEQUENCE_COLUMN) + ":"
+                          + encode_basestring(
+                              seqs if isinstance(seqs, str) else seqs[i]))
+            override[i] = ("{" + ",".join(fields) + "}\n").encode()
+    out, starts = eg.assemble_rows(n, pieces, override)
+    body = out.tobytes()
+    return ([body[starts[i]:starts[i + 1]] for i in range(n)],
+            used_device)
 
 
 @dataclass(frozen=True)
@@ -259,6 +372,8 @@ class _KeyPairTokenProvider:
 
 
 class SnowflakeDestination(Destination):
+    egress_encoder = "json"  # device-rendered NDJSON fields (ops/egress.py)
+
     def __init__(self, config: SnowflakeConfig,
                  retry: DestinationRetryPolicy | None = None):
         self.config = config
@@ -445,8 +560,17 @@ class SnowflakeDestination(Destination):
         then pushed pre-encoded through the same compressor."""
         await self._ensure_table(schema)
         builder = RowBatchBuilder()
-        for line in encode_batch_ndjson(schema, batch, "insert",
-                                        ZERO_OFFSET):
+        try:
+            lines, used_device = encode_batch_ndjson_fast(
+                schema, batch, "insert", ZERO_OFFSET,
+                egress=getattr(batch, "device_egress", None))
+            count_egress_write(used_device)
+        except EtlError:
+            raise
+        except Exception:  # fall back — the write must never fail here
+            lines = encode_batch_ndjson(schema, batch, "insert",
+                                        ZERO_OFFSET)
+        for line in lines:
             builder.push_encoded_line(line, ZERO_OFFSET)
         return await self._finish_copy(schema, builder)
 

@@ -166,6 +166,54 @@ def change_type_arrow(change_types):
     return pa.array(change_type_batch(change_types).astype("U6"))
 
 
+def count_egress_write(used_device: bool) -> None:
+    """Account one columnar wire write: path=device when any field came
+    from device-rendered egress buffers, path=host when every field was
+    rendered host-side (OPERATIONS.md egress telemetry)."""
+    from ..telemetry.metrics import ETL_EGRESS_WRITES_TOTAL, registry
+
+    registry.counter_inc(ETL_EGRESS_WRITES_TOTAL,
+                         labels={"path": "device" if used_device
+                                 else "host"})
+
+
+def fixed_width_string_arrow(buf: np.ndarray):
+    """pyarrow StringArray from an (n, W) uint8 buffer where every row is
+    exactly W bytes (the sequence-key / hex-token shape) — offsets are an
+    arange, values the buffer itself. Lets callers that already rendered
+    the buffer (watermark comparisons) reuse it instead of re-rendering
+    through `sequence_number_arrow`."""
+    import pyarrow as pa
+
+    n, width = buf.shape
+    offsets = np.arange(0, (n + 1) * width, width, dtype=np.int32)
+    return pa.StringArray.from_buffers(
+        n, pa.py_buffer(offsets.tobytes()),
+        pa.py_buffer(np.ascontiguousarray(buf).tobytes()))
+
+
+def string_array_from_fixed(buf: np.ndarray, lens: np.ndarray):
+    """pyarrow StringArray straight from a left-aligned fixed-width byte
+    buffer (the DeviceEgress field shape: (n, W) uint8 + per-row lengths)
+    — offsets from one cumsum, values gathered without per-row Python.
+    The Arrow-consuming destinations (BigQuery proto string cells,
+    lake/Iceberg Parquet) turn device-rendered text columns into arrays
+    through this one helper."""
+    import pyarrow as pa
+
+    n, width = buf.shape
+    lens = np.asarray(lens, dtype=np.int64)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    total = int(offsets[-1])
+    pos = np.arange(total, dtype=np.int64) - np.repeat(offsets[:-1], lens)
+    src = np.repeat(np.arange(n, dtype=np.int64) * width, lens) + pos
+    values = buf.reshape(-1)[src]
+    return pa.StringArray.from_buffers(
+        n, pa.py_buffer(offsets.astype(np.int32).tobytes()),
+        pa.py_buffer(values.tobytes()))
+
+
 def escaped_table_name(name: TableName) -> str:
     """`schema_table` with underscores in parts doubled so the mapping is
     injective (reference table_name.rs)."""

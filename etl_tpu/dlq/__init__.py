@@ -15,8 +15,13 @@
                   (commit_lsn, tx_ordinal) — destinations collapse the
                   duplicate exactly like any at-least-once redelivery.
   discard       — mark entries `discarded` (kept for audit)
-  unquarantine  — lift a table's quarantine record so the (restarted)
-                  replicator streams it again
+  compact       — TTL expiry: delete replayed/discarded entries older
+                  than the retention window (`dead` entries are the
+                  zero-loss ledger and never expire)
+  unquarantine  — lift a table's quarantine record; a RUNNING
+                  replicator adopts the lift live at its next
+                  quarantine poll (PoisonConfig.quarantine_poll_s,
+                  default 30 s) — no restart needed
 
 The zero-loss invariant this surface completes:
 `delivered ∪ dead-lettered == committed truth` (docs/dead-letter.md) —
@@ -158,14 +163,33 @@ class DeadLetterQueue:
             done.append(eid)
         return done
 
+    async def compact(self, older_than_s: float,
+                      statuses=None) -> dict:
+        """TTL compaction: delete terminal (replayed/discarded) entries
+        whose last status transition is older than `older_than_s`
+        seconds. `dead` entries never expire — they are the zero-loss
+        ledger — and passing "dead" in `statuses` is refused."""
+        statuses = tuple(statuses) if statuses else (
+            DLQ_STATUS_REPLAYED, DLQ_STATUS_DISCARDED)
+        if DLQ_STATUS_DEAD in statuses:
+            raise EtlError(
+                ErrorKind.STATE_STORE_FAILED,
+                "refusing to expire `dead` entries: they are the "
+                "zero-loss ledger (replay or discard them first)")
+        purged = await self.store.purge_dead_letters(older_than_s,
+                                                     statuses)
+        return {"purged": purged, "older_than_s": older_than_s,
+                "statuses": sorted(statuses)}
+
     async def quarantined(self) -> dict:
         return await self.store.get_quarantined_tables()
 
     async def unquarantine(self, table_id: int) -> bool:
         """Lift a table's quarantine. Returns False when the table was
-        not quarantined. The running replicator adopts the lift at its
-        next restart (docs/dead-letter.md runbook: replay first, then
-        unquarantine, then roll the pod)."""
+        not quarantined. A running replicator adopts the lift LIVE at
+        its next quarantine poll (PoisonConfig.quarantine_poll_s,
+        default 30 s) — docs/dead-letter.md runbook: replay first,
+        then unquarantine; no pod roll required."""
         records = await self.store.get_quarantined_tables()
         if table_id not in records:
             return False

@@ -5,6 +5,8 @@
     python -m etl_tpu.dlq --sqlite state.db --pipeline-id 1 \
         replay --destination-json dest.json [--table 16384] [--ids 1 2]
     python -m etl_tpu.dlq --sqlite state.db --pipeline-id 1 discard 3 4
+    python -m etl_tpu.dlq --sqlite state.db --pipeline-id 1 \
+        compact [--older-than-s 604800]
     python -m etl_tpu.dlq --sqlite state.db --pipeline-id 1 quarantined
     python -m etl_tpu.dlq --sqlite state.db --pipeline-id 1 \
         unquarantine 16384
@@ -17,7 +19,10 @@ durably awaited) in WAL order and marks them `replayed`; it is
 idempotent — replayed entries are skipped on a re-run, and re-pushed
 rows are at-least-once duplicates destinations already collapse. The
 runbook (docs/dead-letter.md): fix the root cause → replay → verify →
-unquarantine → roll the replicator pod (it adopts the lift at startup).
+unquarantine — a running replicator adopts the lift live at its next
+quarantine poll (PoisonConfig.quarantine_poll_s, default 30 s).
+`compact` expires terminal (replayed/discarded) entries past the
+retention window; `dead` entries never expire.
 
 Output is one JSON document (sorted keys) per invocation; exit 0 on
 success, 1 on a typed failure.
@@ -90,6 +95,9 @@ async def _run(args) -> dict:
                 await dest.shutdown()
         if args.cmd == "discard":
             return {"discarded": await dlq.discard(args.entry_ids)}
+        if args.cmd == "compact":
+            return await dlq.compact(args.older_than_s,
+                                     statuses=args.status or None)
         if args.cmd == "quarantined":
             records = await dlq.quarantined()
             return {"quarantined": [r.to_json()
@@ -144,12 +152,29 @@ def main(argv: "list[str] | None" = None) -> int:
         "discard", help="mark entries discarded (kept for audit)")
     p_discard.add_argument("entry_ids", type=int, nargs="+")
 
+    from ..config.pipeline import PoisonConfig
+
+    p_compact = sub.add_parser(
+        "compact", help="TTL expiry of replayed/discarded entries "
+                        "older than the retention window (`dead` "
+                        "entries never expire)")
+    p_compact.add_argument(
+        "--older-than-s", type=float,
+        default=PoisonConfig().dlq_retention_s,
+        help="retention window in seconds (default: "
+             "PoisonConfig.dlq_retention_s, 7 days)")
+    p_compact.add_argument(
+        "--status", action="append", default=None,
+        choices=["replayed", "discarded"],
+        help="restrict expiry to these terminal statuses "
+             "(repeatable; default: both)")
+
     sub.add_parser("quarantined", help="list quarantined tables")
 
     p_unq = sub.add_parser(
         "unquarantine", help="lift a table's quarantine (replay first; "
-                             "the replicator adopts the lift at its "
-                             "next restart)")
+                             "a running replicator adopts the lift "
+                             "live at its next quarantine poll)")
     p_unq.add_argument("table_id", type=int)
 
     args = parser.parse_args(argv)

@@ -210,15 +210,23 @@ class ColumnarBatch:
     `source_rows` (int64[num_rows] | None) is set by filtered decodes
     only: the staged-batch row index each surviving row came from, so
     consumers holding per-source-row side arrays (the assembler's LSN /
-    change-type vectors) can compact them to match."""
+    change-type vectors) can compact them to match.
 
-    __slots__ = ("schema", "columns", "num_rows", "source_rows")
+    `device_egress` (ops/egress.py DeviceEgress | None) is attached by
+    unfiltered device decodes whose program rendered wire text in-fused:
+    per-column destination-ready byte buffers the columnar encoders
+    splice instead of re-rendering. Row-count-preserving only — `take`
+    deliberately drops it (the buffers are positional)."""
+
+    __slots__ = ("schema", "columns", "num_rows", "source_rows",
+                 "device_egress")
 
     def __init__(self, schema: ReplicatedTableSchema, columns: list[Column]):
         self.schema = schema
         self.columns = columns
         self.num_rows = len(columns[0]) if columns else 0
         self.source_rows: np.ndarray | None = None
+        self.device_egress = None
         for c in columns:
             if len(c) != self.num_rows:
                 raise ValueError("ragged columnar batch")

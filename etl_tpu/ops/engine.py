@@ -151,6 +151,13 @@ class _PackedInputs:
     # slot. None on the fused-filter path (predicates bind staged column
     # indices, so those programs stay exact).
     plan: "object | None" = None
+    # in-flight device egress output (ops/egress.py): (ebytes, elens,
+    # EgressPlan) attached by the dispatch stage when the decoder has a
+    # wire encoder bound; completion fetches and indexes it per schema
+    # column. None = no device egress for this batch (cold program,
+    # filtered dispatch, non-renderable layout) — destinations fall back
+    # to the host twins.
+    egress: "tuple | None" = None
 
 
 def build_device_program(specs: tuple[tuple[int, CellKind, int, int], ...],
@@ -516,6 +523,14 @@ class _PendingDecode:
                     v.copy_to_host_async()
                 except AttributeError:
                     pass  # non-jax array (tests may inject numpy)
+        if meta is not None and meta.egress is not None:
+            # wire bytes + lengths ride the link alongside the packed
+            # words; completion finds them landed
+            for v in meta.egress[:2]:
+                try:
+                    v.copy_to_host_async()
+                except AttributeError:
+                    pass
 
     @property
     def survivors(self) -> "np.ndarray | None":
@@ -612,9 +627,14 @@ class DeviceDecoder:
                  mesh: "object | str | None" = "auto",
                  mesh_min_rows: int | None = None,
                  telemetry: bool = True,
-                 nonblocking_compile: bool = False):
+                 nonblocking_compile: bool = False,
+                 egress: "str | None" = None):
         self.schema = schema
         self.use_pallas = use_pallas
+        # wire encoder name (ops/egress.py ENCODER_*) when the bound
+        # destination consumes device-rendered text; decoded batches then
+        # carry `device_egress` buffers next to their columns
+        self.egress = egress
         # streaming decoders (assembler / copy) must never block a worker
         # on a first-touch XLA build: a 120-column host program compiles
         # for tens of seconds (measured 32s on this container), which
@@ -1083,8 +1103,9 @@ class DeviceDecoder:
                                pad_total / rows_total if rows_total else 0.0)
         try:
             if pred is not None:
-                return fn(bmat, lengths, row_flags)  # async dispatch
-            return fn(bmat, lengths)  # async dispatch
+                out = fn(bmat, lengths, row_flags)  # async dispatch
+            else:
+                out = fn(bmat, lengths)  # async dispatch
         except Exception:
             # host calls never run pallas — an error there is real, not a
             # Mosaic rejection; misrouting it would disable pallas AND send
@@ -1102,6 +1123,60 @@ class DeviceDecoder:
                 exc_info=True)
             self.use_pallas = False
             return self._dispatch_stage(staged, specs, packed, host)
+        if self.egress is not None and pred is None and specs:
+            # stage 2b: the egress program renders wire text from the
+            # decode output's device-resident words. Unfiltered batches
+            # only (compacted words re-index rows) and never fatal — a
+            # cold program, an un-renderable layout or any failure just
+            # ships the batch without device egress.
+            words = out[0] if isinstance(out, tuple) else out
+            packed.egress = self._egress_stage(words, pspecs, packed, host)
+        return out
+
+    def _egress_stage(self, words, pspecs: tuple,
+                      packed: "_PackedInputs", host: bool):
+        try:
+            from . import egress as egress_mod
+            from . import program_store
+
+            plan = egress_mod.plan_for_specs(pspecs, self.egress)
+            if plan is None:
+                return None
+            from ..parallel.mesh import mesh_cache_key
+
+            mesh = self.mesh if packed.use_mesh else None
+            key = egress_mod.egress_fn_key(
+                packed.row_capacity, pspecs, self.egress,
+                mesh_cache_key(mesh) if mesh is not None else None)
+
+            def _builder():
+                return egress_mod.build_egress_fn(pspecs, plan, mesh=mesh)
+
+            fn = egress_mod.egress_fn_ready(
+                key, _builder, (words,),
+                blocking=not self.nonblocking_compile)
+            if fn is None:
+                return None
+            self._fn_cache[key] = fn
+            if host:
+                # observed-signature recording, same as decode host
+                # dispatches: a restarted pipeline prewarms the egress
+                # programs the workload actually used
+                program_store.record_observed(key)
+            ebytes, elens = fn(words)  # async dispatch
+            if self._telemetry:
+                from ..telemetry.metrics import (
+                    ETL_EGRESS_DEVICE_BATCHES_TOTAL, registry)
+
+                registry.counter_inc(ETL_EGRESS_DEVICE_BATCHES_TOTAL)
+            return (ebytes, elens, plan)
+        except Exception:
+            import logging
+
+            logging.getLogger("etl_tpu.ops").warning(
+                "device egress dispatch failed; batch ships without "
+                "wire buffers", exc_info=True)
+            return None
 
     def _device_call(self, staged: StagedBatch, specs: tuple,
                      host: bool = False):
@@ -1370,11 +1445,28 @@ class DeviceDecoder:
             packed_np = np.asarray(packed) if packed is not None else None
             if shard_bad is not None and self._telemetry:
                 self._shard_health(shard_bad)
-            batch, _ = self._assemble(
+            batch, fixups = self._assemble(
                 staged, specs, packed_np, bad_rows,
                 plan=meta.plan if meta is not None else None)
             fetched = packed_np.nbytes if packed_np is not None else 0.0
             host_rf = self._host_filter_for(staged)
+            if meta is not None and meta.egress is not None \
+                    and host_rf is None:
+                # attach the device-rendered wire buffers; `fixups` (the
+                # oracle-patched rows) become the untrusted set whose
+                # lines destinations re-render per value. Host-filtered
+                # batches skip the attach: take() re-indexes rows.
+                from . import egress as egress_mod
+
+                try:
+                    batch.device_egress = egress_mod.materialize(
+                        meta.egress, meta.plan, self._dense, n, fixups)
+                except Exception:
+                    import logging
+
+                    logging.getLogger("etl_tpu.ops").warning(
+                        "egress materialization failed; batch ships "
+                        "without wire buffers", exc_info=True)
             if host_rf is not None:
                 # predicate outside the device envelope (or an oracle-
                 # routed batch): the same filter applies host-side over

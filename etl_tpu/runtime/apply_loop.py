@@ -166,7 +166,11 @@ class ApplyLoop:
             lag_bytes=lambda: max(
                 0, int(self.state.received_lsn) - int(self.state.durable_lsn)),
             admission_capacity=config.batch.admission_capacity,
-            seal_bytes=config.batch.max_size_bytes)
+            seal_bytes=config.batch.max_size_bytes,
+            # fuse the destination's wire encoder into the decode
+            # programs (ops/egress.py; docs/decode-pipeline.md)
+            egress_encoder=(getattr(destination, "egress_encoder", None)
+                            if config.batch.device_egress else None))
         self.state = _LoopState(durable_lsn=start_lsn, received_lsn=start_lsn,
                                 last_status_flush_lsn=start_lsn)
         # bounded write window (runtime/ack_window.py): flushes keep

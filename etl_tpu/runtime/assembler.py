@@ -72,8 +72,12 @@ class EventAssembler:
     def __init__(self, engine: BatchEngine, monitor=None,
                  decode_window: int = 3, supervisor=None,
                  lag_bytes=None, admission_capacity: int = 0,
-                 seal_bytes: int = 0):
+                 seal_bytes: int = 0, egress_encoder: "str | None" = None):
         self.engine = engine
+        # wire-encoder name (ops/egress.py) the destination consumes —
+        # bound into every DeviceDecoder this loop creates so decoded
+        # batches carry device-rendered wire buffers (`device_egress`)
+        self.egress_encoder = egress_encoder
         # byte seal (0 = off): seal the open run once its size-hint
         # bytes reach this bound (scaled with the dynamic row seal the
         # same ×-factor _scaled_max_bytes uses), so one contiguous run
@@ -268,7 +272,8 @@ class EventAssembler:
             # previous incarnation's AOT executable, so a warm restart
             # decodes its first flush on the real program, zero builds
             # (ops/program_store.py)
-            decoder = DeviceDecoder(r.schema, nonblocking_compile=True)
+            decoder = DeviceDecoder(r.schema, nonblocking_compile=True,
+                                    egress=self.egress_encoder)
             self._decoders[r.table_id] = decoder
         lens = np.fromiter((len(p) for p in r.payloads), dtype=np.int32,
                            count=len(r.payloads))

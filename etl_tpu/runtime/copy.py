@@ -285,7 +285,12 @@ async def parallel_table_copy(*, source_factory, primary_source,
     # first touch into a disk load instead: table re-syncs after a
     # restart decode on the cached executable from chunk one
     # (ops/program_store.py)
-    decoder = DeviceDecoder(schema, nonblocking_compile=True) \
+    decoder = DeviceDecoder(
+        schema, nonblocking_compile=True,
+        # fuse the destination's wire encoder into the copy decode
+        # programs too (ops/egress.py)
+        egress=(getattr(destination, "egress_encoder", None)
+                if config.batch.device_egress else None)) \
         if config.batch.batch_engine is BatchEngine.TPU else None
     progress = CopyProgress()
     queue: asyncio.Queue[CopyPartition] = asyncio.Queue()

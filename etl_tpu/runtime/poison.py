@@ -55,6 +55,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import math
+import re
 import time
 from collections import deque
 
@@ -88,6 +89,30 @@ ISOLATION_TRACE: "deque[dict]" = deque(maxlen=256)
 
 def reset_isolation_trace() -> None:
     ISOLATION_TRACE.clear()
+
+
+#: cap on the stored per-entry column attribution (comma-joined names)
+_COLUMNS_MAX_CHARS = 200
+
+
+def attribute_poison_columns(detail: str, schema) -> str:
+    """Best-effort column attribution for an isolated poison row: the
+    replicated column names that appear as whole tokens in the
+    classified error detail (destinations name the offending column in
+    schema-drift / unencodable-value rejections), comma-joined in
+    schema order. Empty when the detail names no column — attribution
+    is a hint for `dlq inspect`, never load-bearing."""
+    if not detail:
+        return ""
+    hits = []
+    for col in schema.replicated_columns:
+        name = col.name
+        if not name:
+            continue
+        if re.search(r"(?<![A-Za-z0-9_])" + re.escape(name)
+                     + r"(?![A-Za-z0-9_])", detail):
+            hits.append(name)
+    return ",".join(hits)[:_COLUMNS_MAX_CHARS]
 
 
 def bisection_bound(rows: int, tables: int, poison_rows: int) -> int:
@@ -173,9 +198,12 @@ class PoisonIsolator:
         # quarantined-table set: loaded from the store on first use so a
         # restarted worker parks from its very first flush; updated by
         # this isolator on budget trips. External lifts (the operator
-        # CLI) are adopted at the next worker restart (runbook).
+        # CLI's `unquarantine`) are adopted LIVE: submit() re-reads the
+        # store every `quarantine_poll_s` and swaps in the fresh set, so
+        # a lifted table resumes streaming without a worker restart.
         self._quarantined: "set[TableId] | None" = None
         self._records: dict[TableId, QuarantineRecord] = {}
+        self._last_poll = time.monotonic()
         # sliding poison budget per table: dead-letter timestamps
         self._poison_times: "dict[TableId, deque[float]]" = {}
         # serialize isolations across overlapping ack-window tasks: two
@@ -196,7 +224,47 @@ class PoisonIsolator:
         except EtlError:
             self._records = {}
         self._quarantined = set(self._records)
+        self._last_poll = time.monotonic()
         registry.gauge_set(ETL_QUARANTINED_TABLES, len(self._quarantined))
+
+    async def _maybe_refresh(self) -> None:
+        """Live quarantine-lift adoption: every `quarantine_poll_s` the
+        flush path re-reads the store's quarantine records and swaps in
+        the fresh set, so an operator `unquarantine` (another process)
+        takes effect without a worker restart. Serialized on the
+        isolation lock — a budget trip persists its record BEFORE the
+        local set mutates, so a refresh that waited out an isolation
+        always reads at-least-as-current state. A store read failure
+        keeps the current set and retries next poll (never fails a
+        flush over a poll)."""
+        poll = getattr(self.config, "quarantine_poll_s", 0.0)
+        if not poll or self._quarantined is None:
+            return
+        if time.monotonic() - self._last_poll < poll:
+            return
+        async with self._lock:
+            if time.monotonic() - self._last_poll < poll:
+                return  # a concurrent submit refreshed while we waited
+            self._last_poll = time.monotonic()
+            try:
+                fresh = dict(await self.store.get_quarantined_tables())
+            except EtlError:
+                return
+            lifted = set(self._quarantined) - set(fresh)
+            adopted = set(fresh) - set(self._quarantined)
+            self._records = fresh
+            self._quarantined = set(fresh)
+            registry.gauge_set(ETL_QUARANTINED_TABLES,
+                               len(self._quarantined))
+            if lifted:
+                logger.info(
+                    "quarantine lift adopted live for table(s) %s: "
+                    "their events stream to the destination again",
+                    sorted(lifted))
+            if adopted:
+                logger.warning(
+                    "externally-quarantined table(s) %s adopted from "
+                    "the store", sorted(adopted))
 
     def quarantined_tables(self) -> "set[TableId]":
         return set(self._quarantined or ())
@@ -246,7 +314,7 @@ class PoisonIsolator:
     # -- dead-letter appends --------------------------------------------------
 
     async def _dead_letter(self, events, error: "EtlError | None",
-                           reason: str) -> int:
+                           reason: str, columns: str = "") -> int:
         """Append per-row events to the DLQ (idempotent keyed upsert).
         Returns the number appended. A store that cannot persist dead
         letters surfaces as _IsolationAborted carrying the ORIGINAL
@@ -268,7 +336,7 @@ class PoisonIsolator:
                 entry_id=0, table_id=ev.schema.id,
                 commit_lsn=int(ev.commit_lsn), tx_ordinal=ev.tx_ordinal,
                 change_type=change, payload=payload,
-                error_kind=kind_name, detail=detail))
+                error_kind=kind_name, detail=detail, columns=columns))
         if not entries:
             return 0
         try:
@@ -345,7 +413,10 @@ class PoisonIsolator:
         if len(events) == 1:
             ev = events[0]
             self._note_poison(table_id)
-            await self._dead_letter([ev], error, "poison")
+            await self._dead_letter(
+                [ev], error, "poison",
+                columns=attribute_poison_columns(error.detail or "",
+                                                 ev.schema))
             trace["poison_rows"] += 1
             self.stats["poison_rows"] += 1
             logger.warning(
@@ -474,6 +545,7 @@ class PoisonIsolator:
         destinations: BigQuery transfers append errors to the ack
         future) at durability time, via the guarded ack."""
         await self._ensure_loaded()
+        await self._maybe_refresh()
         if self._quarantined:
             healthy, parked = [], []
             for ev in events:

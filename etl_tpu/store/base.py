@@ -66,6 +66,13 @@ class DeadLetterEntry:
     detail: str  # the triggering error's detail, truncated
     attempts: int = 1  # write attempts that found this row poison
     status: str = DLQ_STATUS_DEAD
+    # best-effort column attribution: replicated column names the
+    # classified error detail names, comma-joined in schema order
+    # (runtime/poison.py attribute_poison_columns); "" = unattributed
+    columns: str = ""
+    # store-stamped unix seconds of the last append/status transition —
+    # the compaction clock (`python -m etl_tpu.dlq compact`)
+    updated_at: int = 0
 
     def key(self) -> tuple:
         return (self.table_id, self.commit_lsn, self.tx_ordinal,
@@ -77,7 +84,8 @@ class DeadLetterEntry:
             "commit_lsn": self.commit_lsn, "tx_ordinal": self.tx_ordinal,
             "change_type": self.change_type, "error_kind": self.error_kind,
             "detail": self.detail, "attempts": self.attempts,
-            "status": self.status,
+            "status": self.status, "columns": self.columns,
+            "updated_at": self.updated_at,
         }
 
 
@@ -220,6 +228,17 @@ class StateStore(abc.ABC):
         raise EtlError(
             ErrorKind.STATE_STORE_FAILED,
             f"{type(self).__name__} does not persist dead letters")
+
+    async def purge_dead_letters(self, older_than_s: float,
+                                 statuses: "Sequence[str]" = (
+                                     DLQ_STATUS_REPLAYED,
+                                     DLQ_STATUS_DISCARDED)) -> int:
+        """TTL compaction: delete entries in `statuses` whose last
+        append/status transition is older than `older_than_s` seconds.
+        Returns the number purged. Entries still `dead` are the
+        zero-loss ledger and MUST NOT be offered for expiry; a store
+        with no DLQ surface compacts nothing."""
+        return 0
 
     async def get_quarantined_tables(self
                                      ) -> "dict[TableId, QuarantineRecord]":

@@ -122,8 +122,11 @@ class MemoryStore(PipelineStore):
     async def append_dead_letters(self, entries) -> list[int]:
         from dataclasses import replace
 
+        import time
+
         failpoints.fail_point(failpoints.STORE_DLQ_COMMIT)
         await failpoints.stall_point(failpoints.STORE_DLQ_COMMIT)
+        now = int(time.time())  # store-stamped compaction clock
         ids = []
         for e in entries:
             cur = self._dead_letters.get(e.key())
@@ -133,11 +136,14 @@ class MemoryStore(PipelineStore):
                 # instead of duplicating the entry
                 merged = replace(cur, attempts=cur.attempts + e.attempts,
                                  error_kind=e.error_kind,
-                                 detail=e.detail or cur.detail)
+                                 detail=e.detail or cur.detail,
+                                 columns=e.columns or cur.columns,
+                                 updated_at=now)
                 self._dead_letters[e.key()] = merged
                 ids.append(merged.entry_id)
                 continue
-            stored = replace(e, entry_id=self._next_dlq_id)
+            stored = replace(e, entry_id=self._next_dlq_id,
+                             updated_at=now)
             self._next_dlq_id += 1
             self._dead_letters[stored.key()] = stored
             ids.append(stored.entry_id)
@@ -161,12 +167,26 @@ class MemoryStore(PipelineStore):
                                      status: str) -> None:
         from dataclasses import replace
 
+        import time
+
         for k, e in self._dead_letters.items():
             if e.entry_id == entry_id:
-                self._dead_letters[k] = replace(e, status=status)
+                self._dead_letters[k] = replace(e, status=status,
+                                                updated_at=int(time.time()))
                 return
         raise EtlError(ErrorKind.STATE_STORE_FAILED,
                        f"no dead-letter entry {entry_id}")
+
+    async def purge_dead_letters(self, older_than_s, statuses=(
+            "replayed", "discarded")) -> int:
+        import time
+
+        cutoff = int(time.time() - older_than_s)
+        doomed = [k for k, e in self._dead_letters.items()
+                  if e.status in statuses and e.updated_at < cutoff]
+        for k in doomed:
+            del self._dead_letters[k]
+        return len(doomed)
 
     async def get_quarantined_tables(self) -> dict[TableId, QuarantineRecord]:
         return dict(self._quarantine)

@@ -30,6 +30,7 @@ import abc
 import json
 import re
 import sqlite3
+import time
 from dataclasses import dataclass
 from pathlib import Path
 
@@ -115,6 +116,13 @@ CREATE TABLE IF NOT EXISTS etl_quarantine (
     PRIMARY KEY (pipeline_id, table_id)
 );
 """),
+    # per-column poison attribution + the TTL-compaction clock. One
+    # ADD COLUMN per statement: sqlite's ALTER TABLE accepts exactly
+    # one action, and the runner splits on ";" anyway.
+    ("20260806000000_dead_letter_ttl", """
+ALTER TABLE etl_dead_letter ADD COLUMN poison_columns TEXT NOT NULL DEFAULT '';
+ALTER TABLE etl_dead_letter ADD COLUMN updated_at BIGINT NOT NULL DEFAULT 0;
+"""),
 ]
 
 
@@ -149,8 +157,23 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
     async def _migrate_and_warm(self, bigserial: str) -> None:
         for _name, ddl in MIGRATIONS:
             for stmt in ddl.format(bigserial=bigserial).split(";"):
-                if stmt.strip():
+                if not stmt.strip():
+                    continue
+                try:
                     await self._run(stmt)
+                except Exception as e:
+                    # there is no applied-migrations ledger — every
+                    # connect re-runs the list and relies on
+                    # idempotency. CREATEs carry IF NOT EXISTS; ALTER
+                    # TABLE ADD COLUMN has no portable spelling of
+                    # that (sqlite), so a duplicate-column error IS
+                    # the already-applied signal, in both dialects
+                    msg = str(e).lower()
+                    if stmt.lstrip().upper().startswith("ALTER TABLE") \
+                            and ("duplicate column" in msg
+                                 or "already exists" in msg):
+                        continue
+                    raise
         await self._load_caches()
 
     async def _load_caches(self) -> None:
@@ -339,14 +362,16 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
     # unquarantine must be visible to whichever process reads next.
 
     _DLQ_COLS = ("id, table_id, commit_lsn, tx_ordinal, change_type, "
-                 "payload, error_kind, detail, attempts, status")
+                 "payload, error_kind, detail, attempts, status, "
+                 "poison_columns, updated_at")
 
     @staticmethod
     def _dlq_row(r) -> DeadLetterEntry:
         return DeadLetterEntry(
             entry_id=int(r[0]), table_id=int(r[1]), commit_lsn=int(r[2]),
             tx_ordinal=int(r[3]), change_type=int(r[4]), payload=r[5],
-            error_kind=r[6], detail=r[7], attempts=int(r[8]), status=r[9])
+            error_kind=r[6], detail=r[7], attempts=int(r[8]), status=r[9],
+            columns=r[10], updated_at=int(r[11]))
 
     #: rows per multi-row upsert statement: fixed-size chunks keep the
     #: `?`→`$n` placeholder rewrite cache small (≤ _DLQ_CHUNK distinct
@@ -375,14 +400,16 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
                     cur, attempts=cur.attempts + e.attempts,
                     error_kind=e.error_kind, detail=e.detail or cur.detail)
         todo = [merged[k] for k in order]
-        row_sql = "(?, ?, ?, ?, ?, ?, ?, ?, ?, ?)"
+        now = int(time.time())  # the compaction clock, store-stamped
+        row_sql = "(?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)"
         for i in range(0, len(todo), self._DLQ_CHUNK):
             chunk = todo[i:i + self._DLQ_CHUNK]
             params: list = []
             for e in chunk:
                 params += [pid, e.table_id, e.commit_lsn, e.tx_ordinal,
                            e.change_type, e.payload, e.error_kind,
-                           e.detail, e.attempts, e.status]
+                           e.detail, e.attempts, e.status, e.columns,
+                           now]
             # idempotent keyed upsert on the WAL coordinates: a crash
             # between bisection and ack re-streams the batch and
             # re-appends the same rows — attempts accumulate, no dup row
@@ -390,12 +417,15 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
                 "INSERT INTO etl_dead_letter "
                 "(pipeline_id, table_id, commit_lsn, tx_ordinal, "
                 "change_type, payload, error_kind, detail, attempts, "
-                "status) VALUES " + ", ".join([row_sql] * len(chunk))
+                "status, poison_columns, updated_at) VALUES "
+                + ", ".join([row_sql] * len(chunk))
                 + " ON CONFLICT (pipeline_id, table_id, commit_lsn, "
                 "tx_ordinal, change_type) DO UPDATE SET "
                 "attempts = etl_dead_letter.attempts + excluded.attempts, "
                 "error_kind = excluded.error_kind, "
-                "detail = excluded.detail",
+                "detail = excluded.detail, "
+                "poison_columns = excluded.poison_columns, "
+                "updated_at = excluded.updated_at",
                 tuple(params))
         if not todo:
             return []
@@ -442,9 +472,27 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
             raise EtlError(ErrorKind.STATE_STORE_FAILED,
                            f"no dead-letter entry {entry_id}")
         await self._run(
-            "UPDATE etl_dead_letter SET status = ? WHERE "
-            "pipeline_id = ? AND id = ?",
-            (status, self.pipeline_id, entry_id))
+            "UPDATE etl_dead_letter SET status = ?, updated_at = ? "
+            "WHERE pipeline_id = ? AND id = ?",
+            (status, int(time.time()), self.pipeline_id, entry_id))
+
+    async def purge_dead_letters(self, older_than_s, statuses=(
+            "replayed", "discarded")) -> int:
+        """TTL compaction (operator CLI): delete terminal entries whose
+        last transition predates the cutoff. Two statements instead of
+        relying on a DELETE rowcount — the execution seam returns rows,
+        not counts, and portably so."""
+        cutoff = int(time.time() - older_than_s)
+        marks = ", ".join(["?"] * len(statuses))
+        where = (f"pipeline_id = ? AND status IN ({marks}) "
+                 f"AND updated_at < ?")
+        params = (self.pipeline_id, *statuses, cutoff)
+        rows = await self._run(
+            f"SELECT id FROM etl_dead_letter WHERE {where}", params)
+        if rows:
+            await self._run(
+                f"DELETE FROM etl_dead_letter WHERE {where}", params)
+        return len(rows)
 
     async def get_quarantined_tables(self) -> dict[TableId, QuarantineRecord]:
         rows = await self._run(

@@ -151,6 +151,12 @@ ETL_DECODE_DEVICE_OOM_FALLBACKS_TOTAL = \
 # that would wedge the apply loop into a stall-restart cycle)
 ETL_DECODE_BACKGROUND_COMPILES_TOTAL = \
     "etl_decode_background_compiles_total"
+# device-resident wire egress (ops/egress.py): batches whose dispatch
+# attached device-rendered wire buffers, and destination writes that
+# consumed them via the fast assembly path vs fell back to the host
+# columnar encoders (label path=device|host)
+ETL_EGRESS_DEVICE_BATCHES_TOTAL = "etl_egress_device_batches_total"
+ETL_EGRESS_WRITES_TOTAL = "etl_egress_writes_total"
 # program store (ops/program_store.py): cache hits by layer (memory =
 # the in-process _SHARED_FN_CACHE, disk = a deserialized AOT
 # executable), misses by reason (absent = never compiled on this

@@ -477,10 +477,13 @@ class FakePgServer:
             w.write(_command_complete("ALTER TABLE"))
             w.write(READY)
             return True
-        if first == "ALTER" and any(t in norm for t in API_TABLE_NAMES):
-            # api migrations use ALTER TABLE ... ADD COLUMN — pass it to
-            # the embedded sqlite (same dialect), duplicate-column errors
-            # surface for the client's idempotence check
+        if first == "ALTER" and "ADD COLUMN" in norm.upper() \
+                and any(t in norm for t in STORE_TABLE_NAMES
+                        + API_TABLE_NAMES):
+            # api AND store migrations use ALTER TABLE ... ADD COLUMN —
+            # pass it to the embedded sqlite (same dialect),
+            # duplicate-column errors surface for the client's
+            # idempotence check
             pass
         elif first not in ("CREATE", "INSERT", "UPDATE", "DELETE",
                            "SELECT", "BEGIN", "COMMIT", "ROLLBACK"):

@@ -1106,3 +1106,225 @@ class TestDlqCli:
         assert out["replayed"] == []
         out = self.run_cli(*base, "unquarantine", "16384")
         assert out["lifted"] is True
+
+
+# -- live quarantine lifts (ISSUE 17 satellite) -------------------------------
+
+
+class TestLiveQuarantineLift:
+    """submit() re-reads the store's quarantine records every
+    `quarantine_poll_s`, so an operator `unquarantine` from another
+    process takes effect on a RUNNING worker — no restart."""
+
+    def make(self, poll: float):
+        config = PipelineConfig(
+            pipeline_id=1, publication_name="pub",
+            poison=PoisonConfig(budget_rows=3, window_s=300.0,
+                                quarantine_poll_s=poll))
+        store = MemoryStore()
+        inner = MemoryDestination()
+        iso = PoisonIsolator(store=store,
+                             destination=RecordingPoisonDest(inner),
+                             config=config)
+        return store, inner, iso
+
+    async def test_lift_adopted_without_restart(self):
+        store, inner, iso = self.make(poll=0.01)
+        schema = make_schema()
+        await store.set_table_quarantine(
+            16384, QuarantineRecord(16384, 100, 3))
+        ack = await iso.submit([insert_event(schema, 1, "v1")])
+        assert ack.is_durable
+        assert inner.events == []  # parked: quarantine loaded at start
+        # the operator lifts from ANOTHER process (store-level write)
+        await store.set_table_quarantine(16384, None)
+        await asyncio.sleep(0.02)
+        await iso.submit([insert_event(schema, 2, "v2")])
+        assert [e.row.values[0] for e in inner.events] == [2]
+        assert iso.quarantined_tables() == set()
+
+    async def test_external_quarantine_adopted(self):
+        store, inner, iso = self.make(poll=0.01)
+        schema = make_schema()
+        await iso.submit([insert_event(schema, 1, "v1")])
+        assert len(inner.events) == 1
+        await store.set_table_quarantine(
+            16384, QuarantineRecord(16384, 100, 3))
+        await asyncio.sleep(0.02)
+        await iso.submit([insert_event(schema, 2, "v2")])
+        assert len(inner.events) == 1  # second write parked
+        entries = await store.list_dead_letters()
+        assert [(e.table_id, e.tx_ordinal) for e in entries] \
+            == [(16384, 2)]
+
+    async def test_poll_zero_disables_refresh(self):
+        store, inner, iso = self.make(poll=0.0)
+        schema = make_schema()
+        await iso.submit([insert_event(schema, 1, "v1")])
+        await store.set_table_quarantine(
+            16384, QuarantineRecord(16384, 100, 3))
+        await asyncio.sleep(0.02)
+        await iso.submit([insert_event(schema, 2, "v2")])
+        # never re-read: both events delivered on the startup-loaded set
+        assert len(inner.events) == 2
+
+    async def test_store_error_keeps_current_set(self):
+        store, inner, iso = self.make(poll=0.01)
+        schema = make_schema()
+        await store.set_table_quarantine(
+            16384, QuarantineRecord(16384, 100, 3))
+        await iso.submit([insert_event(schema, 1, "v1")])
+        assert inner.events == []
+
+        async def boom():
+            raise EtlError(ErrorKind.STATE_STORE_FAILED, "poll down")
+
+        store.get_quarantined_tables = boom  # type: ignore[assignment]
+        await asyncio.sleep(0.02)
+        await iso.submit([insert_event(schema, 2, "v2")])
+        # a poll failure never fails a flush NOR forgets the local set
+        assert inner.events == []
+        assert iso.quarantined_tables() == {16384}
+
+
+# -- per-column poison attribution (ISSUE 17 satellite) -----------------------
+
+
+class TestColumnAttribution:
+    def test_token_matching(self):
+        from etl_tpu.runtime.poison import attribute_poison_columns
+
+        schema = make_schema()
+        assert attribute_poison_columns(
+            "invalid value for column note", schema) == "note"
+        assert attribute_poison_columns(
+            "note and id both malformed", schema) == "id,note"
+        # substrings are NOT matches: token boundaries only
+        assert attribute_poison_columns(
+            "noteworthy identity mismatch", schema) == ""
+        assert attribute_poison_columns("", schema) == ""
+
+    async def test_attribution_lands_in_dlq_entry(self, config):
+        store = MemoryStore()
+        inner = MemoryDestination()
+        iso = PoisonIsolator(store=store,
+                             destination=RecordingPoisonDest(inner),
+                             config=config)
+        schema = make_schema()
+        # the rejection detail embeds the value repr — the poison value
+        # names the column, as real schema-drift rejections do
+        events = [insert_event(schema, i,
+                               "POISON note overflow" if i == 2
+                               else f"v{i}")
+                  for i in range(6)]
+        await iso.submit(events)
+        (entry,) = await store.list_dead_letters()
+        assert entry.columns == "note"
+        assert entry.describe()["columns"] == "note"
+
+    def test_inspect_surfaces_columns(self, tmp_path):
+        import dataclasses
+
+        async def seed():
+            s = sqlite_store(tmp_path)
+            await s.connect()
+            schema = make_schema()
+            e = make_entry(insert_event(schema, 1, "v1"))
+            await s.append_dead_letters(
+                [dataclasses.replace(e, columns="note")])
+            await s.close()
+
+        asyncio.new_event_loop().run_until_complete(seed())
+        cli = TestDlqCli()
+        base = ["--sqlite", str(tmp_path / "state.db"),
+                "--pipeline-id", "1"]
+        out = cli.run_cli(*base, "list")
+        eid = out["entries"][0]["entry_id"]
+        assert out["entries"][0]["columns"] == "note"
+        detail = cli.run_cli(*base, "inspect", str(eid))
+        assert detail["columns"] == "note"
+
+
+# -- DLQ TTL compaction (ISSUE 17 satellite) ----------------------------------
+
+
+class TestDlqCompaction:
+    async def test_purge_respects_status_and_age(self, dialect, tmp_path):
+        env = _StoreEnv(dialect, tmp_path)
+        try:
+            store = await env.make()
+            schema = make_schema()
+            await store.append_dead_letters(
+                [make_entry(insert_event(schema, i, f"v{i}",
+                                         commit=100 + i))
+                 for i in range(4)])
+            got = await store.list_dead_letters()
+            assert all(e.updated_at > 0 for e in got)
+            dlq = DeadLetterQueue(store)
+            # terminal entries inside the retention window: kept
+            await store.set_dead_letter_status(got[0].entry_id,
+                                               DLQ_STATUS_REPLAYED)
+            await store.set_dead_letter_status(got[1].entry_id,
+                                               DLQ_STATUS_DISCARDED)
+            out = await dlq.compact(3600.0)
+            assert out["purged"] == 0
+            assert len(await store.list_dead_letters(status=None)) == 4
+            # status-restricted expiry (cutoff in the future via a
+            # negative window: every terminal entry is "old enough")
+            out = await dlq.compact(-2.0, statuses=["replayed"])
+            assert out["purged"] == 1
+            # full terminal expiry; `dead` entries survive any window
+            out = await dlq.compact(-2.0)
+            assert out["purged"] == 1
+            left = await store.list_dead_letters(status=None)
+            assert sorted(e.status for e in left) \
+                == [DLQ_STATUS_DEAD, DLQ_STATUS_DEAD]
+        finally:
+            await env.cleanup()
+
+    async def test_compact_refuses_dead(self):
+        dlq = DeadLetterQueue(MemoryStore())
+        with pytest.raises(EtlError):
+            await dlq.compact(0.0, statuses=["dead"])
+        with pytest.raises(EtlError):
+            await dlq.compact(0.0, statuses=["replayed", "dead"])
+
+    async def test_status_transition_bumps_updated_at(self):
+        import dataclasses
+
+        store = MemoryStore()
+        schema = make_schema()
+        await store.append_dead_letters(
+            [make_entry(insert_event(schema, 1, "v1"))])
+        (e,) = await store.list_dead_letters()
+        key = next(iter(store._dead_letters))
+        store._dead_letters[key] = dataclasses.replace(
+            e, updated_at=e.updated_at - 7 * 86400)  # age it a week
+        await store.set_dead_letter_status(e.entry_id,
+                                           DLQ_STATUS_REPLAYED)
+        (bumped,) = await store.list_dead_letters(status=None)
+        assert bumped.updated_at >= e.updated_at  # transition re-stamps
+
+    def test_cli_compact(self, tmp_path):
+        async def seed():
+            s = sqlite_store(tmp_path)
+            await s.connect()
+            schema = make_schema()
+            ids = await s.append_dead_letters(
+                [make_entry(insert_event(schema, i, f"v{i}",
+                                         commit=100 + i))
+                 for i in range(2)])
+            await s.set_dead_letter_status(ids[0], DLQ_STATUS_DISCARDED)
+            await s.close()
+
+        asyncio.new_event_loop().run_until_complete(seed())
+        cli = TestDlqCli()
+        base = ["--sqlite", str(tmp_path / "state.db"),
+                "--pipeline-id", "1"]
+        out = cli.run_cli(*base, "compact", "--older-than-s=-2")
+        assert out["purged"] == 1
+        assert out["statuses"] == ["discarded", "replayed"]
+        # default window: nothing fresh expires
+        out = cli.run_cli(*base, "compact")
+        assert out["purged"] == 0 and out["older_than_s"] == 604800.0
+        assert cli.run_cli(*base, "list")["count"] == 1
