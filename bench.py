@@ -334,6 +334,101 @@ def run_autoscale_bench(seed: int = 7, reaction_ticks_max: int = 3) -> dict:
     }
 
 
+def run_fleet_bench(seed: int = 7, fleet_size: int = 100,
+                    converge_ticks_max: int = 3) -> dict:
+    """Fleet converge gate (docs/fleet.md): a `fleet_size`-pipeline
+    seeded FleetSpec reconciles onto an empty simulated fleet, then
+    through one versioned add/remove/resize edit. GATED: (a) each
+    convergence completes within `converge_ticks_max` WORKING ticks;
+    (b) zero double-actuations — every runtime call in the actuation
+    log is backed 1:1 by an APPLIED record in the per-pipeline journals,
+    and nothing stays pending; (c) the observed fleet equals the
+    quota-clamped placement exactly (no leaks, no strays); (d) the
+    actuation trace is bit-identical across two runs of the same seed.
+    Wall clock is RECORDED, not gated — pure host arithmetic on this
+    container, but the tick counts are the product's contract."""
+    import asyncio
+
+    from etl_tpu.fleet import (FleetReconciler, PipelineSpec,
+                               SimulatedFleetRuntime, seeded_fleet_spec)
+    from etl_tpu.fleet.reconciler import place_fleet
+    from etl_tpu.store.memory import MemoryStore
+
+    async def drive() -> dict:
+        store = MemoryStore()
+        runtime = SimulatedFleetRuntime(seed=seed)
+        spec = seeded_fleet_spec(seed, fleet_size)
+        await store.update_fleet_spec(spec.to_json())
+        reconciler = FleetReconciler(store=store, runtime=runtime)
+        t0 = time.perf_counter()
+        ticks = await reconciler.converge(
+            max_ticks=converge_ticks_max + 1)
+        converge_s = time.perf_counter() - t0
+        edited = spec.with_edit(
+            remove=[1, 2], resize={10: 6, 11: 1},
+            add=[PipelineSpec(pipeline_id=fleet_size + 1,
+                              tenant_id="tenant-edit", shard_count=2)])
+        await store.update_fleet_spec(edited.to_json())
+        t0 = time.perf_counter()
+        edit_ticks = await reconciler.converge(
+            max_ticks=converge_ticks_max + 1)
+        edit_s = time.perf_counter() - t0
+        journals = await store.get_fleet_journals()
+        statuses = [e.get("status") for doc in journals.values()
+                    for e in doc.get("entries", [])]
+        return {
+            "ticks": ticks,
+            "edit_ticks": edit_ticks,
+            "converge_s": converge_s,
+            "edit_s": edit_s,
+            "applied": statuses.count("applied"),
+            "pending": statuses.count("pending"),
+            "actuations": list(runtime.actuation_log),
+            "observed": await runtime.list_pipelines(),
+            "targets": place_fleet(edited),
+            "violations": runtime.violations(),
+        }
+
+    first = asyncio.run(drive())
+    second = asyncio.run(drive())
+    failures = []
+    for label, ticks in (("initial", first["ticks"]),
+                         ("edit", first["edit_ticks"])):
+        if ticks > converge_ticks_max:
+            failures.append(f"{label} converge took {ticks} working "
+                            f"ticks, gate is {converge_ticks_max}")
+    double = len(first["actuations"]) - first["applied"]
+    if double != 0:
+        failures.append(f"{double} runtime actuations not backed by an "
+                        f"applied journal record")
+    if first["pending"]:
+        failures.append(f"{first['pending']} journal records still "
+                        f"pending after convergence")
+    if first["observed"] != first["targets"]:
+        failures.append("observed fleet != quota-clamped placement")
+    if first["violations"]:
+        failures.extend(first["violations"][:5])
+    if first["actuations"] != second["actuations"]:
+        failures.append("actuation trace not deterministic across two "
+                        "runs of the same seed")
+    return {
+        "mode": "fleet",
+        "seed": seed,
+        "fleet_size": fleet_size,
+        "converge_ticks": first["ticks"],
+        "edit_converge_ticks": first["edit_ticks"],
+        "converge_ticks_max": converge_ticks_max,
+        "converge_wall_clock_s": round(first["converge_s"], 4),
+        "edit_wall_clock_s": round(first["edit_s"], 4),
+        "actuations": len(first["actuations"]),
+        "applied_records": first["applied"],
+        "double_actuations": double,
+        "deterministic": first["actuations"] == second["actuations"],
+        "failures": failures,
+        "ok": not failures,
+    }
+
+
 def run_smoke() -> dict:
     """CI gate: CPU backend, small batches, pipelined decode must be
     byte-identical to serial decode() and the stage histograms must have
@@ -526,6 +621,18 @@ def run_smoke() -> dict:
     autoscale_chaos = asyncio.run(run_autoscale_surge_drain(seed=7))
     autoscale_ok = autoscale["ok"] and autoscale_chaos.ok
 
+    # fleet converge gate (ISSUE 18): the 100-pipeline declarative
+    # reconcile — empty→steady and through one add/remove/resize edit
+    # within the working-tick budget, zero double-actuations
+    # (journal-verified), observed == quota-clamped placement, and a
+    # deterministic actuation trace per seed. Wall clock recorded, not
+    # gated. The kill-mid-roll successor proof is
+    # `python -m etl_tpu.chaos --fleet`.
+    fleet = run_fleet_bench(
+        fleet_size=floors.get("fleet_bench_pipelines", 100),
+        converge_ticks_max=floors.get("fleet_converge_ticks_max", 3))
+    fleet_ok = fleet["ok"]
+
     # program-cache coldstart gate (ISSUE 12): two replicator subprocess
     # lifetimes against one cache dir — the warm restart must compile
     # ZERO fresh XLA programs and serve its first durable batch from
@@ -677,7 +784,8 @@ def run_smoke() -> dict:
                    and egress_ok and workload_ok and mesh_ok and mp_ok
                    and sharded_chaos_ok and sharded_ok
                    and selectivity_ok and coldstart_ok
-                   and autoscale_ok and ack_ok and poison_ok),
+                   and autoscale_ok and fleet_ok and ack_ok
+                   and poison_ok),
         "poison_ok": bool(poison_ok),
         "poison_throughput_ratio": poison["poison_throughput_ratio"],
         "poison_ratio_floor": poison_floor,
@@ -702,6 +810,14 @@ def run_smoke() -> dict:
         "autoscale_failures": autoscale["failures"],
         "autoscale_chaos_ok": bool(autoscale_chaos.ok),
         "autoscale_chaos": autoscale_chaos.describe(),
+        "fleet_ok": bool(fleet_ok),
+        "fleet_converge_ticks": fleet["converge_ticks"],
+        "fleet_edit_converge_ticks": fleet["edit_converge_ticks"],
+        "fleet_converge_ticks_max": fleet["converge_ticks_max"],
+        "fleet_double_actuations": fleet["double_actuations"],
+        "fleet_deterministic": bool(fleet["deterministic"]),
+        "fleet_converge_wall_clock_s": fleet["converge_wall_clock_s"],
+        "fleet_failures": fleet["failures"],
         "selectivity_ok": bool(selectivity_ok),
         "selectivity": selectivity,
         "coldstart_ok": bool(coldstart_ok),
@@ -851,7 +967,8 @@ def main():
                         choices=["decode", "table_copy", "table_streaming",
                                  "wide_row", "lag", "egress", "workload",
                                  "multi_pipeline", "mesh_check",
-                                 "selectivity", "coldstart", "autoscale"])
+                                 "selectivity", "coldstart", "autoscale",
+                                 "fleet"])
     parser.add_argument("--multi-pipeline", dest="multi_pipeline",
                         action="store_true",
                         help="alias for --mode multi_pipeline: N "
@@ -970,6 +1087,16 @@ def main():
                         help="workload generator seed (--workload mode)")
     parser.add_argument("--engine", default="tpu",
                         choices=["tpu", "cpu", "pallas"])
+    parser.add_argument("--fleet", dest="fleet", action="store_true",
+                        help="fleet converge gate: a 100-pipeline seeded "
+                             "FleetSpec reconciles onto an empty "
+                             "simulated fleet and through one "
+                             "add/remove/resize edit; gates working "
+                             "ticks <= fleet_converge_ticks_max, zero "
+                             "double-actuations (journal-verified), "
+                             "observed == quota-clamped placement, and "
+                             "a deterministic actuation trace; wall "
+                             "clock recorded, not gated")
     parser.add_argument("--smoke", action="store_true",
                         help="CI gate: CPU backend, small batches, assert "
                              "pipelined decode == serial decode; exit 1 on "
@@ -983,6 +1110,20 @@ def main():
         args.mode = "coldstart"
     if args.autoscale:
         args.mode = "autoscale"
+    if args.fleet:
+        args.mode = "fleet"
+    if args.mode == "fleet":
+        # pure host-side reconciliation arithmetic: never touches a
+        # device backend or the accelerator tunnel
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_FLOOR.json")) as f:
+            floors = json.load(f)
+        out = run_fleet_bench(
+            seed=args.seed,
+            fleet_size=floors.get("fleet_bench_pipelines", 100),
+            converge_ticks_max=floors.get("fleet_converge_ticks_max", 3))
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
     if args.mode == "autoscale":
         # pure policy arithmetic over the seeded synthetic timeline:
         # never touches a device backend or the accelerator tunnel

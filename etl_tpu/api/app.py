@@ -74,9 +74,16 @@ def _bool(v) -> bool:
 
 class ApiState:
     def __init__(self, db: "str | ApiDb", cipher: ConfigCipher,
-                 orchestrator: Orchestrator, api_key: str | None = None):
+                 orchestrator: Orchestrator, api_key: str | None = None,
+                 fleet_store=None, fleet_lag_of=None):
         self.cipher = cipher
         self.orchestrator = orchestrator
+        # fleet control plane (docs/fleet.md): the StateStore holding the
+        # FleetSpec + actuation journals the /v1/fleet endpoint reports
+        # on (None = this deployment runs no fleet), and an optional
+        # async pipeline_id -> lag-bytes reader (None = lag unreported)
+        self.fleet_store = fleet_store
+        self.fleet_lag_of = fleet_lag_of
         # deployment API key (reference etl-api authentication module):
         # when set, every /v1 route requires `Authorization: Bearer <key>`
         # BEFORE tenant routing — the tenant header alone is an assertion,
@@ -723,6 +730,68 @@ def build_app(state: ApiState) -> web.Application:
     r.add_get("/v1/pipelines/{id}/replication-status", replication_status)
     r.add_post("/v1/pipelines/{id}/version", update_pipeline_version)
     r.add_post("/v1/pipelines/{id}/rollback-tables", rollback_tables)
+
+    # -- fleet (docs/fleet.md) --------------------------------------------------
+
+    async def fleet_status(_req: web.Request):
+        """ONE aggregated view of every pipeline the fleet runs:
+        desired vs observed shard counts, orchestrator health with the
+        pod /health degraded reasons, per-pipeline lag when a reader is
+        wired, and the fleet-wide degraded-reason tally. Deliberately
+        tenant-headerless: this is the operator's fleet console, behind
+        the same bearer auth as every /v1 route."""
+        from ..fleet.reconciler import place_fleet
+        from ..fleet.spec import FleetSpec
+        from ..models.errors import EtlError
+
+        spec_doc = None
+        if state.fleet_store is not None:
+            spec_doc = await state.fleet_store.get_fleet_spec()
+        spec = FleetSpec.from_json(spec_doc)
+        targets = place_fleet(spec)
+        by_id = spec.by_id()
+        try:
+            observed = await state.orchestrator.list_pipelines()
+        except EtlError:
+            observed = {}
+        pipelines = []
+        reason_tally: dict[str, int] = {}
+        states_tally: dict[str, int] = {}
+        for pid in sorted(set(targets) | set(observed)):
+            st = await state.orchestrator.status(pid)
+            lag = None
+            if state.fleet_lag_of is not None:
+                lag = await state.fleet_lag_of(pid)
+            for reason in st.reasons:
+                reason_tally[reason] = reason_tally.get(reason, 0) + 1
+            states_tally[st.state] = states_tally.get(st.state, 0) + 1
+            p = by_id.get(pid)
+            pipelines.append({
+                "pipeline_id": pid,
+                "tenant_id": p.tenant_id if p else None,
+                "profile": p.profile if p else None,
+                "desired_shards": targets.get(pid, 0),
+                "observed_shards": observed.get(pid, 0),
+                "state": st.state,
+                "detail": st.detail,
+                "degraded_reasons": list(st.reasons),
+                "lag_bytes": lag,
+            })
+        return web.json_response({
+            "spec_version": spec.spec_version,
+            "pipelines": pipelines,
+            "counts": {
+                "desired": len(targets),
+                "observed": len(observed),
+                "by_state": states_tally,
+            },
+            "converged": dict(observed) == targets,
+            "degraded_reasons": reason_tally,
+            "quotas": {t: q.to_json()
+                       for t, q in sorted(spec.quotas.items())},
+        })
+
+    r.add_get("/v1/fleet", fleet_status)
     return app
 
 
@@ -897,6 +966,10 @@ OPENAPI_DOC["paths"] = {
         "post": _op("reset errored (or listed) tables for resync",
                     params=_ID_PARAM, body=_ref("RollbackRequest"),
                     resp=_ref("RollbackResponse"))},
+    "/v1/fleet": {
+        "get": _op("aggregated fleet view: desired vs observed shards, "
+                   "health + pod degraded reasons, lag per pipeline, "
+                   "tenant quotas (docs/fleet.md)")},
 }
 
 

@@ -57,6 +57,10 @@ class ReplicatorStatus:
     pipeline_id: int
     state: str  # "stopped" | "starting" | "running" | "failed"
     detail: str = ""
+    # degraded reasons from the pod's live /health probe (supervision
+    # health state machine) — a pipeline can be `running` yet degraded;
+    # the /fleet endpoint aggregates these across the whole fleet
+    reasons: tuple = ()
 
 
 class Orchestrator(abc.ABC):
@@ -68,6 +72,16 @@ class Orchestrator(abc.ABC):
 
     @abc.abstractmethod
     async def status(self, pipeline_id: int) -> ReplicatorStatus: ...
+
+    async def list_pipelines(self) -> "dict[int, int]":
+        """Enumerate every pipeline this orchestrator runs:
+        pipeline_id → live shard count. The fleet reconciler's observe
+        step and the chaos leak checks both depend on it; orchestrators
+        that cannot enumerate cannot join a fleet."""
+        raise EtlError(
+            ErrorKind.CONFIG_INVALID,
+            f"{type(self).__name__} cannot enumerate pipelines — fleet "
+            f"reconciliation needs a list-capable orchestrator")
 
     async def restart_pipeline(self, spec: ReplicatorSpec) -> None:
         await self.stop_pipeline(spec.pipeline_id)
@@ -586,6 +600,72 @@ class K8sOrchestrator(Orchestrator):
         for name in shard_names:
             await self._delete_owned(name)
 
+    async def list_pipelines(self) -> "dict[int, int]":
+        """Enumerate the fleet from the StatefulSet inventory: one
+        labelSelector list over `app=etl-replicator`, grouped by the
+        `pipeline_id` label — shard count is the number of `-sN` replica
+        sets (or 1 for an unsharded deployment)."""
+        ns = self.namespace
+        status, doc = await self._api(
+            "GET", f"/apis/apps/v1/namespaces/{ns}/statefulsets"
+                   f"?labelSelector=app%3Detl-replicator")
+        if status >= 400 or not isinstance(doc, dict) \
+                or not isinstance(doc.get("items"), list):
+            raise EtlError(ErrorKind.DESTINATION_FAILED,
+                           f"k8s LIST statefulsets → {status}")
+        fleet: "dict[int, int]" = {}
+        sharded: "dict[int, set]" = {}
+        for item in doc["items"]:
+            meta = item.get("metadata", {})
+            labels = meta.get("labels", {})
+            try:
+                pid = int(labels.get("pipeline_id", ""))
+            except ValueError:
+                continue
+            name = meta.get("name", "")
+            base = self._name(pid)
+            if name.startswith(f"{base}-s") \
+                    and name[len(base) + 2:].isdigit():
+                sharded.setdefault(pid, set()).add(name)
+            elif name == base:
+                fleet.setdefault(pid, 1)
+        for pid, names in sharded.items():
+            # a sharded deployment's per-shard sets win over a stale
+            # unsharded one caught mid-roll
+            fleet[pid] = len(names)
+        return fleet
+
+    async def probe_pod_health(self, pipeline_id: int,
+                               app_name: "str | None" = None
+                               ) -> "dict | None":
+        """GET the replicator pod's live /health JSON through the API
+        server's pod proxy (the in-cluster observability app,
+        replicator.py build_observability_app). Returns the body dict —
+        `{"status": "ok"|"degraded"|"faulted"|..., "reasons": {...}}` —
+        or None when there is no pod / no proxy / no parseable body;
+        callers treat None as "no evidence", never as failure."""
+        ns = self.namespace
+        name = app_name or self._name(pipeline_id)
+        status, doc = await self._api(
+            "GET", f"/api/v1/namespaces/{ns}/pods"
+                   f"?labelSelector=app%3D{name}")
+        if status >= 400:
+            return None
+        items = doc.get("items", []) if isinstance(doc, dict) else []
+        pod_name = (items[0].get("metadata", {}).get("name", "")
+                    if items else "")
+        if not pod_name:
+            return None
+        status, body = await self._api(
+            "GET", f"/api/v1/namespaces/{ns}/pods/{pod_name}"
+                   f"/proxy/health")
+        # 503 is a MEANINGFUL health answer (faulted/starting pods serve
+        # it with a JSON body); only a transport-level miss is None
+        if status == 404 or not isinstance(body, dict) \
+                or "status" not in body:
+            return None
+        return body
+
     async def pod_status(self, pipeline_id: int,
                          app_name: "str | None" = None) -> str:
         """Pod-level state (reference get_replicator_pod_status): derives
@@ -619,8 +699,30 @@ class K8sOrchestrator(Orchestrator):
             return ReplicatorStatus(pipeline_id, "failed",
                                     "pod failed (see pod status)")
         ready = doc.get("status", {}).get("readyReplicas", 0)
-        return ReplicatorStatus(pipeline_id,
-                                "running" if ready else "starting")
+        if not ready:
+            return ReplicatorStatus(pipeline_id, "starting")
+        # the pod is ready at the Kubernetes level — now ask the
+        # REPLICATOR what it thinks: the live /health probe surfaces the
+        # supervision health state readiness cannot see (a pod can be
+        # Ready while its apply loop is faulted behind a dead heartbeat)
+        health = await self.probe_pod_health(pipeline_id, app_name=name)
+        if health is not None:
+            h = str(health.get("status", ""))
+            if h == "faulted":
+                return ReplicatorStatus(
+                    pipeline_id, "failed",
+                    f"pod /health faulted: {health.get('fatal', '')}")
+            if h == "degraded":
+                reasons = health.get("reasons") or {}
+                if isinstance(reasons, dict):
+                    flat = tuple(f"{k}: {v}" for k, v in
+                                 sorted(reasons.items()))
+                else:
+                    flat = (str(reasons),)
+                return ReplicatorStatus(
+                    pipeline_id, "running",
+                    "degraded: " + "; ".join(flat), reasons=flat)
+        return ReplicatorStatus(pipeline_id, "running")
 
     async def status(self, pipeline_id: int) -> ReplicatorStatus:
         """Aggregate over the deployment's replica sets: a sharded
@@ -633,17 +735,22 @@ class K8sOrchestrator(Orchestrator):
                                           self._name(pipeline_id))
         states = []
         details = []
+        reasons: list = []
         for i, name in enumerate(shard_names):
             st = await self._status_one(pipeline_id, name)
             states.append(st.state)
             details.append(f"s{i}={st.state}"
                            + (f" ({st.detail})" if st.detail else ""))
+            reasons.extend(f"s{i} {r}" for r in st.reasons)
         detail = ", ".join(details)
         if any(s == "failed" for s in states):
-            return ReplicatorStatus(pipeline_id, "failed", detail)
+            return ReplicatorStatus(pipeline_id, "failed", detail,
+                                    reasons=tuple(reasons))
         if any(s in ("starting", "stopped") for s in states):
-            return ReplicatorStatus(pipeline_id, "starting", detail)
-        return ReplicatorStatus(pipeline_id, "running", detail)
+            return ReplicatorStatus(pipeline_id, "starting", detail,
+                                    reasons=tuple(reasons))
+        return ReplicatorStatus(pipeline_id, "running", detail,
+                                reasons=tuple(reasons))
 
     async def shutdown(self) -> None:
         if self._session is not None:
@@ -728,6 +835,18 @@ class LocalOrchestrator(Orchestrator):
     async def stop_pipeline(self, pipeline_id: int) -> None:
         for key in self._keys_for(pipeline_id):
             await self._stop_key(key)
+
+    async def list_pipelines(self) -> "dict[int, int]":
+        """Enumerate from the process table: shard count is the number
+        of `(pipeline_id, shard)` keys (1 for an unsharded scalar key).
+        Exited processes still count — presence is registration, health
+        is `status()`'s job; the fleet reconciler must not re-create a
+        pipeline just because its process crashed between ticks."""
+        fleet: "dict[int, int]" = {}
+        for key in self._procs:
+            pid = key[0] if isinstance(key, tuple) else key
+            fleet[pid] = fleet.get(pid, 0) + 1
+        return fleet
 
     async def status(self, pipeline_id: int) -> ReplicatorStatus:
         keys = self._keys_for(pipeline_id)

@@ -89,6 +89,23 @@ def main(argv: list[str] | None = None) -> int:
                              "restore exact truth idempotently; (2) a "
                              "hard kill mid-bisection must reconverge "
                              "within the dup budget after restart")
+    parser.add_argument("--fleet", dest="fleet", action="store_true",
+                        help="run the fleet reconciliation scenario "
+                             "instead of the corpus: a 100-pipeline "
+                             "declarative fleet (seeded tenancy "
+                             "profiles, biting quotas) reconciles from "
+                             "empty, absorbs one versioned "
+                             "add/remove/resize edit, the coordinator "
+                             "is hard-killed mid-roll in BOTH crash "
+                             "windows (before and after the actuation "
+                             "landed) and the successor must converge "
+                             "via the per-pipeline actuation journal "
+                             "with zero double-actuations, zero leaked "
+                             "pipelines, and per-pipeline zero-loss / "
+                             "bounded-dup invariants intact; the three "
+                             "policy plugins (PID lag-target, adaptive "
+                             "ack-depth, admission weights) run on one "
+                             "signal bus")
     parser.add_argument("--list", action="store_true",
                         help="list scenario names and exit")
     parser.add_argument("--timeout", type=float, default=60.0,
@@ -109,6 +126,21 @@ def main(argv: list[str] | None = None) -> int:
         for s in SCENARIOS + WORKLOAD_MATRIX:
             print(f"{s.name}: {s.description}")
         return 0
+
+    if args.fleet:
+        if args.matrix or args.workload or args.scenario or args.sharded \
+                or args.autoscale or args.multi_pipeline \
+                or args.ack_window or args.dlq:
+            parser.error("--fleet runs its own 100-pipeline "
+                         "reconciliation scenario and cannot be "
+                         "combined with --matrix/--workload/--scenario/"
+                         "--sharded/--autoscale/--multi-pipeline/"
+                         "--ack-window/--dlq")
+        from .fleet import run_fleet_chaos
+
+        run = asyncio.run(run_fleet_chaos(seed=args.seed))
+        print(json.dumps(run.describe(), sort_keys=True))
+        return 0 if run.ok else 1
 
     if args.multi_pipeline:
         if args.matrix or args.workload or args.scenario or args.sharded \

@@ -88,6 +88,12 @@ STORE_SHARD_COMMIT = "store.shard_commit"
 # covers (etl_tpu/autoscale/controller.py)
 STORE_AUTOSCALE_COMMIT = "store.autoscale_commit"
 
+# fleet spec/journal commits (store/memory.py, store/sql.py): the fleet
+# reconciler persists each actuation decision here BEFORE driving the
+# orchestrator — a fault is the crash-before-actuation window the
+# successor's resume protocol covers (etl_tpu/fleet/reconciler.py)
+STORE_FLEET_COMMIT = "store.fleet_commit"
+
 # dead-letter appends (store/memory.py, store/sql.py): the isolation
 # protocol persists poison rows here BEFORE acking their flush durable —
 # a fault is the crash-between-bisect-and-dead-letter window the
@@ -105,7 +111,8 @@ CHAOS_SITES = (
     APPLY_FRAME_READ,
     DESTINATION_WRITE, DESTINATION_FLUSH,
     STORE_STATE_COMMIT, STORE_SCHEMA_COMMIT, STORE_PROGRESS_COMMIT,
-    STORE_SHARD_COMMIT, STORE_AUTOSCALE_COMMIT, STORE_DLQ_COMMIT,
+    STORE_SHARD_COMMIT, STORE_AUTOSCALE_COMMIT, STORE_FLEET_COMMIT,
+    STORE_DLQ_COMMIT,
     POISON_BISECT,
 )
 
@@ -117,7 +124,8 @@ ASYNC_STALL_SITES = (
     APPLY_FRAME_READ, DESTINATION_WRITE, DESTINATION_FLUSH,
     COPY_PARTITION_START, COPY_PARTITION_END,
     STORE_STATE_COMMIT, STORE_SCHEMA_COMMIT, STORE_PROGRESS_COMMIT,
-    STORE_SHARD_COMMIT, STORE_AUTOSCALE_COMMIT, STORE_DLQ_COMMIT,
+    STORE_SHARD_COMMIT, STORE_AUTOSCALE_COMMIT, STORE_FLEET_COMMIT,
+    STORE_DLQ_COMMIT,
     POISON_BISECT,
 )
 

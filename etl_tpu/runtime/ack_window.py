@@ -113,6 +113,14 @@ class AckWindow:
             return 1  # drain-to-serial under memory pressure
         return self._limit
 
+    def set_limit(self, limit: int) -> None:
+        """Retarget the window depth at runtime (the fleet signal bus's
+        adaptive-depth plugin drives this from the measured ack-latency
+        histogram). Shrinking never cancels in-flight writes — the
+        window just refuses new dispatches until it drains below the
+        new depth; memory pressure still clamps to 1 regardless."""
+        self._limit = max(1, int(limit))
+
     def __len__(self) -> int:
         return len(self._entries)
 
@@ -395,6 +403,11 @@ class CopyAckWindow:
         if self._pressure is not None and self._pressure():
             return 1
         return self._limit
+
+    def set_limit(self, limit: int) -> None:
+        """Runtime depth retarget (see AckWindow.set_limit): excess
+        pending acks drain FIFO on the next add()."""
+        self._limit = max(1, int(limit))
 
     def __len__(self) -> int:
         return len(self._acks)

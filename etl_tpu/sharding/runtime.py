@@ -225,6 +225,34 @@ class ShardScopedStore(PipelineStore):
             "shard-scoped runtimes cannot rewrite the autoscale journal; "
             "drive scale decisions through AutoscaleController")
 
+    # -- fleet spec / actuation journals (docs/fleet.md) ----------------------
+    # Reads pass through (a pod may inspect the fleet's desired state,
+    # e.g. to report its tenancy profile on /health/detail); WRITES are
+    # control-plane-only — only the fleet coordinator, against the RAW
+    # store, ever moves the spec or a journal.
+
+    async def get_fleet_spec(self) -> "dict | None":
+        return await self._inner.get_fleet_spec()
+
+    async def update_fleet_spec(self, spec: dict) -> None:
+        raise EtlError(
+            ErrorKind.SHARD_NOT_OWNED,
+            "shard-scoped runtimes cannot rewrite the fleet spec; "
+            "submit desired state through the fleet API")
+
+    async def get_fleet_journal(self, pipeline_id: int) -> "dict | None":
+        return await self._inner.get_fleet_journal(pipeline_id)
+
+    async def get_fleet_journals(self) -> "dict[int, dict]":
+        return await self._inner.get_fleet_journals()
+
+    async def update_fleet_journal(self, pipeline_id: int,
+                                   journal: dict) -> None:
+        raise EtlError(
+            ErrorKind.SHARD_NOT_OWNED,
+            "shard-scoped runtimes cannot rewrite a fleet actuation "
+            "journal; drive convergence through FleetReconciler")
+
     # -- SchemaStore (shared, unguarded — see module docstring) ---------------
 
     async def store_table_schema(self, schema: ReplicatedTableSchema,

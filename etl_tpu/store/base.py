@@ -192,6 +192,49 @@ class StateStore(abc.ABC):
             ErrorKind.STATE_STORE_FAILED,
             f"{type(self).__name__} does not persist autoscale journals")
 
+    # -- fleet spec / actuation-journal surface (docs/fleet.md) ---------------
+    # Same stance again: concrete defaults so stores that never run under
+    # a fleet coordinator keep working unchanged; the memory and sql
+    # backends override with real persistence. The spec is one JSON
+    # document (etl_tpu/fleet/spec.py FleetSpec shape) rewritten
+    # atomically per edit; journals are one small JSON document per
+    # PIPELINE (etl_tpu/fleet/journal.py ActuationJournal shape) so two
+    # pipelines' actuations never contend on one row.
+
+    async def get_fleet_spec(self) -> "dict | None":
+        """The persisted desired-state fleet spec, or None when no
+        operator has ever submitted one."""
+        return None
+
+    async def update_fleet_spec(self, spec: dict) -> None:
+        """Persist the spec document. Spec versions are MONOTONIC;
+        storing a spec whose version is lower than the current record's
+        is a typed error (a stale operator or partitioned coordinator
+        must never roll the fleet's desired state back)."""
+        raise EtlError(
+            ErrorKind.STATE_STORE_FAILED,
+            f"{type(self).__name__} does not persist fleet specs")
+
+    async def get_fleet_journal(self, pipeline_id: int) -> "dict | None":
+        """One pipeline's persisted actuation journal, or None when the
+        reconciler has never actuated it."""
+        return None
+
+    async def get_fleet_journals(self) -> "dict[int, dict]":
+        """Every persisted actuation journal keyed by pipeline id — the
+        successor coordinator's resume scan."""
+        return {}
+
+    async def update_fleet_journal(self, pipeline_id: int,
+                                   journal: dict) -> None:
+        """Persist one pipeline's journal document. Decision ids inside
+        it are MONOTONIC; storing a journal whose next_id is lower than
+        the current record's is a typed error (a stale coordinator must
+        never rewind the actuation history)."""
+        raise EtlError(
+            ErrorKind.STATE_STORE_FAILED,
+            f"{type(self).__name__} does not persist fleet journals")
+
     # -- dead-letter / quarantine surface (docs/dead-letter.md) ---------------
     # Concrete defaults like the shard and autoscale surfaces: stores
     # that never see poison keep working unchanged — READS return empty

@@ -30,6 +30,8 @@ class MemoryStore(PipelineStore):
         self._dest_meta: dict[TableId, DestinationTableMetadata] = {}
         self._shard_assignment: ShardAssignment | None = None
         self._autoscale_journal: dict | None = None
+        self._fleet_spec: dict | None = None
+        self._fleet_journals: dict[int, dict] = {}
         # dead-letter surface: WAL-coordinate key -> entry (the keyed
         # upsert that makes crash-era re-appends idempotent)
         self._dead_letters: dict[tuple, DeadLetterEntry] = {}
@@ -116,6 +118,42 @@ class MemoryStore(PipelineStore):
         failpoints.fail_point(failpoints.STORE_AUTOSCALE_COMMIT)
         await failpoints.stall_point(failpoints.STORE_AUTOSCALE_COMMIT)
         self._autoscale_journal = journal
+
+    # -- fleet spec / actuation journals -------------------------------------
+
+    async def get_fleet_spec(self) -> dict | None:
+        return self._fleet_spec
+
+    async def update_fleet_spec(self, spec: dict) -> None:
+        cur = self._fleet_spec
+        if cur is not None and int(spec.get("spec_version", 0)) \
+                < int(cur.get("spec_version", 0)):
+            raise EtlError(
+                ErrorKind.PROGRESS_REGRESSION,
+                f"fleet spec version regression: {cur.get('spec_version')} "
+                f"-> {spec.get('spec_version')}")
+        failpoints.fail_point(failpoints.STORE_FLEET_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_FLEET_COMMIT)
+        self._fleet_spec = spec
+
+    async def get_fleet_journal(self, pipeline_id: int) -> dict | None:
+        return self._fleet_journals.get(int(pipeline_id))
+
+    async def get_fleet_journals(self) -> dict[int, dict]:
+        return dict(self._fleet_journals)
+
+    async def update_fleet_journal(self, pipeline_id: int,
+                                   journal: dict) -> None:
+        cur = self._fleet_journals.get(int(pipeline_id))
+        if cur is not None and int(journal.get("next_id", 0)) \
+                < int(cur.get("next_id", 0)):
+            raise EtlError(
+                ErrorKind.PROGRESS_REGRESSION,
+                f"fleet journal id regression for pipeline {pipeline_id}: "
+                f"{cur.get('next_id')} -> {journal.get('next_id')}")
+        failpoints.fail_point(failpoints.STORE_FLEET_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_FLEET_COMMIT)
+        self._fleet_journals[int(pipeline_id)] = journal
 
     # -- dead-letter / quarantine surface ------------------------------------
 

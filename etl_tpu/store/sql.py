@@ -123,6 +123,23 @@ CREATE TABLE IF NOT EXISTS etl_quarantine (
 ALTER TABLE etl_dead_letter ADD COLUMN poison_columns TEXT NOT NULL DEFAULT '';
 ALTER TABLE etl_dead_letter ADD COLUMN updated_at BIGINT NOT NULL DEFAULT 0;
 """),
+    # fleet control plane (docs/fleet.md): one desired-state spec row
+    # per fleet (keyed by the coordinator's pipeline_id) and one
+    # actuation journal row PER PIPELINE so concurrent rolls never
+    # contend on a single document
+    ("20260807000000_fleet", """
+CREATE TABLE IF NOT EXISTS etl_fleet_spec (
+    pipeline_id BIGINT NOT NULL,
+    spec_json TEXT NOT NULL,
+    PRIMARY KEY (pipeline_id)
+);
+CREATE TABLE IF NOT EXISTS etl_fleet_journal (
+    pipeline_id BIGINT NOT NULL,
+    member_id BIGINT NOT NULL,
+    journal_json TEXT NOT NULL,
+    PRIMARY KEY (pipeline_id, member_id)
+);
+"""),
 ]
 
 
@@ -354,6 +371,69 @@ class _SqlStoreBase(PipelineStore, abc.ABC):
             "ON CONFLICT (pipeline_id) DO UPDATE SET "
             "journal_json = excluded.journal_json",
             (self.pipeline_id, json.dumps(journal)))
+
+    # -- fleet spec / actuation journals -------------------------------------
+    # Read-through like the autoscale journal: the spec is rewritten by
+    # the OPERATOR (API process) and the journals by the COORDINATOR,
+    # both underneath whoever reads next — a hard-killed coordinator's
+    # successor must see the latest persisted decision, never a
+    # connect-time snapshot. `pipeline_id` here is the FLEET id (the
+    # coordinator opens the store with it); `member_id` is the managed
+    # pipeline's id.
+
+    async def get_fleet_spec(self) -> dict | None:
+        rows = await self._run(
+            "SELECT spec_json FROM etl_fleet_spec "
+            "WHERE pipeline_id = ?", (self.pipeline_id,))
+        return json.loads(rows[0][0]) if rows else None
+
+    async def update_fleet_spec(self, spec: dict) -> None:
+        cur = await self.get_fleet_spec()
+        if cur is not None and int(spec.get("spec_version", 0)) \
+                < int(cur.get("spec_version", 0)):
+            raise EtlError(
+                ErrorKind.PROGRESS_REGRESSION,
+                f"fleet spec version regression: {cur.get('spec_version')} "
+                f"-> {spec.get('spec_version')}")
+        failpoints.fail_point(failpoints.STORE_FLEET_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_FLEET_COMMIT)
+        await self._run(
+            "INSERT INTO etl_fleet_spec "
+            "(pipeline_id, spec_json) VALUES (?, ?) "
+            "ON CONFLICT (pipeline_id) DO UPDATE SET "
+            "spec_json = excluded.spec_json",
+            (self.pipeline_id, json.dumps(spec)))
+
+    async def get_fleet_journal(self, pipeline_id: int) -> dict | None:
+        rows = await self._run(
+            "SELECT journal_json FROM etl_fleet_journal "
+            "WHERE pipeline_id = ? AND member_id = ?",
+            (self.pipeline_id, int(pipeline_id)))
+        return json.loads(rows[0][0]) if rows else None
+
+    async def get_fleet_journals(self) -> dict[int, dict]:
+        rows = await self._run(
+            "SELECT member_id, journal_json FROM etl_fleet_journal "
+            "WHERE pipeline_id = ?", (self.pipeline_id,))
+        return {int(mid): json.loads(raw) for mid, raw in rows}
+
+    async def update_fleet_journal(self, pipeline_id: int,
+                                   journal: dict) -> None:
+        cur = await self.get_fleet_journal(pipeline_id)
+        if cur is not None and int(journal.get("next_id", 0)) \
+                < int(cur.get("next_id", 0)):
+            raise EtlError(
+                ErrorKind.PROGRESS_REGRESSION,
+                f"fleet journal id regression for pipeline {pipeline_id}: "
+                f"{cur.get('next_id')} -> {journal.get('next_id')}")
+        failpoints.fail_point(failpoints.STORE_FLEET_COMMIT)
+        await failpoints.stall_point(failpoints.STORE_FLEET_COMMIT)
+        await self._run(
+            "INSERT INTO etl_fleet_journal "
+            "(pipeline_id, member_id, journal_json) VALUES (?, ?, ?) "
+            "ON CONFLICT (pipeline_id, member_id) DO UPDATE SET "
+            "journal_json = excluded.journal_json",
+            (self.pipeline_id, int(pipeline_id), json.dumps(journal)))
 
     # -- dead-letter / quarantine surface ------------------------------------
     # Read-THROUGH like the shard assignment, not cache-first: the
@@ -645,6 +725,7 @@ import functools
 STORE_TABLE_NAMES = ("etl_replication_state", "etl_table_schemas",
                      "etl_table_mappings", "etl_replication_progress",
                      "etl_shard_assignment", "etl_autoscale_journal",
+                     "etl_fleet_spec", "etl_fleet_journal",
                      "etl_dead_letter", "etl_quarantine")
 
 _QUALIFY_RE = re.compile(r"\b(" + "|".join(STORE_TABLE_NAMES) + r")\b")

@@ -190,6 +190,21 @@ ETL_AUTOSCALE_DECISIONS_TOTAL = "etl_autoscale_decisions_total"
 ETL_AUTOSCALE_HOLDS_TOTAL = "etl_autoscale_holds_total"
 ETL_AUTOSCALE_DECISION_IN_FLIGHT = "etl_autoscale_decision_in_flight"
 ETL_AUTOSCALE_RESUMES_TOTAL = "etl_autoscale_resumes_total"
+# fleet reconciler (etl_tpu/fleet): desired-vs-observed pipeline counts
+# and total desired shards per tick, the spec version currently being
+# reconciled, applied actuations by verb (create/resize/delete), ticks
+# that held a pipeline because a pending journal record was in flight,
+# successor resumes by mode (settle = actuation had landed, journal-only;
+# redrive = crash before actuation, verb re-driven; abort = spec moved
+# on), and a 0/1 converged flag the /fleet endpoint surfaces
+ETL_FLEET_PIPELINES_DESIRED = "etl_fleet_pipelines_desired"
+ETL_FLEET_PIPELINES_OBSERVED = "etl_fleet_pipelines_observed"
+ETL_FLEET_SHARDS_DESIRED = "etl_fleet_shards_desired"
+ETL_FLEET_SPEC_VERSION = "etl_fleet_spec_version"
+ETL_FLEET_RECONCILE_ACTIONS_TOTAL = "etl_fleet_reconcile_actions_total"
+ETL_FLEET_RECONCILE_HOLDS_TOTAL = "etl_fleet_reconcile_holds_total"
+ETL_FLEET_RESUMES_TOTAL = "etl_fleet_resumes_total"
+ETL_FLEET_CONVERGED = "etl_fleet_converged"
 # supervision subsystem (etl_tpu/supervision): watchdog detections by
 # kind+component, cancel-and-restart escalations, the pipeline health
 # state (0 healthy / 1 degraded / 2 faulted), the oldest heartbeat age

@@ -495,6 +495,18 @@ class TestBenchSmoke:
         assert out["autoscale_deterministic"] is True
         assert out["autoscale_chaos_ok"] is True, out["autoscale_chaos"]
         assert out["autoscale_chaos"]["union_matches"] is True
+        # fleet converge gate (ISSUE 18): the 100-pipeline declarative
+        # reconcile — empty -> steady and through one add/remove/resize
+        # edit within the working-tick budget, every runtime actuation
+        # backed 1:1 by an applied journal record (zero
+        # double-actuations), and a deterministic actuation trace
+        assert out["fleet_ok"] is True, out["fleet_failures"]
+        assert out["fleet_converge_ticks"] <= \
+            out["fleet_converge_ticks_max"]
+        assert out["fleet_edit_converge_ticks"] <= \
+            out["fleet_converge_ticks_max"]
+        assert out["fleet_double_actuations"] == 0
+        assert out["fleet_deterministic"] is True
         # windowed-ack gate (ISSUE 14): the same deterministic backlog
         # through the default write window vs a forced window=1 run —
         # speedup above the floor, byte-identical delivery, the

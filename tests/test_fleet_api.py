@@ -1,0 +1,302 @@
+"""Fleet observability surface: the orchestrator list-pipelines
+primitive on all three implementations (base refusal, LocalOrchestrator
+process table, K8sOrchestrator StatefulSet inventory), the pod /health
+probe path feeding degraded reasons into `status()`, and the aggregated
+`/v1/fleet` endpoint."""
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from etl_tpu.api.app import OPENAPI_DOC, ApiState, build_app
+from etl_tpu.api.crypto import ConfigCipher, EncryptionKey
+from etl_tpu.api.orchestrator import (K8sOrchestrator, LocalOrchestrator,
+                                      Orchestrator, ReplicatorStatus)
+from etl_tpu.fleet import FleetSpec, PipelineSpec, TenantQuota
+from etl_tpu.models.errors import ErrorKind, EtlError
+from etl_tpu.store.memory import MemoryStore
+from etl_tpu.testing.fake_http import RecordingHttpServer
+
+
+class _MinimalOrchestrator(Orchestrator):
+    async def start_pipeline(self, spec):
+        pass
+
+    async def stop_pipeline(self, pipeline_id):
+        pass
+
+    async def status(self, pipeline_id):
+        return ReplicatorStatus(pipeline_id, "stopped")
+
+
+class _Proc:
+    def __init__(self, returncode=None):
+        self.returncode = returncode
+
+
+class TestListPipelines:
+    async def test_base_orchestrator_refuses_with_typed_error(self):
+        with pytest.raises(EtlError) as e:
+            await _MinimalOrchestrator().list_pipelines()
+        assert e.value.kind is ErrorKind.CONFIG_INVALID
+        assert "list-capable" in str(e.value)
+
+    async def test_local_counts_shard_keys_including_exited(self, tmp_path):
+        orch = LocalOrchestrator(str(tmp_path))
+        orch._procs = {1: _Proc(),
+                       (2, 0): _Proc(), (2, 1): _Proc(),
+                       # a crashed shard still COUNTS: presence is
+                       # registration — the reconciler must not
+                       # re-create over a crash-restart window
+                       (2, 2): _Proc(returncode=1)}
+        assert await orch.list_pipelines() == {1: 1, 2: 3}
+        assert await LocalOrchestrator(str(tmp_path)).list_pipelines() == {}
+
+    async def test_k8s_inventory_groups_shards_by_pipeline_label(self):
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            def responder(req):
+                if req.path.endswith("/statefulsets"):
+                    assert req.query.get("labelSelector") \
+                        == "app=etl-replicator"
+                    mk = lambda name, pid: {  # noqa: E731
+                        "metadata": {"name": name,
+                                     "labels": {"pipeline_id": str(pid)}}}
+                    return 200, {"items": [
+                        mk("etl-replicator-3", 3),
+                        mk("etl-replicator-4-s0", 4),
+                        mk("etl-replicator-4-s1", 4),
+                        # stale unsharded set caught mid-roll: the
+                        # per-shard sets win
+                        mk("etl-replicator-4", 4),
+                        # unparseable label: skipped, not fatal
+                        {"metadata": {"name": "etl-replicator-x",
+                                      "labels": {"pipeline_id": "nope"}}},
+                    ]}
+                return None
+
+            server.responders.append(responder)
+            orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
+            assert await orch.list_pipelines() == {3: 1, 4: 2}
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_k8s_list_error_is_typed_not_empty(self):
+        """An API-server failure must raise, never read as 'fleet is
+        empty' — an empty observation would make the reconciler
+        re-create every pipeline."""
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            server.responders.append(lambda req: (500, {"message": "boom"}))
+            orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
+            with pytest.raises(EtlError) as e:
+                await orch.list_pipelines()
+            assert e.value.kind is ErrorKind.DESTINATION_FAILED
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+
+def _k8s_health_responder(health_status=200, health_body=None):
+    """statefulset ready + one Running pod + scripted /health body."""
+
+    def responder(req):
+        if "/proxy/health" in req.path:
+            return health_status, health_body
+        if "/pods" in req.path:
+            return 200, {"items": [{
+                "metadata": {"name": "etl-replicator-9-0"},
+                "status": {"phase": "Running",
+                           "containerStatuses": [{"ready": True,
+                                                  "state": {}}]},
+            }]}
+        if req.path.endswith("/statefulsets/etl-replicator-9"):
+            return 200, {"status": {"readyReplicas": 1}}
+        if req.path.endswith("/statefulsets"):
+            return 200, {"items": []}  # unsharded (no -sN sets)
+        return None
+
+    return responder
+
+
+class TestPodHealthProbes:
+    async def test_degraded_health_surfaces_reasons(self):
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            server.responders.append(_k8s_health_responder(
+                200, {"status": "degraded",
+                      "reasons": {"apply_loop": "stalled 12s",
+                                  "slot_lag": "384MiB"}}))
+            orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
+            st = await orch.status(9)
+            assert st.state == "running"
+            assert st.reasons == ("apply_loop: stalled 12s",
+                                  "slot_lag: 384MiB")
+            assert st.detail.startswith("degraded: ")
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_faulted_health_fails_a_ready_pod(self):
+        """A pod can be k8s-Ready while its apply loop is faulted — the
+        probe sees what readiness cannot. 503 is a meaningful answer."""
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            server.responders.append(_k8s_health_responder(
+                503, {"status": "faulted", "fatal": "slot dropped"}))
+            orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
+            st = await orch.status(9)
+            assert st.state == "failed"
+            assert "slot dropped" in st.detail
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+    async def test_healthy_probe_and_probe_misses_stay_running(self):
+        server = RecordingHttpServer()
+        await server.start()
+        try:
+            responders = [_k8s_health_responder(200, {"status": "ok"})]
+            server.responders.append(lambda req: responders[-1](req))
+            orch = K8sOrchestrator(api_url=server.url(), namespace="etl")
+            assert (await orch.status(9)).state == "running"
+            # transport-level miss (proxy 404, no body): no evidence,
+            # k8s readiness stands
+            responders.append(_k8s_health_responder(404, None))
+            assert (await orch.status(9)).state == "running"
+            # unparseable body: same
+            responders.append(_k8s_health_responder(200, {"raw": "huh"}))
+            assert (await orch.status(9)).state == "running"
+            await orch.shutdown()
+        finally:
+            await server.stop()
+
+
+class _FleetStubOrchestrator(Orchestrator):
+    def __init__(self, observed, statuses):
+        self.observed = observed
+        self.statuses = statuses
+
+    async def start_pipeline(self, spec):
+        pass
+
+    async def stop_pipeline(self, pipeline_id):
+        pass
+
+    async def list_pipelines(self):
+        return dict(self.observed)
+
+    async def status(self, pipeline_id):
+        return self.statuses.get(
+            pipeline_id, ReplicatorStatus(pipeline_id, "stopped"))
+
+
+async def _fleet_client(tmp_path, fleet_store, orchestrator,
+                        fleet_lag_of=None):
+    state = ApiState(str(tmp_path / "api.db"),
+                     ConfigCipher(EncryptionKey.generate()),
+                     orchestrator, fleet_store=fleet_store,
+                     fleet_lag_of=fleet_lag_of)
+    client = TestClient(TestServer(build_app(state)))
+    await client.start_server()
+    return client
+
+
+class TestFleetEndpoint:
+    async def test_aggregated_fleet_view(self, tmp_path):
+        store = MemoryStore()
+        spec = FleetSpec(
+            spec_version=5,
+            pipelines=(
+                PipelineSpec(pipeline_id=1, tenant_id="acme",
+                             shard_count=2, profile="insert_heavy"),
+                PipelineSpec(pipeline_id=2, tenant_id="globex",
+                             shard_count=1, profile="tiny_txs"),
+                PipelineSpec(pipeline_id=3, tenant_id="acme",
+                             shard_count=1, profile="giant_tx"),
+            ),
+            quotas={"acme": TenantQuota(max_shards=3, slo_weight=2.0)})
+        await store.update_fleet_spec(spec.to_json())
+        orch = _FleetStubOrchestrator(
+            observed={1: 2, 2: 1, 7: 1},  # 3 missing, 7 is a stray
+            statuses={
+                1: ReplicatorStatus(1, "running"),
+                2: ReplicatorStatus(2, "running",
+                                    "degraded: slot_lag: 1GiB",
+                                    reasons=("slot_lag: 1GiB",)),
+                7: ReplicatorStatus(7, "running"),
+            })
+        lags = {1: 512, 2: 1 << 30, 3: None, 7: 0}
+
+        async def lag_of(pid):
+            return lags.get(pid)
+
+        client = await _fleet_client(tmp_path, store, orch, lag_of)
+        try:
+            doc = await (await client.get("/v1/fleet")).json()
+            assert doc["spec_version"] == 5
+            assert doc["converged"] is False  # 3 missing, 7 stray
+            assert doc["counts"] == {
+                "desired": 3, "observed": 3,
+                "by_state": {"running": 3, "stopped": 1}}
+            assert doc["degraded_reasons"] == {"slot_lag: 1GiB": 1}
+            assert doc["quotas"]["acme"]["max_shards"] == 3
+            rows = {p["pipeline_id"]: p for p in doc["pipelines"]}
+            assert set(rows) == {1, 2, 3, 7}
+            assert rows[1]["desired_shards"] == 2
+            assert rows[1]["observed_shards"] == 2
+            assert rows[1]["lag_bytes"] == 512
+            assert rows[2]["degraded_reasons"] == ["slot_lag: 1GiB"]
+            assert rows[3]["state"] == "stopped"
+            assert rows[3]["observed_shards"] == 0
+            assert rows[3]["tenant_id"] == "acme"
+            # the stray has no spec row: tenant/profile are null
+            assert rows[7]["tenant_id"] is None
+            assert rows[7]["desired_shards"] == 0
+        finally:
+            await client.close()
+
+    async def test_converged_fleet_and_no_store(self, tmp_path):
+        store = MemoryStore()
+        spec = FleetSpec(
+            spec_version=1,
+            pipelines=(PipelineSpec(pipeline_id=1, tenant_id="a"),))
+        await store.update_fleet_spec(spec.to_json())
+        orch = _FleetStubOrchestrator(
+            observed={1: 1},
+            statuses={1: ReplicatorStatus(1, "running")})
+        client = await _fleet_client(tmp_path, store, orch)
+        try:
+            doc = await (await client.get("/v1/fleet")).json()
+            assert doc["converged"] is True
+            assert doc["pipelines"][0]["lag_bytes"] is None  # no reader
+        finally:
+            await client.close()
+        # no fleet store wired: the endpoint answers (empty spec), it
+        # does not 500 — the console works on non-fleet deployments too
+        client = await _fleet_client(tmp_path, None, orch)
+        try:
+            doc = await (await client.get("/v1/fleet")).json()
+            assert doc["spec_version"] == 0
+            assert doc["converged"] is False  # stray pipeline 1
+        finally:
+            await client.close()
+
+    async def test_list_incapable_orchestrator_degrades_gracefully(
+            self, tmp_path):
+        client = await _fleet_client(tmp_path, MemoryStore(),
+                                     _MinimalOrchestrator())
+        try:
+            resp = await client.get("/v1/fleet")
+            assert resp.status == 200
+            doc = await resp.json()
+            assert doc["counts"]["observed"] == 0
+        finally:
+            await client.close()
+
+    def test_openapi_documents_the_route(self):
+        assert "/v1/fleet" in OPENAPI_DOC["paths"]

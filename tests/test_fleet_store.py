@@ -1,0 +1,212 @@
+"""Fleet control-plane persistence: the desired-state spec document and
+the per-pipeline actuation journals on every StateStore dialect —
+memory, sqlite (file-backed restart), and Postgres over the
+from-scratch wire client against the socket-level fake server — plus
+the version-regression refusals, the STORE_FLEET_COMMIT failpoint's
+crash-consistency (refused write mutates nothing), and the
+ShardScopedStore read-forward / write-refuse split."""
+
+import pytest
+
+from etl_tpu.config import PgConnectionConfig
+from etl_tpu.fleet import (ActuationJournal, FleetSpec, PipelineSpec,
+                           TenantQuota, VERB_CREATE)
+from etl_tpu.models.errors import ErrorKind, EtlError
+from etl_tpu.store.memory import MemoryStore
+from etl_tpu.store.sql import PostgresStore, SqliteStore
+
+
+def sample_spec(version: int = 1) -> FleetSpec:
+    return FleetSpec(
+        spec_version=version,
+        pipelines=(PipelineSpec(pipeline_id=1, tenant_id="acme",
+                                shard_count=2, profile="insert_heavy"),
+                   PipelineSpec(pipeline_id=2, tenant_id="globex",
+                                shard_count=4, profile="tiny_txs",
+                                destination="clickhouse",
+                                config={"flush_ms": 50})),
+        quotas={"acme": TenantQuota(max_shards=3, slo_weight=2.0)})
+
+
+def sample_journal() -> dict:
+    j = ActuationJournal()
+    j.open(spec_version=1, verb=VERB_CREATE, from_k=0, to_k=2)
+    return j.to_json()
+
+
+class FleetStoreEnv:
+    """One dialect's stores over shared backing storage: a second
+    `make()` models a coordinator-process restart."""
+
+    def __init__(self, dialect: str, tmp_path):
+        self.dialect = dialect
+        self.tmp_path = tmp_path
+        self._server = None
+        self._stores = []
+
+    async def make(self, pipeline_id: int = 1):
+        if self.dialect == "memory":
+            # memory has no cross-process story; restarts reuse it
+            if self._stores:
+                return self._stores[0]
+            s = MemoryStore()
+        elif self.dialect == "sqlite":
+            s = SqliteStore(self.tmp_path / "fleet.db", pipeline_id)
+            await s.connect()
+        else:
+            if self._server is None:
+                from etl_tpu.postgres.fake import FakeDatabase
+                from etl_tpu.testing.fake_pg_server import FakePgServer
+
+                self._server = FakePgServer(FakeDatabase())
+                await self._server.start()
+            s = PostgresStore(
+                PgConnectionConfig(host="127.0.0.1",
+                                   port=self._server.port,
+                                   name="postgres", username="etl"),
+                pipeline_id)
+            await s.connect()
+        self._stores.append(s)
+        return s
+
+    async def cleanup(self):
+        for s in self._stores:
+            try:
+                await s.close()
+            except Exception:
+                pass
+        if self._server is not None:
+            await self._server.stop()
+
+
+DIALECTS = ["memory", "sqlite", "postgres"]
+
+
+@pytest.mark.parametrize("dialect", DIALECTS)
+class TestFleetStoreDialects:
+    async def test_spec_round_trips_across_restart(self, dialect, tmp_path):
+        env = FleetStoreEnv(dialect, tmp_path)
+        try:
+            s1 = await env.make()
+            assert await s1.get_fleet_spec() is None
+            assert FleetSpec.from_json(await s1.get_fleet_spec()) \
+                == FleetSpec()
+            spec = sample_spec()
+            await s1.update_fleet_spec(spec.to_json())
+
+            s2 = await env.make()
+            back = FleetSpec.from_json(await s2.get_fleet_spec())
+            assert back == spec
+            assert back.quotas["acme"].slo_weight == 2.0
+            assert back.by_id()[2].config == {"flush_ms": 50}
+        finally:
+            await env.cleanup()
+
+    async def test_spec_version_regression_refused(self, dialect, tmp_path):
+        env = FleetStoreEnv(dialect, tmp_path)
+        try:
+            s = await env.make()
+            await s.update_fleet_spec(sample_spec(version=3).to_json())
+            with pytest.raises(EtlError) as e:
+                await s.update_fleet_spec(sample_spec(version=2).to_json())
+            assert e.value.kind is ErrorKind.PROGRESS_REGRESSION
+            # the stored document is untouched by the refused write
+            kept = FleetSpec.from_json(await s.get_fleet_spec())
+            assert kept.spec_version == 3
+            # same-version rewrite is an idempotent retry, not a
+            # regression — a coordinator may repeat a write it cannot
+            # prove landed
+            await s.update_fleet_spec(sample_spec(version=3).to_json())
+        finally:
+            await env.cleanup()
+
+    async def test_journal_round_trip_and_id_regression(self, dialect,
+                                                        tmp_path):
+        env = FleetStoreEnv(dialect, tmp_path)
+        try:
+            s1 = await env.make()
+            assert await s1.get_fleet_journal(7) is None
+            assert await s1.get_fleet_journals() == {}
+            await s1.update_fleet_journal(7, sample_journal())
+            await s1.update_fleet_journal(9, sample_journal())
+
+            s2 = await env.make()
+            back = ActuationJournal.from_json(await s2.get_fleet_journal(7))
+            assert back.pending() is not None
+            assert back.pending().verb == VERB_CREATE
+            assert set((await s2.get_fleet_journals()).keys()) == {7, 9}
+            # next_id moving backwards = a stale coordinator's write
+            with pytest.raises(EtlError) as e:
+                await s2.update_fleet_journal(7, {"next_id": 1,
+                                                  "entries": []})
+            assert e.value.kind is ErrorKind.PROGRESS_REGRESSION
+        finally:
+            await env.cleanup()
+
+
+class TestFleetCommitFailpoint:
+    async def test_refused_spec_write_mutates_nothing(self):
+        from etl_tpu.chaos import failpoints
+
+        store = MemoryStore()
+
+        def boom():
+            raise EtlError(ErrorKind.STATE_STORE_FAILED, "chaos")
+
+        failpoints.arm(failpoints.STORE_FLEET_COMMIT, boom)
+        try:
+            with pytest.raises(EtlError):
+                await store.update_fleet_spec(sample_spec().to_json())
+            assert await store.get_fleet_spec() is None
+        finally:
+            failpoints.disarm_all()
+
+    async def test_refused_journal_write_mutates_nothing(self):
+        from etl_tpu.chaos import failpoints
+
+        store = MemoryStore()
+        await store.update_fleet_journal(3, sample_journal())
+
+        def boom():
+            raise EtlError(ErrorKind.STATE_STORE_FAILED, "chaos")
+
+        failpoints.arm(failpoints.STORE_FLEET_COMMIT, boom)
+        try:
+            with pytest.raises(EtlError):
+                await store.update_fleet_journal(3, {"next_id": 5,
+                                                     "entries": []})
+        finally:
+            failpoints.disarm_all()
+        # the journal the coordinator reads back is the pre-crash one
+        kept = ActuationJournal.from_json(await store.get_fleet_journal(3))
+        assert kept.next_id == sample_journal()["next_id"]
+
+    async def test_site_is_registered_for_chaos_runs(self):
+        from etl_tpu.chaos import failpoints
+
+        assert failpoints.STORE_FLEET_COMMIT in failpoints.CHAOS_SITES
+        assert failpoints.STORE_FLEET_COMMIT in failpoints.ASYNC_STALL_SITES
+
+
+class TestShardScopedFleetSurface:
+    async def test_reads_forward_and_writes_refuse(self):
+        from etl_tpu.sharding.runtime import ShardIdentity, ShardScopedStore
+
+        store = MemoryStore()
+        scoped = ShardScopedStore(store, ShardIdentity(1, 0, 2, 0))
+        await store.update_fleet_spec(sample_spec().to_json())
+        await store.update_fleet_journal(1, sample_journal())
+
+        # a pod may inspect the fleet's desired state...
+        spec = FleetSpec.from_json(await scoped.get_fleet_spec())
+        assert spec.spec_version == 1
+        assert (await scoped.get_fleet_journal(1))["next_id"] == 2
+        assert set((await scoped.get_fleet_journals()).keys()) == {1}
+
+        # ...but only the coordinator, on the RAW store, may move it
+        with pytest.raises(EtlError) as e:
+            await scoped.update_fleet_spec(sample_spec(version=2).to_json())
+        assert e.value.kind is ErrorKind.SHARD_NOT_OWNED
+        with pytest.raises(EtlError) as e:
+            await scoped.update_fleet_journal(1, sample_journal())
+        assert e.value.kind is ErrorKind.SHARD_NOT_OWNED
