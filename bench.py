@@ -689,6 +689,30 @@ def run_smoke() -> dict:
     dlq_chaos = asyncio.run(run_dlq_poison(seed=7))
     poison_ok = not poison_failures and dlq_chaos.ok
 
+    # exactly-once gates (ISSUE 19): (a) the bench A/B — the same seeded
+    # backlog drained through the plain memory sink and through the
+    # transactional sink (dedup tokens derived from WAL coordinates on
+    # every committed write); the transactional rate must hold ≥
+    # exactly_once_ratio_floor of the plain rate, and the hard-kill
+    # restart leg must deliver exactly once with the re-streamed prefix
+    # bounded by the unacked suffix (recovery anchors on the sink's own
+    # high-water mark, not on blind durable progress); (b) the hard-kill
+    # chaos matrix — kills at mid-write, post-write-pre-progress-commit
+    # and mid-recovery windows, asserting dup==0, zero loss, and
+    # monotone sink high-water marks
+    eo = asyncio.run(harness.run_exactly_once(
+        n_events=floors.get("exactly_once_smoke_events", 3_000)))
+    eo_floor = floors.get("exactly_once_ratio_floor", 0.8)
+    eo_failures = list(eo["failures"])
+    if eo["exactly_once_overhead_ratio"] < eo_floor:
+        eo_failures.append(
+            f"transactional throughput ratio "
+            f"{eo['exactly_once_overhead_ratio']} under floor {eo_floor}")
+    from etl_tpu.chaos.exactly_once import run_exactly_once_crash
+
+    eo_chaos = asyncio.run(run_exactly_once_crash(seed=7))
+    eo_ok = not eo_failures and eo_chaos.ok
+
     # multi-pipeline tenancy gate (ISSUE 8): ≥2 concurrent streams
     # sharing one device set through the fair batch-admission scheduler,
     # every stream's end state verified, aggregate events/s above the
@@ -785,7 +809,19 @@ def run_smoke() -> dict:
                    and sharded_chaos_ok and sharded_ok
                    and selectivity_ok and coldstart_ok
                    and autoscale_ok and fleet_ok and ack_ok
-                   and poison_ok),
+                   and poison_ok and eo_ok),
+        "exactly_once_ok": bool(eo_ok),
+        "exactly_once_overhead_ratio": eo["exactly_once_overhead_ratio"],
+        "exactly_once_ratio_floor": eo_floor,
+        "exactly_once_restart_duplicates":
+            eo["restart"]["duplicate_rows"],
+        "exactly_once_restart_restreamed_deduped":
+            eo["restart"]["restreamed_deduped_rows"],
+        "exactly_once_restart_unacked_suffix":
+            eo["restart"]["unacked_suffix_rows"],
+        "exactly_once_failures": eo_failures,
+        "exactly_once_chaos_ok": bool(eo_chaos.ok),
+        "exactly_once_chaos": eo_chaos.describe(),
         "poison_ok": bool(poison_ok),
         "poison_throughput_ratio": poison["poison_throughput_ratio"],
         "poison_ratio_floor": poison_floor,
@@ -1076,6 +1112,18 @@ def main():
                         help="row ops per measured poison pass "
                              "(default: poison_smoke_ops from "
                              "BENCH_FLOOR.json)")
+    parser.add_argument("--exactly-once", dest="exactly_once",
+                        action="store_true",
+                        help="exactly-once mode: the same seeded CDC "
+                             "backlog drained through the plain memory "
+                             "sink and the transactional sink (dedup "
+                             "tokens keyed by WAL coordinates), plus a "
+                             "hard-kill restart leg; gates the "
+                             "transactional rate >= "
+                             "exactly_once_ratio_floor x the plain "
+                             "rate, zero duplicate rows after restart, "
+                             "zero loss, and re-streamed-then-deduped "
+                             "rows <= the unacked suffix at the kill")
     parser.add_argument("--workload", default=None, metavar="PROFILE",
                         help="workload matrix mode: run the named workload "
                              "profile (etl_tpu/workloads; 'all' = every "
@@ -1194,6 +1242,31 @@ def main():
             out["failures"].append(
                 f"poisoned throughput ratio "
                 f"{out['poison_throughput_ratio']} under floor {floor}")
+            out["ok"] = False
+        print(json.dumps(out))
+        sys.exit(0 if out["ok"] else 1)
+    if args.exactly_once:
+        # full pipeline on the host CPU platform (fake walsender, plain
+        # vs transactional memory destination, one hard-kill restart) —
+        # the commit-coordination seam is the system under test; never
+        # touches the tunnel
+        import asyncio
+
+        jax.config.update("jax_platforms", "cpu")
+        from etl_tpu.benchmarks import harness
+
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_FLOOR.json")) as f:
+            floors = json.load(f)
+        out = asyncio.run(harness.run_exactly_once(
+            n_events=floors.get("exactly_once_smoke_events", 3_000)))
+        floor = floors.get("exactly_once_ratio_floor", 0.8)
+        out["ratio_floor"] = floor
+        if out["exactly_once_overhead_ratio"] < floor:
+            out["failures"].append(
+                f"transactional throughput ratio "
+                f"{out['exactly_once_overhead_ratio']} under floor "
+                f"{floor}")
             out["ok"] = False
         print(json.dumps(out))
         sys.exit(0 if out["ok"] else 1)

@@ -124,6 +124,29 @@ def flush_path(fn: _F) -> _F:
     return fn
 
 
+#: attribute set by @transactional_commit (runtime-introspectable, same
+#: lexical matching caveat as HOT_LOOP_ATTR)
+TRANSACTIONAL_COMMIT_ATTR = "__etl_transactional_commit__"
+
+
+def transactional_commit(fn: _F) -> _F:
+    """Mark `fn` as a transactional-commit write path (docs/destinations.md
+    exactly-once contract): a destination entry point that must record the
+    acked WAL coordinate range ATOMICALLY alongside the data it ships —
+    BigQuery `_CHANGE_SEQUENCE_NUMBER` keys, ClickHouse insert-dedup
+    tokens, Iceberg/lake snapshot properties, Snowpipe offset tokens.
+    etl-lint's `uncoordinated-transactional-write` rule flags any
+    destination write call inside a marked frame that ships data WITHOUT
+    its `CommitRange` — an uncoordinated write silently downgrades the
+    sink to at-least-once (a restart cannot see what that write covered,
+    so it re-streams and duplicates), which is exactly the hole the
+    transactional protocol closes. Ship through the `*_committed` seam or
+    pass the range explicitly; justify a deliberate at-least-once escape
+    with an inline ignore."""
+    setattr(fn, TRANSACTIONAL_COMMIT_ATTR, True)
+    return fn
+
+
 def dispatch_stage(fn: _F) -> _F:
     """Mark `fn` as the decode pipeline's DISPATCH stage (ops/pipeline.py
     architecture): a hot-loop function whose job is to start device work,

@@ -964,6 +964,87 @@ class SecretInLog(Rule):
                     f"(\"password=[set]\"), never the secret itself")
 
 
+# -- rule 20 ------------------------------------------------------------------
+
+#: CDC/copy write entry points that land data WITHOUT coordinates when
+#: called from a transactional-commit seam; the `*_committed` variants
+#: carry their range and are always fine
+UNCOORDINATED_WRITE_FNS = frozenset({
+    "write_events", "write_event_batches", "write_table_rows",
+    "write_table_batch",
+})
+
+
+class UncoordinatedTransactionalWrite(Rule):
+    """A `@transactional_commit` function (the exactly-once seam,
+    docs/destinations.md) that performs a CDC write while NEVER
+    consulting its commit-range parameter: the data lands but the WAL
+    coordinate range is never recorded with it, so a crash-restart
+    cannot recover the sink's high-water mark and the destination
+    silently degrades to at-least-once while still ADVERTISING
+    `supports_transactional_commit()` — the worst of both (the apply
+    loop trusts the seam, recovery trusts the marker). Every committed
+    write path must derive its dedup token / MERGE key / snapshot
+    property / offset from the `commit` argument (or explicitly forward
+    it to an inner `*_committed` call); a deliberate pass-through (e.g.
+    offset-token sinks whose plain path already carries coordinates)
+    justifies itself by touching `commit` to decide, or with an inline
+    ignore. Whole-function and lexical: nested defs/lambdas (retried
+    write closures) belong to the marked function's body."""
+
+    name = "uncoordinated-transactional-write"
+
+    @staticmethod
+    def _commit_param(node) -> "str | None":
+        args = node.args
+        params = [a.arg for a in args.posonlyargs + args.args
+                  + args.kwonlyargs]
+        if "commit" in params:
+            return "commit"
+        # the base seam signature is (self, events, commit)
+        if len(params) > 2 and params[0] in ("self", "cls"):
+            return params[2]
+        if len(params) > 1 and params[0] not in ("self", "cls"):
+            return params[1]
+        return None
+
+    def on_function(self, ctx: LintContext, node) -> None:
+        from .visitor import TRANSACTIONAL_COMMIT_DECORATORS
+
+        if ctx.in_transactional_commit:
+            return  # a nested def: the enclosing marked frame's analysis
+            # already covered this body
+        decorators = {terminal_name(d.func if isinstance(d, ast.Call)
+                                    else d)
+                      for d in node.decorator_list}
+        if not (decorators & TRANSACTIONAL_COMMIT_DECORATORS):
+            return
+        commit = self._commit_param(node)
+        consulted = commit is not None and any(
+            isinstance(n, ast.Name) and n.id == commit
+            and isinstance(n.ctx, ast.Load)
+            for stmt in node.body for n in ast.walk(stmt))
+        if consulted:
+            return
+        for stmt in node.body:
+            for n in ast.walk(stmt):
+                if not isinstance(n, ast.Call):
+                    continue
+                term = terminal_name(n.func)
+                if term not in UNCOORDINATED_WRITE_FNS:
+                    continue
+                ctx.report(
+                    self.name, n, f"{term}()",
+                    f"`{term}()` inside a @transactional_commit function "
+                    f"that never consults its commit-range parameter"
+                    f"{f' `{commit}`' if commit else ''}: the data lands "
+                    f"without its WAL coordinates, so recovery cannot "
+                    f"rebuild the high-water mark — derive the dedup "
+                    f"token / commit marker from the commit range, or "
+                    f"justify a deliberate pass-through with an inline "
+                    f"ignore")
+
+
 # -- entry points -------------------------------------------------------------
 
 def default_rules() -> list[Rule]:
@@ -983,6 +1064,7 @@ def default_rules() -> list[Rule]:
         InlineDurabilityWait(),
         UnclassifiedDestinationError(),
         SecretInLog(),
+        UncoordinatedTransactionalWrite(),
     ]
 
 

@@ -158,6 +158,18 @@ SHARD_SCOPED_DECORATORS = frozenset({"shard_scoped"})
 #: inherited by nested defs/lambdas (the flush submit closures).
 FLUSH_PATH_DECORATORS = frozenset({"flush_path"})
 
+#: decorator marking transactional-commit destination entry points
+#: (annotations.transactional_commit): the seam through which a
+#: destination atomically records the acked WAL coordinate range
+#: alongside the data (docs/destinations.md). The
+#: uncoordinated-transactional-write rule requires a marked function
+#: that performs CDC writes to consult its commit-range parameter —
+#: data landing without its coordinates silently downgrades the sink
+#: to at-least-once. Same sanctioning machinery as @dispatch_stage: a
+#: lexical frame flag inherited by nested defs/lambdas (the retried
+#: write closures).
+TRANSACTIONAL_COMMIT_DECORATORS = frozenset({"transactional_commit"})
+
 #: decorator marking the autoscaling control loop's decision path
 #: (annotations.control_loop): the control-loop-blocking-io rule forbids
 #: blocking I/O and ALL device traffic there — the policy must stay a
@@ -251,12 +263,12 @@ class Rule:
 class _Frame:
     __slots__ = ("name", "is_async", "is_hot", "is_dispatch",
                  "is_admission", "is_shard_scoped", "is_control",
-                 "is_flush")
+                 "is_flush", "is_transactional")
 
     def __init__(self, name: str, is_async: bool, is_hot: bool,
                  is_dispatch: bool = False, is_admission: bool = False,
                  is_shard_scoped: bool = False, is_control: bool = False,
-                 is_flush: bool = False):
+                 is_flush: bool = False, is_transactional: bool = False):
         self.name = name
         self.is_async = is_async
         self.is_hot = is_hot
@@ -265,6 +277,7 @@ class _Frame:
         self.is_shard_scoped = is_shard_scoped
         self.is_control = is_control
         self.is_flush = is_flush
+        self.is_transactional = is_transactional
 
 
 class LintContext(ast.NodeVisitor):
@@ -312,6 +325,10 @@ class LintContext(ast.NodeVisitor):
     @property
     def in_flush_path(self) -> bool:
         return bool(self._frames) and self._frames[-1].is_flush
+
+    @property
+    def in_transactional_commit(self) -> bool:
+        return bool(self._frames) and self._frames[-1].is_transactional
 
     @property
     def current_class(self) -> "str | None":
@@ -368,6 +385,9 @@ class LintContext(ast.NodeVisitor):
             or self.in_control_loop
         is_flush = bool(decorators & FLUSH_PATH_DECORATORS) \
             or self.in_flush_path
+        is_transactional = bool(
+            decorators & TRANSACTIONAL_COMMIT_DECORATORS) \
+            or self.in_transactional_commit
         for rule in self.rules:
             rule.on_function(self, node)
         # decorators, default args, and annotations execute ONCE at def
@@ -384,7 +404,7 @@ class LintContext(ast.NodeVisitor):
             self._frames.append(_Frame(node.name, is_async, is_hot,
                                        is_dispatch, is_admission,
                                        is_shard_scoped, is_control,
-                                       is_flush))
+                                       is_flush, is_transactional))
             try:
                 for stmt in node.body:
                     self.visit(stmt)

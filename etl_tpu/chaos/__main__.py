@@ -89,6 +89,18 @@ def main(argv: list[str] | None = None) -> int:
                              "restore exact truth idempotently; (2) a "
                              "hard kill mid-bisection must reconverge "
                              "within the dup budget after restart")
+    parser.add_argument("--exactly-once", dest="exactly_once",
+                        action="store_true",
+                        help="run the exactly-once hard-kill matrix "
+                             "instead of the corpus: CDC flows into a "
+                             "transactional sink that records the acked "
+                             "WAL coordinate range atomically with the "
+                             "data, the pipeline is hard-killed at "
+                             "mid-write, post-write-pre-progress-commit, "
+                             "and mid-recovery windows, and every "
+                             "restart must recover the sink high-water "
+                             "mark and converge with duplication == 0, "
+                             "zero-loss, and a monotone high-water mark")
     parser.add_argument("--fleet", dest="fleet", action="store_true",
                         help="run the fleet reconciliation scenario "
                              "instead of the corpus: a 100-pipeline "
@@ -126,6 +138,20 @@ def main(argv: list[str] | None = None) -> int:
         for s in SCENARIOS + WORKLOAD_MATRIX:
             print(f"{s.name}: {s.description}")
         return 0
+
+    if args.exactly_once:
+        if args.matrix or args.workload or args.scenario or args.sharded \
+                or args.autoscale or args.multi_pipeline \
+                or args.ack_window or args.dlq or args.fleet:
+            parser.error("--exactly-once runs its own hard-kill matrix "
+                         "and cannot be combined with --matrix/"
+                         "--workload/--scenario/--sharded/--autoscale/"
+                         "--multi-pipeline/--ack-window/--dlq/--fleet")
+        from .exactly_once import run_exactly_once_crash
+
+        run = asyncio.run(run_exactly_once_crash(seed=args.seed))
+        print(json.dumps(run.describe(), sort_keys=True))
+        return 0 if run.ok else 1
 
     if args.fleet:
         if args.matrix or args.workload or args.scenario or args.sharded \

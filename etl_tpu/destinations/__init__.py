@@ -1,7 +1,9 @@
 """Destination implementations."""
 
-from .base import Destination, WriteAck, expand_batch_events
+from .base import (CommitRange, Destination, WriteAck, event_coordinate,
+                   expand_batch_events)
 from .delay import DelayedAckDestination
 from .memory import (FaultAction, FaultInjectingDestination, FaultKind,
-                     MemoryDestination, PoisonRejectingDestination)
+                     MemoryDestination, PoisonRejectingDestination,
+                     TransactionalMemoryDestination)
 from .registry import build_destination

@@ -72,6 +72,71 @@ class WriteAck:
         await asyncio.shield(self._fut)
 
 
+@dataclass(frozen=True, slots=True)
+class CommitRange:
+    """The WAL coordinate range one transactional write covers.
+
+    `high` is the lexicographic max `(commit_lsn, tx_ordinal)` across the
+    rows shipped — the per-row dedup key the sinks already speak
+    (`EventSequenceKey`, `offset_token_batch`, the DLQ's identity).
+    `commit_end_lsn` is the commit watermark the flush may claim for
+    durable progress (the ack window's `covered`); None for a
+    mid-transaction prefix flush, whose rows still dedup by `high`.
+    `replay` marks a DLQ re-delivery: the sink dedups those by EXACT row
+    key (MERGE semantics) and must NOT advance its streaming high-water
+    mark — replayed rows sit below it by construction (they were parked,
+    not delivered, while the stream moved on)."""
+
+    high: "tuple[int, int]"
+    commit_end_lsn: "int | None" = None
+    replay: bool = False
+
+    def token(self) -> str:
+        """Wire token for sinks that record the range as an opaque string
+        (ClickHouse insert-dedup ids, Snowpipe offsets): same hex shape
+        as `EventSequenceKey.offset_token`."""
+        return f"{self.high[0]:016x}/{self.high[1]:016x}"
+
+    @classmethod
+    def from_events(cls, events: "Iterable[Event]",
+                    commit_end_lsn: "Lsn | int | None" = None,
+                    replay: bool = False) -> "CommitRange | None":
+        """Derive the covered range from a WAL-ordered flush payload.
+        Returns None when nothing in `events` carries row coordinates
+        (schema/relation-only flushes have nothing to dedup)."""
+        high: "tuple[int, int] | None" = None
+        for e in events:
+            if isinstance(e, DecodedBatchEvent):
+                if len(e.commit_lsns) == 0:
+                    continue
+                lsns = np.asarray(e.commit_lsns, dtype=np.uint64)
+                ords = np.asarray(e.tx_ordinals, dtype=np.uint64)
+                top = int(lsns.max())
+                cand = (top, int(ords[lsns == top].max()))
+            else:
+                lsn = getattr(e, "commit_lsn", None)
+                ordinal = getattr(e, "tx_ordinal", None)
+                if lsn is None or ordinal is None:
+                    continue
+                cand = (int(lsn), int(ordinal))
+            if high is None or cand > high:
+                high = cand
+        if high is None:
+            return None
+        end = int(commit_end_lsn) if commit_end_lsn is not None else None
+        return cls(high=high, commit_end_lsn=end, replay=replay)
+
+
+def event_coordinate(e: Event) -> "tuple[int, int] | None":
+    """The `(commit_lsn, tx_ordinal)` identity of one row-granular event,
+    None for controls without row identity (Begin/Commit/Relation)."""
+    lsn = getattr(e, "commit_lsn", None)
+    ordinal = getattr(e, "tx_ordinal", None)
+    if lsn is None or ordinal is None:
+        return None
+    return (int(lsn), int(ordinal))
+
+
 class Destination(abc.ABC):
     """Where decoded rows and CDC events land. Implementations must be
     idempotent under at-least-once delivery (SURVEY §5 checkpoint/resume)."""
@@ -116,6 +181,48 @@ class Destination(abc.ABC):
         legacy `write_events` path unchanged (destinations there expand
         batches to per-row events themselves — the compatibility shim)."""
         return await self.write_events(events)
+
+    # -- transactional commit seam (ROADMAP item 1, exactly-once) -------------
+    #
+    # A destination that can record the acked WAL coordinate range
+    # ATOMICALLY alongside the data opts in by returning True from the
+    # capability probe and overriding the two methods below. The apply
+    # loop then ships every CDC flush through
+    # `write_event_batches_committed` with its CommitRange, and restart
+    # recovery calls `recover_high_water` to trim the re-stream window to
+    # exactly the unacked suffix — hard-kill anywhere, dup budget == 0.
+    # Destinations that stay out keep today's at-least-once contract
+    # bit-for-bit: the defaults below never change behavior.
+
+    def supports_transactional_commit(self) -> bool:
+        """Capability probe. True = this destination atomically persists
+        each write's CommitRange with the data, dedups re-delivered rows
+        by coordinate, and can answer `recover_high_water` after a crash.
+        Wrappers delegate dynamically so the probe reflects the wrapped
+        sink, never the wrapper."""
+        return False
+
+    async def write_event_batches_committed(
+            self, events: Sequence[Event],
+            commit: "CommitRange | None") -> WriteAck:
+        """CDC path, transactional seam: ship `events` AND record `commit`
+        in the same atomic unit (one MERGE / one insert with its dedup
+        token / one snapshot commit). Rows at coordinates ≤ the sink's
+        recorded high-water are duplicates of a blind re-stream and must
+        not double-apply; `commit.replay` ranges dedup by exact row key
+        instead (DLQ re-delivery). Default: the at-least-once compat shim
+        — destinations that don't opt in ignore the range."""
+        return await self.write_event_batches(events)
+
+    async def recover_high_water(self) -> "CommitRange | None":
+        """Restart recovery: the high-water CommitRange of the last
+        transactional write this sink made durable, None when the sink
+        has never committed one (fresh sink, or a non-transactional
+        destination). Must be read-only and idempotent — recovery may be
+        killed and re-run mid-query. Failures must surface as typed
+        EtlErrors; the caller retries and degrades to a blind re-stream
+        (sink-side dedup still holds the exactly-once invariant)."""
+        return None
 
     @abc.abstractmethod
     async def drop_table(self, table_id: TableId,

@@ -49,8 +49,9 @@ from ..models.pgtypes import CellKind
 from ..models.schema import (ReplicatedTableSchema, SchemaDiff, TableId)
 from ..models.table_row import ColumnarBatch, TableRow
 from ..models.default_expression import column_default_sql
+from ..analysis.annotations import transactional_commit
 from . import bq_proto
-from .base import Destination, WriteAck, expand_batch_events
+from .base import CommitRange, Destination, WriteAck, expand_batch_events
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN,
                    DestinationRetryPolicy, TaskSet, change_type_label,
                    classify_http_error, count_egress_write,
@@ -142,6 +143,8 @@ class BigQueryDestination(Destination):
         self._created: dict[TableId, ReplicatedTableSchema] = {}
         self._names: dict[TableId, str] = {}
         self._append_sem: asyncio.Semaphore | None = None
+        self._marker_ready = False
+        self._marker_lock = asyncio.Lock()
 
     # -- REST transport ----------------------------------------------------------
 
@@ -356,6 +359,107 @@ class BigQueryDestination(Destination):
 
         self._tasks.spawn(execute())
         return ack
+
+    # -- transactional seam (docs/destinations.md exactly-once contract) ------
+    #
+    # BigQuery's CDC tables already MERGE on `_CHANGE_SEQUENCE_NUMBER`
+    # (commit_lsn/tx_ordinal/ordinal), so a re-streamed duplicate row
+    # collapses at query time; what the seam ADDS is the recoverable
+    # coordinate record: a `_etl_commit_marker` table whose description
+    # metadata holds the acked high-water JSON, PATCHed only after the
+    # flush's storage-write appends are durable. Recovery reads it back
+    # through the same REST surface.
+
+    _COMMIT_MARKER = "_etl_commit_marker"
+    _MAX_REPLAY_TOKENS = 256
+
+    def supports_transactional_commit(self) -> bool:
+        return True
+
+    def _marker_path(self) -> str:
+        return f"{self._dataset_path()}/tables/{self._COMMIT_MARKER}"
+
+    async def _ensure_marker(self) -> None:
+        if self._marker_ready:
+            return
+        await self._api("POST", f"{self._dataset_path()}/tables", {
+            "tableReference": {"tableId": self._COMMIT_MARKER},
+            "schema": {"fields": [{"name": "unused", "type": "STRING"}]},
+        })  # 409 → alreadyExists: idempotent
+        self._marker_ready = True
+
+    async def _marker_state(self) -> dict:
+        doc = await self._api("GET", self._marker_path())
+        desc = doc.get("description") or ""
+        try:
+            state = json.loads(desc)
+        except ValueError:
+            state = {}
+        return state if isinstance(state, dict) else {}
+
+    async def _advance_marker(self, commit: CommitRange) -> None:
+        """Read-modify-write under the marker lock: concurrent in-flight
+        flushes finalize out of order, and the recorded high-water must
+        stay monotone regardless."""
+        async with self._marker_lock:
+            state = await self._marker_state()
+            if commit.replay:
+                tokens = list(state.get("replay_tokens", []))
+                if commit.token() not in tokens:
+                    tokens.append(commit.token())
+                state["replay_tokens"] = tokens[-self._MAX_REPLAY_TOKENS:]
+            else:
+                cur = state.get("high")
+                high = list(commit.high)
+                if cur is None or high > list(cur):
+                    state["high"] = high
+                    if commit.commit_end_lsn:
+                        state["commit_end_lsn"] = commit.commit_end_lsn
+            await self._api("PATCH", self._marker_path(),
+                            {"description": json.dumps(state,
+                                                       sort_keys=True)})
+
+    async def _finalize_commit(self, inner: "WriteAck | None",
+                               commit: CommitRange,
+                               fut: asyncio.Future) -> None:
+        try:
+            if inner is not None:
+                await inner.wait_durable()
+            await self._advance_marker(commit)
+            if not fut.done():
+                fut.set_result(None)
+        except BaseException as e:  # etl-lint: ignore[cancellation-swallow] — transferred to the ack future, not dropped
+            if not fut.done():
+                fut.set_exception(e)
+
+    @transactional_commit
+    async def write_event_batches_committed(
+            self, events: Sequence[Event], commit: CommitRange) -> WriteAck:
+        """Committed CDC write: the data program ships first (storage-
+        write appends, MERGE-keyed), then the WAL range PATCHes the
+        marker — the outer ack only resolves durable once BOTH landed.
+        A crash between them re-streams a flush the sequence-number
+        MERGE absorbs."""
+        await self._ensure_marker()
+        if commit.replay:
+            state = await self._marker_state()
+            if commit.token() in state.get("replay_tokens", []):
+                return WriteAck.durable()
+        inner = await self.write_event_batches(events)
+        # plain ack, not accepted(): the inner write already fired the
+        # DESTINATION_WRITE chaos site for this flush
+        fut = asyncio.get_event_loop().create_future()
+        self._tasks.spawn(self._finalize_commit(inner, commit, fut))
+        return WriteAck(fut)
+
+    async def recover_high_water(self) -> "CommitRange | None":
+        await self._ensure_marker()
+        state = await self._marker_state()
+        high = state.get("high")
+        if not high:
+            return None
+        return CommitRange(high=(int(high[0]), int(high[1])),
+                           commit_end_lsn=state.get("commit_end_lsn"))
 
     async def _append_encoded_and_resolve(self, table: str,
                                           schema: ReplicatedTableSchema,

@@ -36,7 +36,8 @@ from ..models.default_expression import column_default_sql
 from ..models.schema import (ReplicatedTableSchema, SchemaDiff, TableId,
                              TableName)
 from ..models.table_row import ColumnarBatch
-from .base import Destination, WriteAck
+from ..analysis.annotations import transactional_commit
+from .base import CommitRange, Destination, WriteAck
 from .base import expand_batch_events
 from .util import (CDC_DELETE, CDC_UPSERT, CHANGE_SEQUENCE_COLUMN,
                    CHANGE_TYPE_COLUMN, DestinationRetryPolicy,
@@ -428,6 +429,13 @@ class ClickHouseDestination(Destination):
         self._session: aiohttp.ClientSession | None = None
         self._created_tables: dict[TableId, ReplicatedTableSchema] = {}
         self._names: dict[TableId, str] = {}
+        # exactly-once seam state: `_dedup_token` is attached (suffixed
+        # with a per-INSERT ordinal) to every data INSERT issued inside
+        # one committed write, so a re-streamed duplicate flush is
+        # collapsed by ClickHouse's insert_deduplication_token window
+        self._dedup_token: str | None = None
+        self._dedup_seq = 0
+        self._commit_log_ready = False
 
     # -- http ------------------------------------------------------------------
 
@@ -435,6 +443,16 @@ class ClickHouseDestination(Destination):
         if self._session is None:
             self._session = aiohttp.ClientSession()
         params = {"database": self.config.database, "query": sql}
+        if self._dedup_token is not None and sql.startswith("INSERT INTO"):
+            # one token per INSERT within the committed write: identical
+            # token on two inserts into the SAME table would make
+            # ClickHouse silently drop the second block, so suffix with
+            # the (deterministic) per-call ordinal — a re-streamed
+            # duplicate flush replays the same program order and lands
+            # on the same tokens
+            params["insert_deduplication_token"] = \
+                f"{self._dedup_token}/{self._dedup_seq}"
+            self._dedup_seq += 1
 
         async def attempt() -> str:
             async with self._session.post(
@@ -571,6 +589,77 @@ class ClickHouseDestination(Destination):
             else:
                 await self._apply_schema_change(op[1])
         return WriteAck.durable()
+
+    # -- transactional seam (docs/destinations.md exactly-once contract) ------
+
+    _COMMIT_LOG = "_etl_commit_log"
+
+    def supports_transactional_commit(self) -> bool:
+        return True
+
+    async def _ensure_commit_log(self) -> None:
+        if self._commit_log_ready:
+            return
+        await self._execute(
+            f"CREATE TABLE IF NOT EXISTS "
+            f"`{self.config.database}`.`{self._COMMIT_LOG}` ("
+            f"token String, commit_lsn UInt64, tx_ordinal UInt64, "
+            f"commit_end_lsn UInt64, replay UInt8) "
+            f"ENGINE = ReplacingMergeTree ORDER BY (commit_lsn, "
+            f"tx_ordinal, token)")
+        self._commit_log_ready = True
+
+    @transactional_commit
+    async def write_event_batches_committed(
+            self, events: Sequence[Event], commit: CommitRange) -> WriteAck:
+        """Committed CDC write: every data INSERT carries an
+        `insert_deduplication_token` derived from the flush's WAL range
+        (ClickHouse collapses re-streamed duplicate blocks inside its
+        dedup window), and the range lands in `_etl_commit_log` AFTER
+        the data — recovery reads the log's maximum, so a crash between
+        data and log re-streams a flush the tokens then absorb."""
+        await self._ensure_commit_log()
+        if commit.replay:
+            # replay-mode: exact-token dedup against the log, never
+            # advancing the streaming high-water (replay rows sit BELOW
+            # it by construction)
+            seen = await self._execute(
+                f"SELECT count() FROM "
+                f"`{self.config.database}`.`{self._COMMIT_LOG}` "
+                f"WHERE token = '{commit.token()}' AND replay = 1 "
+                f"FORMAT TabSeparated")
+            if int(seen.strip() or 0):
+                return WriteAck.durable()
+        self._dedup_token = commit.token()
+        self._dedup_seq = 0
+        try:
+            ack = await self.write_event_batches(events)
+        finally:
+            self._dedup_token = None
+        lsn, ordinal = commit.high
+        await self._execute(
+            f"INSERT INTO `{self.config.database}`.`{self._COMMIT_LOG}` "
+            f"(token, commit_lsn, tx_ordinal, commit_end_lsn, replay) "
+            f"FORMAT TabSeparated",
+            f"{commit.token()}\t{lsn}\t{ordinal}\t"
+            f"{commit.commit_end_lsn or 0}\t"
+            f"{1 if commit.replay else 0}\n".encode())
+        return ack
+
+    async def recover_high_water(self) -> "CommitRange | None":
+        await self._ensure_commit_log()
+        text = await self._execute(
+            f"SELECT commit_lsn, tx_ordinal, commit_end_lsn FROM "
+            f"`{self.config.database}`.`{self._COMMIT_LOG}` "
+            f"WHERE replay = 0 "
+            f"ORDER BY commit_lsn DESC, tx_ordinal DESC LIMIT 1 "
+            f"FORMAT TabSeparated")
+        line = text.strip()
+        if not line:
+            return None
+        lsn, ordinal, end = (int(v) for v in line.split("\t"))
+        return CommitRange(high=(lsn, ordinal),
+                           commit_end_lsn=end or None)
 
     async def _insert_tsv(self, name: str, schema: ReplicatedTableSchema,
                           body: bytes) -> None:

@@ -99,6 +99,21 @@ class DelayedAckDestination(Destination):
         return await self._delayed(
             await self.inner.write_event_batches(events))
 
+    # transactional seam: the inner sink commits data + coordinate range
+    # immediately, only the ACK is delayed — exactly the crash window the
+    # exactly-once chaos matrix kills inside (sink has the range, the
+    # pipeline never saw the ack, recovery must not double-apply)
+    def supports_transactional_commit(self) -> bool:
+        return self.inner.supports_transactional_commit()
+
+    async def write_event_batches_committed(self, events: Sequence,
+                                            commit) -> WriteAck:
+        return await self._delayed(
+            await self.inner.write_event_batches_committed(events, commit))
+
+    async def recover_high_water(self):
+        return await self.inner.recover_high_water()
+
     async def drop_table(self, table_id, schema=None) -> None:
         await self.inner.drop_table(table_id, schema)
 

@@ -35,13 +35,13 @@ import aiohttp
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from ..analysis.annotations import hot_loop
+from ..analysis.annotations import hot_loop, transactional_commit
 from ..models.errors import ErrorKind, EtlError
 from ..models.event import ChangeType, DeleteEvent, Event
 from ..models.pgtypes import CellKind
 from ..models.schema import ReplicatedTableSchema, TableId
 from ..models.table_row import ColumnarBatch
-from .base import Destination, WriteAck, expand_batch_events
+from .base import CommitRange, Destination, WriteAck, expand_batch_events
 from .iceberg_meta import (DataFileInfo, build_snapshot, data_file_stats,
                            new_snapshot_id, write_manifest,
                            write_manifest_list)
@@ -106,6 +106,10 @@ class IcebergDestination(Destination):
         self.retry = retry or DestinationRetryPolicy()
         self._session: aiohttp.ClientSession | None = None
         self._tables: dict[TableId, _TableState] = {}
+        # exactly-once seam: the in-flight committed write's range,
+        # stamped into every snapshot summary _commit_snapshot builds
+        # while it is set (atomic with the catalog CAS commit)
+        self._pending_commit = None
 
     async def _api(self, method: str, path: str,
                    body: dict | None = None,
@@ -317,6 +321,20 @@ class IcebergDestination(Destination):
                 snapshot_id, st.snapshot_id, sequence_number, manifest_list,
                 operation, len(files), added, new_total,
                 int(time.time() * 1000), commit_schema_id)
+            if self._pending_commit is not None:
+                # exactly-once: the WAL range rides the snapshot summary
+                # (the Flink/Iceberg checkpoint-id idiom) — data files
+                # and coordinates land in ONE catalog CAS commit, and
+                # recover_high_water reads them back from the snapshot
+                # log
+                pc = self._pending_commit
+                if pc.replay:
+                    snapshot["summary"]["etl-replay-token"] = pc.token()
+                else:
+                    snapshot["summary"]["etl-high-water"] = pc.token()
+                    if pc.commit_end_lsn:
+                        snapshot["summary"]["etl-commit-end-lsn"] = \
+                            str(pc.commit_end_lsn)
             body = {
                 "requirements": [{
                     "type": "assert-ref-snapshot-id", "ref": "main",
@@ -473,6 +491,69 @@ class IcebergDestination(Destination):
             else:
                 await self._apply_schema_change(op[1])
         return WriteAck.durable()
+
+    # -- transactional seam (docs/destinations.md exactly-once contract) ------
+
+    def supports_transactional_commit(self) -> bool:
+        return True
+
+    @transactional_commit
+    async def write_event_batches_committed(
+            self, events: Sequence[Event], commit: CommitRange) -> WriteAck:
+        """Committed CDC write: the flush's WAL range is stamped into
+        every snapshot summary the write commits (`_commit_snapshot`
+        reads `_pending_commit`), so data files and coordinates land in
+        ONE catalog CAS commit per table. Replays dedup by their exact
+        token against the snapshot log and never stamp the streaming
+        high-water key."""
+        if commit.replay and await self._replay_seen(commit.token()):
+            return WriteAck.durable()
+        self._pending_commit = commit
+        try:
+            return await self.write_event_batches(events)
+        finally:
+            self._pending_commit = None
+
+    async def _catalog_table_names(self) -> list[str]:
+        doc = await self._api(
+            "GET", f"/namespaces/{self.config.namespace}/tables")
+        return [t["name"] for t in doc.get("identifiers", [])]
+
+    async def _replay_seen(self, token: str) -> bool:
+        for name in await self._catalog_table_names():
+            loaded = await self._api(
+                "GET",
+                f"/namespaces/{self.config.namespace}/tables/{name}")
+            for snap in loaded.get("metadata", {}).get("snapshots", []):
+                if snap.get("summary", {}).get("etl-replay-token") \
+                        == token:
+                    return True
+        return False
+
+    async def recover_high_water(self) -> "CommitRange | None":
+        """Max `etl-high-water` token across every table's snapshot log
+        in the catalog — the committed truth survives a hard kill
+        because it rides the snapshot commits themselves."""
+        best: "tuple[int, int] | None" = None
+        best_end: "int | None" = None
+        for name in await self._catalog_table_names():
+            loaded = await self._api(
+                "GET",
+                f"/namespaces/{self.config.namespace}/tables/{name}")
+            for snap in loaded.get("metadata", {}).get("snapshots", []):
+                summary = snap.get("summary", {})
+                tok = summary.get("etl-high-water")
+                if not tok:
+                    continue
+                lsn_hex, _, ord_hex = tok.partition("/")
+                coord = (int(lsn_hex, 16), int(ord_hex, 16))
+                if best is None or coord > best:
+                    best = coord
+                    end = summary.get("etl-commit-end-lsn")
+                    best_end = int(end) if end else None
+        if best is None:
+            return None
+        return CommitRange(high=best, commit_end_lsn=best_end)
 
     async def _write_cdc_run(self, schema: ReplicatedTableSchema,
                              evs: list) -> None:

@@ -35,7 +35,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from ..analysis.annotations import hot_loop
+from ..analysis.annotations import hot_loop, transactional_commit
 
 from ..models.errors import ErrorKind, EtlError
 from ..models.event import (ChangeType, DecodedBatchEvent, DeleteEvent,
@@ -43,7 +43,7 @@ from ..models.event import (ChangeType, DecodedBatchEvent, DeleteEvent,
                             TruncateEvent, UpdateEvent)
 from ..models.schema import ReplicatedTableSchema, TableId
 from ..models.table_row import ColumnarBatch
-from .base import Destination, WriteAck, expand_batch_events
+from .base import CommitRange, Destination, WriteAck, expand_batch_events
 from .util import (CHANGE_SEQUENCE_COLUMN, CHANGE_TYPE_COLUMN, CDC_DELETE,
                    CDC_PATCH, CDC_UPSERT, PATCH_MISSING_COLUMN,
                    _identity_values, change_type_label, escaped_table_name,
@@ -141,6 +141,15 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
     replay_epoch TEXT NOT NULL,
     pending_replay_epoch TEXT,
     updated_at TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS lake_commit_log (
+    id INTEGER PRIMARY KEY CHECK (id = 1),  -- singleton high-water row
+    commit_lsn BIGINT NOT NULL,
+    tx_ordinal BIGINT NOT NULL,
+    commit_end_lsn BIGINT
+);
+CREATE TABLE IF NOT EXISTS lake_replay_tokens (
+    token TEXT PRIMARY KEY
 );
 """)
         # older catalogs: add per-file epoch + inline payload columns
@@ -268,6 +277,58 @@ CREATE TABLE IF NOT EXISTS lake_replay_epochs (
             else:
                 self._ensure_table(op[1].new_schema)
         return WriteAck.durable()
+
+    # -- transactional seam (docs/destinations.md exactly-once contract) ------
+
+    def supports_transactional_commit(self) -> bool:
+        return True
+
+    @transactional_commit
+    async def write_event_batches_committed(
+            self, events: Sequence[Event], commit: CommitRange) -> WriteAck:
+        """Committed CDC write: data files land first, then the WAL
+        range commits to the sqlite catalog (`lake_commit_log`, the
+        same transaction domain as the file records). A crash between
+        them re-streams a flush whose duplicate rows the CDC sequence
+        collapse absorbs at read time; replays dedup by exact token in
+        `lake_replay_tokens` and never touch the high-water row."""
+        db = self._catalog()
+        if commit.replay:
+            seen = db.execute(
+                "SELECT 1 FROM lake_replay_tokens WHERE token = ?",
+                (commit.token(),)).fetchone()
+            if seen:
+                return WriteAck.durable()
+        ack = await self.write_event_batches(events)
+        if commit.replay:
+            db.execute("INSERT OR IGNORE INTO lake_replay_tokens "
+                       "(token) VALUES (?)", (commit.token(),))
+        else:
+            lsn, ordinal = commit.high
+            # monotone guard in SQL: out-of-order finalization must not
+            # move the recorded high-water backwards
+            db.execute(
+                "INSERT INTO lake_commit_log "
+                "(id, commit_lsn, tx_ordinal, commit_end_lsn) "
+                "VALUES (1, ?, ?, ?) ON CONFLICT(id) DO UPDATE SET "
+                "commit_lsn = excluded.commit_lsn, "
+                "tx_ordinal = excluded.tx_ordinal, "
+                "commit_end_lsn = excluded.commit_end_lsn "
+                "WHERE excluded.commit_lsn > lake_commit_log.commit_lsn "
+                "OR (excluded.commit_lsn = lake_commit_log.commit_lsn "
+                "AND excluded.tx_ordinal > lake_commit_log.tx_ordinal)",
+                (lsn, ordinal, commit.commit_end_lsn))
+        db.commit()
+        return ack
+
+    async def recover_high_water(self) -> "CommitRange | None":
+        row = self._catalog().execute(
+            "SELECT commit_lsn, tx_ordinal, commit_end_lsn "
+            "FROM lake_commit_log WHERE id = 1").fetchone()
+        if row is None:
+            return None
+        return CommitRange(high=(int(row[0]), int(row[1])),
+                           commit_end_lsn=int(row[2]) if row[2] else None)
 
     @hot_loop
     async def _write_cdc_batch(self, schema: ReplicatedTableSchema,

@@ -15,11 +15,13 @@ from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Sequence
 
+from ..analysis.annotations import transactional_commit
 from ..models.errors import ErrorKind, EtlError
 from ..models.event import Event
 from ..models.schema import ReplicatedTableSchema, TableId
 from ..models.table_row import ColumnarBatch, TableRow
-from .base import Destination, WriteAck, expand_batch_events
+from .base import (CommitRange, Destination, WriteAck, event_coordinate,
+                   expand_batch_events)
 from .util import TaskSet
 
 
@@ -54,6 +56,100 @@ class MemoryDestination(Destination):
     async def truncate_table(self, table_id: TableId) -> None:
         self.table_rows[table_id] = []
         self.truncated_tables.append(table_id)
+
+
+class TransactionalMemoryDestination(MemoryDestination):
+    """Exactly-once fake sink: the in-memory analogue of a sink that
+    records the acked WAL coordinate range atomically with the data
+    (BigQuery MERGE, ClickHouse dedup tokens, Iceberg snapshot
+    properties, Snowpipe offsets). Streamed writes dedup against the
+    monotone high-water coordinate — a blind re-stream's rows at
+    coordinates ≤ high-water are dropped, whatever the batch boundaries
+    of the retry. Replay ranges (`commit.replay`) dedup by EXACT row key
+    instead and never move the high-water mark. `high_water_log` is the
+    chaos monotonicity evidence; `recover_*` knobs script recovery-query
+    faults for the satellite-1 degradation tests."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.high_water: "tuple[int, int]" = (0, 0)
+        self.committed_end_lsn = 0
+        self.high_water_log: "list[tuple[int, int]]" = []
+        self.dedup_skipped_rows = 0
+        self.replayed_keys: set = set()
+        self.replay_skipped_rows = 0
+        self.recover_calls = 0
+        # FIFO of EtlErrors the next recover_high_water() calls raise
+        # (transient-recovery and degrade-to-blind-re-stream scripting)
+        self.recover_faults: "deque[EtlError]" = deque()
+        self.recover_delay_s = 0.0
+        self.uncoordinated_writes = 0  # CDC writes that bypassed the seam
+
+    def supports_transactional_commit(self) -> bool:
+        return True
+
+    async def write_events(self, events: Sequence[Event]) -> WriteAck:
+        self.uncoordinated_writes += 1
+        return await super().write_events(events)
+
+    @staticmethod
+    def _row_key(e: Event) -> "tuple | None":
+        coord = event_coordinate(e)
+        if coord is None:
+            return None
+        tid = getattr(getattr(e, "schema", None), "id", None)
+        return (tid, coord[0], coord[1], type(e).__name__)
+
+    @transactional_commit
+    async def write_event_batches_committed(
+            self, events: Sequence[Event],
+            commit: "CommitRange | None") -> WriteAck:
+        rows = expand_batch_events(list(events))
+        if commit is not None and commit.replay:
+            kept = []
+            for e in rows:
+                key = self._row_key(e)
+                if key is not None and key in self.replayed_keys:
+                    self.replay_skipped_rows += 1
+                    continue
+                if key is not None:
+                    self.replayed_keys.add(key)
+                kept.append(e)
+        else:
+            kept = []
+            for e in rows:
+                coord = event_coordinate(e)
+                if coord is not None and coord <= self.high_water:
+                    self.dedup_skipped_rows += 1
+                    continue
+                kept.append(e)
+        # data + coordinate range land in ONE synchronous step — no await
+        # between them, so a kill can never observe data without its range
+        self.events.extend(kept)
+        if commit is not None and not commit.replay:
+            if commit.high > self.high_water:
+                self.high_water = commit.high
+            self.committed_end_lsn = max(
+                self.committed_end_lsn, commit.commit_end_lsn or 0)
+            self.high_water_log.append(self.high_water)
+        if kept or commit is None:
+            return WriteAck.durable()
+        # fully-deduped flush: nothing was written, so don't fire the
+        # DESTINATION_WRITE chaos site for a phantom destination write
+        fut = asyncio.get_event_loop().create_future()
+        fut.set_result(None)
+        return WriteAck(fut)
+
+    async def recover_high_water(self) -> "CommitRange | None":
+        self.recover_calls += 1
+        if self.recover_delay_s > 0:
+            await asyncio.sleep(self.recover_delay_s)
+        if self.recover_faults:
+            raise self.recover_faults.popleft()
+        if not self.high_water_log:
+            return None
+        return CommitRange(high=self.high_water,
+                           commit_end_lsn=self.committed_end_lsn or None)
 
 
 class FaultKind(enum.Enum):
@@ -193,6 +289,23 @@ class FaultInjectingDestination(Destination):
             "write_events",
             lambda: self.inner.write_event_batches(events))
 
+    # transactional seam: same "write_events" fault key, so every chaos
+    # script against the CDC path exercises the exactly-once seam too
+    def supports_transactional_commit(self) -> bool:
+        return self.inner.supports_transactional_commit()
+
+    async def write_event_batches_committed(self, events: Sequence[Event],
+                                            commit) -> WriteAck:
+        self.write_events_calls += 1
+        return await self._apply_fault(
+            "write_events",
+            lambda: self.inner.write_event_batches_committed(events, commit))
+
+    async def recover_high_water(self):
+        return await self._apply_fault(
+            "recover_high_water",
+            lambda: self.inner.recover_high_water())
+
     async def drop_table(self, table_id: TableId,
                          schema=None) -> None:
         async def run():
@@ -281,6 +394,17 @@ class PoisonRejectingDestination(Destination):
     async def write_event_batches(self, events: Sequence[Event]) -> WriteAck:
         self._scan(events)
         return await self.inner.write_event_batches(events)
+
+    def supports_transactional_commit(self) -> bool:
+        return self.inner.supports_transactional_commit()
+
+    async def write_event_batches_committed(self, events: Sequence[Event],
+                                            commit) -> WriteAck:
+        self._scan(events)
+        return await self.inner.write_event_batches_committed(events, commit)
+
+    async def recover_high_water(self):
+        return await self.inner.recover_high_water()
 
     async def drop_table(self, table_id: TableId, schema=None) -> None:
         await self.inner.drop_table(table_id, schema)

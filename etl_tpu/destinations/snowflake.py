@@ -36,12 +36,13 @@ from ..models.event import (ChangeType, DeleteEvent, Event, InsertEvent,
 from ..models.pgtypes import CellKind
 from ..models.schema import ReplicatedTableSchema, TableId
 from ..models.table_row import ColumnarBatch
-from .base import Destination, WriteAck, expand_batch_events
+from .base import CommitRange, Destination, WriteAck, expand_batch_events
 from ..models.default_expression import column_default_sql
 from .bigquery import encode_value  # same JSON value encoding rules
+from ..analysis.annotations import transactional_commit
 from .snowpipe import (ZERO_OFFSET, AcceptedBatch, ChannelHandle,
                        RestStreamClient, RowBatch, RowBatchBuilder,
-                       offset_token)
+                       decode_offset_token, offset_token)
 from .util import (DestinationRetryPolicy, count_egress_write,
                    escaped_table_name, classify_http_error,
                    require_full_batch, require_full_row,
@@ -390,6 +391,12 @@ class SnowflakeDestination(Destination):
         # across awaits). Parallel copy partitions hit the same table's
         # channel, so every channel interaction holds this per-table lock.
         self._table_locks: dict[TableId, asyncio.Lock] = {}
+        # exactly-once seam: DLQ replays route through dedicated `rp0`
+        # channels — their rows sit BELOW the live channel's committed
+        # offset, and the server's offset dedup would silently drop them
+        # there (see write_event_batches_committed)
+        self._replay_channels: dict[TableId, ChannelHandle] = {}
+        self._replay_mode = False
 
     def _get_session(self) -> aiohttp.ClientSession:
         if self._session is None:
@@ -481,17 +488,20 @@ class SnowflakeDestination(Destination):
     # -- channels --------------------------------------------------------------
 
     def _channel(self, schema: ReplicatedTableSchema) -> ChannelHandle:
-        handle = self._channels.get(schema.id)
+        table = self._replay_channels if self._replay_mode \
+            else self._channels
+        handle = table.get(schema.id)
         if handle is None:
             name = self._table_name(schema)
+            suffix = "rp0" if self._replay_mode else "ch0"
             handle = ChannelHandle(
                 self._stream, self.config.database, self.config.schema,
                 name,
                 channel=(f"etl_{self.config.pipeline_id}_"
-                         f"{self.config.schema}_{name}_ch0"),
+                         f"{self.config.schema}_{name}_{suffix}"),
                 poll_interval_s=self.config.commit_poll_interval_s,
                 wait_timeout_s=self.config.commit_wait_timeout_s)
-            self._channels[schema.id] = handle
+            table[schema.id] = handle
         return handle
 
     def _lock_for(self, table_id: TableId) -> asyncio.Lock:
@@ -654,6 +664,57 @@ class SnowflakeDestination(Destination):
         # rows silently dropped from an earlier batch pass the check
         # that exists to catch them)
         await self._stream_batches(schema, builder.finish())
+
+    # -- transactional seam (docs/destinations.md exactly-once contract) ------
+    #
+    # Snowpipe Streaming IS a transactional sink: every insert ships its
+    # WAL-coordinate offset-token range on the query string, the server
+    # dedups re-streamed rows at-or-below the channel's committed offset,
+    # and `wait_for_offsets_committed` is the atomic data+coordinate
+    # commit. The seam therefore adds only (a) the replay channel split
+    # and (b) reading the committed offsets back at recovery.
+
+    def supports_transactional_commit(self) -> bool:
+        return True
+
+    @transactional_commit
+    async def write_event_batches_committed(
+            self, events: Sequence[Event], commit: CommitRange) -> WriteAck:
+        """Committed CDC write. Streamed flushes take the normal path —
+        the offset tokens already carried by every insert ARE the
+        transactional coordinates. DLQ replays (`commit.replay`) route
+        through per-table `rp0` channels: their rows sit below the live
+        channel's committed offset and would be silently dropped by the
+        server's dedup there, while the fresh replay channel accepts
+        them once and dedups an identical re-run replay."""
+        if not commit.replay:
+            return await self.write_event_batches(events)
+        self._replay_mode = True
+        try:
+            return await self.write_event_batches(events)
+        finally:
+            self._replay_mode = False
+
+    async def recover_high_water(self) -> "CommitRange | None":
+        """Max committed offset token across this destination's live
+        channels (reopening each reads the server's persisted progress).
+        With no channels yet — a cold process that has not streamed —
+        there is nothing to ask; the caller degrades to the progress
+        store and the per-channel offset dedup still bounds duplicates."""
+        best: "tuple[int, int] | None" = None
+        for tid in list(self._channels):
+            handle = self._channels[tid]
+            async with self._lock_for(tid):
+                if not handle.is_open:
+                    await handle.open()
+            tok = handle.committed_offset
+            if tok and tok != ZERO_OFFSET:
+                coord = decode_offset_token(tok)
+                if best is None or coord > best:
+                    best = coord
+        if best is None:
+            return None
+        return CommitRange(high=best)
 
     # -- DDL / lifecycle -------------------------------------------------------
 

@@ -86,7 +86,10 @@ class DeadLetterQueue:
         (unless `include_replayed` forces a re-push — itself safe, CDC
         delivery is keyed by WAL coordinates), and a crash after the
         write but before the status flip re-replays rows a destination
-        collapses as at-least-once duplicates."""
+        collapses as at-least-once duplicates. Against a transactional
+        sink the replay ships a `CommitRange(replay=True)` so the
+        re-run dedups by exact WAL row key with ZERO duplicates, and
+        the sink's streaming high-water stays untouched."""
         from ..telemetry.metrics import ETL_DLQ_REPLAYED_TOTAL, registry
 
         if entry_ids is not None:
@@ -138,7 +141,23 @@ class DeadLetterQueue:
                 continue
             replayable.append(e)
         if events:
-            ack = await destination.write_event_batches(events)
+            if destination.supports_transactional_commit():
+                # replay-mode committed write: the original WAL
+                # coordinates ride along so a transactional sink dedups
+                # a re-run replay by EXACT row key — and `replay=True`
+                # keeps the sink's streaming high-water untouched
+                # (parked rows sit BELOW it; advancing it here would
+                # make the live stream drop rows it never applied)
+                from ..destinations.base import CommitRange
+
+                rng = CommitRange.from_events(events, replay=True)
+                if rng is not None:
+                    ack = await destination \
+                        .write_event_batches_committed(events, rng)
+                else:  # pragma: no cover — replays always carry coords
+                    ack = await destination.write_event_batches(events)
+            else:
+                ack = await destination.write_event_batches(events)
             if ack is not None:
                 await ack.wait_durable()
         for e in replayable:

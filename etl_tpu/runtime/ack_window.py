@@ -53,22 +53,26 @@ from ..telemetry.metrics import (ETL_DESTINATION_ACK_BUSY_SECONDS_TOTAL,
                                  ETL_DESTINATION_ACK_LATENCY_SECONDS,
                                  ETL_DESTINATION_ACK_OVERLAP_RATIO,
                                  ETL_DESTINATION_ACK_OVERLAP_SECONDS_TOTAL,
+                                 ETL_EXACTLY_ONCE_HIGH_WATER_LSN,
                                  registry)
 
 
 class AckEntry:
     """One dispatched flush: its write task (submission + durability
-    wait), the durable watermark it covers, its accounting, and the
-    payload events (so a hard-killed loop can abandon the pending
-    decodes of entries that will never deliver)."""
+    wait), the durable watermark it covers, the transactional
+    CommitRange it shipped (None on at-least-once paths), its
+    accounting, and the payload events (so a hard-killed loop can
+    abandon the pending decodes of entries that will never deliver)."""
 
-    __slots__ = ("task", "commit_end_lsn", "n_events", "nbytes",
-                 "dispatched_at", "payload")
+    __slots__ = ("task", "commit_end_lsn", "commit_range", "n_events",
+                 "nbytes", "dispatched_at", "payload")
 
     def __init__(self, task: asyncio.Task, commit_end_lsn, n_events: int,
-                 nbytes: int, dispatched_at: float, payload=None):
+                 nbytes: int, dispatched_at: float, payload=None,
+                 commit_range=None):
         self.task = task
         self.commit_end_lsn = commit_end_lsn
+        self.commit_range = commit_range
         self.n_events = n_events
         self.nbytes = nbytes
         self.dispatched_at = dispatched_at
@@ -105,6 +109,14 @@ class AckWindow:
         self._last_t = time.monotonic()
         self._busy_s = 0.0
         self._overlap_s = 0.0
+        # max (commit_lsn, tx_ordinal) across ACKED transactional writes:
+        # monotone because submissions chain in WAL order and only the
+        # contiguous durable prefix pops
+        self._acked_high: "tuple[int, int] | None" = None
+
+    @property
+    def acked_high_water(self) -> "tuple[int, int] | None":
+        return self._acked_high
 
     # -- capacity -------------------------------------------------------------
 
@@ -173,12 +185,17 @@ class AckWindow:
                  *, commit_end_lsn=None, n_events: int = 0,
                  nbytes: int = 0,
                  on_durable: "Callable[[], None] | None" = None,
-                 payload=None) -> AckEntry:
+                 payload=None, commit_range=None) -> AckEntry:
         """Start one write: `submit()` performs the destination call and
         returns its ack (None for an event-less commit-boundary flush).
         The window serializes submissions in dispatch order and owns the
         durability wait; `on_durable` runs after the ack resolves (egress
-        accounting rides durable acks)."""
+        accounting rides durable acks). `commit_range` is the
+        transactional CommitRange the submit ships (None on at-least-once
+        paths): because submissions chain in WAL order and pops consume
+        only the contiguous durable prefix, the acked ranges advance
+        monotonically — `acked_high_water` exposes the max, the
+        coordinate a restart's sink-side recovery should agree with."""
         prev = self._submit_tail
         loop = asyncio.get_event_loop()
         submitted: "asyncio.Future[bool]" = loop.create_future()
@@ -211,7 +228,8 @@ class AckWindow:
 
         self._tick()
         entry = AckEntry(asyncio.ensure_future(run()), commit_end_lsn,
-                         n_events, nbytes, t0, payload)
+                         n_events, nbytes, t0, payload,
+                         commit_range=commit_range)
         self._entries.append(entry)
         self._bytes += nbytes
         self._publish()
@@ -325,6 +343,13 @@ class AckWindow:
                 # so a done SUCCESSOR cannot pop either.
                 self._abandon_entry(entry)
                 break
+            if entry.commit_range is not None \
+                    and not entry.commit_range.replay:
+                high = entry.commit_range.high
+                if self._acked_high is None or high > self._acked_high:
+                    self._acked_high = high
+                    registry.gauge_set(ETL_EXACTLY_ONCE_HIGH_WATER_LSN,
+                                       high[0], labels=self._labels)
             done.append(entry)
         # surface every other completed failure too (fail fast + the
         # whole poison signal): a later entry that already failed can

@@ -789,6 +789,21 @@ class ApplyLoop:
         else:
             self._batch_deadline = None
 
+        # transactional commit seam (docs/destinations.md exactly-once):
+        # when the destination opts in, the flush ships its WAL
+        # coordinate range alongside the data so the sink records both
+        # atomically — a blind re-stream's rows then dedup sink-side and
+        # restart recovery can trim the re-stream window to the unacked
+        # suffix. The range is derived from the SAME payload the write
+        # carries (CoalescedBatch / row-event coordinates), with the
+        # commit watermark `covered` as the resume anchor.
+        commit_range = None
+        if events and self.destination.supports_transactional_commit():
+            from ..destinations.base import CommitRange
+
+            commit_range = CommitRange.from_events(
+                events, commit_end_lsn=commit_end)
+
         async def submit():
             if not events:
                 return None  # commit-boundary-only flush: no destination
@@ -803,7 +818,11 @@ class ApplyLoop:
             # them, quarantined tables' events park — transient failures
             # pass through to the worker-retry path unchanged.
             if self._poison is not None:
-                return await self._poison.submit(events)
+                return await self._poison.submit(events,
+                                                 commit=commit_range)
+            if commit_range is not None:
+                return await self.destination.write_event_batches_committed(
+                    events, commit_range)
             return await self.destination.write_event_batches(events)
 
         def on_durable() -> None:
@@ -819,7 +838,7 @@ class ApplyLoop:
         self._ack_window.dispatch(
             submit, commit_end_lsn=commit_end, n_events=len(events),
             nbytes=batch_bytes, on_durable=on_durable if events else None,
-            payload=events)
+            payload=events, commit_range=commit_range)
         return True
 
     @flush_path

@@ -240,4 +240,78 @@ class ApplyWorker:
         else:
             slot_flush = slot.confirmed_flush_lsn
         durable = await self.store.get_durable_progress(self.slot_name)
-        return max(durable or Lsn.ZERO, slot_flush)
+        start = max(durable or Lsn.ZERO, slot_flush)
+        sink = await self._recover_sink_high_water()
+        if sink is not None and sink.commit_end_lsn:
+            sink_lsn = Lsn(sink.commit_end_lsn)
+            if sink_lsn > start:
+                # sink is ahead of the progress store: the crash landed
+                # between the committed write and the progress commit.
+                # Bootstrap the store from the sink's own record so the
+                # re-stream window starts past what the sink already
+                # holds (exactly-once recovery, docs/destinations.md)
+                logger.info(
+                    "sink high-water %s ahead of durable progress %s; "
+                    "bootstrapping store and resuming past it",
+                    sink_lsn, start)
+                await self.store.update_durable_progress(
+                    self.slot_name, sink_lsn)
+                start = sink_lsn
+        return start
+
+    async def _recover_sink_high_water(self):
+        """Query a transactional sink's recovery high-water mark
+        (`Destination.recover_high_water`), bounded and retried.
+
+        Failure policy (exactly-once satellite): each attempt is bounded
+        by `destination_op_timeout_s`, failures surface as typed
+        `EtlError`s through the worker-scoped `RetryPolicy`, and
+        exhausting it DEGRADES — loud warning + fallback counter, return
+        None, resume from the progress store (blind at-least-once
+        re-stream; the sink's own coordinate dedup still holds dup==0).
+        `Pipeline.start` must never wedge on a sink that cannot answer
+        its recovery query."""
+        if not self.destination.supports_transactional_commit():
+            return None
+        from ..telemetry.metrics import (
+            ETL_EXACTLY_ONCE_RECOVERIES_TOTAL,
+            ETL_EXACTLY_ONCE_RECOVERY_FALLBACKS_TOTAL, registry)
+
+        timeout_s = self.config.destination_op_timeout_s
+
+        async def _one_attempt():
+            try:
+                if timeout_s > 0:
+                    return await asyncio.wait_for(
+                        self.destination.recover_high_water(), timeout_s)
+                return await self.destination.recover_high_water()
+            except asyncio.TimeoutError:
+                raise EtlError(
+                    ErrorKind.TIMEOUT,
+                    f"sink recovery query exceeded {timeout_s:.1f}s")
+            except EtlError:
+                raise
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # untyped sink client failure
+                raise EtlError(ErrorKind.DESTINATION_FAILED,
+                               f"sink recovery query failed: {e!r}")
+
+        policy = RetryPolicy.from_config(self.config.apply_retry)
+        try:
+            rng = await policy.execute(_one_attempt)
+        except EtlError as e:
+            reason = "timeout" if e.kind is ErrorKind.TIMEOUT else "error"
+            registry.counter_inc(
+                ETL_EXACTLY_ONCE_RECOVERY_FALLBACKS_TOTAL,
+                labels={"reason": reason})
+            logger.warning(
+                "sink recovery high-water query failed after retries "
+                "(%s); DEGRADING to blind re-stream from the progress "
+                "store — at-least-once window reopens until the sink "
+                "answers again (sink-side dedup still bounds "
+                "duplicates): %s", reason, e)
+            return None
+        if rng is not None:
+            registry.counter_inc(ETL_EXACTLY_ONCE_RECOVERIES_TOTAL)
+        return rng

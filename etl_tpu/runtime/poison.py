@@ -60,7 +60,8 @@ import time
 from collections import deque
 
 from ..config.pipeline import PipelineConfig
-from ..destinations.base import WriteAck, expand_batch_events
+from ..destinations.base import (CommitRange, WriteAck,
+                                 expand_batch_events)
 from ..models.errors import ErrorKind, EtlError, is_poison_error
 from ..models.event import (DecodedBatchEvent, DeleteEvent, InsertEvent,
                             RelationEvent, TruncateEvent, UpdateEvent)
@@ -380,7 +381,22 @@ class PoisonIsolator:
             self.stats["probe_writes"] += 1
             registry.counter_inc(ETL_POISON_BISECTION_WRITES_TOTAL)
         try:
-            ack = await self.destination.write_event_batches(list(events))
+            batch = list(events)
+            if self.destination.supports_transactional_commit():
+                # per-probe sub-range: the healthy complement of a
+                # bisection must stay coordinated (WAL order makes the
+                # sink's high-water advance monotone across probes),
+                # while a failing probe lands nothing — so a later DLQ
+                # replay of the isolated row deduplicates by exact key,
+                # not against a high-water this probe never earned
+                rng = CommitRange.from_events(batch)
+                if rng is not None:
+                    ack = await self.destination \
+                        .write_event_batches_committed(batch, rng)
+                else:
+                    ack = await self.destination.write_event_batches(batch)
+            else:
+                ack = await self.destination.write_event_batches(batch)
             if ack is not None:
                 await ack.wait_durable()
         except EtlError as e:
@@ -537,13 +553,22 @@ class PoisonIsolator:
             await self._isolate(events, e)
         return _settled_ack()
 
-    async def submit(self, events) -> "WriteAck | None":
+    async def submit(self, events,
+                     commit=None) -> "WriteAck | None":
         """The apply loop's flush `submit()` body. Fast path: one
         membership check + the destination write. Slow paths: park
         quarantined tables' events, isolate on a poison failure —
         whether it surfaces at the write call or (deferred-ack
         destinations: BigQuery transfers append errors to the ack
-        future) at durability time, via the guarded ack."""
+        future) at durability time, via the guarded ack.
+
+        `commit` (a `CommitRange`, exactly-once pipelines only) rides
+        the fast path through `write_event_batches_committed` so the
+        sink lands data + coordinate range atomically. Isolation probe
+        writes re-derive their own sub-ranges (`_probe_write`): the
+        flush-level range covers rows a bisection may park, and
+        advancing the sink's high-water past a parked row would make
+        its DLQ replay look like a duplicate."""
         await self._ensure_loaded()
         await self._maybe_refresh()
         if self._quarantined:
@@ -575,7 +600,11 @@ class PoisonIsolator:
             return _settled_ack()
         events = list(events)
         try:
-            ack = await self.destination.write_event_batches(events)
+            if commit is not None:
+                ack = await self.destination.write_event_batches_committed(
+                    events, commit)
+            else:
+                ack = await self.destination.write_event_batches(events)
         except EtlError as e:
             return await self._handle_poison(events, e)
         if ack is None or ack.is_durable:

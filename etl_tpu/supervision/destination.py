@@ -219,6 +219,28 @@ class SupervisedDestination(Destination):
         return await self._bounded(
             "write_events", self.inner.write_event_batches(events))
 
+    # transactional seam (docs/destinations.md exactly-once contract):
+    # committed writes are bounded + breaker-gated under the SAME
+    # "write_events" op label as the at-least-once CDC path — the timeout
+    # metric and breaker verdicts must not fork per delivery guarantee.
+    # The recovery query is NOT breaker-gated: it runs at restart, where
+    # an open breaker from the crashed attempt must not shed the one
+    # call that would trim the re-stream window (Pipeline.start must
+    # never wedge on it; the caller owns retry + degradation).
+    def supports_transactional_commit(self) -> bool:
+        return self.inner.supports_transactional_commit()
+
+    async def write_event_batches_committed(self, events: Sequence,
+                                            commit) -> WriteAck:
+        return await self._bounded(
+            "write_events",
+            self.inner.write_event_batches_committed(events, commit))
+
+    async def recover_high_water(self):
+        return await self._bounded(
+            "recover_high_water", self.inner.recover_high_water(),
+            gated=False)
+
     async def drop_table(self, table_id, schema=None) -> None:
         await self._bounded("drop_table",
                             self.inner.drop_table(table_id, schema))

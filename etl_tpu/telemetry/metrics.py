@@ -135,6 +135,19 @@ ETL_SHARD_REBALANCE_DURATION_SECONDS = \
 ETL_SHARD_REBALANCE_MOVED_TABLES_TOTAL = \
     "etl_shard_rebalance_moved_tables_total"
 ETL_SHARD_WRITE_REFUSALS_TOTAL = "etl_shard_write_refusals_total"
+# exactly-once delivery (destinations/base.py transactional seam +
+# runtime recovery): rows a transactional sink dropped as coordinate
+# duplicates of a blind re-stream (label mode=stream|replay), restart
+# recoveries that successfully read the sink's high-water mark vs fell
+# back to the legacy blind re-stream (the loud-warning degradation,
+# labeled by reason: error = typed sink failure after retries, timeout =
+# the op bound cut it off), and the high coordinate of the last acked
+# transactional commit range — the operator-visible high-water mark
+ETL_EXACTLY_ONCE_DEDUP_ROWS_TOTAL = "etl_exactly_once_dedup_rows_total"
+ETL_EXACTLY_ONCE_RECOVERIES_TOTAL = "etl_exactly_once_recoveries_total"
+ETL_EXACTLY_ONCE_RECOVERY_FALLBACKS_TOTAL = \
+    "etl_exactly_once_recovery_fallbacks_total"
+ETL_EXACTLY_ONCE_HIGH_WATER_LSN = "etl_exactly_once_high_water_lsn"
 # chaos subsystem (etl_tpu/chaos): fault firings per site, per-scenario
 # pass/fail, and how long crash→restart recovery took until the workload
 # fully re-delivered

@@ -59,6 +59,8 @@ class FakeIcebergCatalog:
         app.router.add_post("/v1/namespaces", self._create_namespace)
         app.router.add_post("/v1/namespaces/{ns}/tables",
                             self._create_table)
+        app.router.add_get("/v1/namespaces/{ns}/tables",
+                           self._list_tables)
         app.router.add_get("/v1/namespaces/{ns}/tables/{t}",
                            self._load_table)
         app.router.add_post("/v1/namespaces/{ns}/tables/{t}",
@@ -140,6 +142,12 @@ class FakeIcebergCatalog:
             "refs": {k: {"snapshot-id": v, "type": "branch"}
                      for k, v in t.refs.items()},
         }
+
+    async def _list_tables(self, request: web.Request) -> web.Response:
+        ns = request.match_info["ns"]
+        return web.json_response({"identifiers": [
+            {"namespace": [n], "name": t.name}
+            for (n, _), t in sorted(self.tables.items()) if n == ns]})
 
     async def _load_table(self, request: web.Request) -> web.Response:
         key = (request.match_info["ns"], request.match_info["t"])

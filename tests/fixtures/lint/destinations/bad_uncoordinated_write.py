@@ -1,0 +1,31 @@
+# etl-lint fixture: @transactional_commit entry points that land CDC
+# data without ever consulting the commit-range parameter — the write
+# happens but its WAL coordinate range is never recorded alongside it,
+# so crash recovery cannot rebuild the sink's high-water mark: a silent
+# downgrade to at-least-once behind a transactional marker. Nested
+# write closures (the retried attempt() shape) belong to the marked
+# function's body and are in scope too.
+# expect: uncoordinated-transactional-write=3
+from etl_tpu.analysis.annotations import transactional_commit
+
+
+class ForgetfulDestination:
+    @transactional_commit
+    async def write_event_batches_committed(self, events, commit):
+        # flagged: forwards to the plain path, commit never touched
+        return await self.write_event_batches(events)
+
+    @transactional_commit
+    async def write_committed_retried(self, events, commit):
+        async def attempt():
+            # flagged: the closure writes, the marked frame never
+            # derives a token / marker from `commit`
+            return await self.inner.write_events(events)
+
+        return await attempt()
+
+
+@transactional_commit
+async def route_committed(sink, events, commit):
+    # flagged: free-function seam, coordinates dropped on the floor
+    return await sink.write_table_batch(None, events)

@@ -1,0 +1,24 @@
+# etl-lint fixture: coordinated @transactional_commit entry points —
+# every committed write derives its dedup token / commit marker from
+# the commit-range parameter (or consults it to choose a deliberate
+# pass-through, the offset-token sink shape), so rule 20 stays quiet.
+from etl_tpu.analysis.annotations import transactional_commit
+
+
+class CoordinatedDestination:
+    @transactional_commit
+    async def write_event_batches_committed(self, events, commit):
+        # token-armed write: data + coordinates land together
+        self._arm_dedup(commit.token())
+        try:
+            return await self.write_event_batches(events)
+        finally:
+            self._disarm_dedup()
+
+    @transactional_commit
+    async def offset_token_committed(self, events, commit):
+        if not commit.replay:
+            # the plain path's offset tokens already ARE the
+            # coordinates — consulting `commit` is the decision
+            return await self.write_event_batches(events)
+        return await self._replay_write(events, commit.token())
