@@ -777,7 +777,22 @@ def run_smoke() -> dict:
     t0 = time.perf_counter()
     lint_findings = analyze_paths([str(repo_package_dir())])
     lint_seconds = time.perf_counter() - t0
-    lint_ok = lint_seconds < lint_budget_s
+
+    # baseline-hygiene gate (ISSUE 20 satellite): the CI entry point in
+    # --check-baseline mode — exits 1 when a baseline entry or inline
+    # ignore no longer matches a live finding, so grandfathered debt
+    # can only shrink. A subprocess on purpose: it exercises the exact
+    # command CI runs (sys.path bootstrap included), inside the same
+    # wall-clock budget as the in-process pass above.
+    t0 = time.perf_counter()
+    baseline_proc = subprocess.run(
+        [_sys.executable, os.path.join(_repo, "scripts", "lint_repo.py"),
+         "--check-baseline", "-q"],
+        capture_output=True, text=True, timeout=600, cwd=_repo)
+    baseline_seconds = time.perf_counter() - t0
+    baseline_clean = baseline_proc.returncode == 0
+    lint_ok = (lint_seconds < lint_budget_s and baseline_clean
+               and baseline_seconds < lint_budget_s)
 
     # IR-tier gate (ISSUE 16 CI satellite): the compiled-program
     # contract pass — every enumerable canonical layout lowered through
@@ -904,6 +919,10 @@ def run_smoke() -> dict:
         "static_analysis_budget_s": lint_budget_s,
         "static_analysis_under_budget": bool(lint_ok),
         "static_analysis_findings": len(lint_findings),
+        "static_analysis_baseline_clean": bool(baseline_clean),
+        "static_analysis_baseline_seconds": round(baseline_seconds, 3),
+        "static_analysis_baseline_error": "" if baseline_clean
+        else (baseline_proc.stderr or baseline_proc.stdout or "")[-400:],
         "ir_analysis_seconds": round(ir_seconds, 3),
         "ir_analysis_budget_s": ir_budget_s,
         "ir_analysis_under_budget": bool(ir_seconds < ir_budget_s),

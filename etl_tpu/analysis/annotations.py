@@ -147,6 +147,71 @@ def transactional_commit(fn: _F) -> _F:
     return fn
 
 
+#: attribute set by @domain (runtime-introspectable, same lexical
+#: matching caveat as HOT_LOOP_ATTR). Holds the pinned domain name.
+DOMAIN_ATTR = "__etl_domain__"
+
+#: the execution domains the concurrency tier understands. Matches
+#: analysis/domains.py — kept here so the decorator can validate eagerly
+#: (a typo'd pin would otherwise silently create a new domain).
+KNOWN_DOMAINS = frozenset({"loop", "worker", "executor", "sweep",
+                           "coordinator"})
+
+
+def domain(name: str) -> "Callable[[_F], _F]":
+    """Pin `fn` to one execution domain for the concurrency tier
+    (analysis/domains.py): `loop` (asyncio event loop), `worker`
+    (dedicated thread), `executor` (run_in_executor / to_thread
+    offload), `sweep` (supervision sweep thread), `coordinator`
+    (out-of-process control loop acting through the shared StateStore).
+
+    Inference normally derives domains by propagating from spawn sites
+    and async entry points; a pin OVERRIDES inference for the decorated
+    function — incoming propagation is ignored, the pinned domain still
+    propagates outward through its callees. Use it where inference
+    cannot see the spawn (a callback registered with an external
+    library, a coordinator tick entry invoked by a process manager) or
+    where a deliberate single-domain contract should be enforced even
+    if a new caller appears from another domain."""
+    if name not in KNOWN_DOMAINS:
+        raise ValueError(
+            f"unknown execution domain {name!r}; expected one of "
+            f"{sorted(KNOWN_DOMAINS)}")
+
+    def mark(fn: _F) -> _F:
+        setattr(fn, DOMAIN_ATTR, name)
+        return fn
+
+    return mark
+
+
+#: attribute set by @handoff (runtime-introspectable, same lexical
+#: matching caveat as HOT_LOOP_ATTR)
+HANDOFF_ATTR = "__etl_handoff__"
+
+
+def handoff(fn: _F) -> _F:
+    """Mark `fn` as a deliberate cross-domain OWNERSHIP-TRANSFER seam:
+    code that mutates shared state from one domain on behalf of another
+    under a happens-before edge the lockset analysis cannot see —
+    a StagingArena lease handed to the pipeline worker before the
+    submitting task ever looks at it again, an AckWindow entry payload
+    published before the dispatch that makes it reachable, a
+    DecodePipeline result future resolved by the worker and consumed by
+    the loop, a coordinator's persist-then-actuate journal write.
+
+    The concurrency rules (`unsynchronized-shared-mutation`,
+    `loop-state-from-thread`, `coordinator-store-bypass`) sanction
+    accesses inside a marked frame. The marker is a CONTRACT, not an
+    escape hatch: the decorated function must establish the transfer
+    edge itself (publish via a queue/future/journal, or touch state
+    only before the other domain can reach it). Document the edge in
+    the docstring of every function you mark — docs/CONCURRENCY.md
+    has the discipline."""
+    setattr(fn, HANDOFF_ATTR, True)
+    return fn
+
+
 def dispatch_stage(fn: _F) -> _F:
     """Mark `fn` as the decode pipeline's DISPATCH stage (ops/pipeline.py
     architecture): a hot-loop function whose job is to start device work,

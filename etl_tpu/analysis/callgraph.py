@@ -52,7 +52,12 @@ AWAIT_FORWARDERS = frozenset({"wait_for", "shield", "gather"})
 #: constructors whose result is an asyncio lock-ish resource
 _LOCK_CTORS = frozenset({"asyncio.Lock", "asyncio.Semaphore",
                          "asyncio.BoundedSemaphore", "asyncio.Condition"})
-_THREAD_LOCK_CTORS = frozenset({"threading.Lock", "threading.RLock"})
+#: a threading.Condition IS a mutex (acquire/release around its lock)
+#: — holding it guards state for both the lock rules and the
+#: concurrency tier's lockset analysis (the queue/Condition-handoff
+#: sanction in docs/CONCURRENCY.md rides on this)
+_THREAD_LOCK_CTORS = frozenset({"threading.Lock", "threading.RLock",
+                                "threading.Condition"})
 
 _MAX_CHASE = 5  # re-export hops followed before giving up
 

@@ -50,6 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help=argparse.SUPPRESS)
     p.add_argument("--callgraph", action="store_true",
                    help="dump the resolved call graph edges and exit")
+    p.add_argument("--domains", action="store_true",
+                   help="dump the inferred execution-domain map "
+                        "(concurrency tier) and exit")
     p.add_argument("--explain", action="store_true",
                    help="print a resolvable file:line trace for each "
                         "violation's call chain")
@@ -84,6 +87,33 @@ def _dump_callgraph(paths, as_json: bool) -> int:
         for src, dst in edges:
             print(f"{src} -> {dst}")
         print(f"etl-lint: {len(edges)} resolved call edges",
+              file=sys.stderr)
+    return 0
+
+
+def _dump_domains(paths, as_json: bool) -> int:
+    """`path::qualname: domain,domain` lines, sorted and stable — the
+    review-diffable twin of --callgraph (two runs over an unchanged
+    tree print byte-identical output; see docs/CONCURRENCY.md)."""
+    from .callgraph import Project
+    from .domains import infer_domains
+    from .rules import analyze_paths
+
+    units: list = []
+    analyze_paths(paths, interprocedural=False, lexical=False,
+                  units_out=units)
+    project = Project.build([(u.path, u.source, u.tree) for u in units])
+    dm = infer_domains(project)
+    rows = [(f"{fn.module.path}::{fn.qualname}", domains)
+            for fn, domains in dm.items()]
+    if as_json:
+        print(json.dumps({"domains": {name: domains
+                                      for name, domains in rows}},
+                         indent=2, sort_keys=True))
+    else:
+        for name, domains in rows:
+            print(f"{name}: {','.join(domains)}")
+        print(f"etl-lint: {len(rows)} functions classified",
               file=sys.stderr)
     return 0
 
@@ -133,6 +163,12 @@ def main(argv: "list[str] | None" = None) -> int:
     if args.callgraph:
         try:
             return _dump_callgraph(paths, args.fmt == "json")
+        except (SyntaxError, OSError) as e:
+            print(f"etl-lint: {e}", file=sys.stderr)
+            return 2
+    if args.domains:
+        try:
+            return _dump_domains(paths, args.fmt == "json")
         except (SyntaxError, OSError) as e:
             print(f"etl-lint: {e}", file=sys.stderr)
             return 2

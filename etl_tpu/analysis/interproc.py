@@ -92,6 +92,8 @@ class ModuleUnit:
 
 
 def analyze_interprocedural(units: "list[ModuleUnit]") -> list[Finding]:
+    from .concurrency import analyze_concurrency  # deferred: imports us
+
     project = Project.build([(u.path, u.source, u.tree) for u in units])
     supp = {u.path: u.suppressions for u in units}
     findings: list[Finding] = []
@@ -102,6 +104,7 @@ def analyze_interprocedural(units: "list[ModuleUnit]") -> list[Finding]:
     findings += _donated_buffer_use(project, supp)
     findings += _lock_held_across_await(project, supp)
     findings += _lock_order_inversion(project, supp)
+    findings += analyze_concurrency(project, supp)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule, f.detail))
     return findings
 

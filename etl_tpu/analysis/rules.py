@@ -1076,6 +1076,10 @@ INTERPROC_RULE_NAMES = (
     "donated-buffer-use",
     "lock-held-across-await",
     "lock-order-inversion",
+    # concurrency tier (etl_tpu/analysis/concurrency.py)
+    "unsynchronized-shared-mutation",
+    "loop-state-from-thread",
+    "coordinator-store-bypass",
 )
 
 RULE_NAMES = tuple(r.name for r in default_rules()) + INTERPROC_RULE_NAMES

@@ -39,6 +39,7 @@ import asyncio
 import logging
 from dataclasses import dataclass, field, replace
 
+from ..analysis.annotations import domain, handoff
 from ..models.errors import ErrorKind, EtlError
 from ..telemetry.metrics import (ETL_AUTOSCALE_BACKLOG_BYTES,
                                  ETL_AUTOSCALE_CAPACITY_BYTES_PER_S,
@@ -206,11 +207,14 @@ class AutoscaleController:
         return AutoscaleJournal.from_json(
             await self.store.get_autoscale_journal())
 
+    @handoff  # persist-then-actuate seam: the journal write IS the
+    # happens-before edge a restarted controller resumes from
     async def _save_journal(self, journal: AutoscaleJournal) -> None:
         await self.store.update_autoscale_journal(journal.to_json())
 
     # -- the loop body -------------------------------------------------------
 
+    @domain("coordinator")
     async def tick(self, at_s: float) -> Decision:
         """One closed-loop turn. Returns the decision (HOLD decisions
         carry the reason — cooldown, dead zone, overlap refusal)."""
@@ -329,6 +333,7 @@ class AutoscaleController:
 
     # -- crash recovery ------------------------------------------------------
 
+    @domain("coordinator")
     async def resume(self, abort: bool = False) -> "DecisionRecord | None":
         """Recover from a controller crash. Returns the settled record,
         or None when nothing was pending. Idempotent: re-running against

@@ -44,7 +44,7 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass, field, replace
 
-from ..analysis.annotations import control_loop
+from ..analysis.annotations import control_loop, domain, handoff
 from ..telemetry.metrics import (ETL_FLEET_CONVERGED,
                                  ETL_FLEET_PIPELINES_DESIRED,
                                  ETL_FLEET_PIPELINES_OBSERVED,
@@ -169,6 +169,8 @@ class FleetReconciler:
         return ActuationJournal.from_json(
             await self.store.get_fleet_journal(pipeline_id))
 
+    @handoff  # persist-then-actuate seam: the journal write IS the
+    # happens-before edge a restarted coordinator resumes from
     async def _save_journal(self, pipeline_id: int,
                             journal: ActuationJournal) -> None:
         await self.store.update_fleet_journal(pipeline_id,
@@ -214,6 +216,7 @@ class FleetReconciler:
 
     # -- the loop body -------------------------------------------------------
 
+    @domain("coordinator")
     async def tick(self) -> ReconcileResult:
         """One reconcile turn (module docstring). Every applied action
         is journaled persist-then-actuate; a crash mid-tick leaves at
@@ -277,6 +280,7 @@ class FleetReconciler:
 
     # -- crash recovery ------------------------------------------------------
 
+    @domain("coordinator")
     async def resume(self) -> "list[ActuationRecord]":
         """Settle every pending actuation a dead coordinator left
         behind (module docstring). Returns the settled records;

@@ -32,6 +32,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import threading
 import time
 
 import numpy as np
@@ -84,6 +85,11 @@ class DeviceCostModel:
 
 
 _MEASURED: "list[DeviceCostModel | None] | None" = None
+# `measure()` runs on the event loop (first decoder built mid-stream)
+# AND in prewarm's executor thread; the lock makes the probe
+# single-flight — the loser of the race waits for the winner's model
+# instead of re-running a multi-second probe and tearing `_MEASURED`
+_MEASURE_LOCK = threading.Lock()
 
 
 def _fit_round_trip(device) -> tuple[float, float]:
@@ -144,33 +150,38 @@ def _measure_host_rate() -> float:
 
 def measure(force: bool = False) -> DeviceCostModel | None:
     """Probe once per process (a few seconds, dominated by the trivial
-    program's compile); None when there is no separate accelerator."""
+    program's compile); None when there is no separate accelerator.
+    Single-flight under `_MEASURE_LOCK`: safe to race from the loop and
+    prewarm's executor thread."""
     global _MEASURED
     if _MEASURED is not None and not force:
         return _MEASURED[0]
-    import jax
+    with _MEASURE_LOCK:
+        if _MEASURED is not None and not force:
+            return _MEASURED[0]
+        import jax
 
-    backend = jax.default_backend()
-    if backend == "cpu":
-        _MEASURED = [None]
-        return None
-    try:
-        device = jax.devices()[0]
-        fixed, bw = _fit_round_trip(device)
-        host_rate = _measure_host_rate()
-        model = DeviceCostModel(fixed_s=fixed, bytes_per_s=bw,
-                                host_col_rows_per_s=host_rate,
-                                backend=backend)
-        log.info(
-            "device cost model: fixed=%.1fms bw=%.1fMB/s host=%.2fM "
-            "col-rows/s (%s)", fixed * 1e3, bw / 1e6, host_rate / 1e6,
-            backend)
-    except Exception:
-        log.warning("device probe failed; keeping static routing",
-                    exc_info=True)
-        model = None
-    _MEASURED = [model]
-    return model
+        backend = jax.default_backend()
+        if backend == "cpu":
+            _MEASURED = [None]
+            return None
+        try:
+            device = jax.devices()[0]
+            fixed, bw = _fit_round_trip(device)
+            host_rate = _measure_host_rate()
+            model = DeviceCostModel(fixed_s=fixed, bytes_per_s=bw,
+                                    host_col_rows_per_s=host_rate,
+                                    backend=backend)
+            log.info(
+                "device cost model: fixed=%.1fms bw=%.1fMB/s host=%.2fM "
+                "col-rows/s (%s)", fixed * 1e3, bw / 1e6, host_rate / 1e6,
+                backend)
+        except Exception:
+            log.warning("device probe failed; keeping static routing",
+                        exc_info=True)
+            model = None
+        _MEASURED = [model]
+        return model
 
 
 async def prewarm() -> DeviceCostModel | None:

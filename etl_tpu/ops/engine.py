@@ -703,7 +703,12 @@ class DeviceDecoder:
         # decoder compile-count invariants on it); the fns themselves
         # live in the module-level _SHARED_FN_CACHE
         self._fn_cache: dict[tuple, Callable] = {}
-        self._host_specs_cache: tuple | None = None
+        # computed eagerly: a decoder is shared between the event loop
+        # and warm_host_programs' executor thread, and an init-before-
+        # spawn write is the one publication order that needs no lock
+        # (the lazy fill here was the concurrency tier's first real
+        # unsynchronized-shared-mutation finding)
+        self._host_specs_cache: tuple = self._compute_host_specs()
         if device_min_rows is not None:
             self.device_min_rows = device_min_rows
         else:
@@ -753,20 +758,21 @@ class DeviceDecoder:
             out.append((spec.index, spec.kind, w, bw))
         return tuple(out)
 
+    def _compute_host_specs(self) -> tuple:
+        from .bitpack import saturation_width
+
+        out = []
+        for spec in self._dense:
+            w = _HOST_WIDTH[spec.kind]
+            bw = round_up_even(min(w, saturation_width(spec.kind)))
+            out.append((spec.index, spec.kind, w, bw))
+        return tuple(out)
+
     def _host_specs(self) -> tuple:
         """Data-independent specs for the host-CPU program (fixed gather
         widths per kind, bit widths at saturation): the signature never
         drifts with field lengths, so each (schema, row bucket) compiles
-        exactly once."""
-        if self._host_specs_cache is None:
-            from .bitpack import saturation_width
-
-            out = []
-            for spec in self._dense:
-                w = _HOST_WIDTH[spec.kind]
-                bw = round_up_even(min(w, saturation_width(spec.kind)))
-                out.append((spec.index, spec.kind, w, bw))
-            self._host_specs_cache = tuple(out)
+        exactly once. Computed at construction — see __init__."""
         return self._host_specs_cache
 
     def _can_nibble(self, widths: tuple[int, ...]) -> bool:

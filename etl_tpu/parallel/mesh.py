@@ -18,6 +18,7 @@ change (jax.sharding.Mesh spanning hosts).
 
 from __future__ import annotations
 
+import threading
 from typing import Sequence
 
 import jax
@@ -54,14 +55,21 @@ def decode_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh | None:
 
 
 _DEFAULT_MESH: "list[Mesh | None] | None" = None
+# decoders are built on the event loop AND inside warm_host_programs'
+# executor offload; the lock keeps the lazy init single-flight so both
+# callers share ONE mesh object (program-cache keys fingerprint the
+# mesh — two racing inits would double-compile every sharded program)
+_DEFAULT_MESH_LOCK = threading.Lock()
 
 
 def default_decode_mesh() -> Mesh | None:
     """Cached decode_mesh over jax.devices() — what DeviceDecoder uses when
-    constructed with mesh='auto'."""
+    constructed with mesh='auto'. Thread-safe: see `_DEFAULT_MESH_LOCK`."""
     global _DEFAULT_MESH
     if _DEFAULT_MESH is None:
-        _DEFAULT_MESH = [decode_mesh()]
+        with _DEFAULT_MESH_LOCK:
+            if _DEFAULT_MESH is None:
+                _DEFAULT_MESH = [decode_mesh()]
     return _DEFAULT_MESH[0]
 
 

@@ -43,6 +43,7 @@ import logging
 import time
 from dataclasses import dataclass, field
 
+from ..analysis.annotations import domain, handoff
 from ..models.errors import ErrorKind, EtlError
 from ..models.lsn import Lsn
 from ..postgres.slots import apply_slot_name, table_sync_slot_name
@@ -94,13 +95,21 @@ class ShardCoordinator:
 
     # -- assignment access ----------------------------------------------------
 
+    @handoff  # the ONE seam that mutates the multi-process shard fence:
+    # every epoch/status transition pods act on goes through here, so a
+    # crashed coordinator always leaves a resumable record behind
+    async def _persist_assignment(self,
+                                  assignment: ShardAssignment) -> None:
+        await self.store.update_shard_assignment(assignment)
+
+    @domain("coordinator")
     async def current(self, bootstrap_shard_count: int = 1
                       ) -> ShardAssignment:
         assignment = await self.store.get_shard_assignment()
         if assignment is None:
             assignment = ShardAssignment(
                 epoch=0, shard_count=bootstrap_shard_count)
-            await self.store.update_shard_assignment(assignment)
+            await self._persist_assignment(assignment)
         return assignment
 
     async def _published_tables(self) -> list:
@@ -118,6 +127,7 @@ class ShardCoordinator:
 
     # -- two-phase rebalance --------------------------------------------------
 
+    @domain("coordinator")
     async def add_shard(self) -> RebalanceResult:
         """Grow K→K+1 (the new shard is index K). Re-running after a
         crash or quiesce timeout RESUMES the persisted in-flight record
@@ -148,6 +158,7 @@ class ShardCoordinator:
         finally:
             await source.close()
 
+    @domain("coordinator")
     async def abort_rebalance(self) -> None:
         """Roll an in-flight rebalance back to steady at the SAME epoch
         (pods never noticed); an add-shard's already-created slot is
@@ -163,10 +174,11 @@ class ShardCoordinator:
                     self.pipeline_id, assignment.next_shard_count - 1))
             finally:
                 await source.close()
-        await self.store.update_shard_assignment(ShardAssignment(
+        await self._persist_assignment(ShardAssignment(
             epoch=assignment.epoch, shard_count=assignment.shard_count,
             status=STATUS_STEADY))
 
+    @domain("coordinator")
     async def remove_shard(self) -> RebalanceResult:
         """Shrink K→K-1 (the TOP shard retires; its tables re-home onto
         the survivors). The retired shard's slots are deleted after the
@@ -208,7 +220,7 @@ class ShardCoordinator:
         # phase 1b: persist the in-flight record — a coordinator crash
         # after this point leaves enough state to resume (same fence,
         # same moved set; re-running recomputes both identically)
-        await self.store.update_shard_assignment(ShardAssignment(
+        await self._persist_assignment(ShardAssignment(
             epoch=assignment.epoch, shard_count=assignment.shard_count,
             status=STATUS_REBALANCING, fence_lsn=int(fence),
             next_shard_count=new_count,
@@ -225,7 +237,7 @@ class ShardCoordinator:
         flipped = ShardAssignment(epoch=assignment.epoch + 1,
                                   shard_count=new_count,
                                   status=STATUS_STEADY)
-        await self.store.update_shard_assignment(flipped)
+        await self._persist_assignment(flipped)
 
         duration = time.monotonic() - t0
         registry.histogram_observe(ETL_SHARD_REBALANCE_DURATION_SECONDS,

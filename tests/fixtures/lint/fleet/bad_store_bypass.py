@@ -1,0 +1,15 @@
+# expect: coordinator-store-bypass=1
+"""Coordinator-domain code mutating a multi-process StateStore surface
+directly: a crash between this write and the actuation it implies
+leaves the fleet and the journal disagreeing."""
+
+from etl_tpu.analysis.annotations import domain
+
+
+class SpecPusher:
+    def __init__(self, store):
+        self.store = store
+
+    @domain("coordinator")
+    async def push(self, spec: dict) -> None:
+        await self.store.update_fleet_spec(spec)  # no persist seam

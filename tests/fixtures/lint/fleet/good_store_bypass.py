@@ -1,0 +1,18 @@
+"""The persist-then-actuate discipline: every multi-process store
+mutation routes through ONE @handoff-marked seam, so a restarted
+coordinator always resumes from a consistent journal."""
+
+from etl_tpu.analysis.annotations import domain, handoff
+
+
+class JournaledPusher:
+    def __init__(self, store):
+        self.store = store
+
+    @handoff
+    async def _save_spec(self, spec: dict) -> None:
+        await self.store.update_fleet_spec(spec)
+
+    @domain("coordinator")
+    async def push(self, spec: dict) -> None:
+        await self._save_spec(spec)

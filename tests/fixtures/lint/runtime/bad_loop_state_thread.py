@@ -1,0 +1,18 @@
+# expect: loop-state-from-thread=1
+"""Worker-thread code scheduling onto the event loop through a
+non-thread-safe surface: asyncio documents `call_soon` (and friends)
+as loop-affine; the crossing must be `call_soon_threadsafe`."""
+
+import threading
+
+
+class Notifier:
+    def __init__(self, loop):
+        self._loop = loop
+        threading.Thread(target=self._poll, daemon=True).start()
+
+    def _poll(self):
+        self._loop.call_soon(self._wake)  # corrupts loop internals
+
+    def _wake(self):
+        pass

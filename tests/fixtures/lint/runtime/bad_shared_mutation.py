@@ -1,0 +1,29 @@
+# expect: unsynchronized-shared-mutation=2
+"""The historical race shapes the concurrency tier exists to catch:
+a worker thread and the event loop both rebinding shared attributes
+with no common thread lock (the PR 12 retired-shard gauge leak and the
+PR 13 stranded-lease accounting both matched this pattern — found by
+chaos sampling then; found statically now)."""
+
+import asyncio
+import threading
+
+
+class ProgressBoard:
+    """Worker publishes, loop resets — no lock anywhere."""
+
+    def __init__(self):
+        self.applied_lsn = 0
+        self.outstanding = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            self.applied_lsn = self.applied_lsn + 1  # worker-domain write
+            self.outstanding = self.outstanding - 1  # worker-domain write
+
+    async def reset(self):
+        self.applied_lsn = 0  # loop-domain write: races _run
+        self.outstanding = 0  # loop-domain write: races _run
+        await asyncio.sleep(0)

@@ -1,0 +1,31 @@
+"""The sanctioned loop crossings from a worker thread:
+`call_soon_threadsafe` and resolving a concurrent.futures future the
+loop awaits (wrapped by a @handoff seam)."""
+
+import threading
+
+from etl_tpu.analysis.annotations import handoff
+
+
+class Notifier:
+    def __init__(self, loop):
+        self._loop = loop
+        threading.Thread(target=self._poll, daemon=True).start()
+
+    def _poll(self):
+        self._loop.call_soon_threadsafe(self._wake)
+
+    def _wake(self):
+        pass
+
+
+class ResultPublisher:
+    def __init__(self, loop, future):
+        self._loop = loop
+        self._future = future
+        threading.Thread(target=self._run, daemon=True).start()
+
+    @handoff
+    def _run(self):
+        # future resolution is the handoff edge; the loop side awaits it
+        self._loop.call_soon(self._future.set_result, 1)

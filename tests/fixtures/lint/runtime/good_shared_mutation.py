@@ -1,0 +1,66 @@
+"""Every guard the concurrency tier recognizes, in one clean file:
+lock-held writes, Condition-handoff writes, init-before-spawn, and an
+explicit @handoff ownership-transfer seam."""
+
+import asyncio
+import threading
+
+from etl_tpu.analysis.annotations import handoff
+
+
+class LockedBoard:
+    """Writes from both domains hold the SAME threading.Lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.applied_lsn = 0  # init-before-spawn: no thread exists yet
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.applied_lsn = self.applied_lsn + 1
+
+    async def reset(self):
+        with self._lock:
+            self.applied_lsn = 0
+
+
+class CondQueue:
+    """Condition-handoff mediated: the Condition IS the mutex."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.item = None
+        threading.Thread(target=self._consume, daemon=True).start()
+
+    def _consume(self):
+        with self._cond:
+            while self.item is None:
+                self._cond.wait()
+            self.item = None
+
+    async def publish(self, item):
+        with self._cond:
+            self.item = item
+            self._cond.notify()
+
+
+class FutureHandoff:
+    """Ownership transfer through a declared @handoff seam: the result
+    is published via a future the other domain awaits, so the write
+    needs no lock — the future resolution is the happens-before edge."""
+
+    def __init__(self):
+        self.result = None
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    @handoff
+    def _run(self):
+        self.result = 42  # published before the future resolves
+
+    async def consume(self):
+        await asyncio.sleep(0)
+        return self.result

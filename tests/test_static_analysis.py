@@ -31,12 +31,17 @@ _EXPECT_RE = re.compile(r"^#\s*expect:\s*([a-z-]+)=(\d+)\s*$", re.M)
 
 #: path-head scopes of the whole-program rules (interproc.py has no
 #: per-module Rule objects, so negative coverage is computed from these)
+from etl_tpu.analysis.concurrency import CONCURRENCY_RULE_SCOPES
+
 _INTERPROC_SCOPES = {
     "arena-lease-leak": None,  # everywhere
     "donated-buffer-use": None,
     "lock-held-across-await": ("runtime", "destinations", "postgres",
                                "store", "supervision", "api", "ops"),
     "lock-order-inversion": None,
+    "unsynchronized-shared-mutation": CONCURRENCY_RULE_SCOPES,
+    "loop-state-from-thread": CONCURRENCY_RULE_SCOPES,
+    "coordinator-store-bypass": None,  # follows the domain, not the path
 }
 
 
